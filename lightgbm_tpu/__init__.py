@@ -1,0 +1,17 @@
+"""lightgbm_tpu: a TPU-native gradient boosting framework.
+
+A from-scratch re-design of LightGBM (v2.1.1 feature surface) for
+JAX/XLA on TPU: HBM-resident packed bin matrix, MXU one-hot-matmul
+histograms, fully-jitted leaf-wise tree growth, XLA-collective
+distributed training.  User API mirrors the reference python package
+(lgb.train / Dataset / Booster / sklearn wrappers).
+"""
+from .basic import Dataset, Booster
+from .config import Config
+from .engine import train, cv
+from .utils.log import Log, LightGBMError
+
+__version__ = "0.1.0"
+
+__all__ = ["Dataset", "Booster", "Config", "train", "cv", "Log",
+           "LightGBMError", "__version__"]

@@ -1,0 +1,288 @@
+"""Booster: the user-facing trained-model handle.
+
+The analog of the reference's C-API Booster + python Booster
+(reference: src/c_api.cpp:29-311, python-package/lightgbm/basic.py:1264+)
+— owns the boosting object during training and the host-side tree list
+for prediction/serialization; model text format is interchangeable with
+the reference's (gbdt_model_text.cpp:235-315).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from .config import Config, canonical_objective
+from .dataset import Dataset
+from .tree import Tree
+from .utils.log import Log
+
+MODEL_VERSION = "v2"
+
+
+class Booster:
+    def __init__(self, config: Optional[Config] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 init_model=None, custom_objective: bool = False):
+        self.config = config or Config()
+        self.gbdt = None
+        self.best_iteration = -1
+        self.models: List[Tree] = []
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.max_feature_idx = 0
+        self.objective_str = "regression"
+        self.average_output = False
+
+        if model_file is not None:
+            with open(model_file) as f:
+                self._load_from_string(f.read())
+            return
+        if model_str is not None:
+            self._load_from_string(model_str)
+            return
+        if train_set is None:
+            return
+
+        from .boosting import create_boosting
+        self.gbdt = create_boosting(self.config, train_set,
+                                    custom_objective=custom_objective)
+        self.models = self.gbdt.models      # shared list, grows in place
+        self.num_class = self.config.num_class
+        self.num_tree_per_iteration = self.config.num_tree_per_iteration
+        self.feature_names = train_set.feature_names
+        self.feature_infos = train_set.feature_infos()
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.objective_str = self._objective_to_string()
+        if init_model is not None:
+            base = (Booster(model_file=init_model)
+                    if isinstance(init_model, str) else init_model)
+            self._continue_from(base, train_set)
+
+    # ------------------------------------------------------------------
+    def _objective_to_string(self) -> str:
+        o = self.config.objective
+        if o == "binary":
+            return f"binary sigmoid:{self.config.sigmoid:g}"
+        if o in ("multiclass", "multiclassova"):
+            s = f"{o} num_class:{self.config.num_class}"
+            if o == "multiclassova":
+                s += f" sigmoid:{self.config.sigmoid:g}"
+            return s
+        if o == "regression" and self.config.reg_sqrt:
+            return "regression sqrt"
+        if o == "lambdarank":
+            return "lambdarank"
+        return o
+
+    # ------------------------------------------------------------------
+    def _continue_from(self, base: "Booster", train_set: Dataset) -> None:
+        """Continued training: seed scores with the old model's
+        predictions (reference boosting.cpp:44-60 + gbdt.h MergeFrom)."""
+        import jax.numpy as jnp
+        raw = train_set._raw_data
+        if raw is None:
+            Log.fatal("Continued training requires raw data on the Dataset")
+        pred = base.predict(raw, raw_score=True)
+        pred = pred.reshape(self.num_class, train_set.num_data) \
+            if pred.ndim > 1 and self.num_class > 1 else \
+            pred.reshape(1, -1) if pred.ndim == 1 else pred.T
+        pad = self.gbdt.grower.n_padded - train_set.num_data
+        pred = np.pad(pred.astype(np.float32), ((0, 0), (0, pad)))
+        self.gbdt.scores = self.gbdt.scores + jnp.asarray(pred)
+        for t in base.models:
+            self.models.append(t)
+        # note: models list order => merged model predicts old + new trees
+
+    # ------------------------------------------------------------------
+    def update(self, train_set=None, fobj=None) -> bool:
+        if fobj is not None:
+            score = self._current_train_scores()
+            grad, hess = fobj(score, self.gbdt.train_set)
+            return self.gbdt.train_one_iter(grad, hess)
+        return self.gbdt.train_one_iter()
+
+    def rollback_one_iter(self):
+        self.gbdt.rollback_one_iter()
+
+    @property
+    def current_iteration(self) -> int:
+        return self.gbdt.iter_ if self.gbdt else \
+            len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def _current_train_scores(self) -> np.ndarray:
+        s = np.asarray(self.gbdt.scores[:, :self.gbdt.num_data])
+        if self.num_tree_per_iteration == 1:
+            return s[0]
+        return s.T.reshape(-1, order="F")  # class-major like reference
+
+    # ------------------------------------------------------------------
+    def predict(self, data: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False) -> np.ndarray:
+        """Host prediction on raw features (reference
+        gbdt_prediction.cpp:9-100; SHAP via tree.PredictContrib)."""
+        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        if data.ndim == 1:
+            data = data[None, :]
+        n = data.shape[0]
+        k = max(self.num_tree_per_iteration, 1)
+        models = self._used_models(num_iteration)
+
+        if pred_leaf:
+            out = np.zeros((n, len(models)), dtype=np.int32)
+            for i, t in enumerate(models):
+                out[:, i] = t.predict_leaf(data)
+            return out
+        if pred_contrib:
+            from .shap import predict_contrib
+            return predict_contrib(self, data, models)
+
+        raw = np.zeros((n, k), dtype=np.float64)
+        for i, t in enumerate(models):
+            raw[:, i % k] += t.predict(data)
+        raw = self._add_init_and_average(raw, len(models))
+        if not raw_score:
+            raw = self._convert_output(raw)
+        return raw[:, 0] if k == 1 else raw
+
+    def _used_models(self, num_iteration: int) -> List[Tree]:
+        k = max(self.num_tree_per_iteration, 1)
+        if num_iteration is None or num_iteration <= 0:
+            if self.best_iteration > 0:
+                num_iteration = self.best_iteration
+            else:
+                return self.models
+        return self.models[:num_iteration * k]
+
+    def _add_init_and_average(self, raw, num_models):
+        if self.average_output and num_models:
+            raw = raw / (num_models // max(self.num_tree_per_iteration, 1))
+        return raw
+
+    def _convert_output(self, raw: np.ndarray) -> np.ndarray:
+        obj = self.objective_str.split()[0] if self.objective_str else ""
+        obj = canonical_objective(obj)
+        if obj == "binary":
+            m = re.search(r"sigmoid:([0-9.eE+-]+)", self.objective_str)
+            sig = float(m.group(1)) if m else 1.0
+            return 1.0 / (1.0 + np.exp(-sig * raw))
+        if obj == "multiclass":
+            e = np.exp(raw - raw.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        if obj == "multiclassova":
+            m = re.search(r"sigmoid:([0-9.eE+-]+)", self.objective_str)
+            sig = float(m.group(1)) if m else 1.0
+            return 1.0 / (1.0 + np.exp(-sig * raw))
+        if obj in ("poisson", "gamma", "tweedie"):
+            return np.exp(raw)
+        if obj == "regression" and "sqrt" in self.objective_str:
+            return np.sign(raw) * raw * raw
+        if obj == "cross_entropy":
+            return 1.0 / (1.0 + np.exp(-raw))
+        if obj == "cross_entropy_lambda":
+            return np.log1p(np.exp(raw))
+        return raw
+
+    # ------------------------------------------------------------------
+    def eval(self) -> List:
+        return self.gbdt.eval_metrics() if self.gbdt else []
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: int = -1) -> None:
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration))
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        """reference gbdt_model_text.cpp:235-315 SaveModelToString."""
+        models = self._used_models(num_iteration)
+        out = ["tree", f"version={MODEL_VERSION}",
+               f"num_class={self.num_class}",
+               f"num_tree_per_iteration={self.num_tree_per_iteration}",
+               "label_index=0",
+               f"max_feature_idx={self.max_feature_idx}",
+               f"objective={self.objective_str}"]
+        if self.average_output:
+            out.append("average_output")
+        out.append("feature_names=" + " ".join(self.feature_names))
+        out.append("feature_infos=" + " ".join(self.feature_infos))
+        tree_strs = []
+        for i, t in enumerate(models):
+            tree_strs.append(f"Tree={i}\n{t.to_string()}\n")
+        out.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+        out.append("")
+        text = "\n".join(out) + "\n" + "".join(tree_strs)
+        # feature importances footer
+        imp = self.feature_importance("split", num_iteration)
+        pairs = [(int(v), self.feature_names[i]) for i, v in enumerate(imp)
+                 if v > 0]
+        pairs.sort(key=lambda p: -p[0])
+        text += "\nfeature importances:\n"
+        for v, name in pairs:
+            text += f"{name}={v}\n"
+        return text
+
+    # ------------------------------------------------------------------
+    def _load_from_string(self, text: str) -> None:
+        """reference gbdt_model_text.cpp:317+ LoadModelFromString."""
+        header, _, rest = text.partition("Tree=0")
+        kv = {}
+        for line in header.splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        self.num_class = int(kv.get("num_class", "1"))
+        self.num_tree_per_iteration = int(
+            kv.get("num_tree_per_iteration", "1"))
+        self.max_feature_idx = int(kv.get("max_feature_idx", "0"))
+        self.objective_str = kv.get("objective", "regression")
+        self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos = kv.get("feature_infos", "").split()
+        self.average_output = "average_output" in header.splitlines()
+        self.models = []
+        if not rest:
+            return
+        blocks = re.split(r"Tree=\d+\n", "Tree=0" + rest)
+        for block in blocks:
+            block = block.strip()
+            if not block or block.startswith("feature importances"):
+                continue
+            block = block.split("\nfeature importances")[0]
+            if "num_leaves" not in block:
+                continue
+            self.models.append(Tree.from_string(block))
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = -1) -> np.ndarray:
+        """reference gbdt.h FeatureImportance."""
+        models = self._used_models(num_iteration)
+        n = self.max_feature_idx + 1
+        imp = np.zeros(n, dtype=np.float64)
+        for t in models:
+            m = t.num_leaves - 1
+            for i in range(m):
+                f = t.split_feature[i]
+                if importance_type == "split":
+                    imp[f] += 1
+                else:
+                    imp[f] += max(t.split_gain[i], 0.0)
+        return imp
+
+    # ------------------------------------------------------------------
+    def __getstate__(self):
+        state = {"model_str": self.model_to_string(),
+                 "best_iteration": self.best_iteration}
+        return state
+
+    def __setstate__(self, state):
+        self.__init__(model_str=state["model_str"])
+        self.best_iteration = state.get("best_iteration", -1)

@@ -1,0 +1,362 @@
+"""GBDT: the boosting orchestrator.
+
+TPU-native re-design of the reference GBDT
+(reference: src/boosting/gbdt.{h,cpp}; TrainOneIter hot path
+gbdt.cpp:386-481, bagging :234-316, boost_from_average :362-384,
+early stopping :582-639, score updating :528-580).  Scores, gradients
+and the binned matrix live on device for the whole run; one boosting
+iteration is a handful of jitted calls (gradients -> bagging mask ->
+tree growth -> score update) with no host sync.  Host work per
+iteration: pulling the finished tree's small arrays for the model
+(asynchronously) and optional metric printing.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..learner.grower import TreeGrower, TreeArrays
+from ..metrics import Metric, create_metrics
+from ..objectives import Objective, create_objective
+from ..ops.predict import predict_binned
+from ..tree import Tree
+from ..utils.log import Log
+
+
+class _ValidSet:
+    """Per-validation-set device state (the ScoreUpdater analog,
+    reference score_updater.hpp:17-120)."""
+
+    def __init__(self, dataset: Dataset, num_class: int, init_score: float,
+                 metrics: List[Metric]):
+        self.dataset = dataset
+        self.num_data = dataset.num_data
+        self.bins = jax.device_put(dataset.group_bins)
+        self.scores = jnp.full((num_class, dataset.num_data), 0.0,
+                               dtype=jnp.float32)
+        if dataset.metadata.init_score is not None:
+            init = dataset.metadata.init_score.astype(np.float32)
+            self.scores = jnp.asarray(
+                init.reshape(num_class, dataset.num_data))
+        if init_score != 0.0:
+            self.scores = self.scores + init_score
+        self.metrics = metrics
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree trainer."""
+
+    def __init__(self, config: Config, train_set: Dataset,
+                 objective: Optional[Objective] = None,
+                 custom_objective: bool = False):
+        self.config = config
+        self.train_set = train_set
+        self.num_data = train_set.num_data
+        self.objective = (None if custom_objective else
+                          (objective if objective is not None
+                           else create_objective(config)))
+        self.num_class = config.num_tree_per_iteration
+        self.shrinkage_rate = config.learning_rate
+
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, self.num_data)
+
+        self.grower = TreeGrower(train_set, config)
+        self.models: List[Tree] = []
+        self.device_trees: List[TreeArrays] = []   # kept for DART drops
+        self.iter_ = 0
+        self.train_metrics: List[Metric] = []
+        self.valid_sets: List[_ValidSet] = []
+        self.valid_names: List[str] = []
+
+        # boost_from_average (reference gbdt.cpp:362-384)
+        self.init_score = 0.0
+        has_init = train_set.metadata.init_score is not None
+        if (self.objective is not None and config.boost_from_average
+                and not has_init and self.num_class == 1):
+            self.init_score = float(self.objective.boost_from_score())
+            if abs(self.init_score) > 1e-15:
+                Log.info(f"Start training from score {self.init_score:f}")
+
+        n_pad = self.grower.n_padded
+        base = np.zeros((self.num_class, self.num_data), dtype=np.float32)
+        if has_init:
+            base += train_set.metadata.init_score.reshape(
+                self.num_class, self.num_data).astype(np.float32)
+        base += self.init_score
+        pad = np.zeros((self.num_class, n_pad - self.num_data),
+                       dtype=np.float32)
+        self.scores = jnp.asarray(np.concatenate([base, pad], axis=1))
+
+        self._rng = np.random.RandomState(config.seed)
+        self._bag_rng = jax.random.PRNGKey(config.bagging_seed)
+        self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
+        self._grad_fn = jax.jit(self._compute_gradients)
+        self._update_train_fn = jax.jit(self._update_train_scores)
+        self._predict_valid_fn = jax.jit(self._predict_valid)
+        self._eval_cache: Dict[Tuple[int, int], List[float]] = {}
+        # early stopping state per (dataset, metric-output)
+        self._best_score: Dict[Tuple[int, int], float] = {}
+        self._best_iter: Dict[Tuple[int, int], int] = {}
+        self.best_iteration = -1
+
+        # row weights as count channel (bagging multiplies into this)
+        w = train_set.metadata.weight
+        self._full_counts = jnp.asarray(self.grower.pad_rows(
+            np.ones(self.num_data, dtype=np.float32)))
+        self._weights_dev = (None if w is None else jnp.asarray(
+            self.grower.pad_rows(w.astype(np.float32))))
+        self._bag_mask: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------------
+    def add_valid(self, valid_set: Dataset, name: str) -> None:
+        metrics = create_metrics(self.config)
+        for m in metrics:
+            m.init(valid_set.metadata, valid_set.num_data)
+        self.valid_sets.append(
+            _ValidSet(valid_set, self.num_class, self.init_score, metrics))
+        self.valid_names.append(name)
+
+    def add_train_metrics(self) -> None:
+        self.train_metrics = create_metrics(self.config)
+        for m in self.train_metrics:
+            m.init(self.train_set.metadata, self.num_data)
+
+    # ------------------------------------------------------------------
+    def _compute_gradients(self, scores):
+        """scores: (K, n_padded) -> (K, n_padded) grad/hess, zero-padded."""
+        n = self.num_data
+        s = scores[:, :n]
+        if self.num_class == 1:
+            g, h = self.objective.get_gradients(s[0])
+            g, h = g[None, :], h[None, :]
+        else:
+            g, h = self.objective.get_gradients(s.T)
+            g, h = g.T, h.T
+        pad = scores.shape[1] - n
+        if pad:
+            g = jnp.pad(g, ((0, 0), (0, pad)))
+            h = jnp.pad(h, ((0, 0), (0, pad)))
+        return g, h
+
+    # ------------------------------------------------------------------
+    def _bagging_counts(self, iteration: int):
+        """Per-iteration bagging mask (reference gbdt.cpp:234-316 with
+        mask-based rows instead of index subsets)."""
+        cfg = self.config
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            return self._full_counts, None
+        if iteration % cfg.bagging_freq == 0 or self._bag_mask is None:
+            self._bag_rng, sub = jax.random.split(self._bag_rng)
+            u = jax.random.uniform(sub, (self.grower.n_padded,))
+            self._bag_mask = (u < cfg.bagging_fraction) & \
+                (self._full_counts > 0)
+        counts = jnp.where(self._bag_mask, 1.0, 0.0)
+        return counts, self._bag_mask
+
+    # ------------------------------------------------------------------
+    def _feature_mask(self) -> jax.Array:
+        """Per-tree feature sampling (reference
+        serial_tree_learner.cpp:252-345 BeforeTrain)."""
+        f = self.config.feature_fraction
+        F = self.grower.num_features
+        if f >= 1.0:
+            return jnp.ones(F, dtype=bool)
+        used = max(1, int(round(F * f)))
+        idx = self._feat_rng.choice(F, size=used, replace=False)
+        mask = np.zeros(F, dtype=bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def _update_train_scores(self, scores, leaf_id, leaf_value, class_idx,
+                             shrinkage):
+        delta = leaf_value[jnp.clip(leaf_id, 0, leaf_value.shape[0] - 1)]
+        delta = jnp.where(leaf_id >= 0, delta, 0.0) * shrinkage
+        return scores.at[class_idx].add(delta)
+
+    def _predict_valid(self, tree: TreeArrays, bins):
+        g = self.grower
+        return predict_binned(tree, bins, g.f_group, g.g2f_lut, g.f_missing,
+                              g.f_default_bin, g.f_num_bin,
+                              max_steps=self.config.num_leaves)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference gbdt.cpp:386-481).
+        Custom grad/hess (shape (N,) or (N, K)) bypass the objective —
+        the LGBM_BoosterUpdateOneIterCustom path."""
+        if grad is None or hess is None:
+            if self.objective is None:
+                Log.fatal("No objective and no custom gradients")
+            g, h = self._grad_fn(self.scores)
+        else:
+            grad = np.asarray(grad, dtype=np.float32).reshape(
+                self.num_class, self.num_data)
+            hess = np.asarray(hess, dtype=np.float32).reshape(
+                self.num_class, self.num_data)
+            pad = self.grower.n_padded - self.num_data
+            g = jnp.asarray(np.pad(grad, ((0, 0), (0, pad))))
+            h = jnp.asarray(np.pad(hess, ((0, 0), (0, pad))))
+
+        counts, bag_mask = self._bagging_counts(self.iter_)
+        g, h = self._mask_gradients(g, h, counts)
+        self._last_counts = counts
+
+        should_continue = False
+        for k in range(self.num_class):
+            feature_mask = self._feature_mask()
+            tree_arrays, leaf_id = self.grower.train_tree(
+                g[k], h[k], counts, feature_mask)
+            tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k)
+            self.device_trees.append(tree_arrays)
+            # update train scores via the partition shortcut
+            self.scores = self._update_train_fn(
+                self.scores, leaf_id, tree_arrays.leaf_value, k,
+                self.shrinkage_rate)
+            for vs in self.valid_sets:
+                delta = self._predict_valid_fn(tree_arrays, vs.bins)
+                vs.scores = vs.scores.at[k].add(
+                    delta * self.shrinkage_rate)
+            # host model (pull is async until .to_string/.predict)
+            host_tree = Tree.from_grower_arrays(
+                {f: np.asarray(getattr(tree_arrays, f))
+                 for f in tree_arrays._fields}, self.train_set)
+            host_tree.apply_shrinkage(self.shrinkage_rate)
+            if self.iter_ == 0 and self.init_score != 0.0:
+                # fold the init score into the first tree so saved models
+                # and raw predictions carry it (reference gbdt.cpp:452-454
+                # Tree::AddBias)
+                host_tree.leaf_value += self.init_score
+                host_tree.internal_value += self.init_score
+            if host_tree.num_leaves > 1:
+                should_continue = True
+            self.models.append(host_tree)
+
+        if not should_continue:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements.")
+            for _ in range(self.num_class):
+                self.models.pop()
+                self.device_trees.pop()
+            return True
+        self.iter_ += 1
+        return False
+
+    # ------------------------------------------------------------------
+    def _mask_gradients(self, g, h, counts):
+        """Apply bagging mask and row weights to gradient channels.
+        Row weights are already inside the objective's gradients
+        (reference semantics); only the bag mask zeroes rows here."""
+        mask = counts > 0
+        return g * mask[None, :], h * mask[None, :]
+
+    # ------------------------------------------------------------------
+    def _finalize_tree(self, tree_arrays: TreeArrays, leaf_id, class_idx
+                       ) -> TreeArrays:
+        """Objective-specific leaf refitting hook (RenewTreeOutput,
+        reference serial_tree_learner.cpp:776-806).  Percentile-based
+        refits land with the device segment-percentile op."""
+        if self.objective is not None and \
+                self.objective.is_renew_tree_output:
+            tree_arrays = self._renew_tree_output(tree_arrays, leaf_id,
+                                                  class_idx)
+        return tree_arrays
+
+    def _renew_tree_output(self, tree_arrays, leaf_id, class_idx):
+        """Re-fit leaf outputs to the objective's percentile (L1-family
+        objectives; reference regression_objective.hpp RenewTreeOutput).
+        Device: lexicographic sort by (leaf, residual) then per-leaf
+        percentile interpolation."""
+        from ..ops.percentile import leaf_percentiles
+        n = self.num_data
+        obj = self.objective
+        pred = self.scores[class_idx, :n]
+        label = obj._label_dev
+        residual = label - pred
+        alpha = obj.renew_alpha
+        if hasattr(obj, "_label_weight_dev"):
+            w = obj._label_weight_dev          # mape weighting
+        elif obj.weight is not None:
+            w = obj._weight_dev
+        else:
+            w = None
+        # restrict to in-bag rows (reference passes bag_data_indices,
+        # gbdt.cpp:446-447): out-of-bag rows get leaf -1 and are ignored
+        lid = jnp.where(self._last_counts[:n] > 0, leaf_id[:n], -1)
+        L = self.config.num_leaves
+        new_values = leaf_percentiles(residual, lid, L, alpha, w)
+        ok = tree_arrays.leaf_count > 0
+        return tree_arrays._replace(
+            leaf_value=jnp.where(ok, new_values,
+                                 tree_arrays.leaf_value))
+
+    # ------------------------------------------------------------------
+    def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
+        """Returns (dataset_name, metric_name, value, bigger_better)."""
+        out = []
+        if self.train_metrics:
+            s = self._scores_for_eval(self.scores[:, :self.num_data])
+            for m in self.train_metrics:
+                for name, v in zip(m.names(), m.eval(s, self.objective)):
+                    out.append(("training", name, v, m.bigger_is_better))
+        for vs, vname in zip(self.valid_sets, self.valid_names):
+            s = self._scores_for_eval(vs.scores)
+            for m in vs.metrics:
+                for name, v in zip(m.names(), m.eval(s, self.objective)):
+                    out.append((vname, name, v, m.bigger_is_better))
+        return out
+
+    def _scores_for_eval(self, scores):
+        if self.num_class == 1:
+            return scores[0]
+        return scores.T       # (N, K)
+
+    # ------------------------------------------------------------------
+    def check_early_stopping(self, results, iteration: int) -> bool:
+        """Reference gbdt.cpp:582-639: stop as soon as ANY validation
+        metric has not improved for early_stopping_round iterations;
+        best_iteration comes from the triggering metric."""
+        rounds = self.config.early_stopping_round
+        if rounds <= 0:
+            return False
+        for i, (dname, mname, value, bigger) in enumerate(results):
+            if dname == "training":
+                continue
+            key = (i, 0)
+            score = value if bigger else -value
+            if key not in self._best_score or score > self._best_score[key]:
+                self._best_score[key] = score
+                self._best_iter[key] = iteration
+            elif iteration - self._best_iter[key] >= rounds:
+                self.best_iteration = self._best_iter[key] + 1
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """reference gbdt.cpp:483-499."""
+        if len(self.models) < self.num_class:
+            return
+        for k in reversed(range(self.num_class)):
+            tree_arrays = self.device_trees.pop()
+            self.models.pop()
+            self.scores = self.scores.at[k].add(
+                -self.shrinkage_rate * self._predict_valid_fn(
+                    tree_arrays, self.grower.bins))
+            for vs in self.valid_sets:
+                vs.scores = vs.scores.at[k].add(
+                    -self.shrinkage_rate * self._predict_valid_fn(
+                        tree_arrays, vs.bins))
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)

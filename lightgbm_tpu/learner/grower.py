@@ -1,0 +1,405 @@
+"""On-device tree growth: the TPU-native serial tree learner.
+
+Re-design of SerialTreeLearner's leaf-wise loop
+(reference: src/treelearner/serial_tree_learner.cpp:156-220 Train,
+:700-774 Split) for XLA's static-shape world.  One jitted function grows
+a whole tree: a ``lax.while_loop`` over frontier rounds where each round
+  1. builds histograms for EVERY active leaf in one MXU pass
+     (ops/histogram.py — replaces the smaller/larger-leaf scheduling and
+     histogram pool),
+  2. scores every (leaf, feature, threshold) candidate at once
+     (ops/split.py),
+  3. splits the top-gain leaves within the remaining leaf budget —
+     gain-ordered, so leaf slot/node numbering matches the reference's
+     sequential best-first allocation whenever the budget doesn't bind,
+  4. re-labels rows (ops/partition.py).
+Zero host round-trips inside a tree; the boosting loop stays on device
+too and only syncs for metric printing/early stopping.
+
+Tree state is a fixed-size struct of arrays (the reference's Tree,
+include/LightGBM/tree.h:352-391, is already array-of-nodes — here the
+arrays live in HBM and are scattered into with `mode='drop'`).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..ops.histogram import (compute_group_histograms, compute_leaf_totals,
+                             expand_feature_histograms)
+from ..ops.partition import apply_splits
+from ..ops.split import (SplitResult, build_cat_bitset,
+                         find_categorical_splits, find_numerical_splits)
+
+NEG_INF = -jnp.inf
+
+
+class TreeArrays(NamedTuple):
+    """Device-side grown tree (fixed shapes; L leaf slots, M=L-1 nodes)."""
+    num_leaves: jax.Array        # scalar int32 — actual leaves used
+    leaf_value: jax.Array        # (L,) f32
+    leaf_weight: jax.Array       # (L,) f32 (sum_hessian)
+    leaf_count: jax.Array        # (L,) f32
+    leaf_parent: jax.Array       # (L,) int32 — parent internal node (-1 root)
+    leaf_depth: jax.Array        # (L,) int32
+    node_feature: jax.Array      # (M,) int32 inner feature idx
+    node_threshold: jax.Array    # (M,) int32 bin threshold / num-cats-1
+    node_default_left: jax.Array  # (M,) bool
+    node_is_cat: jax.Array       # (M,) bool
+    node_cat_mask: jax.Array     # (M, B) bool — feature-bin left set
+    node_gain: jax.Array         # (M,) f32
+    node_value: jax.Array        # (M,) f32 internal output
+    node_weight: jax.Array       # (M,) f32
+    node_count: jax.Array        # (M,) f32
+    node_left: jax.Array         # (M,) int32 (neg = ~leaf)
+    node_right: jax.Array        # (M,) int32
+
+
+class GrowerState(NamedTuple):
+    leaf_id: jax.Array
+    num_leaves: jax.Array        # scalar int32
+    round_idx: jax.Array
+    done: jax.Array
+    leaf_sum_grad: jax.Array
+    leaf_sum_hess: jax.Array
+    leaf_count: jax.Array
+    leaf_min_c: jax.Array
+    leaf_max_c: jax.Array
+    leaf_is_left: jax.Array      # (L,) bool — side under its parent
+    tree: TreeArrays
+
+
+def _encode_leaf(leaf_slot):
+    """LightGBM child encoding: ~leaf (negative) marks a leaf index."""
+    return -(leaf_slot + 1)
+
+
+class TreeGrower:
+    """Builds and caches the jitted per-tree training function for one
+    Dataset + Config combination."""
+
+    def __init__(self, dataset: Dataset, config: Config):
+        self.config = config
+        self.num_leaves = config.num_leaves
+        self.max_group_bin = dataset.max_group_bin
+        self.max_feature_bin = dataset.max_feature_bin
+        self.num_groups = dataset.num_groups
+        self.num_features = dataset.num_features
+
+        meta = dataset.feature_meta_arrays()
+        self.f_num_bin = jnp.asarray(meta["num_bin"])
+        self.f_default_bin = jnp.asarray(meta["default_bin"])
+        self.f_missing = jnp.asarray(meta["missing_type"])
+        self.f_is_cat = jnp.asarray(meta["is_categorical"])
+        self.f_monotone = jnp.asarray(meta["monotone"])
+        self.f_group = jnp.asarray(
+            np.array([f.group for f in dataset.features], dtype=np.int32))
+        self.has_categorical = bool(meta["is_categorical"].any())
+
+        bin_map, fix_bin = dataset.feature_bin_maps()
+        self.bin_map = jnp.asarray(bin_map)
+        self.fix_bin = jnp.asarray(fix_bin)
+        self.g2f_lut = jnp.asarray(self._build_g2f_lut(dataset))
+
+        self.cfg_scalars: Dict[str, float] = dict(
+            lambda_l1=config.lambda_l1, lambda_l2=config.lambda_l2,
+            max_delta_step=config.max_delta_step,
+            min_data_in_leaf=float(config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
+            min_gain_to_split=config.min_gain_to_split,
+            cat_smooth=config.cat_smooth, cat_l2=config.cat_l2,
+            max_cat_threshold=config.max_cat_threshold,
+            max_cat_to_onehot=config.max_cat_to_onehot,
+            min_data_in_group=float(config.min_data_in_group),
+        )
+        self.max_depth = config.max_depth
+        # hard bound on frontier rounds (the while_loop exits early when
+        # no leaf splits)
+        self.max_rounds = config.num_leaves - 1
+
+        # pad rows to a histogram-chunk multiple once, host-side
+        n = dataset.num_data
+        from ..ops.histogram import _pick_chunk
+        cdt = jnp.dtype(config.hist_compute_dtype)
+        self.chunk = _pick_chunk(n, self.num_groups, self.max_group_bin,
+                                 cdt.itemsize)
+        self.n_padded = ((n + self.chunk - 1) // self.chunk) * self.chunk
+        self.num_data = n
+        pad = self.n_padded - n
+        bins_np = dataset.group_bins
+        if pad:
+            bins_np = np.concatenate(
+                [bins_np, np.zeros((pad, bins_np.shape[1]), dtype=np.uint8)])
+        self.bins = jax.device_put(bins_np)
+        self._row_valid = jnp.asarray(
+            np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
+        self._train_tree = jax.jit(self._train_tree_impl)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _build_g2f_lut(dataset: Dataset) -> np.ndarray:
+        """(F, GB) map: group bin -> this feature's bin (default bin for
+        group bins owned by bundle siblings / the shared slot)."""
+        F = dataset.num_features
+        GB = dataset.max_group_bin
+        lut = np.zeros((F, GB), dtype=np.int32)
+        for j, f in enumerate(dataset.features):
+            if not f.collapsed_default:
+                lut[j] = np.minimum(np.arange(GB), f.num_bin - 1)
+            else:
+                lut[j, :] = f.default_bin
+                adj = 1 if f.mapper.default_bin == 0 else 0
+                for b in range(f.num_bin):
+                    if b == f.mapper.default_bin:
+                        continue
+                    gb = b + f.offset - adj
+                    if gb < GB:
+                        lut[j, gb] = b
+        return lut
+
+    # ------------------------------------------------------------------
+    def pad_rows(self, arr: np.ndarray, fill=0.0) -> np.ndarray:
+        pad = self.n_padded - self.num_data
+        if pad == 0:
+            return arr
+        return np.concatenate([arr, np.full(pad, fill, dtype=arr.dtype)])
+
+    # ------------------------------------------------------------------
+    def train_tree(self, grad: jax.Array, hess: jax.Array,
+                   counts: jax.Array, feature_mask: jax.Array
+                   ) -> Tuple[TreeArrays, jax.Array]:
+        """Grow one tree.  grad/hess/counts are (n_padded,) with zeros
+        for out-of-bag and padded rows.  Returns (tree, final leaf_id)."""
+        return self._train_tree(grad, hess, counts, feature_mask)
+
+    # ------------------------------------------------------------------
+    def _init_state(self, grad, hess, counts) -> GrowerState:
+        L = self.num_leaves
+        M = L - 1
+        B = self.max_feature_bin
+        n = self.n_padded
+        leaf_id = jnp.where(self._row_valid, 0, -1).astype(jnp.int32)
+        totals = compute_leaf_totals(grad, hess, counts, leaf_id, 1)
+        leaf_sum_grad = jnp.zeros(L, jnp.float32).at[0].set(totals[0, 0])
+        leaf_sum_hess = jnp.zeros(L, jnp.float32).at[0].set(totals[0, 1])
+        leaf_count = jnp.zeros(L, jnp.float32).at[0].set(totals[0, 2])
+        tree = TreeArrays(
+            num_leaves=jnp.int32(1),
+            leaf_value=jnp.zeros(L, jnp.float32),
+            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(totals[0, 1]),
+            leaf_count=jnp.zeros(L, jnp.float32).at[0].set(totals[0, 2]),
+            leaf_parent=jnp.full(L, -1, jnp.int32),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            node_feature=jnp.zeros(M, jnp.int32),
+            node_threshold=jnp.zeros(M, jnp.int32),
+            node_default_left=jnp.zeros(M, bool),
+            node_is_cat=jnp.zeros(M, bool),
+            node_cat_mask=jnp.zeros((M, B), bool),
+            node_gain=jnp.zeros(M, jnp.float32),
+            node_value=jnp.zeros(M, jnp.float32),
+            node_weight=jnp.zeros(M, jnp.float32),
+            node_count=jnp.zeros(M, jnp.float32),
+            node_left=jnp.zeros(M, jnp.int32),
+            node_right=jnp.zeros(M, jnp.int32),
+        )
+        return GrowerState(
+            leaf_id=leaf_id, num_leaves=jnp.int32(1),
+            round_idx=jnp.int32(0), done=jnp.bool_(False),
+            leaf_sum_grad=leaf_sum_grad, leaf_sum_hess=leaf_sum_hess,
+            leaf_count=leaf_count,
+            leaf_min_c=jnp.full(L, -jnp.inf, jnp.float32),
+            leaf_max_c=jnp.full(L, jnp.inf, jnp.float32),
+            leaf_is_left=jnp.zeros(L, bool),
+            tree=tree)
+
+    # ------------------------------------------------------------------
+    def _train_tree_impl(self, grad, hess, counts, feature_mask):
+        L = self.num_leaves
+        state = self._init_state(grad, hess, counts)
+
+        def cond(st: GrowerState):
+            return ~st.done
+
+        def body(st: GrowerState):
+            return self._round(st, grad, hess, counts, feature_mask)
+
+        final = jax.lax.while_loop(cond, body, state)
+        tree = final.tree._replace(num_leaves=final.num_leaves)
+        return tree, final.leaf_id
+
+    # ------------------------------------------------------------------
+    def _round(self, st: GrowerState, grad, hess, counts, feature_mask
+               ) -> GrowerState:
+        cfg = self.cfg_scalars
+        L = self.num_leaves
+        M = L - 1
+        B = self.max_feature_bin
+
+        # 1. histograms for every leaf in one pass
+        group_hist = compute_group_histograms(
+            self.bins, grad, hess, counts, st.leaf_id,
+            num_leaves=L, max_group_bin=self.max_group_bin,
+            compute_dtype=self.config.hist_compute_dtype, chunk=self.chunk)
+        leaf_totals = jnp.stack(
+            [st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count], axis=1)
+        hist = expand_feature_histograms(group_hist, self.bin_map,
+                                         self.fix_bin, leaf_totals)
+
+        # 2. split finding
+        num_res = find_numerical_splits(
+            hist, st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count,
+            self.f_num_bin, self.f_missing, self.f_default_bin,
+            self.f_monotone, st.leaf_min_c, st.leaf_max_c, cfg)
+        if self.has_categorical:
+            cat_res = find_categorical_splits(
+                hist, st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count,
+                self.f_num_bin, self.f_missing, st.leaf_min_c, st.leaf_max_c,
+                cfg)
+            icat = self.f_is_cat[None, :]
+            res = SplitResult(*[jnp.where(icat, c, n) for c, n
+                                in zip(cat_res, num_res)])
+        else:
+            res = num_res
+        gains = jnp.where(feature_mask[None, :], res.gain, NEG_INF)
+
+        # 3. per-leaf best feature & candidate selection
+        best_f = jnp.argmax(gains, axis=1).astype(jnp.int32)   # (L,)
+        best_gain = jnp.take_along_axis(gains, best_f[:, None],
+                                        axis=1)[:, 0]
+        slot = jnp.arange(L, dtype=jnp.int32)
+        active = slot < st.num_leaves
+        depth_ok = (self.max_depth <= 0) | \
+            (st.tree.leaf_depth < self.max_depth)
+        cand = active & depth_ok & (best_gain > 0.0)
+
+        key = jnp.where(cand, best_gain, NEG_INF)
+        order = jnp.argsort(-key)                   # best first, stable
+        rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
+        budget = L - st.num_leaves
+        do_split = cand & (rank < budget)
+        k = do_split.sum().astype(jnp.int32)
+
+        right_slot = st.num_leaves + rank            # valid where do_split
+        node_id = (st.num_leaves - 1) + rank
+
+        def at_leaf(arr2d):
+            return jnp.take_along_axis(arr2d, best_f[:, None], axis=1)[:, 0]
+
+        thr = at_leaf(res.threshold)
+        dleft = at_leaf(res.default_left)
+        lsg = at_leaf(res.left_sum_grad)
+        lsh = at_leaf(res.left_sum_hess)
+        lsc = at_leaf(res.left_count)
+        lout = at_leaf(res.left_output)
+        rout = at_leaf(res.right_output)
+        cat_dir = at_leaf(res.cat_dir)
+        f_is_cat_leaf = self.f_is_cat[best_f]
+        f_missing_leaf = self.f_missing[best_f]
+        f_dbin_leaf = self.f_default_bin[best_f]
+        f_nb_leaf = self.f_num_bin[best_f]
+        f_group_leaf = self.f_group[best_f]
+        f_mono_leaf = self.f_monotone[best_f]
+
+        # categorical bitsets for chosen features
+        if self.has_categorical:
+            hist_chosen = jnp.take_along_axis(
+                hist, best_f[:, None, None, None], axis=1)[:, 0]  # (L,B,3)
+            cat_mask = build_cat_bitset(hist_chosen, thr, cat_dir,
+                                        f_nb_leaf, f_missing_leaf, cfg)
+            # sorted-mode threshold in the model = number of cats left;
+            # reference stores the category list, we store the mask
+        else:
+            cat_mask = jnp.zeros((L, B), bool)
+
+        # 4. scatter new internal nodes (drop out-of-budget writes)
+        nid = jnp.where(do_split, node_id, M)
+        t = st.tree
+        # internal_value = the leaf's output before it split (tree.cpp Split)
+        parent_out = t.leaf_value
+        tree = t._replace(
+            node_feature=t.node_feature.at[nid].set(best_f, mode="drop"),
+            node_threshold=t.node_threshold.at[nid].set(thr, mode="drop"),
+            node_default_left=t.node_default_left.at[nid].set(
+                dleft, mode="drop"),
+            node_is_cat=t.node_is_cat.at[nid].set(f_is_cat_leaf,
+                                                  mode="drop"),
+            node_cat_mask=t.node_cat_mask.at[nid].set(cat_mask,
+                                                      mode="drop"),
+            node_gain=t.node_gain.at[nid].set(best_gain, mode="drop"),
+            node_value=t.node_value.at[nid].set(parent_out, mode="drop"),
+            node_weight=t.node_weight.at[nid].set(st.leaf_sum_hess,
+                                                  mode="drop"),
+            node_count=t.node_count.at[nid].set(st.leaf_count, mode="drop"),
+            node_left=t.node_left.at[nid].set(_encode_leaf(slot),
+                                              mode="drop"),
+            node_right=t.node_right.at[nid].set(_encode_leaf(right_slot),
+                                                mode="drop"),
+        )
+        # parent child-pointer fixup: this leaf's slot in its parent now
+        # points at the new internal node
+        has_parent = do_split & (t.leaf_parent >= 0)
+        p = jnp.where(has_parent, t.leaf_parent, M)
+        pl = jnp.where(has_parent & st.leaf_is_left, p, M)
+        pr = jnp.where(has_parent & ~st.leaf_is_left, p, M)
+        tree = tree._replace(
+            node_left=tree.node_left.at[pl].set(node_id, mode="drop"),
+            node_right=tree.node_right.at[pr].set(node_id, mode="drop"),
+        )
+
+        # 5. child leaf state (left keeps the slot, right takes right_slot)
+        rsg = st.leaf_sum_grad - lsg
+        rsh = st.leaf_sum_hess - lsh
+        rsc = st.leaf_count - lsc
+        new_depth = t.leaf_depth + 1
+        rs = jnp.where(do_split, right_slot, L)
+
+        def upd(arr, left_val, right_val):
+            arr = arr.at[rs].set(right_val, mode="drop")
+            return jnp.where(do_split, left_val, arr)
+
+        leaf_sum_grad = upd(st.leaf_sum_grad, lsg, rsg)
+        leaf_sum_hess = upd(st.leaf_sum_hess, lsh, rsh)
+        leaf_count = upd(st.leaf_count, lsc, rsc)
+
+        # monotone constraint propagation (serial_tree_learner.cpp:764-774)
+        mid = (lout + rout) / 2.0
+        is_num = ~f_is_cat_leaf
+        lmin = jnp.where(is_num & (f_mono_leaf < 0), mid, st.leaf_min_c)
+        lmax = jnp.where(is_num & (f_mono_leaf > 0), mid, st.leaf_max_c)
+        rmin = jnp.where(is_num & (f_mono_leaf > 0), mid, st.leaf_min_c)
+        rmax = jnp.where(is_num & (f_mono_leaf < 0), mid, st.leaf_max_c)
+        leaf_min_c = upd(st.leaf_min_c, lmin, rmin)
+        leaf_max_c = upd(st.leaf_max_c, lmax, rmax)
+
+        tree = tree._replace(
+            leaf_value=upd(t.leaf_value, lout, rout),
+            leaf_weight=upd(t.leaf_weight, lsh, rsh),
+            leaf_count=upd(t.leaf_count, lsc, rsc),
+            leaf_parent=upd(t.leaf_parent, node_id, node_id),
+            leaf_depth=upd(t.leaf_depth, new_depth, new_depth),
+        )
+        leaf_is_left = upd(st.leaf_is_left,
+                           jnp.ones(L, bool), jnp.zeros(L, bool))
+
+        # 6. row re-labeling
+        g2f_leaf = self.g2f_lut[best_f]               # (L, GB)
+        leaf_id = apply_splits(
+            self.bins, st.leaf_id, do_split, f_group_leaf, g2f_leaf,
+            f_is_cat_leaf, thr, dleft, f_missing_leaf, f_dbin_leaf,
+            f_nb_leaf, cat_mask, right_slot)
+
+        num_leaves = st.num_leaves + k
+        round_idx = st.round_idx + 1
+        done = (k == 0) | (num_leaves >= L) | (round_idx >= self.max_rounds)
+        return GrowerState(
+            leaf_id=leaf_id, num_leaves=num_leaves, round_idx=round_idx,
+            done=done, leaf_sum_grad=leaf_sum_grad,
+            leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
+            leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c,
+            leaf_is_left=leaf_is_left, tree=tree)

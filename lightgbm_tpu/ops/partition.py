@@ -1,0 +1,88 @@
+"""Row partition: apply chosen splits to the per-row leaf assignment.
+
+TPU-native replacement for DataPartition's index-permutation split
+(reference: src/treelearner/data_partition.hpp:109-161) and the
+per-bin routing rules of DenseBin::Split / SplitCategorical
+(reference: src/io/dense_bin.hpp:191-283).  Instead of compacting row
+indices into contiguous per-leaf ranges, every row carries a ``leaf_id``
+and one vectorized pass re-labels the rows of every leaf split this
+round — recompute-with-masks beats in-place permutation on TPU.
+
+Routing semantics (full per-feature bin space, so the reference's
+min_bin/max_bin/bias adjustments vanish):
+  * NaN-missing: NaN bin (last) rides ``default_left``; other bins
+    (including the zero/default bin) compare ``bin <= threshold``.
+  * Zero-missing: the default(zero) bin rides ``default_left``; other
+    bins compare.
+  * None: plain compare.
+  * Categorical: ``cat_mask[bin]`` decides (bundle/out-of-range rows
+    resolve through the group->feature-bin LUT to the default bin,
+    reproducing the FindInBitset(default_bin) routing).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+def apply_splits(bins: jax.Array, leaf_id: jax.Array,
+                 split_mask: jax.Array, feat_group: jax.Array,
+                 g2f_lut: jax.Array, is_cat: jax.Array,
+                 threshold: jax.Array, default_left: jax.Array,
+                 missing_type: jax.Array, default_bin: jax.Array,
+                 num_bin: jax.Array, cat_mask: jax.Array,
+                 right_slot: jax.Array) -> jax.Array:
+    """Re-label rows of splitting leaves.
+
+    Args:
+      bins: (N, G) uint8 group-bin matrix.
+      leaf_id: (N,) int32, negative = padded row (left untouched).
+      split_mask: (L,) bool — leaves splitting this round.
+      feat_group: (L,) int32 — group column of the chosen feature.
+      g2f_lut: (L, GB) int32 — group-bin -> feature-bin map of the
+        chosen feature (identity for unbundled groups; other features'
+        ranges and the shared slot 0 map to the default bin).
+      is_cat/threshold/default_left/missing_type/default_bin/num_bin:
+        (L,) chosen-split metadata gathered per leaf.
+      cat_mask: (L, B) bool — categorical left-set in feature-bin space.
+      right_slot: (L,) int32 — leaf slot assigned to the right child.
+
+    Returns: updated (N,) leaf_id (left child keeps the parent slot).
+    """
+    n = bins.shape[0]
+    gb_dim = g2f_lut.shape[1]
+    l = leaf_id
+    safe_l = jnp.clip(l, 0, split_mask.shape[0] - 1)
+    active = (l >= 0) & split_mask[safe_l]
+
+    grp = feat_group[safe_l]                                    # (N,)
+    gb = jnp.take_along_axis(bins, grp[:, None].astype(jnp.int32),
+                             axis=1)[:, 0].astype(jnp.int32)    # (N,)
+    fb = g2f_lut.reshape(-1)[safe_l * gb_dim + gb]              # (N,)
+
+    thr = threshold[safe_l]
+    dleft = default_left[safe_l]
+    mtype = missing_type[safe_l]
+    dbin = default_bin[safe_l]
+    nb = num_bin[safe_l]
+    cat = is_cat[safe_l]
+
+    is_nan_bin = fb == (nb - 1)
+    is_def_bin = fb == dbin
+    cmp_left = fb <= thr
+
+    num_left = jnp.where(
+        (mtype == MISSING_NAN) & is_nan_bin, dleft,
+        jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
+
+    b_dim = cat_mask.shape[1]
+    cat_left = cat_mask.reshape(-1)[safe_l * b_dim
+                                    + jnp.clip(fb, 0, b_dim - 1)]
+    go_left = jnp.where(cat, cat_left, num_left)
+
+    new_id = jnp.where(go_left, l, right_slot[safe_l])
+    return jnp.where(active, new_id, l).astype(jnp.int32)

@@ -52,6 +52,7 @@ class Booster:
         from .boosting import create_boosting
         self.gbdt = create_boosting(self.config, train_set,
                                     custom_objective=custom_objective)
+        self.average_output = getattr(self.gbdt, "average_output", False)
         self.models = self.gbdt.models      # shared list, grows in place
         self.num_class = self.config.num_class
         self.num_tree_per_iteration = self.config.num_tree_per_iteration
@@ -150,7 +151,8 @@ class Booster:
         for i, t in enumerate(models):
             raw[:, i % k] += t.predict(data)
         raw = self._add_init_and_average(raw, len(models))
-        if not raw_score:
+        if not raw_score and not self.average_output:
+            # RF leaf outputs are already in converted space
             raw = self._convert_output(raw)
         return raw[:, 0] if k == 1 else raw
 

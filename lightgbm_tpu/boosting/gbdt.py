@@ -187,11 +187,23 @@ class GBDT:
                               max_steps=self.config.num_leaves)
 
     # ------------------------------------------------------------------
+    # hooks for DART/GOSS/RF subclasses --------------------------------
+    def _before_boosting(self) -> None:
+        """Called before gradient computation (DART drops trees here)."""
+
+    def _after_iteration(self) -> None:
+        """Called after the iteration's trees are in (DART normalizes)."""
+
+    def _sample_rows(self, g, h, counts):
+        """Row-sampling hook; GOSS reweights gradients here."""
+        return g, h, counts
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (reference gbdt.cpp:386-481).
         Custom grad/hess (shape (N,) or (N, K)) bypass the objective —
         the LGBM_BoosterUpdateOneIterCustom path."""
+        self._before_boosting()
         if grad is None or hess is None:
             if self.objective is None:
                 Log.fatal("No objective and no custom gradients")
@@ -206,6 +218,7 @@ class GBDT:
             h = jnp.asarray(np.pad(hess, ((0, 0), (0, pad))))
 
         counts, bag_mask = self._bagging_counts(self.iter_)
+        g, h, counts = self._sample_rows(g, h, counts)
         g, h = self._mask_gradients(g, h, counts)
         self._last_counts = counts
 
@@ -246,6 +259,7 @@ class GBDT:
                 self.models.pop()
                 self.device_trees.pop()
             return True
+        self._after_iteration()
         self.iter_ += 1
         return False
 

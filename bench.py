@@ -1,0 +1,86 @@
+"""Benchmark: Higgs-like binary training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation (BASELINE.md): the reference trains HIGGS
+(10.5M rows x 28 features, 500 iters, 255 leaves) in 238.51 s on a
+2x E5-2670v3 — 4.543e-8 s per (tree x row).  This harness trains a
+synthetic 28-feature binary task at BENCH_ROWS x BENCH_ITERS with the
+GPU-table config (63 bins, 255 leaves — docs/GPU-Performance.rst:108)
+and reports wall-clock; vs_baseline = scaled_reference_time / ours
+(>1 means faster than the reference CPU).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+BENCH_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+BENCH_FEATURES = 28
+BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 100))
+NUM_LEAVES = 255
+MAX_BIN = 63
+REF_SEC_PER_TREE_ROW = 238.51 / (500 * 10_500_000)
+
+
+def make_data(n, f, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) * (rng.rand(f) > 0.3)
+    logit = X[:, :f] @ w + 0.5 * np.sin(3 * X[:, 0]) * X[:, 1]
+    y = (logit + rng.logistic(size=n) > 0).astype(np.float32)
+    return X.astype(np.float64), y
+
+
+def main():
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+
+    X, y = make_data(BENCH_ROWS, BENCH_FEATURES)
+    params = {
+        "objective": "binary", "num_leaves": NUM_LEAVES,
+        "max_bin": MAX_BIN, "learning_rate": 0.1, "verbose": -1,
+        "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
+        "hist_compute_dtype": os.environ.get("BENCH_HIST_DTYPE",
+                                             "bfloat16"),
+    }
+    cfg = Config.from_params(params)
+    t0 = time.time()
+    core = lgb.Dataset(X, label=y).construct(cfg)
+    prep_s = time.time() - t0
+
+    gbdt = GBDT(cfg, core)
+    # warmup: compile
+    t0 = time.time()
+    gbdt.train_one_iter()
+    jax.block_until_ready(gbdt.scores)
+    compile_s = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(BENCH_ITERS - 1):
+        gbdt.train_one_iter()
+    jax.block_until_ready(gbdt.scores)
+    train_s = time.time() - t0
+    per_tree = train_s / (BENCH_ITERS - 1)
+    total_equiv = per_tree * BENCH_ITERS
+
+    ref_scaled = REF_SEC_PER_TREE_ROW * BENCH_ROWS * BENCH_ITERS
+    result = {
+        "metric": f"higgs_synth_{BENCH_ROWS//1000}k_{BENCH_ITERS}trees_s",
+        "value": round(total_equiv, 3),
+        "unit": "s",
+        "vs_baseline": round(ref_scaled / total_equiv, 3),
+    }
+    print(json.dumps(result))
+    # diagnostics on stderr so the stdout contract stays one line
+    import sys
+    print(f"prep={prep_s:.1f}s compile={compile_s:.1f}s "
+          f"per_tree={per_tree*1000:.1f}ms ref_scaled={ref_scaled:.1f}s",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

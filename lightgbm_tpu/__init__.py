@@ -10,8 +10,18 @@ from .basic import Dataset, Booster
 from .config import Config
 from .engine import train, cv
 from .utils.log import Log, LightGBMError
+from .callback import (early_stopping, print_evaluation, record_evaluation,
+                       reset_parameter)
+from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
+from . import plotting
+from .plotting import (plot_importance, plot_metric, plot_tree,
+                       create_tree_digraph)
 
 __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "Config", "train", "cv", "Log",
-           "LightGBMError", "__version__"]
+           "LightGBMError", "early_stopping", "print_evaluation",
+           "record_evaluation", "reset_parameter", "LGBMModel",
+           "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+           "plot_importance", "plot_metric", "plot_tree",
+           "create_tree_digraph", "__version__"]

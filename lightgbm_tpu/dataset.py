@@ -206,7 +206,7 @@ class Dataset:
             self._build_groups(reference=reference)
         else:
             cat_set = set(categorical_features or [])
-            sample_vals, total_cnt = _sample_feature_values(
+            sample_vals, total_cnt, sample_rows = _sample_feature_values(
                 data, config.bin_construct_sample_cnt, config.data_random_seed)
             self.mappers = find_bin_mappers(
                 sample_vals, total_cnt, config.max_bin, config.min_data_in_bin,
@@ -217,7 +217,8 @@ class Dataset:
             if not self.used_features:
                 Log.warning("There are no meaningful features; "
                             "all features are constant or filtered")
-            self._build_groups(reference=None)
+            self._build_groups(reference=None, sample_nonzero=sample_rows,
+                               sample_cnt=total_cnt)
 
         self._bin_data(data)
         self._raw_data = data
@@ -232,7 +233,9 @@ class Dataset:
         return self
 
     # ------------------------------------------------------------------
-    def _build_groups(self, reference: Optional["Dataset"]) -> None:
+    def _build_groups(self, reference: Optional["Dataset"],
+                      sample_nonzero: Optional[List[np.ndarray]] = None,
+                      sample_cnt: int = 0) -> None:
         """Assign features to groups.  With EFB disabled (or until the
         bundler finds conflicts-free bundles) every used feature is its
         own single-feature group with identity bin mapping.
@@ -246,7 +249,7 @@ class Dataset:
             self.group_is_multi = reference.group_is_multi
             self._bundles = reference._bundles
             return
-        bundles = _find_bundles(self)
+        bundles = _find_bundles(self, sample_nonzero, sample_cnt)
         self._bundles = bundles
         self.features = [None] * 0
         feats: List[FeatureView] = []
@@ -378,11 +381,13 @@ class Dataset:
 
 
 # ---------------------------------------------------------------------------
-def _sample_feature_values(data: np.ndarray, sample_cnt: int,
-                           seed: int) -> Tuple[List[np.ndarray], int]:
+def _sample_feature_values(data: np.ndarray, sample_cnt: int, seed: int
+                           ) -> Tuple[List[np.ndarray], int,
+                                      List[np.ndarray]]:
     """Row-sample then collect per-feature non-zero (and NaN) values for
     bin finding (reference dataset_loader.cpp:649-754 sampling +
-    bin.cpp:207 contract: zeros are implicit)."""
+    bin.cpp:207 contract: zeros are implicit).  Also returns per-feature
+    non-zero row indices within the sample, feeding the EFB bundler."""
     num_data = data.shape[0]
     if num_data > sample_cnt:
         rng = np.random.RandomState(seed)
@@ -393,15 +398,69 @@ def _sample_feature_values(data: np.ndarray, sample_cnt: int,
         sample = data
     total = sample.shape[0]
     out = []
+    rows = []
     for j in range(data.shape[1]):
         col = sample[:, j]
         keep = np.isnan(col) | (np.abs(col) > 1e-35)
         out.append(col[keep])
-    return out, total
+        rows.append(np.nonzero(keep)[0])
+    return out, total, rows
 
 
-def _find_bundles(ds: Dataset) -> List[List[int]]:
+def _find_bundles(ds: Dataset, sample_nonzero: Optional[List[np.ndarray]]
+                  = None, sample_cnt: int = 0) -> List[List[int]]:
     """Exclusive feature bundling (reference dataset.cpp:66-210
-    FindGroups/FastFeatureBundling).  v1: single-feature groups only;
-    the greedy conflict-graph bundler lands with the sparse-data path."""
-    return [[fidx] for fidx in ds.used_features]
+    FindGroups/FastFeatureBundling): greedily pack mutually-exclusive
+    sparse features into shared bin columns, tolerating
+    ``max_conflict_rate`` collisions, with the 256-bins-per-group cap
+    the GPU learner imposes (dataset.cpp:76,90-91) — which is exactly
+    the uint8 packed-column constraint here.
+
+    ``sample_nonzero``: per-feature sorted row indices (within the
+    sample) where the feature is non-default.  When absent (e.g.
+    reloaded binary cache) falls back to single-feature groups.
+    """
+    cfg = ds.config
+    if (sample_nonzero is None or cfg is None or not cfg.enable_bundle
+            or not cfg.is_enable_bundle):
+        return [[fidx] for fidx in ds.used_features]
+
+    max_group_bins = 256
+    max_conflict = int(cfg.max_conflict_rate * max(sample_cnt, 1))
+    # order by non-zero count descending (densest placed first,
+    # mirroring the reference's sorted-by-count greedy pass)
+    order = sorted(ds.used_features,
+                   key=lambda f: -len(sample_nonzero[f]))
+    bundles: List[List[int]] = []
+    bundle_rows: List[np.ndarray] = []
+    bundle_bins: List[int] = []
+    bundle_conflicts: List[int] = []
+    for fidx in order:
+        m = ds.mappers[fidx]
+        nb = m.num_bin - (1 if m.default_bin == 0 else 0)
+        rows = sample_nonzero[fidx]
+        placed = False
+        # a feature covering most rows can't bundle with anything
+        if len(rows) * 2 < sample_cnt:
+            for bi in range(len(bundles)):
+                if bundle_bins[bi] + nb > max_group_bins:
+                    continue
+                conflicts = np.intersect1d(bundle_rows[bi], rows,
+                                           assume_unique=True).size
+                if bundle_conflicts[bi] + conflicts <= max_conflict:
+                    bundles[bi].append(fidx)
+                    bundle_rows[bi] = np.union1d(bundle_rows[bi], rows)
+                    bundle_bins[bi] += nb
+                    bundle_conflicts[bi] += conflicts
+                    placed = True
+                    break
+        if not placed:
+            bundles.append([fidx])
+            bundle_rows.append(rows)
+            bundle_bins.append(nb + 1)  # + shared default slot
+            bundle_conflicts.append(0)
+    # stable order: by first (lowest) feature index
+    for b in bundles:
+        b.sort()
+    bundles.sort(key=lambda b: b[0])
+    return bundles

@@ -154,6 +154,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if metrics is not None:
         params["metric"] = metrics
     config = Config.from_params(params)
+    if hasattr(train_set, "construct"):
+        train_set = train_set.construct(config)
     label = train_set.metadata.label
     n = train_set.num_data
     rng = np.random.RandomState(seed)

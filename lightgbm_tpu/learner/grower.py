@@ -82,9 +82,19 @@ def _encode_leaf(leaf_slot):
 
 class TreeGrower:
     """Builds and caches the jitted per-tree training function for one
-    Dataset + Config combination."""
+    Dataset + Config combination.
 
-    def __init__(self, dataset: Dataset, config: Config):
+    Distributed modes (tree_learner=data/feature/voting) work through
+    the ShardingPolicy: the bin matrix is placed sharded over the mesh
+    and the histogram output constrained, after which XLA inserts the
+    reduce-scatter/all-gather the reference's Network layer hand-codes
+    (see parallel/mesh.py)."""
+
+    def __init__(self, dataset: Dataset, config: Config, policy=None):
+        from ..parallel.mesh import ShardingPolicy, build_mesh
+        if policy is None:
+            policy = ShardingPolicy(config, build_mesh(config))
+        self.policy = policy
         self.config = config
         self.num_leaves = config.num_leaves
         self.max_group_bin = dataset.max_group_bin
@@ -136,8 +146,8 @@ class TreeGrower:
         if pad:
             bins_np = np.concatenate(
                 [bins_np, np.zeros((pad, bins_np.shape[1]), dtype=np.uint8)])
-        self.bins = jax.device_put(bins_np)
-        self._row_valid = jnp.asarray(
+        self.bins = self.policy.place_rows(bins_np)
+        self._row_valid = self.policy.place_rows(
             np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
         self._train_tree = jax.jit(self._train_tree_impl)
 
@@ -241,11 +251,15 @@ class TreeGrower:
         M = L - 1
         B = self.max_feature_bin
 
-        # 1. histograms for every leaf in one pass
+        # 1. histograms for every leaf in one pass; under a mesh the
+        # row-sharded contraction lowers to a reduce-scatter onto the
+        # constrained feature sharding (the reference's
+        # Network::ReduceScatter of concatenated histograms)
         group_hist = compute_group_histograms(
             self.bins, grad, hess, counts, st.leaf_id,
             num_leaves=L, max_group_bin=self.max_group_bin,
             compute_dtype=self.config.hist_compute_dtype, chunk=self.chunk)
+        group_hist = self.policy.constrain_hist(group_hist)
         leaf_totals = jnp.stack(
             [st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count], axis=1)
         hist = expand_feature_histograms(group_hist, self.bin_map,

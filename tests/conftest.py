@@ -1,0 +1,28 @@
+"""Test harness: force a CPU-only 8-device virtual mesh.
+
+Distributed learners are exercised on host-simulated devices (the
+reference has no multi-node CI at all — SURVEY §4; this is the
+deterministic multi-host substitute).  The TPU plugin environment may
+override JAX_PLATFORMS via a config update at interpreter start, so we
+set the config explicitly after import — tests must never touch (or
+hang on) the real accelerator tunnel.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

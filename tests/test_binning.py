@@ -1,0 +1,101 @@
+"""BinMapper semantics tests (reference bin.cpp:73-390 behavior)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BIN_CATEGORICAL, BIN_NUMERICAL,
+                                  MISSING_NAN, MISSING_NONE, MISSING_ZERO,
+                                  BinMapper, greedy_find_bin)
+
+
+def _fit(values, total=None, max_bin=255, min_data_in_bin=3,
+         bin_type=BIN_NUMERICAL, use_missing=True, zero_as_missing=False):
+    values = np.asarray(values, dtype=np.float64)
+    total = total if total is not None else len(values)
+    m = BinMapper()
+    m.find_bin(values, total, max_bin, min_data_in_bin, 2, bin_type,
+               use_missing, zero_as_missing)
+    return m
+
+
+def test_simple_numerical():
+    vals = np.repeat(np.arange(1, 11, dtype=float), 10)
+    m = _fit(vals)
+    assert not m.is_trivial
+    assert m.num_bin == 11  # 10 values + zero bin
+    bins = m.value_to_bin(np.array([1.0, 5.0, 10.0]))
+    assert bins[0] < bins[1] < bins[2]
+
+
+def test_zero_gets_own_bin():
+    vals = np.array([-2.0] * 30 + [3.0] * 30)
+    m = _fit(vals, total=90)  # 30 implicit zeros
+    zb = m.value_to_bin(np.array([0.0]))[0]
+    nb = m.value_to_bin(np.array([-2.0]))[0]
+    pb = m.value_to_bin(np.array([3.0]))[0]
+    assert nb < zb < pb
+    assert m.default_bin == zb
+
+
+def test_missing_nan_bin():
+    vals = np.array([1.0, 2.0, 3.0] * 20 + [np.nan] * 10)
+    m = _fit(vals)
+    assert m.missing_type == MISSING_NAN
+    nanb = m.value_to_bin(np.array([np.nan]))[0]
+    assert nanb == m.num_bin - 1
+
+
+def test_no_missing():
+    vals = np.array([1.0, 2.0, 3.0] * 20)
+    m = _fit(vals)
+    assert m.missing_type == MISSING_NONE
+    # NaN at predict time maps like 0.0
+    assert m.value_to_bin(np.array([np.nan]))[0] == \
+        m.value_to_bin(np.array([0.0]))[0]
+
+
+def test_zero_as_missing():
+    vals = np.array([1.0, 2.0, 3.0, -1.0] * 20)
+    m = _fit(vals, total=100, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+
+
+def test_trivial_constant():
+    m = _fit(np.array([5.0] * 50))
+    assert m.is_trivial
+
+
+def test_categorical_mapping():
+    vals = np.array([1.0] * 50 + [2.0] * 30 + [7.0] * 20)
+    m = _fit(vals, bin_type=BIN_CATEGORICAL)
+    assert m.bin_type == BIN_CATEGORICAL
+    b1 = m.value_to_bin(np.array([1.0]))[0]
+    b2 = m.value_to_bin(np.array([2.0]))[0]
+    b7 = m.value_to_bin(np.array([7.0]))[0]
+    # most-frequent-first ordering
+    assert b1 < b2 < b7
+    # unseen category falls into the last bin
+    assert m.value_to_bin(np.array([99.0]))[0] == m.num_bin - 1
+
+
+def test_categorical_negative_is_nan():
+    vals = np.array([1.0] * 50 + [-3.0] * 10)
+    m = _fit(vals, bin_type=BIN_CATEGORICAL)
+    assert m.value_to_bin(np.array([-3.0]))[0] == m.num_bin - 1
+
+
+def test_greedy_find_bin_respects_max_bin():
+    dv = np.arange(1000, dtype=np.float64)
+    cnt = np.ones(1000, dtype=np.int64)
+    bounds = greedy_find_bin(dv, cnt, 16, 1000, 0)
+    assert len(bounds) <= 16
+    assert bounds[-1] == np.inf
+
+
+def test_value_to_bin_roundtrip_monotone():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(5000)
+    m = _fit(vals, max_bin=63)
+    x = np.sort(rng.randn(1000))
+    bins = m.value_to_bin(x)
+    assert np.all(np.diff(bins) >= 0)  # monotone mapping
+    assert bins.max() < m.num_bin

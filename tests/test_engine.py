@@ -1,0 +1,268 @@
+"""Workload integration tests — the analog of the reference's
+tests/python_package_test/test_engine.py (binary :35, regression :82,
+missing-value matrix :101-213, categorical :214-281, multiclass :282,
+early stopping :330, continued training :361, cv :413, feature name
+:437, save/load/pickle :450, SHAP :533, monotone :603)."""
+import pickle
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_digits, make_regression
+from sklearn.metrics import log_loss, mean_squared_error, roc_auc_score
+from sklearn.model_selection import train_test_split
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data():
+    X, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(X, y, test_size=0.1, random_state=42)
+
+
+def test_binary():
+    X_train, X_test, y_train, y_test = _binary_data()
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    er = {}
+    bst = lgb.train(params, ds, 50,
+                    valid_sets=[lgb.Dataset(X_test, label=y_test,
+                                            reference=ds)],
+                    evals_result=er, verbose_eval=False)
+    pred = bst.predict(X_test)
+    ll = log_loss(y_test, pred)
+    # reference threshold: logloss < 0.15 after 50 iters (test_engine.py:35)
+    assert ll < 0.15
+    assert abs(er["valid_0"]["binary_logloss"][-1] - ll) < 1e-3
+
+
+def test_regression():
+    X, y = make_regression(n_samples=500, n_features=10, noise=10.0,
+                           random_state=42)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=0.1, random_state=42)
+    params = {"objective": "regression", "metric": "l2", "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, 50, verbose_eval=False)
+    mse = mean_squared_error(y_test, bst.predict(X_test))
+    base = mean_squared_error(y_test, np.full_like(y_test, y_train.mean()))
+    assert mse < 0.2 * base
+
+
+def test_rf():
+    X_train, X_test, y_train, y_test = _binary_data()
+    params = {"objective": "binary", "boosting": "rf",
+              "bagging_freq": 1, "bagging_fraction": 0.5,
+              "feature_fraction": 0.5, "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, 30, verbose_eval=False)
+    pred = bst.predict(X_test)
+    assert roc_auc_score(y_test, pred) > 0.95
+
+
+def test_dart():
+    X_train, X_test, y_train, y_test = _binary_data()
+    params = {"objective": "binary", "boosting": "dart", "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, 40, verbose_eval=False)
+    assert log_loss(y_test, bst.predict(X_test)) < 0.3
+
+
+def test_goss():
+    X_train, X_test, y_train, y_test = _binary_data()
+    params = {"objective": "binary", "boosting": "goss", "verbose": -1,
+              "learning_rate": 0.1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, 40, verbose_eval=False)
+    assert log_loss(y_test, bst.predict(X_test)) < 0.3
+
+
+def test_multiclass():
+    X, y = load_digits(n_class=10, return_X_y=True)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=0.1, random_state=42)
+    params = {"objective": "multiclass", "num_class": 10,
+              "metric": "multi_logloss", "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train(params, ds, 30, verbose_eval=False)
+    pred = bst.predict(X_test)
+    assert pred.shape == (len(y_test), 10)
+    acc = (np.argmax(pred, axis=1) == y_test).mean()
+    assert acc > 0.9
+
+
+def test_missing_value_nan():
+    """Crafted missing-handling check (reference test_engine.py:101-140)."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(200)
+    X = np.column_stack([x, rng.rand(200)])
+    y = (x > 0.5).astype(float)
+    X[:20, 0] = np.nan
+    y[:20] = 1.0   # NaN strongly predicts positive
+    params = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 1,
+              "min_data_in_bin": 1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 30, verbose_eval=False)
+    Xt = np.array([[np.nan, 0.5], [0.9, 0.5], [0.1, 0.5]])
+    pred = bst.predict(Xt)
+    assert pred[0] > 0.5      # NaN routes to the positive side
+    assert pred[1] > 0.5
+    assert pred[2] < 0.5
+
+
+def test_missing_value_zero():
+    rng = np.random.RandomState(0)
+    x = rng.rand(200) + 0.5
+    X = np.column_stack([x, rng.rand(200)])
+    y = (x > 1.0).astype(float)
+    X[:30, 0] = 0.0
+    y[:30] = 1.0
+    params = {"objective": "binary", "verbose": -1,
+              "zero_as_missing": True, "min_data_in_leaf": 1,
+              "min_data_in_bin": 1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 30, verbose_eval=False)
+    pred = bst.predict(np.array([[0.0, 0.5], [0.6, 0.5], [1.4, 0.5]]))
+    assert pred[0] > 0.5
+    assert pred[2] > 0.5
+    assert pred[1] < 0.5
+
+
+def test_categorical_handling():
+    """Crafted categorical splits (reference test_engine.py:214-281)."""
+    rng = np.random.RandomState(0)
+    cat = rng.randint(0, 8, size=600).astype(float)
+    X = np.column_stack([cat, rng.rand(600)])
+    # categories {1, 3, 5} are positive
+    y = np.isin(cat, [1, 3, 5]).astype(float)
+    params = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 1,
+              "max_cat_to_onehot": 1}  # force sorted-mode cat splits
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(params, ds, 30, verbose_eval=False)
+    pred = bst.predict(np.column_stack(
+        [np.arange(8), np.full(8, 0.5)]))
+    for c in range(8):
+        if c in (1, 3, 5):
+            assert pred[c] > 0.5, c
+        else:
+            assert pred[c] < 0.5, c
+
+
+def test_early_stopping():
+    X_train, X_test, y_train, y_test = _binary_data()
+    ds = lgb.Dataset(X_train, label=y_train)
+    vs = lgb.Dataset(X_test, label=y_test, reference=ds)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "verbose": -1}, ds, 500, valid_sets=[vs],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration > 0
+    assert bst.num_trees() < 500
+
+
+def test_continued_training():
+    X_train, X_test, y_train, y_test = _binary_data()
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1}
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst1 = lgb.train(params, ds, 20, verbose_eval=False)
+    ll1 = log_loss(y_test, bst1.predict(X_test))
+    ds2 = lgb.Dataset(X_train, label=y_train)
+    bst2 = lgb.train(params, ds2, 20, init_model=bst1, verbose_eval=False)
+    ll2 = log_loss(y_test, bst2.predict(X_test))
+    assert bst2.num_trees() == 40
+    assert ll2 < ll1
+
+
+def test_cv():
+    X_train, _, y_train, _ = _binary_data()
+    ds = lgb.Dataset(X_train, label=y_train)
+    res = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "verbose": -1}, ds, 10, nfold=3)
+    assert "binary_logloss-mean" in res
+    assert len(res["binary_logloss-mean"]) == 10
+    assert res["binary_logloss-mean"][-1] < res["binary_logloss-mean"][0]
+
+
+def test_feature_names():
+    X = np.random.RandomState(0).rand(100, 3)
+    y = X[:, 0]
+    names = ["alpha", "beta", "gamma"]
+    ds = lgb.Dataset(X, label=y, feature_name=names)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, ds, 5, verbose_eval=False)
+    assert bst.feature_names == names
+    assert "alpha" in bst.model_to_string()
+
+
+def test_save_load_pickle_roundtrip():
+    X_train, X_test, y_train, y_test = _binary_data()
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, ds, 10,
+                    verbose_eval=False)
+    pred = bst.predict(X_test)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    assert np.allclose(pred, bst2.predict(X_test))
+    bst3 = pickle.loads(pickle.dumps(bst))
+    assert np.allclose(pred, bst3.predict(X_test))
+
+
+def test_shap_contribs_sum():
+    """SHAP contribs sum to raw prediction (reference test_engine.py:533)."""
+    X_train, X_test, y_train, _ = _binary_data()
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, ds, 10,
+                    verbose_eval=False)
+    contrib = bst.predict(X_test[:30], pred_contrib=True)
+    raw = bst.predict(X_test[:30], raw_score=True)
+    assert np.allclose(contrib.sum(axis=1), raw, atol=1e-6)
+
+
+def test_monotone_constraints():
+    """Scan the learned function for monotonicity
+    (reference test_engine.py:603)."""
+    rng = np.random.RandomState(0)
+    n = 2000
+    x_inc = rng.rand(n)
+    x_dec = rng.rand(n)
+    x_free = rng.rand(n)
+    y = (5 * x_inc - 5 * x_dec + np.sin(10 * x_free)
+         + 0.1 * rng.randn(n))
+    X = np.column_stack([x_inc, x_dec, x_free])
+    params = {"objective": "regression", "verbose": -1,
+              "monotone_constraints": [1, -1, 0], "num_leaves": 31}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 60, verbose_eval=False)
+    # vary one monotone feature over a grid, others fixed
+    grid = np.linspace(0.01, 0.99, 50)
+    for col, sign in ((0, 1), (1, -1)):
+        for trial in range(5):
+            base = rng.rand(3)
+            pts = np.tile(base, (50, 1))
+            pts[:, col] = grid
+            pred = bst.predict(pts)
+            diffs = np.diff(pred) * sign
+            assert np.all(diffs >= -1e-10), (col, sign)
+
+
+def test_custom_objective_fobj():
+    X_train, X_test, y_train, y_test = _binary_data()
+
+    def logregobj(preds, dataset):
+        labels = dataset.metadata.label[:dataset.num_data]
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - labels, p * (1 - p)
+
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train({"objective": "none", "verbose": -1}, ds, 30,
+                    fobj=logregobj, verbose_eval=False)
+    raw = bst.predict(X_test, raw_score=True)
+    pred = 1.0 / (1.0 + np.exp(-raw))
+    assert log_loss(y_test, pred) < 0.2
+
+
+def test_reset_parameter_callback():
+    X_train, _, y_train, _ = _binary_data()
+    ds = lgb.Dataset(X_train, label=y_train)
+    lrs = [0.1] * 5 + [0.05] * 5
+    bst = lgb.train({"objective": "binary", "verbose": -1}, ds, 10,
+                    callbacks=[lgb.reset_parameter(learning_rate=lrs)],
+                    verbose_eval=False)
+    assert bst.num_trees() == 10

@@ -1,0 +1,126 @@
+"""sklearn-wrapper tests (reference tests/python_package_test/
+test_sklearn.py:39-205)."""
+import pickle
+
+import numpy as np
+from sklearn.datasets import load_breast_cancer, load_digits, make_regression
+from sklearn.metrics import log_loss, mean_squared_error
+from sklearn.model_selection import train_test_split
+
+import lightgbm_tpu as lgb
+
+
+def test_regressor():
+    X, y = make_regression(n_samples=400, n_features=8, noise=5.0,
+                           random_state=0)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=0)
+    m = lgb.LGBMRegressor(n_estimators=30, silent=True)
+    m.fit(X_tr, y_tr)
+    mse = mean_squared_error(y_te, m.predict(X_te))
+    base = mean_squared_error(y_te, np.full_like(y_te, y_tr.mean()))
+    assert mse < 0.3 * base
+    assert m.n_features_ == 8
+    assert m.feature_importances_.sum() > 0
+
+
+def test_classifier_binary():
+    X, y = load_breast_cancer(return_X_y=True)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=0)
+    m = lgb.LGBMClassifier(n_estimators=30, silent=True)
+    m.fit(X_tr, y_tr)
+    proba = m.predict_proba(X_te)
+    assert proba.shape == (len(y_te), 2)
+    assert log_loss(y_te, proba[:, 1]) < 0.25
+    pred = m.predict(X_te)
+    assert set(np.unique(pred)) <= set(m.classes_)
+    assert (pred == y_te).mean() > 0.9
+
+
+def test_classifier_multiclass():
+    X, y = load_digits(n_class=4, return_X_y=True)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=0)
+    m = lgb.LGBMClassifier(n_estimators=20, silent=True)
+    m.fit(X_tr, y_tr)
+    assert m.n_classes_ == 4
+    proba = m.predict_proba(X_te)
+    assert proba.shape == (len(y_te), 4)
+    assert (m.predict(X_te) == y_te).mean() > 0.9
+
+
+def test_classifier_string_labels():
+    X, y = load_breast_cancer(return_X_y=True)
+    ys = np.where(y > 0, "pos", "neg")
+    m = lgb.LGBMClassifier(n_estimators=10, silent=True)
+    m.fit(X, ys)
+    pred = m.predict(X[:10])
+    assert set(pred) <= {"pos", "neg"}
+
+
+def test_ranker():
+    rng = np.random.RandomState(0)
+    n_q, per_q = 30, 20
+    n = n_q * per_q
+    X = rng.rand(n, 5)
+    rel = (X[:, 0] * 3).astype(int).clip(0, 3)
+    group = [per_q] * n_q
+    m = lgb.LGBMRanker(n_estimators=20, silent=True,
+                       min_child_samples=1)
+    m.fit(X, rel, group=group)
+    scores = m.predict(X)
+    # higher relevance should get higher mean score
+    assert scores[rel == 3].mean() > scores[rel == 0].mean()
+
+
+def test_custom_objective():
+    X, y = load_breast_cancer(return_X_y=True)
+
+    def logregobj(y_true, y_pred):
+        p = 1.0 / (1.0 + np.exp(-y_pred))
+        return p - y_true, p * (1 - p)
+
+    m = lgb.LGBMClassifier(n_estimators=20, objective=logregobj,
+                           silent=True)
+    m.fit(X, y)
+    raw = m.booster_.predict(X, raw_score=True)
+    p = 1.0 / (1.0 + np.exp(-raw))
+    assert log_loss(y, p) < 0.25
+
+
+def test_dart_sklearn():
+    X, y = load_breast_cancer(return_X_y=True)
+    m = lgb.LGBMClassifier(boosting_type="dart", n_estimators=20,
+                           silent=True)
+    m.fit(X, y)
+    assert (m.predict(X) == y).mean() > 0.9
+
+
+def test_clone_and_pickle():
+    X, y = load_breast_cancer(return_X_y=True)
+    m = lgb.LGBMClassifier(n_estimators=10, silent=True)
+    params = m.get_params()
+    m2 = lgb.LGBMClassifier(**params)
+    assert m2.get_params()["n_estimators"] == 10
+    m.fit(X, y)
+    s = pickle.dumps(m.booster_)
+    b = pickle.loads(s)
+    assert np.allclose(b.predict(X[:5]),
+                       m.booster_.predict(X[:5]))
+
+
+def test_grid_search_compatible():
+    from sklearn.model_selection import GridSearchCV
+    X, y = load_breast_cancer(return_X_y=True)
+    gs = GridSearchCV(lgb.LGBMClassifier(n_estimators=5, silent=True),
+                      {"num_leaves": [7, 15]}, cv=2, scoring="accuracy")
+    gs.fit(X, y)
+    assert gs.best_score_ > 0.85
+
+
+def test_early_stopping_sklearn():
+    X, y = load_breast_cancer(return_X_y=True)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=0)
+    m = lgb.LGBMClassifier(n_estimators=300, silent=True)
+    m.fit(X_tr, y_tr, eval_set=[(X_te, y_te)],
+          eval_metric="binary_logloss", early_stopping_rounds=5)
+    assert m.best_iteration_ > 0
+    assert m.booster_.num_trees() < 300

@@ -263,6 +263,108 @@ class Booster:
             self.models.append(Tree.from_string(block))
 
     # ------------------------------------------------------------------
+    def dump_model(self, num_iteration: int = -1) -> Dict[str, Any]:
+        """JSON model dump (reference gbdt_model_text.cpp:20-180
+        DumpModel / Tree::ToJSON)."""
+        models = self._used_models(num_iteration)
+
+        def node_json(tree: Tree, node: int):
+            if node < 0:
+                leaf = -node - 1
+                return {"leaf_index": leaf,
+                        "leaf_value": float(tree.leaf_value[leaf]),
+                        "leaf_count": int(tree.leaf_count[leaf])}
+            dt = int(tree.decision_type[node])
+            is_cat = bool(dt & 1)
+            mtype = {0: "None", 1: "Zero", 2: "NaN"}[(dt >> 2) & 3]
+            out = {
+                "split_index": int(node),
+                "split_feature": int(tree.split_feature[node]),
+                "split_gain": float(tree.split_gain[node]),
+                "threshold": float(tree.threshold[node]),
+                "decision_type": "==" if is_cat else "<=",
+                "default_left": bool(dt & 2),
+                "missing_type": mtype,
+                "internal_value": float(tree.internal_value[node]),
+                "internal_count": int(tree.internal_count[node]),
+                "left_child": node_json(tree, int(tree.left_child[node])),
+                "right_child": node_json(tree, int(tree.right_child[node])),
+            }
+            if is_cat:
+                ci = int(tree.threshold[node])
+                lo, hi = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+                out["cat_threshold"] = list(tree.cat_threshold[lo:hi])
+            return out
+
+        return {
+            "name": "tree",
+            "version": MODEL_VERSION,
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": 0,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": self.objective_str,
+            "average_output": self.average_output,
+            "feature_names": list(self.feature_names),
+            "tree_info": [
+                {"tree_index": i, "num_leaves": t.num_leaves,
+                 "num_cat": t.num_cat, "shrinkage": t.shrinkage,
+                 "tree_structure": node_json(
+                     t, 0 if t.num_leaves > 1 else -1)}
+                for i, t in enumerate(models)],
+        }
+
+    # ------------------------------------------------------------------
+    def refit(self, data: np.ndarray, label: np.ndarray,
+              params: Optional[Dict[str, Any]] = None) -> "Booster":
+        """Refit leaf values on new data keeping the tree structures
+        (reference gbdt.cpp:338-360 RefitTree + c_api refit task)."""
+        from .config import Config
+        from .dataset import Metadata
+        from .objectives import create_objective
+        from .ops.split import calculate_leaf_output
+
+        import jax.numpy as jnp  # noqa: F401  (objectives use jnp)
+
+        params = dict(params or {})
+        params.setdefault("objective", self.objective_str.split()[0])
+        if self.num_tree_per_iteration > 1:
+            params.setdefault("num_class", self.num_tree_per_iteration)
+        config = Config.from_params(params)
+        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        n = data.shape[0]
+        objective = create_objective(config)
+        meta = Metadata(n)
+        meta.set_label(label)
+        objective.init(meta, n)
+
+        k = max(self.num_tree_per_iteration, 1)
+        leaf_preds = self.predict(data, pred_leaf=True)  # (n, ntrees)
+        scores = np.zeros((n, k), dtype=np.float64)
+        for i, tree in enumerate(self.models):
+            cls = i % k
+            s = scores if k > 1 else scores[:, 0]
+            g, h = objective.get_gradients(np.asarray(s, dtype=np.float32))
+            g = np.asarray(g)
+            h = np.asarray(h)
+            if k > 1:
+                g, h = g[:, cls], h[:, cls]
+            lp = leaf_preds[:, i]
+            shrink = tree.shrinkage if tree.shrinkage != 0 else 1.0
+            for leaf in range(tree.num_leaves):
+                mask = lp == leaf
+                if not mask.any():
+                    continue
+                sg, sh = float(g[mask].sum()), float(h[mask].sum())
+                out = float(calculate_leaf_output(
+                    np.float64(sg), np.float64(sh), config.lambda_l1,
+                    config.lambda_l2, config.max_delta_step))
+                tree.leaf_value[leaf] = out * shrink
+                tree.leaf_count[leaf] = int(mask.sum())
+            scores[:, cls] += tree.leaf_value[lp]
+        return self
+
+    # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split",
                            num_iteration: int = -1) -> np.ndarray:
         """reference gbdt.h FeatureImportance."""

@@ -16,6 +16,19 @@ from .booster import Booster
 from .engine import train as _train
 from .utils.log import Log
 
+try:  # sklearn integration is optional (reference compat.py gating)
+    from sklearn.base import (BaseEstimator as _SKBase,
+                              ClassifierMixin as _SKClassifier,
+                              RegressorMixin as _SKRegressor)
+except ImportError:  # pragma: no cover
+    _SKBase = object
+
+    class _SKClassifier:  # type: ignore
+        pass
+
+    class _SKRegressor:  # type: ignore
+        pass
+
 
 class _ObjectiveFunctionWrapper:
     """Adapts sklearn-style fobj(y_true, y_pred) -> (grad, hess)
@@ -54,7 +67,7 @@ class _EvalFunctionWrapper:
         raise TypeError("Self-defined eval function takes 3 or 4 arguments")
 
 
-class LGBMModel:
+class LGBMModel(_SKBase):
     """Base estimator (reference sklearn.py:127-598)."""
 
     def __init__(self, boosting_type="gbdt", num_leaves=31, max_depth=-1,
@@ -232,12 +245,12 @@ class LGBMModel:
         return self._n_features
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(LGBMModel, _SKRegressor):
     def _default_objective(self):
         return "regression"
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(LGBMModel, _SKClassifier):
     def _default_objective(self):
         if self._n_classes is not None and self._n_classes > 2:
             return "multiclass"

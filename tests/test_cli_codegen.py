@@ -1,0 +1,137 @@
+"""CLI + codegen-equivalence tests — the analog of the reference's
+if-else CI task (.travis/test.sh:58-65, tests/cpp_test/) and the
+Python<->CLI consistency suite (tests/python_package_test/
+test_consistency.py)."""
+import ctypes
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import run as cli_run
+
+
+def _write_csv(path, X, y):
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.10g")
+
+
+@pytest.fixture
+def trained(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 6)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.randn(400) > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, ds, 10, verbose_eval=False)
+    return bst, X, y
+
+
+def test_cli_train_predict_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 5)
+    y = X[:, 0] * 2 + 0.1 * rng.randn(300)
+    train_csv = tmp_path / "train.csv"
+    _write_csv(train_csv, X, y)
+    model = tmp_path / "model.txt"
+    out = tmp_path / "pred.txt"
+    cli_run([f"data={train_csv}", "task=train", "objective=regression",
+             "num_iterations=10", f"output_model={model}", "verbose=-1",
+             "num_leaves=7"])
+    assert model.exists()
+    cli_run([f"data={train_csv}", "task=predict",
+             f"input_model={model}", f"output_result={out}", "verbose=-1"])
+    pred = np.loadtxt(out)
+    assert pred.shape == (300,)
+    # CLI-trained predictions match Python-trained (consistency test)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1}, lgb.Dataset(X, label=y), 10,
+                    verbose_eval=False)
+    assert np.allclose(pred, bst.predict(X), atol=1e-5)
+
+
+def test_config_file(tmp_path):
+    rng = np.random.RandomState(1)
+    X = rng.randn(200, 4)
+    y = X[:, 0]
+    train_csv = tmp_path / "train.csv"
+    _write_csv(train_csv, X, y)
+    conf = tmp_path / "train.conf"
+    model = tmp_path / "model.txt"
+    conf.write_text(f"""# comment line
+task = train
+objective = regression
+data = {train_csv}
+num_trees = 5
+num_leaves = 7
+output_model = {model}
+verbose = -1
+""")
+    cli_run([f"config={conf}"])
+    assert model.exists()
+    b = lgb.Booster(model_file=str(model))
+    assert b.num_trees() == 5
+
+
+def test_ifelse_codegen_equivalence(trained, tmp_path):
+    """Generated C++ must reproduce raw predictions exactly."""
+    bst, X, y = trained
+    from lightgbm_tpu.codegen import model_to_ifelse_cpp
+    code = model_to_ifelse_cpp(bst)
+    src = tmp_path / "pred.cpp"
+    lib = tmp_path / "pred.so"
+    src.write_text(code)
+    subprocess.check_call(["g++", "-O2", "-shared", "-fPIC",
+                           str(src), "-o", str(lib)])
+    cdll = ctypes.CDLL(str(lib))
+    cdll.PredictRaw.argtypes = [ctypes.POINTER(ctypes.c_double),
+                                ctypes.POINTER(ctypes.c_double)]
+    raw_py = bst.predict(X, raw_score=True)
+    out = np.zeros(1)
+    got = np.zeros(len(X))
+    for i in range(len(X)):
+        row = np.ascontiguousarray(X[i], dtype=np.float64)
+        cdll.PredictRaw(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        got[i] = out[0]
+    assert np.allclose(got, raw_py, atol=1e-10)
+
+
+def test_dump_model_json(trained):
+    bst, X, y = trained
+    d = bst.dump_model()
+    json.dumps(d)  # must be serializable
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 10
+    ts = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in ts
+    assert "left_child" in ts
+
+
+def test_refit(trained):
+    bst, X, y = trained
+    rng = np.random.RandomState(5)
+    X2 = rng.randn(300, 6)
+    y2 = (X2[:, 0] - X2[:, 1] > 0).astype(float)
+    before = bst.predict(X2)
+    from sklearn.metrics import log_loss
+    ll_before = log_loss(y2, np.clip(before, 1e-9, 1 - 1e-9))
+    bst.refit(X2, y2)
+    after = bst.predict(X2)
+    ll_after = log_loss(y2, np.clip(after, 1e-9, 1 - 1e-9))
+    assert ll_after <= ll_before + 1e-6
+
+
+def test_convert_model_cli(trained, tmp_path):
+    bst, X, y = trained
+    model = tmp_path / "model.txt"
+    cpp = tmp_path / "gen.cpp"
+    bst.save_model(str(model))
+    cli_run([f"input_model={model}", "task=convert_model",
+             f"convert_model={cpp}", "convert_model_language=cpp",
+             "verbose=-1"])
+    text = cpp.read_text()
+    assert "PredictRaw" in text and "PredictTree0" in text

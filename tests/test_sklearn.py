@@ -61,7 +61,7 @@ def test_ranker():
     n_q, per_q = 30, 20
     n = n_q * per_q
     X = rng.rand(n, 5)
-    rel = (X[:, 0] * 3).astype(int).clip(0, 3)
+    rel = (X[:, 0] * 4).astype(int).clip(0, 3)
     group = [per_q] * n_q
     m = lgb.LGBMRanker(n_estimators=20, silent=True,
                        min_child_samples=1)

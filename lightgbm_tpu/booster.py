@@ -128,9 +128,13 @@ class Booster:
     # ------------------------------------------------------------------
     def predict(self, data: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False) -> np.ndarray:
+                pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
         """Host prediction on raw features (reference
-        gbdt_prediction.cpp:9-100; SHAP via tree.PredictContrib)."""
+        gbdt_prediction.cpp:9-100; SHAP via tree.PredictContrib;
+        margin-based early stop prediction_early_stop.cpp:13-80)."""
         data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
         if data.ndim == 1:
             data = data[None, :]
@@ -148,8 +152,26 @@ class Booster:
             return predict_contrib(self, data, models)
 
         raw = np.zeros((n, k), dtype=np.float64)
-        for i, t in enumerate(models):
-            raw[:, i % k] += t.predict(data)
+        if pred_early_stop and not self.average_output:
+            # rows whose margin already exceeds the threshold skip the
+            # remaining trees, checked every pred_early_stop_freq trees
+            # (reference prediction_early_stop.cpp: binary |score|,
+            # multiclass top-2 gap)
+            active = np.ones(n, dtype=bool)
+            for i, t in enumerate(models):
+                if not active.any():
+                    break
+                raw[active, i % k] += t.predict(data[active])
+                if (i + 1) % (pred_early_stop_freq * k) == 0:
+                    if k == 1:
+                        margin = np.abs(raw[:, 0])
+                    else:
+                        part = np.partition(raw, k - 2, axis=1)
+                        margin = part[:, -1] - part[:, -2]
+                    active &= margin < pred_early_stop_margin
+        else:
+            for i, t in enumerate(models):
+                raw[:, i % k] += t.predict(data)
         raw = self._add_init_and_average(raw, len(models))
         if not raw_score and not self.average_output:
             # RF leaf outputs are already in converted space

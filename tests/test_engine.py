@@ -266,3 +266,78 @@ def test_reset_parameter_callback():
                     callbacks=[lgb.reset_parameter(learning_rate=lrs)],
                     verbose_eval=False)
     assert bst.num_trees() == 10
+
+
+def test_lambdarank_ndcg():
+    """Ranking end-to-end (reference test_engine.py lambdarank flow)."""
+    rng = np.random.RandomState(0)
+    n_q, per_q = 50, 20
+    n = n_q * per_q
+    X = rng.rand(n, 6)
+    rel = (X[:, 0] * 2 + X[:, 1] * 2 + 0.3 * rng.randn(n)).clip(0, 3)
+    rel = rel.astype(int)
+    group = [per_q] * n_q
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "ndcg_eval_at": [1, 3, 5], "verbose": -1,
+              "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=rel.astype(float), group=group)
+    er = {}
+    bst = lgb.train(params, ds, 30, valid_sets=[ds], evals_result=er,
+                    verbose_eval=False)
+    ndcg3 = er["training"]["ndcg@3"]
+    assert ndcg3[-1] > ndcg3[0]
+    assert ndcg3[-1] > 0.8
+
+
+def test_xentropy_objectives():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 5)
+    p = 1.0 / (1.0 + np.exp(-(X[:, 0] - X[:, 1])))
+    y = p  # probabilistic labels in [0, 1]
+    for obj in ("cross_entropy", "cross_entropy_lambda"):
+        params = {"objective": obj, "verbose": -1}
+        er = {}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 20,
+                        valid_sets=[lgb.Dataset(X, label=y)],
+                        evals_result=er, verbose_eval=False)
+        key = next(iter(er["valid_0"]))
+        vals = er["valid_0"][key]
+        assert vals[-1] < vals[0], obj
+
+
+def test_regression_objectives_train():
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 5)
+    y_pos = np.exp(X[:, 0] * 0.5 + 0.1 * rng.randn(600))
+    cases = {
+        "regression_l1": None, "huber": None, "fair": None,
+        "quantile": None, "mape": None,
+        "poisson": y_pos, "gamma": y_pos, "tweedie": y_pos,
+    }
+    for obj, labels in cases.items():
+        yy = labels if labels is not None else X[:, 0] * 2 + 0.2 * rng.randn(600)
+        params = {"objective": obj, "verbose": -1, "metric": obj}
+        er = {}
+        lgb.train(params, lgb.Dataset(X, label=yy), 15,
+                  valid_sets=[lgb.Dataset(X, label=yy)],
+                  evals_result=er, verbose_eval=False)
+        key = next(iter(er["valid_0"]))
+        vals = er["valid_0"][key]
+        assert vals[-1] < vals[0], (obj, vals[0], vals[-1])
+
+
+def test_prediction_early_stop():
+    """reference test_engine.py:303 pred_early_stop."""
+    X_train, X_test, y_train, _ = _binary_data()
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, ds, 60,
+                    verbose_eval=False)
+    full = bst.predict(X_test, raw_score=True)
+    es = bst.predict(X_test, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=1.5)
+    # same sign (classification decision unchanged), values may differ
+    assert np.all(np.sign(full) == np.sign(es))
+    es_loose = bst.predict(X_test, raw_score=True, pred_early_stop=True,
+                           pred_early_stop_freq=5,
+                           pred_early_stop_margin=1e9)
+    assert np.allclose(full, es_loose)

@@ -1,0 +1,66 @@
+"""Generate the example datasets (the reference ships binary.train etc;
+this repo synthesizes equivalents so examples run offline).
+
+    python examples/make_data.py
+"""
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def write(path, X, y, fmt="%.6g"):
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt=fmt)
+
+
+def main():
+    rng = np.random.RandomState(42)
+
+    # binary classification (reference examples/binary_classification)
+    n, f = 7000, 28
+    X = rng.randn(n, f)
+    w = rng.randn(f) * (rng.rand(f) > 0.4)
+    y = (X @ w + rng.logistic(size=n) > 0).astype(int)
+    d = os.path.join(HERE, "binary_classification")
+    write(os.path.join(d, "binary.train"), X[:5000], y[:5000])
+    write(os.path.join(d, "binary.test"), X[5000:], y[5000:])
+
+    # regression
+    n = 7000
+    X = rng.rand(n, 12)
+    y = (10 * np.sin(np.pi * X[:, 0] * X[:, 1]) + 20 * (X[:, 2] - 0.5) ** 2
+         + 10 * X[:, 3] + 5 * X[:, 4] + rng.randn(n))
+    d = os.path.join(HERE, "regression")
+    write(os.path.join(d, "regression.train"), X[:5000], y[:5000])
+    write(os.path.join(d, "regression.test"), X[5000:], y[5000:])
+
+    # multiclass
+    n, k = 7000, 5
+    centers = rng.randn(k, 10) * 3
+    cls = rng.randint(0, k, n)
+    X = centers[cls] + rng.randn(n, 10)
+    d = os.path.join(HERE, "multiclass_classification")
+    write(os.path.join(d, "multiclass.train"), X[:5000], cls[:5000])
+    write(os.path.join(d, "multiclass.test"), X[5000:], cls[5000:])
+
+    # lambdarank with .query side files
+    n_q, per_q = 200, 25
+    n = n_q * per_q
+    X = rng.rand(n, 15)
+    rel = np.clip((X[:, 0] * 2 + X[:, 1] * 2
+                   + 0.5 * rng.randn(n)).astype(int), 0, 4)
+    d = os.path.join(HERE, "lambdarank")
+    split = 150 * per_q
+    write(os.path.join(d, "rank.train"), X[:split], rel[:split])
+    write(os.path.join(d, "rank.test"), X[split:], rel[split:])
+    np.savetxt(os.path.join(d, "rank.train.query"),
+               np.full(150, per_q, dtype=int), fmt="%d")
+    np.savetxt(os.path.join(d, "rank.test.query"),
+               np.full(50, per_q, dtype=int), fmt="%d")
+
+    print("example datasets written")
+
+
+if __name__ == "__main__":
+    main()

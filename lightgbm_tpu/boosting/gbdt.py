@@ -25,7 +25,7 @@ from ..metrics import Metric, create_metrics
 from ..objectives import Objective, create_objective
 from ..ops.predict import predict_binned
 from ..tree import Tree
-from ..utils.log import Log
+from ..utils.log import Log, PhaseTimer
 
 
 class _ValidSet:
@@ -93,6 +93,10 @@ class GBDT:
                        dtype=np.float32)
         self.scores = jnp.asarray(np.concatenate([base, pad], axis=1))
 
+        # per-phase wall-clock accounting (the TIMETAG analog,
+        # reference gbdt.cpp:21-29/52-61); reported at Log.debug level
+        # when training finishes
+        self.timer = PhaseTimer()
         self._rng = np.random.RandomState(config.seed)
         self._bag_rng = jax.random.PRNGKey(config.bagging_seed)
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
@@ -204,6 +208,7 @@ class GBDT:
         Custom grad/hess (shape (N,) or (N, K)) bypass the objective —
         the LGBM_BoosterUpdateOneIterCustom path."""
         self._before_boosting()
+        self.timer.start("boosting")
         if grad is None or hess is None:
             if self.objective is None:
                 Log.fatal("No objective and no custom gradients")
@@ -217,13 +222,17 @@ class GBDT:
             g = jnp.asarray(np.pad(grad, ((0, 0), (0, pad))))
             h = jnp.asarray(np.pad(hess, ((0, 0), (0, pad))))
 
+        self.timer.stop("boosting")
+        self.timer.start("bagging")
         counts, bag_mask = self._bagging_counts(self.iter_)
         g, h, counts = self._sample_rows(g, h, counts)
         g, h = self._mask_gradients(g, h, counts)
         self._last_counts = counts
+        self.timer.stop("bagging")
 
         should_continue = False
         for k in range(self.num_class):
+            self.timer.start("tree")
             feature_mask = self._feature_mask()
             tree_arrays, leaf_id = self.grower.train_tree(
                 g[k], h[k], counts, feature_mask)
@@ -251,6 +260,7 @@ class GBDT:
             if host_tree.num_leaves > 1:
                 should_continue = True
             self.models.append(host_tree)
+            self.timer.stop("tree")
 
         if not should_continue:
             Log.warning("Stopped training because there are no more leaves "
@@ -314,6 +324,13 @@ class GBDT:
     # ------------------------------------------------------------------
     def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
         """Returns (dataset_name, metric_name, value, bigger_better)."""
+        self.timer.start("metric")
+        try:
+            return self._eval_metrics_impl()
+        finally:
+            self.timer.stop("metric")
+
+    def _eval_metrics_impl(self):
         out = []
         if self.train_metrics:
             s = self._scores_for_eval(self.scores[:, :self.num_data])

@@ -89,6 +89,7 @@ class Booster:
         raw = train_set._raw_data
         if raw is None:
             Log.fatal("Continued training requires raw data on the Dataset")
+        base._sync_models()
         pred = base.predict(raw, raw_score=True)
         pred = pred.reshape(self.num_class, train_set.num_data) \
             if pred.ndim > 1 and self.num_class > 1 else \
@@ -98,6 +99,11 @@ class Booster:
         self.gbdt.scores = self.gbdt.scores + jnp.asarray(pred)
         for t in base.models:
             self.models.append(t)
+            # register foreign trees in the lazy-materialization
+            # bookkeeping so flush_models() indexes stay aligned
+            self.gbdt._tree_scale.append(1.0)
+            self.gbdt._applied_scale.append(1.0)
+            self.gbdt._scale_offset += 1
         # note: models list order => merged model predicts old + new trees
 
     # ------------------------------------------------------------------
@@ -111,12 +117,19 @@ class Booster:
     def rollback_one_iter(self):
         self.gbdt.rollback_one_iter()
 
+    def _sync_models(self) -> None:
+        """Materialize any device-resident trees into self.models
+        (one batched transfer; no-op for file-loaded models)."""
+        if self.gbdt is not None:
+            self.gbdt.flush_models()
+
     @property
     def current_iteration(self) -> int:
         return self.gbdt.iter_ if self.gbdt else \
             len(self.models) // max(self.num_tree_per_iteration, 1)
 
     def num_trees(self) -> int:
+        self._sync_models()
         return len(self.models)
 
     def _current_train_scores(self) -> np.ndarray:
@@ -179,6 +192,7 @@ class Booster:
         return raw[:, 0] if k == 1 else raw
 
     def _used_models(self, num_iteration: int) -> List[Tree]:
+        self._sync_models()
         k = max(self.num_tree_per_iteration, 1)
         if num_iteration is None or num_iteration <= 0:
             if self.best_iteration > 0:
@@ -360,6 +374,7 @@ class Booster:
         meta.set_label(label)
         objective.init(meta, n)
 
+        self._sync_models()
         k = max(self.num_tree_per_iteration, 1)
         leaf_preds = self.predict(data, pred_leaf=True)  # (n, ntrees)
         scores = np.zeros((n, k), dtype=np.float64)

@@ -87,18 +87,18 @@ class DART(GBDT):
             else:
                 new_w = w * (k / (k + cfg.learning_rate))
             for ki in range(self.num_class):
-                t = self.device_trees[i * self.num_class + ki]
+                idx = i * self.num_class + ki
+                t = self.device_trees[idx]
                 pred_train = self._predict_valid_fn(t, self.grower.bins)
                 self.scores = self.scores.at[ki].add(new_w * pred_train)
                 for vs in self.valid_sets:
                     pv = self._predict_valid_fn(t, vs.bins)
                     vs.scores = vs.scores.at[ki].add((new_w - w) * pv)
-                # keep the host model consistent with the weight change
-                host = self.models[i * self.num_class + ki]
+                # record the weight change; flush_models() bakes the
+                # cumulative scale into the host tree lazily
+                # (_scale_offset skips trees merged from an init_model)
                 scale = new_w / w if w != 0 else 0.0
-                host.leaf_value *= scale
-                host.internal_value *= scale
-                host.shrinkage *= scale
+                self._tree_scale[self._scale_offset + idx] *= scale
             if not cfg.uniform_drop:
                 self.sum_weight -= w * (1.0 / (k + 1.0)
                                         if not cfg.xgboost_dart_mode

@@ -5,10 +5,13 @@ TPU-native re-design of the reference GBDT
 gbdt.cpp:386-481, bagging :234-316, boost_from_average :362-384,
 early stopping :582-639, score updating :528-580).  Scores, gradients
 and the binned matrix live on device for the whole run; one boosting
-iteration is a handful of jitted calls (gradients -> bagging mask ->
-tree growth -> score update) with no host sync.  Host work per
-iteration: pulling the finished tree's small arrays for the model
-(asynchronously) and optional metric printing.
+iteration is ONE jitted call (gradients -> bagging mask -> tree growth
+-> score update -> validation-score update) with no host sync.  Host
+work per iteration is O(1) dispatch only; finished trees stay on device
+and are pulled to host models in a single batched transfer when the
+model is actually needed (flush_models) — on a remote-attached TPU
+every host pull costs a full RPC round trip, so the loop never blocks
+on one.
 """
 from __future__ import annotations
 
@@ -99,11 +102,23 @@ class GBDT:
         self.timer = PhaseTimer()
         self._rng = np.random.RandomState(config.seed)
         self._bag_rng = jax.random.PRNGKey(config.bagging_seed)
+        self._iter_key_rng = np.random.RandomState(config.bagging_seed)
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
         self._grad_fn = jax.jit(self._compute_gradients)
         self._update_train_fn = jax.jit(self._update_train_scores)
         self._predict_valid_fn = jax.jit(self._predict_valid)
         self._eval_cache: Dict[Tuple[int, int], List[float]] = {}
+        # lazily-materialized host models: finished device trees queue in
+        # _pending as (TreeArrays, shrinkage, bias) and are pulled in one
+        # batched transfer by flush_models()
+        self._pending: List[Tuple[TreeArrays, float, float]] = []
+        self._scale_offset = 0   # foreign (init_model) trees precede ours
+        self._tree_scale: List[float] = []    # DART renorm per model idx
+        self._applied_scale: List[float] = []  # scale baked into models[i]
+        self._nl_window: List[jax.Array] = []  # deferred 1-leaf stop checks
+        self._stop_check_every = 8
+        self._fused_step = None
+        self._bag_state: Optional[jax.Array] = None
         # early stopping state per (dataset, metric-output)
         self._best_score: Dict[Tuple[int, int], float] = {}
         self._best_iter: Dict[Tuple[int, int], int] = {}
@@ -164,18 +179,21 @@ class GBDT:
         return counts, self._bag_mask
 
     # ------------------------------------------------------------------
-    def _feature_mask(self) -> jax.Array:
+    def _feature_mask_np(self) -> np.ndarray:
         """Per-tree feature sampling (reference
-        serial_tree_learner.cpp:252-345 BeforeTrain)."""
+        serial_tree_learner.cpp:252-345 BeforeTrain); host-side."""
         f = self.config.feature_fraction
         F = self.grower.num_features
         if f >= 1.0:
-            return jnp.ones(F, dtype=bool)
+            return np.ones(F, dtype=bool)
         used = max(1, int(round(F * f)))
         idx = self._feat_rng.choice(F, size=used, replace=False)
         mask = np.zeros(F, dtype=bool)
         mask[idx] = True
-        return jnp.asarray(mask)
+        return mask
+
+    def _feature_mask(self) -> jax.Array:
+        return jnp.asarray(self._feature_mask_np())
 
     # ------------------------------------------------------------------
     def _update_train_scores(self, scores, leaf_id, leaf_value, class_idx,
@@ -199,46 +217,169 @@ class GBDT:
         """Called after the iteration's trees are in (DART normalizes)."""
 
     def _sample_rows(self, g, h, counts):
-        """Row-sampling hook; GOSS reweights gradients here."""
+        """Row-sampling hook for the custom-gradient path; GOSS
+        reweights gradients here."""
         return g, h, counts
 
+    def _sample_rows_fused(self, g, h, counts, key):
+        """Jit-traceable row-sampling hook (GOSS overrides)."""
+        return g, h, counts
+
+    def _sample_active(self) -> bool:
+        """Whether _sample_rows_fused does anything this iteration
+        (static per compile — GOSS flips it once)."""
+        return False
+
+    # ------------------------------------------------------------------
+    def _use_bagging_fused(self) -> bool:
+        """Whether the fused step draws a bagging mask (GOSS replaces
+        bagging entirely — reference goss.hpp Bagging override)."""
+        cfg = self.config
+        return cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+
+    # ------------------------------------------------------------------
+    def _feature_masks(self) -> jax.Array:
+        """(K, F) per-tree feature sampling masks for one iteration."""
+        if self.config.feature_fraction >= 1.0:
+            if not hasattr(self, "_full_feature_masks"):
+                self._full_feature_masks = jnp.ones(
+                    (self.num_class, self.grower.num_features), bool)
+            return self._full_feature_masks
+        return jnp.asarray(np.stack(
+            [self._feature_mask_np() for _ in range(self.num_class)]))
+
+    # ------------------------------------------------------------------
+    def _build_fused(self):
+        """One boosting iteration as a single jitted program: gradients,
+        bagging draw, K tree growths, train-score and valid-score
+        updates.  The only per-iteration host traffic left is the async
+        dispatch itself."""
+        cfg = self.config
+        use_bag = self._use_bagging_fused()
+        vbins = tuple(vs.bins for vs in self.valid_sets)
+        n_pad = self.grower.n_padded
+        K = self.num_class
+
+        def step(scores, vscores, bag_mask, key, fmask, shrinkage,
+                 fresh_bag, sample_active):
+            g, h = self._compute_gradients(scores)
+            kb, ks = jax.random.split(key)
+            if use_bag:
+                if fresh_bag:
+                    u = jax.random.uniform(kb, (n_pad,))
+                    bag_mask = (u < cfg.bagging_fraction) & \
+                        (self._full_counts > 0)
+                counts = jnp.where(bag_mask, 1.0, 0.0)
+            else:
+                counts = self._full_counts
+            if sample_active:
+                g, h, counts = self._sample_rows_fused(g, h, counts, ks)
+            g, h = self._mask_gradients(g, h, counts)
+            trees = []
+            nl = jnp.int32(1)
+            new_vscores = list(vscores)
+            for k in range(K):
+                tree, leaf_id = self.grower._train_tree_impl(
+                    g[k], h[k], counts, fmask[k])
+                tree = self._finalize_tree(tree, leaf_id, k, scores, counts)
+                # a no-split tree must contribute nothing (the reference
+                # skips UpdateScore when num_leaves==1, gbdt.cpp:427-460)
+                ok = (tree.num_leaves > 1).astype(jnp.float32)
+                tree = tree._replace(leaf_value=tree.leaf_value * ok)
+                lv = tree.leaf_value
+                delta = lv[jnp.clip(leaf_id, 0, lv.shape[0] - 1)]
+                delta = jnp.where(leaf_id >= 0, delta, 0.0) * shrinkage
+                scores = scores.at[k].add(delta)
+                for i, vb in enumerate(vbins):
+                    pv = self._predict_valid(tree, vb)
+                    new_vscores[i] = new_vscores[i].at[k].add(pv * shrinkage)
+                trees.append(tree)
+                nl = jnp.maximum(nl, tree.num_leaves)
+            return (scores, tuple(new_vscores), bag_mask, tuple(trees), nl)
+
+        self._fused_step = jax.jit(
+            step, static_argnames=("fresh_bag", "sample_active"),
+            donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (reference gbdt.cpp:386-481).
         Custom grad/hess (shape (N,) or (N, K)) bypass the objective —
         the LGBM_BoosterUpdateOneIterCustom path."""
+        if grad is not None and hess is not None:
+            return self._train_one_iter_custom(grad, hess)
+        if self.objective is None:
+            Log.fatal("No objective and no custom gradients")
+        self._before_boosting()
+        self.timer.start("tree")
+        if self._fused_step is None:
+            self._build_fused()
+        cfg = self.config
+        use_bag = self._use_bagging_fused()
+        fresh_bag = bool(use_bag and (self._bag_state is None or
+                                      self.iter_ % cfg.bagging_freq == 0))
+        if self._bag_state is None:
+            self._bag_state = self._full_counts > 0
+        key = jax.random.PRNGKey(
+            int(self._iter_key_rng.randint(0, 2**31 - 1)))
+        scores, vscores, bag, trees, nl = self._fused_step(
+            self.scores, tuple(vs.scores for vs in self.valid_sets),
+            self._bag_state, key, self._feature_masks(),
+            jnp.asarray(self.shrinkage_rate, jnp.float32),
+            fresh_bag=fresh_bag, sample_active=self._sample_active())
+        self.scores = scores
+        for vs, s in zip(self.valid_sets, vscores):
+            vs.scores = s
+        self._bag_state = bag
+        bias = self.init_score if (self.iter_ == 0 and
+                                   self.init_score != 0.0) else 0.0
+        for tree in trees:
+            self.device_trees.append(tree)
+            self._pending.append((tree, self.shrinkage_rate, bias))
+            self._tree_scale.append(1.0)
+        self._nl_window.append(nl)
+        self._after_iteration()
+        self.iter_ += 1
+        self.timer.stop("tree")
+        if len(self._nl_window) >= self._stop_check_every:
+            return self._check_stop_window()
+        return False
+
+    # ------------------------------------------------------------------
+    def _train_one_iter_custom(self, grad, hess) -> bool:
+        """Custom-gradient iteration (gradients cross the host boundary
+        every call, like the reference's UpdateOneIterCustom)."""
         self._before_boosting()
         self.timer.start("boosting")
-        if grad is None or hess is None:
-            if self.objective is None:
-                Log.fatal("No objective and no custom gradients")
-            g, h = self._grad_fn(self.scores)
-        else:
-            grad = np.asarray(grad, dtype=np.float32).reshape(
-                self.num_class, self.num_data)
-            hess = np.asarray(hess, dtype=np.float32).reshape(
-                self.num_class, self.num_data)
-            pad = self.grower.n_padded - self.num_data
-            g = jnp.asarray(np.pad(grad, ((0, 0), (0, pad))))
-            h = jnp.asarray(np.pad(hess, ((0, 0), (0, pad))))
-
+        grad = np.asarray(grad, dtype=np.float32).reshape(
+            self.num_class, self.num_data)
+        hess = np.asarray(hess, dtype=np.float32).reshape(
+            self.num_class, self.num_data)
+        pad = self.grower.n_padded - self.num_data
+        g = jnp.asarray(np.pad(grad, ((0, 0), (0, pad))))
+        h = jnp.asarray(np.pad(hess, ((0, 0), (0, pad))))
         self.timer.stop("boosting")
         self.timer.start("bagging")
         counts, bag_mask = self._bagging_counts(self.iter_)
         g, h, counts = self._sample_rows(g, h, counts)
         g, h = self._mask_gradients(g, h, counts)
-        self._last_counts = counts
         self.timer.stop("bagging")
 
-        should_continue = False
+        self.timer.start("tree")
+        bias = self.init_score if (self.iter_ == 0 and
+                                   self.init_score != 0.0) else 0.0
+        nl = jnp.int32(1)
         for k in range(self.num_class):
-            self.timer.start("tree")
             feature_mask = self._feature_mask()
             tree_arrays, leaf_id = self.grower.train_tree(
                 g[k], h[k], counts, feature_mask)
-            tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k)
+            tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k,
+                                              self.scores, counts)
+            ok = (tree_arrays.num_leaves > 1).astype(jnp.float32)
+            tree_arrays = tree_arrays._replace(
+                leaf_value=tree_arrays.leaf_value * ok)
             self.device_trees.append(tree_arrays)
-            # update train scores via the partition shortcut
             self.scores = self._update_train_fn(
                 self.scores, leaf_id, tree_arrays.leaf_value, k,
                 self.shrinkage_rate)
@@ -246,32 +387,79 @@ class GBDT:
                 delta = self._predict_valid_fn(tree_arrays, vs.bins)
                 vs.scores = vs.scores.at[k].add(
                     delta * self.shrinkage_rate)
-            # host model (pull is async until .to_string/.predict)
-            host_tree = Tree.from_grower_arrays(
-                {f: np.asarray(getattr(tree_arrays, f))
-                 for f in tree_arrays._fields}, self.train_set)
-            host_tree.apply_shrinkage(self.shrinkage_rate)
-            if self.iter_ == 0 and self.init_score != 0.0:
-                # fold the init score into the first tree so saved models
-                # and raw predictions carry it (reference gbdt.cpp:452-454
-                # Tree::AddBias)
-                host_tree.leaf_value += self.init_score
-                host_tree.internal_value += self.init_score
-            if host_tree.num_leaves > 1:
-                should_continue = True
-            self.models.append(host_tree)
-            self.timer.stop("tree")
-
-        if not should_continue:
-            Log.warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements.")
-            for _ in range(self.num_class):
-                self.models.pop()
-                self.device_trees.pop()
-            return True
+            self._pending.append((tree_arrays, self.shrinkage_rate, bias))
+            self._tree_scale.append(1.0)
+            nl = jnp.maximum(nl, tree_arrays.num_leaves)
+        self.timer.stop("tree")
+        self._nl_window.append(nl)
         self._after_iteration()
         self.iter_ += 1
+        if len(self._nl_window) >= self._stop_check_every:
+            return self._check_stop_window()
         return False
+
+    # ------------------------------------------------------------------
+    def _check_stop_window(self) -> bool:
+        """Deferred no-split detection: pull the queued per-iteration
+        max-num_leaves scalars in ONE transfer; if some iteration grew
+        no tree, roll back everything after it and stop (the reference
+        checks every iteration — here 1-leaf trees contribute exactly
+        zero score, so late rollback is exact)."""
+        if not self._nl_window:
+            return False
+        vals = np.asarray(jnp.stack(self._nl_window))
+        self._nl_window = []
+        for j, v in enumerate(vals):
+            if int(v) <= 1:
+                overrun = len(vals) - j
+                for _ in range(overrun):
+                    self.rollback_one_iter()
+                Log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements.")
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    def flush_models(self, final: bool = False) -> None:
+        """Materialize queued device trees into host ``self.models`` in
+        one batched device->host transfer, and reconcile DART weight
+        rescales on already-materialized trees.  Only a ``final`` flush
+        consumes the deferred no-split window (popping degenerate tail
+        trees) — mid-training flushes must leave the window for
+        train_one_iter's own stop detection."""
+        if final and self._nl_window:
+            self._check_stop_window()
+        for i, t in enumerate(self.models):
+            if self._applied_scale[i] != self._tree_scale[i]:
+                r = self._tree_scale[i] / self._applied_scale[i]
+                t.leaf_value *= r
+                t.internal_value *= r
+                t.shrinkage *= r
+                self._applied_scale[i] = self._tree_scale[i]
+        if not self._pending:
+            return
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[p[0] for p in self._pending])
+        host = jax.device_get(stacked)
+        pending, self._pending = self._pending, []
+        for i, (_, shrinkage, bias) in enumerate(pending):
+            arrs = {f: np.asarray(getattr(host, f)[i])
+                    for f in host._fields}
+            t = Tree.from_grower_arrays(arrs, self.train_set)
+            t.apply_shrinkage(shrinkage)
+            if bias != 0.0:
+                # fold the init score into the first tree so saved models
+                # and raw predictions carry it (reference gbdt.cpp:452-454)
+                t.leaf_value += bias
+                t.internal_value += bias
+            idx = len(self.models)
+            scale = self._tree_scale[idx]
+            if scale != 1.0:
+                t.leaf_value *= scale
+                t.internal_value *= scale
+                t.shrinkage *= scale
+            self.models.append(t)
+            self._applied_scale.append(scale)
 
     # ------------------------------------------------------------------
     def _mask_gradients(self, g, h, counts):
@@ -282,18 +470,19 @@ class GBDT:
         return g * mask[None, :], h * mask[None, :]
 
     # ------------------------------------------------------------------
-    def _finalize_tree(self, tree_arrays: TreeArrays, leaf_id, class_idx
-                       ) -> TreeArrays:
+    def _finalize_tree(self, tree_arrays: TreeArrays, leaf_id, class_idx,
+                       scores, counts) -> TreeArrays:
         """Objective-specific leaf refitting hook (RenewTreeOutput,
-        reference serial_tree_learner.cpp:776-806).  Percentile-based
-        refits land with the device segment-percentile op."""
+        reference serial_tree_learner.cpp:776-806).  Pure/jittable:
+        ``scores`` are the pre-update scores, ``counts`` the bag mask."""
         if self.objective is not None and \
                 self.objective.is_renew_tree_output:
             tree_arrays = self._renew_tree_output(tree_arrays, leaf_id,
-                                                  class_idx)
+                                                  class_idx, scores, counts)
         return tree_arrays
 
-    def _renew_tree_output(self, tree_arrays, leaf_id, class_idx):
+    def _renew_tree_output(self, tree_arrays, leaf_id, class_idx,
+                           scores, counts):
         """Re-fit leaf outputs to the objective's percentile (L1-family
         objectives; reference regression_objective.hpp RenewTreeOutput).
         Device: lexicographic sort by (leaf, residual) then per-leaf
@@ -301,7 +490,7 @@ class GBDT:
         from ..ops.percentile import leaf_percentiles
         n = self.num_data
         obj = self.objective
-        pred = self.scores[class_idx, :n]
+        pred = scores[class_idx, :n]
         label = obj._label_dev
         residual = label - pred
         alpha = obj.renew_alpha
@@ -313,7 +502,7 @@ class GBDT:
             w = None
         # restrict to in-bag rows (reference passes bag_data_indices,
         # gbdt.cpp:446-447): out-of-bag rows get leaf -1 and are ignored
-        lid = jnp.where(self._last_counts[:n] > 0, leaf_id[:n], -1)
+        lid = jnp.where(counts[:n] > 0, leaf_id[:n], -1)
         L = self.config.num_leaves
         new_values = leaf_percentiles(residual, lid, L, alpha, w)
         ok = tree_arrays.leaf_count > 0
@@ -373,21 +562,27 @@ class GBDT:
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """reference gbdt.cpp:483-499."""
-        if len(self.models) < self.num_class:
+        if self.num_trees < self.num_class:
             return
         for k in reversed(range(self.num_class)):
             tree_arrays = self.device_trees.pop()
-            self.models.pop()
+            if self._pending:
+                _, shrinkage, _ = self._pending.pop()
+            else:
+                self.models.pop()
+                self._applied_scale.pop()
+                shrinkage = self.shrinkage_rate
+            self._tree_scale.pop()
             self.scores = self.scores.at[k].add(
-                -self.shrinkage_rate * self._predict_valid_fn(
+                -shrinkage * self._predict_valid_fn(
                     tree_arrays, self.grower.bins))
             for vs in self.valid_sets:
                 vs.scores = vs.scores.at[k].add(
-                    -self.shrinkage_rate * self._predict_valid_fn(
+                    -shrinkage * self._predict_valid_fn(
                         tree_arrays, vs.bins))
         self.iter_ -= 1
 
     # ------------------------------------------------------------------
     @property
     def num_trees(self) -> int:
-        return len(self.models)
+        return len(self.models) + len(self._pending)

@@ -27,13 +27,22 @@ class GOSS(GBDT):
         # GOSS replaces bagging entirely (reference goss.hpp Bagging)
         return self._full_counts, None
 
+    def _use_bagging_fused(self) -> bool:
+        return False
+
     def _sample_rows(self, g, h, counts):
         # no subsampling for the first 1/learning_rate iterations
         # (reference goss.hpp:138-140)
-        if self.iter_ < int(1.0 / self.config.learning_rate):
+        if not self._sample_active():
             return g, h, counts
         self._goss_key, sub = jax.random.split(self._goss_key)
         return self._goss_fn(g, h, counts, sub)
+
+    def _sample_active(self) -> bool:
+        return self.iter_ >= int(1.0 / self.config.learning_rate)
+
+    def _sample_rows_fused(self, g, h, counts, key):
+        return self._goss_sample(g, h, counts, key)
 
     def _goss_sample(self, g, h, counts, key):
         n_real = self.num_data

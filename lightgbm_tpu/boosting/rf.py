@@ -43,14 +43,14 @@ class RF(GBDT):
             return super().train_one_iter(grad, hess)
 
         counts, _ = self._bagging_counts(self.iter_)
-        self._last_counts = counts
         g, h = self._mask_gradients(g, h, counts)
 
         for k in range(self.num_class):
             feature_mask = self._feature_mask()
             tree_arrays, leaf_id = self.grower.train_tree(
                 g[k], h[k], counts, feature_mask)
-            tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k)
+            tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k,
+                                              self.scores, counts)
             # convert leaf outputs (reference rf.hpp ConvertTreeOutput)
             conv = self.objective.convert_output(tree_arrays.leaf_value)
             tree_arrays = tree_arrays._replace(leaf_value=conv)
@@ -63,10 +63,8 @@ class RF(GBDT):
             for vs in self.valid_sets:
                 pv = self._predict_valid_fn(tree_arrays, vs.bins)
                 vs.scores = (vs.scores * t).at[k].add(pv) / (t + 1.0)
-            host_tree = Tree.from_grower_arrays(
-                {f: np.asarray(getattr(tree_arrays, f))
-                 for f in tree_arrays._fields}, self.train_set)
-            self.models.append(host_tree)
+            self._pending.append((tree_arrays, 1.0, 0.0))
+            self._tree_scale.append(1.0)
         self.iter_ += 1
         return False
 

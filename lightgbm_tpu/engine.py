@@ -130,6 +130,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             break
     if not stopped_early:
         booster.best_iteration = -1
+    if booster.gbdt is not None:
+        booster.gbdt.flush_models(final=True)
     if booster.gbdt is not None and booster.gbdt.timer.acc:
         Log.debug("training phase timings: "
                   + booster.gbdt.timer.report())

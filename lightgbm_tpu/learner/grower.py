@@ -72,6 +72,7 @@ class GrowerState(NamedTuple):
     leaf_min_c: jax.Array
     leaf_max_c: jax.Array
     leaf_is_left: jax.Array      # (L,) bool — side under its parent
+    leaf_forced: jax.Array       # (L,) int32 forced-split spec idx (-1 none)
     tree: TreeArrays
 
 
@@ -133,6 +134,13 @@ class TreeGrower:
         # no leaf splits)
         self.max_rounds = config.num_leaves - 1
 
+        # forced splits (reference serial_tree_learner.cpp:543-698
+        # ForceSplits): JSON tree flattened to spec arrays; leaves carry
+        # a spec index through growth and split at the forced
+        # (feature, threshold) with top priority before gain ordering
+        self.forced_count = 0
+        self._load_forced_splits(dataset, config)
+
         # pad rows to a histogram-chunk multiple once, host-side
         n = dataset.num_data
         from ..ops.histogram import _pick_chunk
@@ -150,6 +158,56 @@ class TreeGrower:
         self._row_valid = self.policy.place_rows(
             np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
         self._train_tree = jax.jit(self._train_tree_impl)
+
+    # ------------------------------------------------------------------
+    def _load_forced_splits(self, dataset: Dataset, config: Config) -> None:
+        """Parse forcedsplits_filename into flat device spec arrays:
+        feature (inner idx), threshold (bin), left/right child spec
+        index.  Real-valued thresholds convert through the feature's
+        BinMapper (the reference's Dataset::BinThreshold)."""
+        fn = getattr(config, "forcedsplits_filename", "")
+        if not fn:
+            return
+        import json as _json
+        from ..utils.log import Log
+        with open(fn) as f:
+            spec = _json.load(f)
+        if not spec:
+            return
+        if config.tree_learner == "voting":
+            Log.warning("forced splits are not supported with "
+                        "tree_learner=voting; ignoring %s" % fn)
+            return
+        real2inner = {f.feature_idx: j
+                      for j, f in enumerate(dataset.features)}
+        nodes: list = []
+
+        def rec(node) -> int:
+            real_f = int(node["feature"])
+            j = real2inner.get(real_f)
+            if j is None:
+                Log.warning("forced split on unused feature %d ignored"
+                            % real_f)
+                return -1
+            mapper = dataset.features[j].mapper
+            thr_bin = int(np.asarray(mapper.value_to_bin(
+                np.array([float(node["threshold"])]))).ravel()[0])
+            idx = len(nodes)
+            nodes.append([j, thr_bin, -1, -1])
+            if isinstance(node.get("left"), dict):
+                nodes[idx][2] = rec(node["left"])
+            if isinstance(node.get("right"), dict):
+                nodes[idx][3] = rec(node["right"])
+            return idx
+
+        if rec(spec) < 0:
+            return
+        arr = np.asarray(nodes, dtype=np.int32)
+        self.forced_count = len(nodes)
+        self.forced_feature = jnp.asarray(arr[:, 0])
+        self.forced_thr = jnp.asarray(arr[:, 1])
+        self.forced_left = jnp.asarray(arr[:, 2])
+        self.forced_right = jnp.asarray(arr[:, 3])
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -218,6 +276,9 @@ class TreeGrower:
             node_left=jnp.zeros(M, jnp.int32),
             node_right=jnp.zeros(M, jnp.int32),
         )
+        leaf_forced = jnp.full(L, -1, jnp.int32)
+        if self.forced_count:
+            leaf_forced = leaf_forced.at[0].set(0)
         return GrowerState(
             leaf_id=leaf_id, num_leaves=jnp.int32(1),
             round_idx=jnp.int32(0), done=jnp.bool_(False),
@@ -226,6 +287,7 @@ class TreeGrower:
             leaf_min_c=jnp.full(L, -jnp.inf, jnp.float32),
             leaf_max_c=jnp.full(L, jnp.inf, jnp.float32),
             leaf_is_left=jnp.zeros(L, bool),
+            leaf_forced=leaf_forced,
             tree=tree)
 
     # ------------------------------------------------------------------
@@ -381,16 +443,42 @@ class TreeGrower:
 
         # 3. per-leaf best feature & candidate selection
         best_fc = jnp.argmax(gains, axis=1).astype(jnp.int32)  # (L,)
-        best_f = best_fc if sel is None else sel[best_fc]
         best_gain = jnp.take_along_axis(gains, best_fc[:, None],
                                         axis=1)[:, 0]
+
+        # forced-split override: evaluate the leaf's forced
+        # (feature, threshold) from the histogram and take it with top
+        # priority regardless of gain ordering (ForceSplits semantics)
+        forced_valid = None
+        if self.forced_count:
+            from ..ops.split import gather_split_at_threshold
+            s_node = jnp.clip(st.leaf_forced, 0, self.forced_count - 1)
+            ff = self.forced_feature[s_node]            # (L,)
+            ft = self.forced_thr[s_node]
+            hist_ff = jnp.take_along_axis(
+                hist, ff[:, None, None, None], axis=1)[:, 0]   # (L, B, 3)
+            (fgain, flg, flh, flc, flo, fro, fdl) = \
+                gather_split_at_threshold(
+                    hist_ff, ft, st.leaf_sum_grad, st.leaf_sum_hess,
+                    st.leaf_count, self.f_num_bin[ff], self.f_missing[ff],
+                    self.f_default_bin[ff], self.f_is_cat[ff], cfg)
+            forced_valid = (st.leaf_forced >= 0) & (fgain > NEG_INF)
+            best_fc = jnp.where(forced_valid, ff, best_fc)
+            best_gain = jnp.where(forced_valid, fgain, best_gain)
+
+        best_f = best_fc if sel is None else sel[best_fc]
         slot = jnp.arange(L, dtype=jnp.int32)
         active = slot < st.num_leaves
         depth_ok = (self.max_depth <= 0) | \
             (st.tree.leaf_depth < self.max_depth)
         cand = active & depth_ok & (best_gain > 0.0)
+        if forced_valid is not None:
+            forced_valid = forced_valid & active
+            cand = cand | forced_valid
 
         key = jnp.where(cand, best_gain, NEG_INF)
+        if forced_valid is not None:
+            key = jnp.where(forced_valid, jnp.inf, key)
         order = jnp.argsort(-key)                   # best first, stable
         rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
         budget = L - st.num_leaves
@@ -413,6 +501,15 @@ class TreeGrower:
         lout = at_leaf(res.left_output)
         rout = at_leaf(res.right_output)
         cat_dir = at_leaf(res.cat_dir)
+        if forced_valid is not None:
+            thr = jnp.where(forced_valid, ft, thr)
+            dleft = jnp.where(forced_valid, fdl, dleft)
+            lsg = jnp.where(forced_valid, flg, lsg)
+            lsh = jnp.where(forced_valid, flh, lsh)
+            lsc = jnp.where(forced_valid, flc, lsc)
+            lout = jnp.where(forced_valid, flo, lout)
+            rout = jnp.where(forced_valid, fro, rout)
+            cat_dir = jnp.where(forced_valid, 0, cat_dir)
         f_is_cat_leaf = self.f_is_cat[best_f]
         f_missing_leaf = self.f_missing[best_f]
         f_dbin_leaf = self.f_default_bin[best_f]
@@ -501,6 +598,17 @@ class TreeGrower:
         leaf_is_left = upd(st.leaf_is_left,
                            jnp.ones(L, bool), jnp.zeros(L, bool))
 
+        # forced-split inheritance: children of a forced split receive
+        # the spec's left/right sub-nodes; any other split clears it
+        if forced_valid is not None:
+            s_node2 = jnp.clip(st.leaf_forced, 0, self.forced_count - 1)
+            fap = do_split & forced_valid
+            lf_left = jnp.where(fap, self.forced_left[s_node2], -1)
+            lf_right = jnp.where(fap, self.forced_right[s_node2], -1)
+            leaf_forced = upd(st.leaf_forced, lf_left, lf_right)
+        else:
+            leaf_forced = st.leaf_forced
+
         # 6. row re-labeling
         g2f_leaf = self.g2f_lut[best_f]               # (L, GB)
         leaf_id = apply_splits(
@@ -516,4 +624,4 @@ class TreeGrower:
             done=done, leaf_sum_grad=leaf_sum_grad,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
             leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c,
-            leaf_is_left=leaf_is_left, tree=tree)
+            leaf_is_left=leaf_is_left, leaf_forced=leaf_forced, tree=tree)

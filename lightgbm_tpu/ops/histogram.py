@@ -27,15 +27,17 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
 
 
 def _pick_chunk(n: int, num_groups: int, max_group_bin: int,
                 itemsize: int, target_bytes: int = 1 << 26) -> int:
     """Row-chunk size bounding the materialized one-hot to ~64 MB."""
     per_row = max(num_groups * max_group_bin * itemsize, 1)
-    chunk = max(256, min(n, target_bytes // per_row))
-    # round to a multiple of 256 for clean tiling
-    return int(max(256, (chunk // 256) * 256))
+    chunk = max(1024, min(n, target_bytes // per_row))
+    # round to a multiple of 1024 for clean tiling (and so the Pallas
+    # kernel's 512-row blocks divide the padded row count)
+    return int(max(1024, (chunk // 1024) * 1024))
 
 
 @functools.partial(
@@ -97,6 +99,84 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
     acc, _ = jax.lax.scan(body, init, xs)
     # (3L, G, B) -> (L, G, B, 3)
     hist = acc.reshape(num_leaves, 3, num_groups, max_group_bin)
+    return jnp.transpose(hist, (0, 2, 3, 1))
+
+
+def _hist_kernel_body(bins_ref, w_ref, leaf_ref, out_ref, *, num_leaves,
+                      max_group_bin):
+    """Pallas TPU kernel: one row-block's histogram contribution.
+
+    The analog of the OpenCL workgroup kernel
+    (reference src/treelearner/ocl/histogram256.cl:345-824), redesigned
+    for the MXU: both one-hot operands are generated in VMEM/registers
+    (never touching HBM — the XLA fallback materializes them) and the
+    (3L, G*B) accumulator lives in VMEM across the whole grid, so HBM
+    traffic is just the packed bin matrix + weights, ~17 bytes/row.
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    c = bins_ref.shape[0]
+    num_groups = bins_ref.shape[1]
+    l3 = 3 * num_leaves
+    b = max_group_bin
+
+    leaf = leaf_ref[:]                                   # (C, 1) int32
+    w = w_ref[:]                                         # (C, 3) f32
+    col = jax.lax.broadcasted_iota(jnp.int32, (c, l3), 1)
+    l_of = col // 3
+    c_of = col % 3
+    wv = jnp.where(c_of == 0, w[:, 0:1],
+                   jnp.where(c_of == 1, w[:, 1:2], w[:, 2:3]))
+    lhs = jnp.where(leaf == l_of, wv, 0.0).astype(jnp.bfloat16)
+
+    binb = bins_ref[:].astype(jnp.int32)                 # (C, G)
+    rep = jnp.broadcast_to(binb[:, :, None],
+                           (c, num_groups, b)).reshape(c, num_groups * b)
+    bcol = jax.lax.broadcasted_iota(jnp.int32, (c, num_groups * b), 1) % b
+    ohb = (rep == bcol).astype(jnp.bfloat16)
+    out_ref[:] += jax.lax.dot_general(
+        lhs, ohb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "max_group_bin", "block", "interpret"))
+def compute_group_histograms_pallas(bins: jax.Array, grad: jax.Array,
+                                    hess: jax.Array, counts: jax.Array,
+                                    leaf_id: jax.Array, *, num_leaves: int,
+                                    max_group_bin: int, block: int = 512,
+                                    interpret: bool = False) -> jax.Array:
+    """Pallas-kernel histogram with the same contract as
+    :func:`compute_group_histograms` (N must be a multiple of
+    ``block``).  Single-device only — the distributed learners keep the
+    XLA formulation so GSPMD can insert the reduce-scatter."""
+    from jax.experimental import pallas as pl_mod  # noqa: F401
+    n, num_groups = bins.shape
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    w = jnp.stack([grad, hess, counts], axis=1).astype(jnp.float32)
+    kern = functools.partial(_hist_kernel_body, num_leaves=num_leaves,
+                             max_group_bin=max_group_bin)
+    out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, num_groups), lambda i: (i, 0)),
+            pl.BlockSpec((block, 3), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((3 * num_leaves, num_groups * max_group_bin),
+                               lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (3 * num_leaves, num_groups * max_group_bin), jnp.float32),
+        interpret=interpret,
+    )(bins, w, leaf_id[:, None])
+    hist = out.reshape(num_leaves, 3, num_groups, max_group_bin)
     return jnp.transpose(hist, (0, 2, 3, 1))
 
 

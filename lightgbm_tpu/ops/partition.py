@@ -18,6 +18,12 @@ min_bin/max_bin/bias adjustments vanish):
   * Categorical: ``cat_mask[bin]`` decides (bundle/out-of-range rows
     resolve through the group->feature-bin LUT to the default bin,
     reproducing the FindInBitset(default_bin) routing).
+
+Implementation note: arbitrary per-row gathers are slow on TPU, so the
+routing decision is evaluated ONCE per (leaf, group-bin) into a tiny
+``(L, GB)`` boolean table, which is then broadcast to rows with a
+leaf-one-hot matmul on the MXU — rows never index anything
+data-dependently.
 """
 from __future__ import annotations
 
@@ -53,36 +59,60 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
 
     Returns: updated (N,) leaf_id (left child keeps the parent slot).
     """
-    n = bins.shape[0]
-    gb_dim = g2f_lut.shape[1]
-    l = leaf_id
-    safe_l = jnp.clip(l, 0, split_mask.shape[0] - 1)
-    active = (l >= 0) & split_mask[safe_l]
+    n, num_groups = bins.shape
+    L, gb_dim = g2f_lut.shape
+    b_dim = cat_mask.shape[1]
 
-    grp = feat_group[safe_l]                                    # (N,)
-    gb = jnp.take_along_axis(bins, grp[:, None].astype(jnp.int32),
-                             axis=1)[:, 0].astype(jnp.int32)    # (N,)
-    fb = g2f_lut.reshape(-1)[safe_l * gb_dim + gb]              # (N,)
-
-    thr = threshold[safe_l]
-    dleft = default_left[safe_l]
-    mtype = missing_type[safe_l]
-    dbin = default_bin[safe_l]
-    nb = num_bin[safe_l]
-    cat = is_cat[safe_l]
-
-    is_nan_bin = fb == (nb - 1)
-    is_def_bin = fb == dbin
-    cmp_left = fb <= thr
-
+    # ---- per-(leaf, group-bin) decision table: tiny (L, GB) ops ----
+    fb = g2f_lut                                    # (L, GB) feature bins
+    is_nan_bin = fb == (num_bin[:, None] - 1)
+    is_def_bin = fb == default_bin[:, None]
+    cmp_left = fb <= threshold[:, None]
+    dleft = default_left[:, None]
+    mtype = missing_type[:, None]
     num_left = jnp.where(
         (mtype == MISSING_NAN) & is_nan_bin, dleft,
         jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
+    cat_left = jnp.take_along_axis(cat_mask, jnp.clip(fb, 0, b_dim - 1),
+                                   axis=1)          # (L, GB)
+    decision = jnp.where(is_cat[:, None], cat_left, num_left)
 
-    b_dim = cat_mask.shape[1]
-    cat_left = cat_mask.reshape(-1)[safe_l * b_dim
-                                    + jnp.clip(fb, 0, b_dim - 1)]
-    go_left = jnp.where(cat, cat_left, num_left)
+    # ---- broadcast per-leaf data to rows with ONE (N,L)@(L,GB+5) dot ----
+    # TPU matmuls run bf16 operand passes at default precision, so
+    # integer columns are split into hi/lo halves (< 256 each, exact in
+    # bf16); the one-hot picks exactly one term, so sums stay exact.
+    def _hi_lo(v):
+        v = v.astype(jnp.int32)
+        return ((v // 256).astype(jnp.float32)[:, None],
+                (v % 256).astype(jnp.float32)[:, None])
 
-    new_id = jnp.where(go_left, l, right_slot[safe_l])
-    return jnp.where(active, new_id, l).astype(jnp.int32)
+    fg_hi, fg_lo = _hi_lo(feat_group)
+    rs_hi, rs_lo = _hi_lo(right_slot)
+    table = jnp.concatenate([
+        decision.astype(jnp.float32),
+        fg_hi, fg_lo, rs_hi, rs_lo,
+        split_mask.astype(jnp.float32)[:, None],
+    ], axis=1)                                      # (L, GB+5)
+    safe_l = jnp.clip(leaf_id, 0, L - 1)
+    ohl = (safe_l[:, None]
+           == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.float32)
+    rows = jnp.dot(ohl, table, preferred_element_type=jnp.float32)
+    d_rows = rows[:, :gb_dim]                       # (N, GB)
+
+    def _from_hi_lo(hi, lo):
+        return (hi.astype(jnp.int32) * 256 + lo.astype(jnp.int32))
+
+    grp_row = _from_hi_lo(rows[:, gb_dim], rows[:, gb_dim + 1])
+    rs_row = _from_hi_lo(rows[:, gb_dim + 2], rows[:, gb_dim + 3])
+    active = (rows[:, gb_dim + 4] > 0.5) & (leaf_id >= 0)
+
+    # chosen-group bin per row, then its decision — masked sums instead
+    # of gathers (G and GB are small)
+    gsel = grp_row[:, None] == jnp.arange(num_groups,
+                                          dtype=jnp.int32)[None, :]
+    gb = jnp.sum(jnp.where(gsel, bins.astype(jnp.int32), 0), axis=1)
+    bsel = gb[:, None] == jnp.arange(gb_dim, dtype=jnp.int32)[None, :]
+    go_left = jnp.sum(jnp.where(bsel, d_rows, 0.0), axis=1) > 0.5
+
+    new_id = jnp.where(go_left, leaf_id, rs_row)
+    return jnp.where(active, new_id, leaf_id).astype(jnp.int32)

@@ -402,6 +402,83 @@ def find_categorical_splits(hist: jax.Array, sum_grad: jax.Array,
         cat_dir=cat_dir)
 
 
+def gather_split_at_threshold(hist_f: jax.Array, threshold: jax.Array,
+                              sum_grad: jax.Array, sum_hess: jax.Array,
+                              num_data: jax.Array, num_bin: jax.Array,
+                              missing_type: jax.Array, default_bin: jax.Array,
+                              is_cat: jax.Array,
+                              cfg: Dict[str, float]):
+    """Split info at a GIVEN (feature, threshold) per leaf — the forced
+    -split evaluation (reference feature_histogram.hpp:273-413
+    GatherInfoForThresholdNumerical/Categorical).
+
+    Numerical semantics follow the reference: missing always rides left
+    (``default_left=True``), the right side accumulates bins
+    ``> threshold`` skipping the default bin for Zero-missing and the
+    NaN bin for NaN-missing; gain not exceeding ``min_gain_shift``
+    yields -inf (the forced split is then aborted).  Categorical forced
+    splits are one-hot at the threshold bin.
+
+    Args:
+      hist_f: (L, B, 3) histograms of each leaf's FORCED feature.
+      threshold: (L,) int32 bin threshold (categorical: the bin).
+      sum_grad/sum_hess/num_data: (L,) leaf totals (sum_hess raw).
+      num_bin/missing_type/default_bin/is_cat: (L,) forced-feature meta.
+
+    Returns: (gain, left_sum_grad, left_sum_hess(+eps removed),
+              left_count, left_output, right_output, default_left) —
+      all (L,); gain already has min_gain_shift subtracted.
+    """
+    L, B, _ = hist_f.shape
+    l1 = cfg["lambda_l1"]
+    l2 = cfg["lambda_l2"]
+    mds = cfg["max_delta_step"]
+    min_gain = cfg["min_gain_to_split"]
+
+    total_h = sum_hess + 2 * K_EPSILON
+    gain_shift = leaf_split_gain(sum_grad, total_h, l1, l2, mds)
+    min_gain_shift = gain_shift + min_gain
+
+    bins = jnp.arange(B, dtype=jnp.int32)
+    h_g, h_h, h_c = hist_f[..., 0], hist_f[..., 1], hist_f[..., 2]
+
+    # ---- numerical: right side = bins > threshold, minus skips ----
+    m_zero = missing_type == MISSING_ZERO
+    skip = jnp.where(m_zero[:, None], bins[None, :] == default_bin[:, None],
+                     bins[None, :] == (num_bin - 1)[:, None])
+    right_sel = (bins[None, :] > threshold[:, None]) \
+        & (bins[None, :] <= (num_bin - 1)[:, None]) & ~skip
+    rg = jnp.sum(h_g * right_sel, axis=1)
+    rh = jnp.sum(h_h * right_sel, axis=1) + K_EPSILON
+    rc = jnp.sum(h_c * right_sel, axis=1)
+    n_lg = sum_grad - rg
+    n_lh = total_h - rh
+    n_lc = num_data - rc
+
+    # ---- categorical one-hot at the threshold bin ----
+    onehot = bins[None, :] == threshold[:, None]
+    c_lg = jnp.sum(h_g * onehot, axis=1)
+    c_lh = jnp.sum(h_h * onehot, axis=1) + K_EPSILON
+    c_lc = jnp.sum(h_c * onehot, axis=1)
+    is_full = missing_type == MISSING_NONE
+    used_bin = num_bin - 1 + is_full.astype(jnp.int32)
+    cat_ok = threshold < used_bin
+
+    lg = jnp.where(is_cat, c_lg, n_lg)
+    lh = jnp.where(is_cat, c_lh, n_lh)
+    lc = jnp.where(is_cat, c_lc, n_lc)
+    rg2 = sum_grad - lg
+    rh2 = total_h - lh
+    gain = (leaf_split_gain(lg, lh, l1, l2, mds)
+            + leaf_split_gain(rg2, rh2, l1, l2, mds))
+    ok = (gain > min_gain_shift) & ~jnp.isnan(gain) \
+        & (~is_cat | cat_ok)
+    gain = jnp.where(ok, gain - min_gain_shift, K_MIN_SCORE)
+    left_out = calculate_leaf_output(lg, lh, l1, l2, mds)
+    right_out = calculate_leaf_output(rg2, rh2, l1, l2, mds)
+    return (gain, lg, lh - K_EPSILON, lc, left_out, right_out, ~is_cat)
+
+
 def _shift_used(arr, n_used):
     """Reverse the first n_used entries of each (l, f) row so a forward
     prefix scan over the result walks the sorted order from the back

@@ -109,6 +109,8 @@ def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
         raise ImportError("You must install graphviz to plot tree")
     if hasattr(booster, "booster_"):
         booster = booster.booster_
+    if getattr(booster, 'gbdt', None) is not None:
+        booster._sync_models()
     if tree_index >= len(booster.models):
         raise IndexError("tree_index is out of range")
     tree = booster.models[tree_index]

@@ -1,0 +1,74 @@
+"""Forced splits (reference serial_tree_learner.cpp:543-698 ForceSplits,
+examples in docs/Parameters.rst forcedsplits_filename)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_data(n=600, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3)
+    # feature 1 is by far the best split; feature 0 is weak
+    y = (X[:, 1] > 0).astype(float) * 2.0 + 0.1 * (X[:, 0] > 0.5)
+    return X, y
+
+
+def test_forced_root_split(tmp_path):
+    X, y = _make_data()
+    spec = {"feature": 0, "threshold": 0.5,
+            "left": {"feature": 2, "threshold": -0.25}}
+    fn = str(tmp_path / "forced.json")
+    with open(fn, "w") as f:
+        json.dump(spec, f)
+
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "min_data_in_leaf": 5, "verbose": -1,
+                     "forcedsplits_filename": fn},
+                    lgb.Dataset(X, label=y), 3)
+    dump = bst.dump_model()
+    root = dump["tree_info"][0]["tree_structure"]
+    assert root["split_feature"] == 0
+    assert abs(root["threshold"] - 0.5) < 0.3
+    # the root's LEFT child must be forced on feature 2
+    left = root["left_child"]
+    assert left["split_feature"] == 2
+    assert abs(left["threshold"] - (-0.25)) < 0.3
+    # without forcing, the root split would be feature 1
+    bst2 = lgb.train({"objective": "regression", "num_leaves": 8,
+                      "min_data_in_leaf": 5, "verbose": -1},
+                     lgb.Dataset(X, label=y), 3)
+    root2 = bst2.dump_model()["tree_info"][0]["tree_structure"]
+    assert root2["split_feature"] == 1
+    # forced model must still fit the dominant signal eventually
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < np.var(y)
+
+
+def test_forced_split_unused_feature_ignored(tmp_path):
+    X, y = _make_data()
+    X[:, 2] = 7.0      # constant -> dropped from training
+    fn = str(tmp_path / "forced.json")
+    with open(fn, "w") as f:
+        json.dump({"feature": 2, "threshold": 1.0}, f)
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "verbose": -1, "forcedsplits_filename": fn},
+                    lgb.Dataset(X, label=y), 2)
+    root = bst.dump_model()["tree_info"][0]["tree_structure"]
+    assert root["split_feature"] == 1   # normal growth
+
+
+def test_forced_split_bad_gain_falls_back(tmp_path):
+    X, y = _make_data()
+    fn = str(tmp_path / "forced.json")
+    # threshold far outside the data range -> empty side, gain invalid
+    with open(fn, "w") as f:
+        json.dump({"feature": 0, "threshold": 1e9}, f)
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "verbose": -1, "forcedsplits_filename": fn},
+                    lgb.Dataset(X, label=y), 2)
+    root = bst.dump_model()["tree_info"][0]["tree_structure"]
+    assert root["split_feature"] == 1   # fell back to the best split

@@ -1,0 +1,31 @@
+"""Pallas histogram kernel vs XLA formulation parity (the analog of the
+reference's GPU_DEBUG_COMPARE CPU-vs-GPU histogram comparator,
+gpu_tree_learner.cpp:1020-1044)."""
+import numpy as np
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import (compute_group_histograms,
+                                        compute_group_histograms_pallas)
+
+
+def test_pallas_kernel_matches_einsum_interpret():
+    rng = np.random.RandomState(0)
+    N, G, B, L = 2048, 5, 16, 7
+    bins = jnp.asarray(rng.randint(0, B, (N, G)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray(np.abs(rng.randn(N)).astype(np.float32))
+    cnt = jnp.asarray((rng.rand(N) > 0.3).astype(np.float32))
+    leaf = jnp.asarray(rng.randint(-1, L, N).astype(np.int32))
+    ref = compute_group_histograms(bins, grad, hess, cnt, leaf,
+                                   num_leaves=L, max_group_bin=B,
+                                   chunk=1024)
+    out = compute_group_histograms_pallas(bins, grad, hess, cnt, leaf,
+                                          num_leaves=L, max_group_bin=B,
+                                          block=512, interpret=True)
+    # the kernel uses bf16 operands (same as XLA's default TPU matmul
+    # precision) with f32 accumulation — tolerance covers the operand
+    # rounding
+    scale = float(jnp.max(jnp.abs(ref))) + 1.0
+    assert float(jnp.max(jnp.abs(ref - out))) / scale < 5e-3
+    # count channel is exact (integers are bf16-exact here)
+    assert float(jnp.max(jnp.abs(ref[..., 2] - out[..., 2]))) == 0.0

@@ -34,7 +34,6 @@ def make_data(n, f, seed=7):
 
 
 def main():
-    import jax
     import lightgbm_tpu as lgb
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
@@ -52,17 +51,23 @@ def main():
     core = lgb.Dataset(X, label=y).construct(cfg)
     prep_s = time.time() - t0
 
+    def drain():
+        # jax.block_until_ready is not a reliable barrier on the
+        # remote-attached (axon) TPU platform — force a device->host
+        # read that depends on the full score state instead.
+        np.asarray(gbdt.scores[:, :8])
+
     gbdt = GBDT(cfg, core)
     # warmup: compile
     t0 = time.time()
     gbdt.train_one_iter()
-    jax.block_until_ready(gbdt.scores)
+    drain()
     compile_s = time.time() - t0
 
     t0 = time.time()
     for _ in range(BENCH_ITERS - 1):
         gbdt.train_one_iter()
-    jax.block_until_ready(gbdt.scores)
+    drain()
     train_s = time.time() - t0
     per_tree = train_s / (BENCH_ITERS - 1)
     total_equiv = per_tree * BENCH_ITERS

@@ -280,6 +280,9 @@ class Config:
     # opt in for benchmarks, keep float32 for reference parity)
     row_chunk: int = 65536          # rows per histogram-scan chunk
     growth_policy: str = "leafwise"  # leafwise (gain-budgeted frontier) | depthwise
+    frontier_width: int = 0         # max splits applied per frontier round
+    # (0 = auto: min(128, num_leaves-1) — one 128-lane MXU strip)
+    hist_kernel: str = "auto"       # auto | pallas | xla histogram path
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
     deterministic: bool = False

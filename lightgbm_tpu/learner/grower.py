@@ -4,26 +4,33 @@ Re-design of SerialTreeLearner's leaf-wise loop
 (reference: src/treelearner/serial_tree_learner.cpp:156-220 Train,
 :700-774 Split) for XLA's static-shape world.  One jitted function grows
 a whole tree: a ``lax.while_loop`` over frontier rounds where each round
-  1. builds histograms for EVERY active leaf in one MXU pass
-     (ops/histogram.py — replaces the smaller/larger-leaf scheduling and
-     histogram pool),
-  2. scores every (leaf, feature, threshold) candidate at once
-     (ops/split.py),
-  3. splits the top-gain leaves within the remaining leaf budget —
-     gain-ordered, so leaf slot/node numbering matches the reference's
-     sequential best-first allocation whenever the budget doesn't bind,
-  4. re-labels rows (ops/partition.py).
+  1. splits every leaf whose CACHED best candidate clears the gain bar
+     (gain-ordered within the remaining leaf budget, so slot/node
+     numbering matches the reference's sequential best-first allocation
+     whenever the budget doesn't bind),
+  2. re-labels rows (ops/partition.py),
+  3. builds histograms ONLY for the newly created right children in one
+     MXU pass (ops/histogram.py, frontier-restricted), and derives each
+     left child as parent-minus-right — the reference's histogram
+     subtraction trick (serial_tree_learner.cpp:505-507) with the roles
+     of the histogram pool played by a fixed (L, G, B, 3) HBM cache,
+  4. runs the split finder only on the 2*W new leaves and caches their
+     best candidates (the best_split_per_leaf_ analog).
 Zero host round-trips inside a tree; the boosting loop stays on device
 too and only syncs for metric printing/early stopping.
 
 Tree state is a fixed-size struct of arrays (the reference's Tree,
 include/LightGBM/tree.h:352-391, is already array-of-nodes — here the
 arrays live in HBM and are scattered into with `mode='drop'`).
+
+The voting-parallel learner keeps the full-frontier formulation (every
+active leaf re-histogrammed per round) because its per-round top-k
+feature election is a collective over freshly built local histograms.
 """
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,11 +38,13 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from ..ops.histogram import (compute_group_histograms, compute_leaf_totals,
-                             expand_feature_histograms)
+from ..ops.histogram import (compute_group_histograms,
+                             compute_group_histograms_pallas,
+                             compute_leaf_totals, expand_feature_histograms)
 from ..ops.partition import apply_splits
 from ..ops.split import (SplitResult, build_cat_bitset,
-                         find_categorical_splits, find_numerical_splits)
+                         find_categorical_splits, find_numerical_splits,
+                         gather_split_at_threshold)
 
 NEG_INF = -jnp.inf
 
@@ -61,6 +70,36 @@ class TreeArrays(NamedTuple):
     node_right: jax.Array        # (M,) int32
 
 
+class SplitCand(NamedTuple):
+    """Cached best split per leaf slot — the best_split_per_leaf_ analog
+    (reference serial_tree_learner.h best_split_per_leaf_ + SplitInfo,
+    split_info.hpp:18-288) as a struct of arrays, all (L,) / (L, B)."""
+    gain: jax.Array
+    feature: jax.Array       # int32 inner feature idx
+    threshold: jax.Array     # int32
+    default_left: jax.Array  # bool
+    lsg: jax.Array           # left sum_grad
+    lsh: jax.Array           # left sum_hess
+    lsc: jax.Array           # left count
+    lout: jax.Array          # constrained left output
+    rout: jax.Array          # constrained right output
+    cat_dir: jax.Array       # int32
+    cat_mask: jax.Array      # (L, B) bool
+
+
+class ForcedCand(NamedTuple):
+    """Cached forced-split evaluation per leaf (ForceSplits semantics,
+    reference serial_tree_learner.cpp:543-698), all (L,)."""
+    gain: jax.Array
+    threshold: jax.Array
+    default_left: jax.Array
+    lsg: jax.Array
+    lsh: jax.Array
+    lsc: jax.Array
+    lout: jax.Array
+    rout: jax.Array
+
+
 class GrowerState(NamedTuple):
     leaf_id: jax.Array
     num_leaves: jax.Array        # scalar int32
@@ -74,6 +113,9 @@ class GrowerState(NamedTuple):
     leaf_is_left: jax.Array      # (L,) bool — side under its parent
     leaf_forced: jax.Array       # (L,) int32 forced-split spec idx (-1 none)
     tree: TreeArrays
+    hist_cache: jax.Array        # (L, G, Bg, 3) f32 — per-leaf group hists
+    cand: SplitCand
+    forced_cand: ForcedCand
 
 
 def _encode_leaf(leaf_slot):
@@ -133,6 +175,11 @@ class TreeGrower:
         # hard bound on frontier rounds (the while_loop exits early when
         # no leaf splits)
         self.max_rounds = config.num_leaves - 1
+        # frontier width: max splits applied per round.  128 keeps the
+        # kernel's leaf strip within one 128-lane tile, so a larger cap
+        # would cost MXU time without reducing round count in practice.
+        self.frontier = min(config.num_leaves - 1,
+                            config.frontier_width or 128)
 
         # forced splits (reference serial_tree_learner.cpp:543-698
         # ForceSplits): JSON tree flattened to spec arrays; leaves carry
@@ -157,6 +204,31 @@ class TreeGrower:
         self.bins = self.policy.place_rows(bins_np)
         self._row_valid = self.policy.place_rows(
             np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
+        # the Pallas kernel path: single TPU device only (its sequential
+        # -grid accumulation is a Mosaic property); the XLA formulation
+        # stays for CPU simulation, GSPMD meshes (where the sharded
+        # contraction must lower to a reduce-scatter), and float32
+        # operand parity (the kernel runs bf16 operands, the analog of
+        # the reference GPU learner's single-precision default,
+        # gpu_tree_learner.cpp:73-77)
+        from ..utils.log import Log
+        hk = getattr(config, "hist_kernel", "auto")
+        if hk not in ("auto", "pallas", "xla"):
+            Log.warning(f"unknown hist_kernel={hk!r}; using 'auto'")
+            hk = "auto"
+        pallas_ok = (
+            self.policy.mesh is None
+            and jax.default_backend() in ("tpu", "axon")
+            and self.n_padded % 1024 == 0)
+        if hk == "pallas" and not pallas_ok:
+            Log.warning("hist_kernel=pallas unavailable here (needs a "
+                        "single TPU device and 1024-row padding); "
+                        "falling back to the XLA histogram path")
+        self.use_pallas = pallas_ok and (
+            hk == "pallas"
+            or (hk == "auto" and config.hist_compute_dtype == "bfloat16"))
+        self._is_voting = (self.policy.mesh is not None
+                           and config.tree_learner == "voting")
         self._train_tree = jax.jit(self._train_tree_impl)
 
     # ------------------------------------------------------------------
@@ -247,11 +319,27 @@ class TreeGrower:
         return self._train_tree(grad, hess, counts, feature_mask)
 
     # ------------------------------------------------------------------
+    def _hist_kernel(self, grad, hess, counts, leaf_id, slots=None,
+                     num_leaves=None):
+        """Frontier histogram dispatch: Pallas on a real single chip,
+        XLA one-hot contraction under meshes / CPU simulation."""
+        L = self.num_leaves if num_leaves is None else num_leaves
+        if self.use_pallas:
+            return compute_group_histograms_pallas(
+                self.bins, grad, hess, counts, leaf_id,
+                num_leaves=L, max_group_bin=self.max_group_bin,
+                slots=slots)
+        return compute_group_histograms(
+            self.bins, grad, hess, counts, leaf_id,
+            num_leaves=L, max_group_bin=self.max_group_bin,
+            compute_dtype=self.config.hist_compute_dtype,
+            chunk=self.chunk, slots=slots)
+
+    # ------------------------------------------------------------------
     def _init_state(self, grad, hess, counts) -> GrowerState:
         L = self.num_leaves
         M = L - 1
         B = self.max_feature_bin
-        n = self.n_padded
         leaf_id = jnp.where(self._row_valid, 0, -1).astype(jnp.int32)
         totals = compute_leaf_totals(grad, hess, counts, leaf_id, 1)
         leaf_sum_grad = jnp.zeros(L, jnp.float32).at[0].set(totals[0, 0])
@@ -279,6 +367,23 @@ class TreeGrower:
         leaf_forced = jnp.full(L, -1, jnp.int32)
         if self.forced_count:
             leaf_forced = leaf_forced.at[0].set(0)
+        cand = SplitCand(
+            gain=jnp.full(L, NEG_INF, jnp.float32),
+            feature=jnp.zeros(L, jnp.int32),
+            threshold=jnp.zeros(L, jnp.int32),
+            default_left=jnp.zeros(L, bool),
+            lsg=jnp.zeros(L, jnp.float32), lsh=jnp.zeros(L, jnp.float32),
+            lsc=jnp.zeros(L, jnp.float32), lout=jnp.zeros(L, jnp.float32),
+            rout=jnp.zeros(L, jnp.float32),
+            cat_dir=jnp.zeros(L, jnp.int32),
+            cat_mask=jnp.zeros((L, B), bool))
+        forced_cand = ForcedCand(
+            gain=jnp.full(L, NEG_INF, jnp.float32),
+            threshold=jnp.zeros(L, jnp.int32),
+            default_left=jnp.zeros(L, bool),
+            lsg=jnp.zeros(L, jnp.float32), lsh=jnp.zeros(L, jnp.float32),
+            lsc=jnp.zeros(L, jnp.float32), lout=jnp.zeros(L, jnp.float32),
+            rout=jnp.zeros(L, jnp.float32))
         return GrowerState(
             leaf_id=leaf_id, num_leaves=jnp.int32(1),
             round_idx=jnp.int32(0), done=jnp.bool_(False),
@@ -288,65 +393,47 @@ class TreeGrower:
             leaf_max_c=jnp.full(L, jnp.inf, jnp.float32),
             leaf_is_left=jnp.zeros(L, bool),
             leaf_forced=leaf_forced,
-            tree=tree)
+            tree=tree,
+            hist_cache=jnp.zeros(
+                (L, self.num_groups, self.max_group_bin, 3), jnp.float32),
+            cand=cand, forced_cand=forced_cand)
 
     # ------------------------------------------------------------------
     def _train_tree_impl(self, grad, hess, counts, feature_mask):
-        L = self.num_leaves
         state = self._init_state(grad, hess, counts)
+        if self._is_voting:
+            body_fn = self._round_voting
+        else:
+            W = self.frontier
+            parents0 = jnp.full((W,), -1, jnp.int32)
+            rights0 = jnp.full((W,), -1, jnp.int32).at[0].set(0)
+            state = self._refresh(state, parents0, rights0, grad, hess,
+                                  counts, feature_mask)
+            body_fn = self._round
 
         def cond(st: GrowerState):
             return ~st.done
 
         def body(st: GrowerState):
-            return self._round(st, grad, hess, counts, feature_mask)
+            return body_fn(st, grad, hess, counts, feature_mask)
 
         final = jax.lax.while_loop(cond, body, state)
         tree = final.tree._replace(num_leaves=final.num_leaves)
         return tree, final.leaf_id
 
     # ------------------------------------------------------------------
-    def _find_splits(self, st: GrowerState, grad, hess, counts,
-                     feature_mask):
-        """Histograms + per-(leaf, feature) split search.  Returns
-        (res, gains, hist, sel) where sel maps the result's feature axis
-        back to inner feature indices (identity unless voting)."""
-        cfg = self.cfg_scalars
-        L = self.num_leaves
-        if self.policy.mesh is not None and \
-                self.config.tree_learner == "voting":
-            return self._voting_find_splits(st, grad, hess, counts,
-                                            feature_mask)
-        # histograms for every leaf in one pass; under a mesh the
-        # row-sharded contraction lowers to a reduce-scatter onto the
-        # constrained feature sharding (the reference's
-        # Network::ReduceScatter of concatenated histograms)
-        group_hist = compute_group_histograms(
-            self.bins, grad, hess, counts, st.leaf_id,
-            num_leaves=L, max_group_bin=self.max_group_bin,
-            compute_dtype=self.config.hist_compute_dtype, chunk=self.chunk)
-        group_hist = self.policy.constrain_hist(group_hist)
-        leaf_totals = jnp.stack(
-            [st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count], axis=1)
-        hist = expand_feature_histograms(group_hist, self.bin_map,
-                                         self.fix_bin, leaf_totals)
-        res, gains = self._run_finders(
-            hist, st, cfg, self.f_num_bin, self.f_missing,
-            self.f_default_bin, self.f_monotone, self.f_is_cat,
-            feature_mask)
-        return res, gains, hist, None
-
-    def _run_finders(self, hist, st, cfg, f_num_bin, f_missing,
-                     f_default_bin, f_monotone, f_is_cat, feature_mask):
+    def _run_finders(self, hist, sum_grad, sum_hess, count, min_c, max_c,
+                     cfg, f_num_bin, f_missing, f_default_bin, f_monotone,
+                     f_is_cat, feature_mask):
+        """Best split per (leaf-row, feature) from per-feature hists.
+        All leaf-shaped args are (L',) aligned with hist's first axis."""
         num_res = find_numerical_splits(
-            hist, st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count,
-            f_num_bin, f_missing, f_default_bin,
-            f_monotone, st.leaf_min_c, st.leaf_max_c, cfg)
+            hist, sum_grad, sum_hess, count, f_num_bin, f_missing,
+            f_default_bin, f_monotone, min_c, max_c, cfg)
         if self.has_categorical:
             cat_res = find_categorical_splits(
-                hist, st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count,
-                f_num_bin, f_missing, st.leaf_min_c, st.leaf_max_c,
-                cfg)
+                hist, sum_grad, sum_hess, count, f_num_bin, f_missing,
+                min_c, max_c, cfg)
             icat = f_is_cat[None, :]
             res = SplitResult(*[jnp.where(icat, c, n) for c, n
                                 in zip(cat_res, num_res)])
@@ -355,161 +442,170 @@ class TreeGrower:
         gains = jnp.where(feature_mask[None, :], res.gain, NEG_INF)
         return res, gains
 
-    def _voting_find_splits(self, st: GrowerState, grad, hess, counts,
-                            feature_mask):
-        """Voting-parallel split search (PV-Tree — reference
-        voting_parallel_tree_learner.cpp): each shard builds LOCAL
-        histograms, votes its top_k features by local gain, the votes
-        are all-reduced, and only the globally top-2k voted features'
-        histograms are exchanged.  Deviation from the reference: the
-        per-leaf top-2k selection is a per-round UNION across the
-        frontier (one static feature subset), which generalizes the
-        reference's smaller/larger-leaf pair to frontier-parallel
-        growth while keeping the same communication scale."""
-        from functools import partial
-        from jax.sharding import PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+    # ------------------------------------------------------------------
+    def _refresh(self, st: GrowerState, parents, rights, grad, hess,
+                 counts, feature_mask) -> GrowerState:
+        """Histogram + split-finder pass over the new leaves of a round.
 
-        cfg = self.cfg_scalars
+        ``rights`` are histogrammed directly from the data (one
+        frontier-restricted MXU pass); each ``parents`` slot (which the
+        left child inherited) becomes parent-minus-right.  The finder
+        then runs on the 2W new leaves only and its results are
+        scattered into the per-leaf candidate cache.  Negative slot
+        entries are inert (their writes drop, their lanes match no row).
+        """
         L = self.num_leaves
-        mesh = self.policy.mesh
-        d = mesh.size
-        axis = mesh.axis_names[0]
-        k2 = min(2 * self.config.top_k, self.num_features)
-        # local constraints scaled down (voting_parallel:55-56)
-        cfg_local = dict(cfg)
-        cfg_local["min_data_in_leaf"] = cfg["min_data_in_leaf"] / d
-        cfg_local["min_sum_hessian_in_leaf"] = \
-            cfg["min_sum_hessian_in_leaf"] / d
+        cfg = self.cfg_scalars
+        cache = st.hist_cache
 
-        spec_rows = P(axis)
-        rep = P()
+        right_hist = self._hist_kernel(grad, hess, counts, st.leaf_id,
+                                       slots=rights)
+        right_hist = self.policy.constrain_hist(right_hist)
+        safe_p = jnp.clip(parents, 0, L - 1)
+        left_hist = cache[safe_p] - right_hist
+        cache = cache.at[jnp.where(parents >= 0, parents, L)].set(
+            left_hist, mode="drop")
+        cache = cache.at[jnp.where(rights >= 0, rights, L)].set(
+            right_hist, mode="drop")
 
-        @partial(shard_map, mesh=mesh,
-                 in_specs=(spec_rows, spec_rows, spec_rows, spec_rows,
-                           spec_rows, rep, rep, rep),
-                 out_specs=(rep, rep), check_rep=False)
-        def inner(bins, g, h, c, leaf_id, mask, min_c, max_c):
-            n_local = bins.shape[0]
-            local_hist = compute_group_histograms(
-                bins, g, h, c, leaf_id, num_leaves=L,
-                max_group_bin=self.max_group_bin,
-                compute_dtype=self.config.hist_compute_dtype,
-                chunk=n_local)
-            local_totals = compute_leaf_totals(g, h, c, leaf_id, L)
-            feat_hist = expand_feature_histograms(
-                local_hist, self.bin_map, self.fix_bin, local_totals)
-            local_st = st._replace(
-                leaf_sum_grad=local_totals[:, 0],
-                leaf_sum_hess=local_totals[:, 1],
-                leaf_count=local_totals[:, 2],
-                leaf_min_c=min_c, leaf_max_c=max_c)
-            _, local_gains = self._run_finders(
-                feat_hist, local_st, cfg_local, self.f_num_bin,
-                self.f_missing, self.f_default_bin, self.f_monotone,
-                self.f_is_cat, mask)
-            # per-leaf local top_k vote (GlobalVoting, :166-195)
-            kth = jax.lax.top_k(local_gains,
-                                min(self.config.top_k,
-                                    self.num_features))[0][:, -1:]
-            votes = ((local_gains >= kth)
-                     & jnp.isfinite(local_gains)).astype(jnp.float32)
-            global_votes = jax.lax.psum(votes, axis)          # (L, F)
-            total_votes = global_votes.sum(axis=0)            # (F,)
-            sel = jax.lax.top_k(total_votes, k2)[1].astype(jnp.int32)
-            # exchange only the selected features' histograms
-            compact = feat_hist[:, sel]                       # (L,k2,B,3)
-            global_compact = jax.lax.psum(compact, axis)
-            return global_compact, sel
-
-        hist, sel = inner(self.bins, grad, hess, counts, st.leaf_id,
-                          feature_mask, st.leaf_min_c, st.leaf_max_c)
+        new_slots = jnp.concatenate([parents, rights])          # (2W,)
+        safe = jnp.clip(new_slots, 0, L - 1)
+        valid = new_slots >= 0
+        h_new = cache[safe]                                     # (2W,G,B,3)
+        sg = st.leaf_sum_grad[safe]
+        sh = st.leaf_sum_hess[safe]
+        sc = st.leaf_count[safe]
+        mc = st.leaf_min_c[safe]
+        xc = st.leaf_max_c[safe]
+        totals = jnp.stack([sg, sh, sc], axis=1)
+        feat_hist = expand_feature_histograms(h_new, self.bin_map,
+                                              self.fix_bin, totals)
         res, gains = self._run_finders(
-            hist, st, cfg, self.f_num_bin[sel], self.f_missing[sel],
-            self.f_default_bin[sel], self.f_monotone[sel],
-            self.f_is_cat[sel], feature_mask[sel])
-        return res, gains, hist, sel
+            feat_hist, sg, sh, sc, mc, xc, cfg, self.f_num_bin,
+            self.f_missing, self.f_default_bin, self.f_monotone,
+            self.f_is_cat, feature_mask)
+
+        best_fc = jnp.argmax(gains, axis=1).astype(jnp.int32)   # (2W,)
+        best_gain = jnp.take_along_axis(gains, best_fc[:, None],
+                                        axis=1)[:, 0]
+
+        def at_leaf(arr2d):
+            return jnp.take_along_axis(arr2d, best_fc[:, None],
+                                       axis=1)[:, 0]
+
+        thr = at_leaf(res.threshold)
+        cat_dir = at_leaf(res.cat_dir)
+        if self.has_categorical:
+            hist_chosen = jnp.take_along_axis(
+                feat_hist, best_fc[:, None, None, None], axis=1)[:, 0]
+            cat_mask = build_cat_bitset(
+                hist_chosen, thr, cat_dir, self.f_num_bin[best_fc],
+                self.f_missing[best_fc], cfg)
+        else:
+            cat_mask = jnp.zeros((new_slots.shape[0],
+                                  self.max_feature_bin), bool)
+
+        idx = jnp.where(valid, new_slots, L)
+        c = st.cand
+        cand = SplitCand(
+            gain=c.gain.at[idx].set(best_gain, mode="drop"),
+            feature=c.feature.at[idx].set(best_fc, mode="drop"),
+            threshold=c.threshold.at[idx].set(thr, mode="drop"),
+            default_left=c.default_left.at[idx].set(
+                at_leaf(res.default_left), mode="drop"),
+            lsg=c.lsg.at[idx].set(at_leaf(res.left_sum_grad), mode="drop"),
+            lsh=c.lsh.at[idx].set(at_leaf(res.left_sum_hess), mode="drop"),
+            lsc=c.lsc.at[idx].set(at_leaf(res.left_count), mode="drop"),
+            lout=c.lout.at[idx].set(at_leaf(res.left_output), mode="drop"),
+            rout=c.rout.at[idx].set(at_leaf(res.right_output), mode="drop"),
+            cat_dir=c.cat_dir.at[idx].set(cat_dir, mode="drop"),
+            cat_mask=c.cat_mask.at[idx].set(cat_mask, mode="drop"))
+
+        forced_cand = st.forced_cand
+        if self.forced_count:
+            spec = st.leaf_forced[safe]                          # (2W,)
+            s_node = jnp.clip(spec, 0, self.forced_count - 1)
+            ff = self.forced_feature[s_node]
+            ft = self.forced_thr[s_node]
+            hist_ff = jnp.take_along_axis(
+                feat_hist, ff[:, None, None, None], axis=1)[:, 0]
+            (fgain, flg, flh, flc, flo, fro, fdl) = \
+                gather_split_at_threshold(
+                    hist_ff, ft, sg, sh, sc, self.f_num_bin[ff],
+                    self.f_missing[ff], self.f_default_bin[ff],
+                    self.f_is_cat[ff], cfg)
+            fgain = jnp.where(spec >= 0, fgain, NEG_INF)
+            fc = forced_cand
+            forced_cand = ForcedCand(
+                gain=fc.gain.at[idx].set(fgain, mode="drop"),
+                threshold=fc.threshold.at[idx].set(ft, mode="drop"),
+                default_left=fc.default_left.at[idx].set(fdl, mode="drop"),
+                lsg=fc.lsg.at[idx].set(flg, mode="drop"),
+                lsh=fc.lsh.at[idx].set(flh, mode="drop"),
+                lsc=fc.lsc.at[idx].set(flc, mode="drop"),
+                lout=fc.lout.at[idx].set(flo, mode="drop"),
+                rout=fc.rout.at[idx].set(fro, mode="drop"))
+
+        return st._replace(hist_cache=cache, cand=cand,
+                           forced_cand=forced_cand)
 
     # ------------------------------------------------------------------
     def _round(self, st: GrowerState, grad, hess, counts, feature_mask
                ) -> GrowerState:
-        cfg = self.cfg_scalars
+        """One cached-candidate frontier round: select/apply splits from
+        the cache, then refresh histograms+candidates for new leaves."""
         L = self.num_leaves
         M = L - 1
-        B = self.max_feature_bin
+        W = self.frontier
 
-        res, gains, hist, sel = self._find_splits(st, grad, hess, counts,
-                                                  feature_mask)
+        best_gain = st.cand.gain
+        best_f = st.cand.feature
+        thr = st.cand.threshold
+        dleft = st.cand.default_left
+        lsg, lsh, lsc = st.cand.lsg, st.cand.lsh, st.cand.lsc
+        lout, rout = st.cand.lout, st.cand.rout
+        cat_mask = st.cand.cat_mask
 
-        # 3. per-leaf best feature & candidate selection
-        best_fc = jnp.argmax(gains, axis=1).astype(jnp.int32)  # (L,)
-        best_gain = jnp.take_along_axis(gains, best_fc[:, None],
-                                        axis=1)[:, 0]
-
-        # forced-split override: evaluate the leaf's forced
-        # (feature, threshold) from the histogram and take it with top
-        # priority regardless of gain ordering (ForceSplits semantics)
         forced_valid = None
         if self.forced_count:
-            from ..ops.split import gather_split_at_threshold
+            fc = st.forced_cand
             s_node = jnp.clip(st.leaf_forced, 0, self.forced_count - 1)
-            ff = self.forced_feature[s_node]            # (L,)
-            ft = self.forced_thr[s_node]
-            hist_ff = jnp.take_along_axis(
-                hist, ff[:, None, None, None], axis=1)[:, 0]   # (L, B, 3)
-            (fgain, flg, flh, flc, flo, fro, fdl) = \
-                gather_split_at_threshold(
-                    hist_ff, ft, st.leaf_sum_grad, st.leaf_sum_hess,
-                    st.leaf_count, self.f_num_bin[ff], self.f_missing[ff],
-                    self.f_default_bin[ff], self.f_is_cat[ff], cfg)
-            forced_valid = (st.leaf_forced >= 0) & (fgain > NEG_INF)
-            best_fc = jnp.where(forced_valid, ff, best_fc)
-            best_gain = jnp.where(forced_valid, fgain, best_gain)
+            ff = self.forced_feature[s_node]
+            forced_valid = (st.leaf_forced >= 0) & (fc.gain > NEG_INF)
+            best_f = jnp.where(forced_valid, ff, best_f)
+            best_gain = jnp.where(forced_valid, fc.gain, best_gain)
+            thr = jnp.where(forced_valid, fc.threshold, thr)
+            dleft = jnp.where(forced_valid, fc.default_left, dleft)
+            lsg = jnp.where(forced_valid, fc.lsg, lsg)
+            lsh = jnp.where(forced_valid, fc.lsh, lsh)
+            lsc = jnp.where(forced_valid, fc.lsc, lsc)
+            lout = jnp.where(forced_valid, fc.lout, lout)
+            rout = jnp.where(forced_valid, fc.rout, rout)
+            fmask = (jnp.arange(self.max_feature_bin, dtype=jnp.int32)[None]
+                     == fc.threshold[:, None])
+            cat_mask = jnp.where(forced_valid[:, None], fmask, cat_mask)
 
-        best_f = best_fc if sel is None else sel[best_fc]
         slot = jnp.arange(L, dtype=jnp.int32)
         active = slot < st.num_leaves
         depth_ok = (self.max_depth <= 0) | \
             (st.tree.leaf_depth < self.max_depth)
-        cand = active & depth_ok & (best_gain > 0.0)
+        cand_m = active & depth_ok & (best_gain > 0.0)
         if forced_valid is not None:
             forced_valid = forced_valid & active
-            cand = cand | forced_valid
+            cand_m = cand_m | forced_valid
 
-        key = jnp.where(cand, best_gain, NEG_INF)
+        key = jnp.where(cand_m, best_gain, NEG_INF)
         if forced_valid is not None:
             key = jnp.where(forced_valid, jnp.inf, key)
         order = jnp.argsort(-key)                   # best first, stable
         rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
         budget = L - st.num_leaves
-        do_split = cand & (rank < budget)
+        do_split = cand_m & (rank < budget) & (rank < W)
         k = do_split.sum().astype(jnp.int32)
 
         right_slot = st.num_leaves + rank            # valid where do_split
         node_id = (st.num_leaves - 1) + rank
 
-        def at_leaf(arr2d):
-            # res arrays live in the (possibly compacted) finder space
-            return jnp.take_along_axis(arr2d, best_fc[:, None],
-                                       axis=1)[:, 0]
-
-        thr = at_leaf(res.threshold)
-        dleft = at_leaf(res.default_left)
-        lsg = at_leaf(res.left_sum_grad)
-        lsh = at_leaf(res.left_sum_hess)
-        lsc = at_leaf(res.left_count)
-        lout = at_leaf(res.left_output)
-        rout = at_leaf(res.right_output)
-        cat_dir = at_leaf(res.cat_dir)
-        if forced_valid is not None:
-            thr = jnp.where(forced_valid, ft, thr)
-            dleft = jnp.where(forced_valid, fdl, dleft)
-            lsg = jnp.where(forced_valid, flg, lsg)
-            lsh = jnp.where(forced_valid, flh, lsh)
-            lsc = jnp.where(forced_valid, flc, lsc)
-            lout = jnp.where(forced_valid, flo, lout)
-            rout = jnp.where(forced_valid, fro, rout)
-            cat_dir = jnp.where(forced_valid, 0, cat_dir)
         f_is_cat_leaf = self.f_is_cat[best_f]
         f_missing_leaf = self.f_missing[best_f]
         f_dbin_leaf = self.f_default_bin[best_f]
@@ -517,18 +613,7 @@ class TreeGrower:
         f_group_leaf = self.f_group[best_f]
         f_mono_leaf = self.f_monotone[best_f]
 
-        # categorical bitsets for chosen features
-        if self.has_categorical:
-            hist_chosen = jnp.take_along_axis(
-                hist, best_fc[:, None, None, None], axis=1)[:, 0]  # (L,B,3)
-            cat_mask = build_cat_bitset(hist_chosen, thr, cat_dir,
-                                        f_nb_leaf, f_missing_leaf, cfg)
-            # sorted-mode threshold in the model = number of cats left;
-            # reference stores the category list, we store the mask
-        else:
-            cat_mask = jnp.zeros((L, B), bool)
-
-        # 4. scatter new internal nodes (drop out-of-budget writes)
+        # scatter new internal nodes (drop out-of-budget writes)
         nid = jnp.where(do_split, node_id, M)
         t = st.tree
         # internal_value = the leaf's output before it split (tree.cpp Split)
@@ -563,7 +648,7 @@ class TreeGrower:
             node_right=tree.node_right.at[pr].set(node_id, mode="drop"),
         )
 
-        # 5. child leaf state (left keeps the slot, right takes right_slot)
+        # child leaf state (left keeps the slot, right takes right_slot)
         rsg = st.leaf_sum_grad - lsg
         rsh = st.leaf_sum_hess - lsh
         rsc = st.leaf_count - lsc
@@ -609,7 +694,250 @@ class TreeGrower:
         else:
             leaf_forced = st.leaf_forced
 
-        # 6. row re-labeling
+        # row re-labeling
+        g2f_leaf = self.g2f_lut[best_f]               # (L, GB)
+        leaf_id = apply_splits(
+            self.bins, st.leaf_id, do_split, f_group_leaf, g2f_leaf,
+            f_is_cat_leaf, thr, dleft, f_missing_leaf, f_dbin_leaf,
+            f_nb_leaf, cat_mask, right_slot)
+
+        num_leaves = st.num_leaves + k
+        round_idx = st.round_idx + 1
+        done = (k == 0) | (num_leaves >= L) | (round_idx >= self.max_rounds)
+
+        st2 = GrowerState(
+            leaf_id=leaf_id, num_leaves=num_leaves, round_idx=round_idx,
+            done=done, leaf_sum_grad=leaf_sum_grad,
+            leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
+            leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c,
+            leaf_is_left=leaf_is_left, leaf_forced=leaf_forced, tree=tree,
+            hist_cache=st.hist_cache, cand=st.cand,
+            forced_cand=st.forced_cand)
+
+        # refresh histograms + candidates for the new leaves.  order[w]
+        # is the leaf with split-rank w (its slot hosts the left child);
+        # the matching right child sits at num_leaves_old + w.  The
+        # final round's refresh would be discarded by the while_loop
+        # exit, so skip the (full data pass) under done.
+        w_iota = jnp.arange(W, dtype=jnp.int32)
+        split_ok = w_iota < k
+        parents = jnp.where(split_ok, order[:W].astype(jnp.int32), -1)
+        rights = jnp.where(split_ok, st.num_leaves + w_iota, -1)
+        return jax.lax.cond(
+            done,
+            lambda s: s,
+            lambda s: self._refresh(s, parents, rights, grad, hess,
+                                    counts, feature_mask),
+            st2)
+
+    # ==================================================================
+    # voting-parallel path (full-frontier formulation)
+    # ==================================================================
+    def _voting_find_splits(self, st: GrowerState, grad, hess, counts,
+                            feature_mask):
+        """Voting-parallel split search (PV-Tree — reference
+        voting_parallel_tree_learner.cpp): each shard builds LOCAL
+        histograms, votes its top_k features by local gain, the votes
+        are all-reduced, and only the globally top-2k voted features'
+        histograms are exchanged.  Deviation from the reference: the
+        per-leaf top-2k selection is a per-round UNION across the
+        frontier (one static feature subset), which generalizes the
+        reference's smaller/larger-leaf pair to frontier-parallel
+        growth while keeping the same communication scale."""
+        from functools import partial
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map as _sm
+            shard_map = functools.partial(_sm, check_vma=False)
+        except ImportError:          # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map as _sm
+            shard_map = functools.partial(_sm, check_rep=False)
+
+        cfg = self.cfg_scalars
+        L = self.num_leaves
+        mesh = self.policy.mesh
+        d = mesh.size
+        axis = mesh.axis_names[0]
+        k2 = min(2 * self.config.top_k, self.num_features)
+        # local constraints scaled down (voting_parallel:55-56)
+        cfg_local = dict(cfg)
+        cfg_local["min_data_in_leaf"] = cfg["min_data_in_leaf"] / d
+        cfg_local["min_sum_hessian_in_leaf"] = \
+            cfg["min_sum_hessian_in_leaf"] / d
+
+        spec_rows = P(axis)
+        rep = P()
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(spec_rows, spec_rows, spec_rows, spec_rows,
+                           spec_rows, rep, rep, rep),
+                 out_specs=(rep, rep))
+        def inner(bins, g, h, c, leaf_id, mask, min_c, max_c):
+            n_local = bins.shape[0]
+            local_hist = compute_group_histograms(
+                bins, g, h, c, leaf_id, num_leaves=L,
+                max_group_bin=self.max_group_bin,
+                compute_dtype=self.config.hist_compute_dtype,
+                chunk=n_local)
+            local_totals = compute_leaf_totals(g, h, c, leaf_id, L)
+            feat_hist = expand_feature_histograms(
+                local_hist, self.bin_map, self.fix_bin, local_totals)
+            _, local_gains = self._run_finders(
+                feat_hist, local_totals[:, 0], local_totals[:, 1],
+                local_totals[:, 2], min_c, max_c, cfg_local,
+                self.f_num_bin, self.f_missing, self.f_default_bin,
+                self.f_monotone, self.f_is_cat, mask)
+            # per-leaf local top_k vote (GlobalVoting, :166-195)
+            kth = jax.lax.top_k(local_gains,
+                                min(self.config.top_k,
+                                    self.num_features))[0][:, -1:]
+            votes = ((local_gains >= kth)
+                     & jnp.isfinite(local_gains)).astype(jnp.float32)
+            global_votes = jax.lax.psum(votes, axis)          # (L, F)
+            total_votes = global_votes.sum(axis=0)            # (F,)
+            sel = jax.lax.top_k(total_votes, k2)[1].astype(jnp.int32)
+            # exchange only the selected features' histograms
+            compact = feat_hist[:, sel]                       # (L,k2,B,3)
+            global_compact = jax.lax.psum(compact, axis)
+            return global_compact, sel
+
+        hist, sel = inner(self.bins, grad, hess, counts, st.leaf_id,
+                          feature_mask, st.leaf_min_c, st.leaf_max_c)
+        res, gains = self._run_finders(
+            hist, st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count,
+            st.leaf_min_c, st.leaf_max_c, cfg, self.f_num_bin[sel],
+            self.f_missing[sel], self.f_default_bin[sel],
+            self.f_monotone[sel], self.f_is_cat[sel], feature_mask[sel])
+        return res, gains, hist, sel
+
+    # ------------------------------------------------------------------
+    def _round_voting(self, st: GrowerState, grad, hess, counts,
+                      feature_mask) -> GrowerState:
+        """Full-frontier round for the voting learner: every active
+        leaf's histogram is rebuilt and searched each round."""
+        L = self.num_leaves
+        M = L - 1
+        B = self.max_feature_bin
+
+        res, gains, hist, sel = self._voting_find_splits(
+            st, grad, hess, counts, feature_mask)
+
+        # per-leaf best feature & candidate selection
+        best_fc = jnp.argmax(gains, axis=1).astype(jnp.int32)  # (L,)
+        best_gain = jnp.take_along_axis(gains, best_fc[:, None],
+                                        axis=1)[:, 0]
+        best_f = best_fc if sel is None else sel[best_fc]
+        slot = jnp.arange(L, dtype=jnp.int32)
+        active = slot < st.num_leaves
+        depth_ok = (self.max_depth <= 0) | \
+            (st.tree.leaf_depth < self.max_depth)
+        cand_m = active & depth_ok & (best_gain > 0.0)
+
+        key = jnp.where(cand_m, best_gain, NEG_INF)
+        order = jnp.argsort(-key)                   # best first, stable
+        rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
+        budget = L - st.num_leaves
+        do_split = cand_m & (rank < budget)
+        k = do_split.sum().astype(jnp.int32)
+
+        right_slot = st.num_leaves + rank            # valid where do_split
+        node_id = (st.num_leaves - 1) + rank
+
+        def at_leaf(arr2d):
+            # res arrays live in the (possibly compacted) finder space
+            return jnp.take_along_axis(arr2d, best_fc[:, None],
+                                       axis=1)[:, 0]
+
+        thr = at_leaf(res.threshold)
+        dleft = at_leaf(res.default_left)
+        lsg = at_leaf(res.left_sum_grad)
+        lsh = at_leaf(res.left_sum_hess)
+        lsc = at_leaf(res.left_count)
+        lout = at_leaf(res.left_output)
+        rout = at_leaf(res.right_output)
+        cat_dir = at_leaf(res.cat_dir)
+        f_is_cat_leaf = self.f_is_cat[best_f]
+        f_missing_leaf = self.f_missing[best_f]
+        f_dbin_leaf = self.f_default_bin[best_f]
+        f_nb_leaf = self.f_num_bin[best_f]
+        f_group_leaf = self.f_group[best_f]
+        f_mono_leaf = self.f_monotone[best_f]
+
+        # categorical bitsets for chosen features
+        if self.has_categorical:
+            hist_chosen = jnp.take_along_axis(
+                hist, best_fc[:, None, None, None], axis=1)[:, 0]  # (L,B,3)
+            cat_mask = build_cat_bitset(hist_chosen, thr, cat_dir,
+                                        f_nb_leaf, f_missing_leaf,
+                                        self.cfg_scalars)
+        else:
+            cat_mask = jnp.zeros((L, B), bool)
+
+        # scatter new internal nodes (drop out-of-budget writes)
+        nid = jnp.where(do_split, node_id, M)
+        t = st.tree
+        parent_out = t.leaf_value
+        tree = t._replace(
+            node_feature=t.node_feature.at[nid].set(best_f, mode="drop"),
+            node_threshold=t.node_threshold.at[nid].set(thr, mode="drop"),
+            node_default_left=t.node_default_left.at[nid].set(
+                dleft, mode="drop"),
+            node_is_cat=t.node_is_cat.at[nid].set(f_is_cat_leaf,
+                                                  mode="drop"),
+            node_cat_mask=t.node_cat_mask.at[nid].set(cat_mask,
+                                                      mode="drop"),
+            node_gain=t.node_gain.at[nid].set(best_gain, mode="drop"),
+            node_value=t.node_value.at[nid].set(parent_out, mode="drop"),
+            node_weight=t.node_weight.at[nid].set(st.leaf_sum_hess,
+                                                  mode="drop"),
+            node_count=t.node_count.at[nid].set(st.leaf_count, mode="drop"),
+            node_left=t.node_left.at[nid].set(_encode_leaf(slot),
+                                              mode="drop"),
+            node_right=t.node_right.at[nid].set(_encode_leaf(right_slot),
+                                                mode="drop"),
+        )
+        has_parent = do_split & (t.leaf_parent >= 0)
+        p = jnp.where(has_parent, t.leaf_parent, M)
+        pl = jnp.where(has_parent & st.leaf_is_left, p, M)
+        pr = jnp.where(has_parent & ~st.leaf_is_left, p, M)
+        tree = tree._replace(
+            node_left=tree.node_left.at[pl].set(node_id, mode="drop"),
+            node_right=tree.node_right.at[pr].set(node_id, mode="drop"),
+        )
+
+        rsg = st.leaf_sum_grad - lsg
+        rsh = st.leaf_sum_hess - lsh
+        rsc = st.leaf_count - lsc
+        new_depth = t.leaf_depth + 1
+        rs = jnp.where(do_split, right_slot, L)
+
+        def upd(arr, left_val, right_val):
+            arr = arr.at[rs].set(right_val, mode="drop")
+            return jnp.where(do_split, left_val, arr)
+
+        leaf_sum_grad = upd(st.leaf_sum_grad, lsg, rsg)
+        leaf_sum_hess = upd(st.leaf_sum_hess, lsh, rsh)
+        leaf_count = upd(st.leaf_count, lsc, rsc)
+
+        mid = (lout + rout) / 2.0
+        is_num = ~f_is_cat_leaf
+        lmin = jnp.where(is_num & (f_mono_leaf < 0), mid, st.leaf_min_c)
+        lmax = jnp.where(is_num & (f_mono_leaf > 0), mid, st.leaf_max_c)
+        rmin = jnp.where(is_num & (f_mono_leaf > 0), mid, st.leaf_min_c)
+        rmax = jnp.where(is_num & (f_mono_leaf < 0), mid, st.leaf_max_c)
+        leaf_min_c = upd(st.leaf_min_c, lmin, rmin)
+        leaf_max_c = upd(st.leaf_max_c, lmax, rmax)
+
+        tree = tree._replace(
+            leaf_value=upd(t.leaf_value, lout, rout),
+            leaf_weight=upd(t.leaf_weight, lsh, rsh),
+            leaf_count=upd(t.leaf_count, lsc, rsc),
+            leaf_parent=upd(t.leaf_parent, node_id, node_id),
+            leaf_depth=upd(t.leaf_depth, new_depth, new_depth),
+        )
+        leaf_is_left = upd(st.leaf_is_left,
+                           jnp.ones(L, bool), jnp.zeros(L, bool))
+
         g2f_leaf = self.g2f_lut[best_f]               # (L, GB)
         leaf_id = apply_splits(
             self.bins, st.leaf_id, do_split, f_group_leaf, g2f_leaf,
@@ -624,4 +952,6 @@ class TreeGrower:
             done=done, leaf_sum_grad=leaf_sum_grad,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
             leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c,
-            leaf_is_left=leaf_is_left, leaf_forced=leaf_forced, tree=tree)
+            leaf_is_left=leaf_is_left, leaf_forced=st.leaf_forced,
+            tree=tree, hist_cache=st.hist_cache, cand=st.cand,
+            forced_cand=st.forced_cand)

@@ -48,7 +48,8 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
                              leaf_id: jax.Array, *, num_leaves: int,
                              max_group_bin: int,
                              compute_dtype: str = "float32",
-                             chunk: Optional[int] = None) -> jax.Array:
+                             chunk: Optional[int] = None,
+                             slots: Optional[jax.Array] = None) -> jax.Array:
     """Build per-leaf histograms for every feature group in one pass.
 
     Args:
@@ -59,11 +60,17 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
       counts: (N,) float32 1.0 for in-bag rows else 0.0 (the ``cnt``
         histogram channel; bagging masks flow through here).
       leaf_id: (N,) int32 current leaf of each row; negative = ignore.
-      num_leaves: static L — number of leaf slots.
+      num_leaves: static L — number of leaf slots (ignored when
+        ``slots`` is given).
       max_group_bin: static B — bins per group column.
+      slots: optional (W,) int32 — restrict to these leaf ids (negative
+        entries match nothing); output leaf axis then follows ``slots``
+        order.  This is the frontier path: only newly created leaves
+        are histogrammed, their siblings come from parent subtraction.
 
     Returns:
-      (L, G, B, 3) float32: sum_grad, sum_hess, count per (leaf, group, bin).
+      (L|W, G, B, 3) float32: sum_grad, sum_hess, count per
+      (leaf, group, bin).
     """
     n, num_groups = bins.shape
     cdt = jnp.dtype(compute_dtype)
@@ -73,7 +80,13 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
         raise ValueError(f"N ({n}) must be padded to a multiple of chunk ({chunk})")
     num_chunks = n // chunk
 
-    leaf_iota = jnp.arange(num_leaves, dtype=jnp.int32)
+    if slots is None:
+        leaf_iota = jnp.arange(num_leaves, dtype=jnp.int32)
+    else:
+        # negative slot entries must match nothing, including the
+        # negative leaf ids of padded rows
+        leaf_iota = jnp.where(slots >= 0, slots, -2)
+        num_leaves = slots.shape[0]
     bin_iota = jnp.arange(max_group_bin, dtype=jnp.int32)
 
     def body(acc, xs):
@@ -82,12 +95,17 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
         ohl = (leaf_c[:, None] == leaf_iota[None, :]).astype(cdt)
         w = jnp.stack([grad_c, hess_c, cnt_c], axis=1).astype(cdt)  # (C, 3)
         lhs = (ohl[:, :, None] * w[:, None, :]).reshape(chunk, num_leaves * 3)
-        # (C, G, B) bin one-hot, generated on the fly
+        # (C, G, B) bin one-hot, generated on the fly; contracted as ONE
+        # (3L x C) @ (C x G*B) dot — a grouped einsum would make XLA
+        # re-read the (C, 3L) operand once per group (G x the HBM
+        # traffic, measured ~10x slower on v5e)
         ohb = (bins_c.astype(jnp.int32)[:, :, None]
                == bin_iota[None, None, :]).astype(cdt)
-        contrib = jnp.einsum("cm,cgb->mgb", lhs, ohb,
-                             preferred_element_type=jnp.float32)
-        return acc + contrib, None
+        contrib = jnp.einsum(
+            "cm,cx->mx", lhs, ohb.reshape(chunk, num_groups * max_group_bin),
+            preferred_element_type=jnp.float32)
+        return acc + contrib.reshape(num_leaves * 3, num_groups,
+                                     max_group_bin), None
 
     init = jnp.zeros((num_leaves * 3, num_groups, max_group_bin),
                      dtype=jnp.float32)
@@ -102,16 +120,26 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
     return jnp.transpose(hist, (0, 2, 3, 1))
 
 
-def _hist_kernel_body(bins_ref, w_ref, leaf_ref, out_ref, *, num_leaves,
-                      max_group_bin):
+def _hist_kernel_body(bins_ref, w_ref, leaf_ref, emat_ref, bcol_ref,
+                      slots_ref, out_ref, *, num_leaves, max_group_bin,
+                      m_pad):
     """Pallas TPU kernel: one row-block's histogram contribution.
 
     The analog of the OpenCL workgroup kernel
     (reference src/treelearner/ocl/histogram256.cl:345-824), redesigned
-    for the MXU: both one-hot operands are generated in VMEM/registers
-    (never touching HBM — the XLA fallback materializes them) and the
+    for the MXU: both one-hot operands are generated in VMEM (never
+    touching HBM — the XLA fallback materializes them) and the
     (3L, G*B) accumulator lives in VMEM across the whole grid, so HBM
     traffic is just the packed bin matrix + weights, ~17 bytes/row.
+
+    Mosaic notes: no vector reshapes (unsupported).  The expensive
+    "repeat each group's bin B times along lanes" broadcast is done on
+    the MXU as ``bins @ E`` with a constant (G, G*B) 0/1 expansion
+    matrix (bin values <= 255 are exact in bf16), followed by a single
+    full-lane-width compare against the constant per-column bin index —
+    the VPU does ~2 ops/element instead of ~6 at half lane width.
+    The (C, 3L) leaf one-hot uses channel-major layout (three
+    lane-aligned strips sharing one (C, m_leaf) one-hot).
     """
     i = pl.program_id(0)
 
@@ -120,27 +148,37 @@ def _hist_kernel_body(bins_ref, w_ref, leaf_ref, out_ref, *, num_leaves,
         out_ref[:] = jnp.zeros_like(out_ref)
 
     c = bins_ref.shape[0]
-    num_groups = bins_ref.shape[1]
-    l3 = 3 * num_leaves
-    b = max_group_bin
+    m_leaf = m_pad // 3
 
     leaf = leaf_ref[:]                                   # (C, 1) int32
     w = w_ref[:]                                         # (C, 3) f32
-    col = jax.lax.broadcasted_iota(jnp.int32, (c, l3), 1)
-    l_of = col // 3
-    c_of = col % 3
-    wv = jnp.where(c_of == 0, w[:, 0:1],
-                   jnp.where(c_of == 1, w[:, 1:2], w[:, 2:3]))
-    lhs = jnp.where(leaf == l_of, wv, 0.0).astype(jnp.bfloat16)
+    ohl = leaf == slots_ref[0:1, :]                      # (C, m_leaf)
+    zero = jnp.zeros((), jnp.float32)
+    lhs = jnp.concatenate(
+        [jnp.where(ohl, w[:, 0:1], zero),
+         jnp.where(ohl, w[:, 1:2], zero),
+         jnp.where(ohl, w[:, 2:3], zero)], axis=1).astype(jnp.bfloat16)
 
-    binb = bins_ref[:].astype(jnp.int32)                 # (C, G)
-    rep = jnp.broadcast_to(binb[:, :, None],
-                           (c, num_groups, b)).reshape(c, num_groups * b)
-    bcol = jax.lax.broadcasted_iota(jnp.int32, (c, num_groups * b), 1) % b
-    ohb = (rep == bcol).astype(jnp.bfloat16)
+    binb = bins_ref[:].astype(jnp.int32).astype(jnp.bfloat16)  # exact <=255
+    rep = jax.lax.dot_general(                           # (C, G*B)
+        binb, emat_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ohb = (rep == bcol_ref[0:1, :]).astype(jnp.bfloat16)
     out_ref[:] += jax.lax.dot_general(
         lhs, ohb, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _expansion_consts(num_groups: int, max_group_bin: int):
+    """Constant (G, G*B) 0/1 expansion matrix and (1, G*B) per-column
+    bin index, both bf16."""
+    g, b = num_groups, max_group_bin
+    emat = np.zeros((g, g * b), dtype=np.float32)
+    for gg in range(g):
+        emat[gg, gg * b:(gg + 1) * b] = 1.0
+    bcol = np.tile(np.arange(b, dtype=np.float32), g)[None, :]
+    return emat.astype(jnp.bfloat16), bcol
 
 
 @functools.partial(
@@ -149,19 +187,35 @@ def _hist_kernel_body(bins_ref, w_ref, leaf_ref, out_ref, *, num_leaves,
 def compute_group_histograms_pallas(bins: jax.Array, grad: jax.Array,
                                     hess: jax.Array, counts: jax.Array,
                                     leaf_id: jax.Array, *, num_leaves: int,
-                                    max_group_bin: int, block: int = 512,
-                                    interpret: bool = False) -> jax.Array:
+                                    max_group_bin: int, block: int = 1024,
+                                    interpret: bool = False,
+                                    slots: Optional[jax.Array] = None
+                                    ) -> jax.Array:
     """Pallas-kernel histogram with the same contract as
     :func:`compute_group_histograms` (N must be a multiple of
-    ``block``).  Single-device only — the distributed learners keep the
-    XLA formulation so GSPMD can insert the reduce-scatter."""
-    from jax.experimental import pallas as pl_mod  # noqa: F401
+    ``block``), including the ``slots`` frontier restriction.
+    Single-device only — the distributed learners keep the XLA
+    formulation so GSPMD can insert the reduce-scatter."""
     n, num_groups = bins.shape
     if n % block != 0:
         raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    if slots is not None:
+        num_leaves = slots.shape[0]
+    # leaf-slot axis padded so the channel-major lhs splits into three
+    # 128-lane-aligned channel strips
+    m_leaf = max(128, ((num_leaves + 127) // 128) * 128)
+    m_pad = 3 * m_leaf
+    if slots is None:
+        slot_row = jnp.arange(m_leaf, dtype=jnp.int32)[None, :]
+    else:
+        # -2 padding: matches neither real leaves nor padded rows (-1)
+        slot_row = jnp.full(m_leaf, -2, jnp.int32) \
+            .at[:num_leaves].set(jnp.where(slots >= 0, slots, -2))[None, :]
     w = jnp.stack([grad, hess, counts], axis=1).astype(jnp.float32)
+    emat, bcol = _expansion_consts(num_groups, max_group_bin)
     kern = functools.partial(_hist_kernel_body, num_leaves=num_leaves,
-                             max_group_bin=max_group_bin)
+                             max_group_bin=max_group_bin, m_pad=m_pad)
+    gb = num_groups * max_group_bin
     out = pl.pallas_call(
         kern,
         grid=(n // block,),
@@ -169,15 +223,18 @@ def compute_group_histograms_pallas(bins: jax.Array, grad: jax.Array,
             pl.BlockSpec((block, num_groups), lambda i: (i, 0)),
             pl.BlockSpec((block, 3), lambda i: (i, 0)),
             pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((num_groups, gb), lambda i: (0, 0)),
+            pl.BlockSpec((1, gb), lambda i: (0, 0)),
+            pl.BlockSpec((1, m_leaf), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((3 * num_leaves, num_groups * max_group_bin),
-                               lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct(
-            (3 * num_leaves, num_groups * max_group_bin), jnp.float32),
+        out_specs=pl.BlockSpec((m_pad, gb), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, gb), jnp.float32),
         interpret=interpret,
-    )(bins, w, leaf_id[:, None])
-    hist = out.reshape(num_leaves, 3, num_groups, max_group_bin)
-    return jnp.transpose(hist, (0, 2, 3, 1))
+    )(bins, w, leaf_id[:, None], jnp.asarray(emat), jnp.asarray(bcol),
+      slot_row)
+    # (3*m_leaf, G*B) channel-major -> (L, G, B, 3)
+    hist = out.reshape(3, m_leaf, num_groups, max_group_bin)[:, :num_leaves]
+    return jnp.transpose(hist, (1, 2, 3, 0))
 
 
 def expand_feature_histograms(group_hist: jax.Array, bin_map: jax.Array,

@@ -88,14 +88,16 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
 
     fg_hi, fg_lo = _hi_lo(feat_group)
     rs_hi, rs_lo = _hi_lo(right_slot)
+    # bf16 operands are exact here (0/1 decisions and hi/lo ints < 256)
+    # and halve the HBM traffic of the materialized (N, L) one-hot
     table = jnp.concatenate([
         decision.astype(jnp.float32),
         fg_hi, fg_lo, rs_hi, rs_lo,
         split_mask.astype(jnp.float32)[:, None],
-    ], axis=1)                                      # (L, GB+5)
+    ], axis=1).astype(jnp.bfloat16)                 # (L, GB+5)
     safe_l = jnp.clip(leaf_id, 0, L - 1)
     ohl = (safe_l[:, None]
-           == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.float32)
+           == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
     rows = jnp.dot(ohl, table, preferred_element_type=jnp.float32)
     d_rows = rows[:, :gb_dim]                       # (N, GB)
 

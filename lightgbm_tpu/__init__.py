@@ -8,7 +8,7 @@ distributed training.  User API mirrors the reference python package
 """
 from .basic import Dataset, Booster
 from .config import Config
-from .engine import train, cv
+from .engine import train, cv, CVBooster
 from .utils.log import Log, LightGBMError
 from .callback import (early_stopping, print_evaluation, record_evaluation,
                        reset_parameter)
@@ -19,7 +19,7 @@ from .plotting import (plot_importance, plot_metric, plot_tree,
 
 __version__ = "0.1.0"
 
-__all__ = ["Dataset", "Booster", "Config", "train", "cv", "Log",
+__all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster", "Log",
            "LightGBMError", "early_stopping", "print_evaluation",
            "record_evaluation", "reset_parameter", "LGBMModel",
            "LGBMRegressor", "LGBMClassifier", "LGBMRanker",

@@ -26,6 +26,7 @@ from ..dataset import Dataset
 from ..learner.grower import TreeGrower, TreeArrays
 from ..metrics import Metric, create_metrics
 from ..objectives import Objective, create_objective
+from ..ops.histogram import leaf_value_broadcast
 from ..ops.predict import predict_binned
 from ..tree import Tree
 from ..utils.log import Log, PhaseTimer
@@ -198,8 +199,7 @@ class GBDT:
     # ------------------------------------------------------------------
     def _update_train_scores(self, scores, leaf_id, leaf_value, class_idx,
                              shrinkage):
-        delta = leaf_value[jnp.clip(leaf_id, 0, leaf_value.shape[0] - 1)]
-        delta = jnp.where(leaf_id >= 0, delta, 0.0) * shrinkage
+        delta = leaf_value_broadcast(leaf_id, leaf_value) * shrinkage
         return scores.at[class_idx].add(delta)
 
     def _predict_valid(self, tree: TreeArrays, bins):
@@ -286,9 +286,8 @@ class GBDT:
                 # skips UpdateScore when num_leaves==1, gbdt.cpp:427-460)
                 ok = (tree.num_leaves > 1).astype(jnp.float32)
                 tree = tree._replace(leaf_value=tree.leaf_value * ok)
-                lv = tree.leaf_value
-                delta = lv[jnp.clip(leaf_id, 0, lv.shape[0] - 1)]
-                delta = jnp.where(leaf_id >= 0, delta, 0.0) * shrinkage
+                delta = leaf_value_broadcast(leaf_id,
+                                             tree.leaf_value) * shrinkage
                 scores = scores.at[k].add(delta)
                 for i, vb in enumerate(vbins):
                     pv = self._predict_valid(tree, vb)

@@ -1,0 +1,337 @@
+"""Flat C-API-compatible function surface.
+
+The reference exposes its core through ~90 flat C functions
+(reference: include/LightGBM/c_api.h, src/c_api.cpp) that the Python,
+R and Java bindings call through ctypes/.Call/JNI.  This framework
+inverts the stack — the core is a Python/JAX program and the native
+code sits BELOW it (lightgbm_tpu/native) — so the C API's role is
+played by this module: the same function names, handle discipline and
+0/-1 + ``LGBM_GetLastError`` error convention (reference
+c_api.h:765-788 API_BEGIN/END), implemented over the Python core.
+Non-Python hosts embed it via CPython (the reference's R binding is
+likewise a thin shim over its C API, R-package/src/lightgbm_R.cpp).
+
+Handles are opaque integers from a process-local registry, mirroring
+the reference's pointer handles.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Dataset
+from .booster import Booster
+from .config import Config
+from .utils.log import Log
+
+_lock = threading.Lock()
+_handles: Dict[int, Any] = {}
+_next_handle = [1]
+_last_error = [""]
+
+
+def _register(obj) -> int:
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _handles[h] = obj
+        return h
+
+
+def _get(handle: int):
+    obj = _handles.get(int(handle))
+    if obj is None:
+        raise KeyError(f"invalid handle {handle}")
+    return obj
+
+
+def _api(fn):
+    """API_BEGIN/API_END analog: catch everything, stash the message,
+    return -1 (reference c_api.h:771-788)."""
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:           # noqa: BLE001 — C boundary
+            _last_error[0] = f"{type(e).__name__}: {e}"
+            return -1
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+def LGBM_GetLastError() -> str:
+    """reference c_api.h:46-50."""
+    return _last_error[0]
+
+
+def _parse_params(parameters: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for tok in (parameters or "").replace("\n", " ").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Dataset
+# ---------------------------------------------------------------------------
+@_api
+def LGBM_DatasetCreateFromMat(data, parameters: str, reference=None,
+                              out=None) -> int:
+    """reference c_api.h:128-147 (row-major float matrix).  ``out`` is
+    a one-element list receiving the handle (the C out-pointer)."""
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(np.asarray(data, dtype=np.float64), reference=ref,
+                 params=params)
+    out[0] = _register(ds)
+    return 0
+
+
+@_api
+def LGBM_DatasetCreateFromFile(filename: str, parameters: str,
+                               reference=None, out=None) -> int:
+    """reference c_api.h:53-66."""
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(str(filename), reference=ref, params=params)
+    out[0] = _register(ds)
+    return 0
+
+
+@_api
+def LGBM_DatasetSetField(handle, field_name: str, field_data) -> int:
+    """reference c_api.h:223-238."""
+    _get(handle).set_field(field_name, np.asarray(field_data))
+    return 0
+
+
+@_api
+def LGBM_DatasetGetField(handle, field_name: str, out=None) -> int:
+    """reference c_api.h:240-256."""
+    out[0] = _get(handle).get_field(field_name)
+    return 0
+
+
+@_api
+def LGBM_DatasetGetNumData(handle, out=None) -> int:
+    out[0] = _get(handle).num_data()
+    return 0
+
+
+@_api
+def LGBM_DatasetGetNumFeature(handle, out=None) -> int:
+    out[0] = _get(handle).num_feature()
+    return 0
+
+
+@_api
+def LGBM_DatasetSaveBinary(handle, filename: str) -> int:
+    """reference c_api.h:204-211."""
+    _get(handle).save_binary(str(filename))
+    return 0
+
+
+@_api
+def LGBM_DatasetFree(handle) -> int:
+    with _lock:
+        _handles.pop(int(handle), None)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Booster
+# ---------------------------------------------------------------------------
+@_api
+def LGBM_BoosterCreate(train_data, parameters: str, out=None) -> int:
+    """reference c_api.h:316-325."""
+    cfg = Config.from_params(_parse_params(parameters))
+    ds = _get(train_data)
+    core = ds.construct(cfg) if hasattr(ds, "construct") else ds
+    bst = Booster(config=cfg, train_set=core)
+    out[0] = _register(bst)
+    return 0
+
+
+@_api
+def LGBM_BoosterCreateFromModelfile(filename: str, out_num_iterations=None,
+                                    out=None) -> int:
+    """reference c_api.h:327-337."""
+    bst = Booster(model_file=str(filename))
+    if out_num_iterations is not None:
+        out_num_iterations[0] = bst.current_iteration
+    out[0] = _register(bst)
+    return 0
+
+
+@_api
+def LGBM_BoosterLoadModelFromString(model_str: str, out_num_iterations=None,
+                                    out=None) -> int:
+    bst = Booster(model_str=model_str)
+    if out_num_iterations is not None:
+        out_num_iterations[0] = bst.current_iteration
+    out[0] = _register(bst)
+    return 0
+
+
+@_api
+def LGBM_BoosterFree(handle) -> int:
+    with _lock:
+        _handles.pop(int(handle), None)
+    return 0
+
+
+@_api
+def LGBM_BoosterAddValidData(handle, valid_data) -> int:
+    """reference c_api.h:348-355."""
+    bst = _get(handle)
+    vs = _get(valid_data)
+    core = vs.construct(bst.config) if hasattr(vs, "construct") else vs
+    bst.gbdt.add_valid(core, f"valid_{len(bst.gbdt.valid_sets)}")
+    return 0
+
+
+@_api
+def LGBM_BoosterGetNumClasses(handle, out=None) -> int:
+    out[0] = _get(handle).num_class
+    return 0
+
+
+@_api
+def LGBM_BoosterUpdateOneIter(handle, is_finished=None) -> int:
+    """reference c_api.h:401-408."""
+    fin = _get(handle).update()
+    if is_finished is not None:
+        is_finished[0] = 1 if fin else 0
+    return 0
+
+
+@_api
+def LGBM_BoosterUpdateOneIterCustom(handle, grad, hess,
+                                    is_finished=None) -> int:
+    """reference c_api.h:410-422 (custom objective gradients)."""
+    fin = _get(handle).update(fobj=lambda *_: (np.asarray(grad),
+                                               np.asarray(hess)))
+    if is_finished is not None:
+        is_finished[0] = 1 if fin else 0
+    return 0
+
+
+@_api
+def LGBM_BoosterRollbackOneIter(handle) -> int:
+    _get(handle).rollback_one_iter()
+    return 0
+
+
+@_api
+def LGBM_BoosterGetCurrentIteration(handle, out=None) -> int:
+    out[0] = _get(handle).current_iteration
+    return 0
+
+
+@_api
+def LGBM_BoosterGetEval(handle, data_idx: int, out=None) -> int:
+    """reference c_api.h:458-472: metric values for one dataset
+    (0 = training, i = i-th validation set)."""
+    bst = _get(handle)
+    if data_idx == 0 and not bst.gbdt.train_metrics:
+        bst.gbdt.add_train_metrics()
+    results = bst.gbdt.eval_metrics()
+    names = ["training"] + bst.gbdt.valid_names
+    want = names[data_idx] if data_idx < len(names) else None
+    out[0] = [v for (dname, _m, v, _b) in results if dname == want]
+    return 0
+
+
+@_api
+def LGBM_BoosterPredictForMat(handle, data, predict_type: int = 0,
+                              num_iteration: int = -1, out=None) -> int:
+    """reference c_api.h:610-635.  predict_type: 0 normal, 1 raw score,
+    2 leaf index, 3 contrib (SHAP)."""
+    bst = _get(handle)
+    out[0] = bst.predict(np.asarray(data, dtype=np.float64),
+                         num_iteration=num_iteration,
+                         raw_score=(predict_type == 1),
+                         pred_leaf=(predict_type == 2),
+                         pred_contrib=(predict_type == 3))
+    return 0
+
+
+@_api
+def LGBM_BoosterSaveModel(handle, num_iteration: int, filename: str) -> int:
+    """reference c_api.h:674-683."""
+    _get(handle).save_model(str(filename), num_iteration=num_iteration)
+    return 0
+
+
+@_api
+def LGBM_BoosterSaveModelToString(handle, num_iteration: int = -1,
+                                  out=None) -> int:
+    out[0] = _get(handle).model_to_string(num_iteration=num_iteration)
+    return 0
+
+
+@_api
+def LGBM_BoosterDumpModel(handle, num_iteration: int = -1, out=None) -> int:
+    """JSON dump (reference c_api.h:694-704)."""
+    out[0] = _get(handle).dump_model(num_iteration=num_iteration)
+    return 0
+
+
+@_api
+def LGBM_BoosterFeatureImportance(handle, num_iteration: int = -1,
+                                  importance_type: int = 0,
+                                  out=None) -> int:
+    """reference c_api.h:717-728; 0 = split counts, 1 = total gain."""
+    out[0] = _get(handle).feature_importance(
+        importance_type="split" if importance_type == 0 else "gain")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Network (distributed seam — reference c_api.h:749-762)
+# ---------------------------------------------------------------------------
+@_api
+def LGBM_NetworkInit(machines: str, local_listen_port: int,
+                     listen_time_out: int, num_machines: int) -> int:
+    """The socket rendezvous has no TPU analog: multi-host setup goes
+    through jax.distributed.initialize + the mesh (parallel/mesh.py).
+    Kept for call-compatibility; warns and succeeds."""
+    if num_machines > 1:
+        Log.warning("LGBM_NetworkInit: use jax.distributed.initialize "
+                    "+ mesh_shape instead; socket rendezvous is not "
+                    "part of the TPU backend")
+    return 0
+
+
+@_api
+def LGBM_NetworkFree() -> int:
+    return 0
+
+
+@_api
+def LGBM_NetworkInitWithFunctions(num_machines: int, rank: int,
+                                  reduce_scatter_ext_fun=None,
+                                  allgather_ext_fun=None) -> int:
+    """The reference's external-collective injection seam
+    (c_api.h:760-762).  Here collectives are compiled into the XLA
+    program by GSPMD, so host callables CANNOT be routed into jitted
+    training — the installed backend only serves host-side simulation
+    (parallel/collectives.py HostCollectives API).  Warns loudly so an
+    embedder expecting the reference's transport injection knows to use
+    jax.distributed.initialize + mesh_shape instead."""
+    from .parallel import collectives
+    if num_machines > 1:
+        Log.warning(
+            "LGBM_NetworkInitWithFunctions: injected collectives are "
+            "NOT used by jitted training on TPU (XLA emits its own over "
+            "ICI/DCN); they are only reachable through the host-side "
+            "simulation API. Use jax.distributed.initialize + "
+            "mesh_shape for real multi-host training.")
+    collectives.install_external(num_machines, rank,
+                                 reduce_scatter_ext_fun,
+                                 allgather_ext_fun)
+    return 0

@@ -282,7 +282,10 @@ class Config:
     growth_policy: str = "leafwise"  # leafwise (gain-budgeted frontier) | depthwise
     frontier_width: int = 0         # max splits applied per frontier round
     # (0 = auto: min(128, num_leaves-1) — one 128-lane MXU strip)
-    hist_kernel: str = "auto"       # auto | pallas | xla histogram path
+    hist_kernel: str = "auto"       # auto | pallas | paired | xla
+    quantized_grad: bool = False    # int8-MXU quantized histogram
+    # construction (one grad/hess scale per tree; the TPU analog of
+    # LightGBM v4 quantized training, arXiv 2207.09682) — TPU path only
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
     deterministic: bool = False

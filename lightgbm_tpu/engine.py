@@ -149,11 +149,31 @@ _CallbackEnv = collections.namedtuple(
     ["model", "params", "iteration", "end_iteration", "evaluation_result_list"])
 
 
+class CVBooster:
+    """Ensemble of per-fold boosters (reference engine.py:230-260).
+    Attribute access fans out to every fold's booster and returns the
+    list of results."""
+
+    def __init__(self, boosters=None):
+        self.boosters = list(boosters or [])
+        self.best_iteration = -1
+
+    def _append(self, booster):
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs)
+                    for b in self.boosters]
+        return handler
+
+
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
        metrics=None, fobj=None, feval=None, init_model=None,
        early_stopping_rounds=None, seed: int = 0,
-       callbacks=None, verbose_eval=None) -> Dict[str, List[float]]:
+       callbacks=None, verbose_eval=None,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
     """K-fold cross-validation (reference engine.py:312-425)."""
     params = dict(params or {})
     if metrics is not None:
@@ -211,7 +231,13 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                 vals = [fe[mname][i] for fe in fold_evals]
                 results[f"{mname}-mean"].append(float(np.mean(vals)))
                 results[f"{mname}-stdv"].append(float(np.std(vals)))
-    return dict(results)
+    out = dict(results)
+    if return_cvbooster:
+        cvb = CVBooster(boosters)
+        cvb.best_iteration = max((b.best_iteration for b in boosters),
+                                 default=-1)
+        out["cvbooster"] = cvb
+    return out
 
 
 def _stratified_folds(label, nfold, rng, shuffle):

@@ -40,7 +40,10 @@ from ..config import Config
 from ..dataset import Dataset
 from ..ops.histogram import (compute_group_histograms,
                              compute_group_histograms_pallas,
-                             compute_leaf_totals, expand_feature_histograms)
+                             compute_group_histograms_pallas_paired,
+                             compute_group_histograms_pallas_q,
+                             compute_leaf_totals, expand_feature_histograms,
+                             quantize_gradients)
 from ..ops.partition import apply_splits
 from ..ops.split import (SplitResult, build_cat_bitset,
                          find_categorical_splits, find_numerical_splits,
@@ -213,20 +216,34 @@ class TreeGrower:
         # gpu_tree_learner.cpp:73-77)
         from ..utils.log import Log
         hk = getattr(config, "hist_kernel", "auto")
-        if hk not in ("auto", "pallas", "xla"):
+        if hk not in ("auto", "pallas", "paired", "xla"):
             Log.warning(f"unknown hist_kernel={hk!r}; using 'auto'")
             hk = "auto"
         pallas_ok = (
             self.policy.mesh is None
             and jax.default_backend() in ("tpu", "axon")
             and self.n_padded % 1024 == 0)
-        if hk == "pallas" and not pallas_ok:
-            Log.warning("hist_kernel=pallas unavailable here (needs a "
+        if hk in ("pallas", "paired") and not pallas_ok:
+            Log.warning(f"hist_kernel={hk} unavailable here (needs a "
                         "single TPU device and 1024-row padding); "
                         "falling back to the XLA histogram path")
         self.use_pallas = pallas_ok and (
-            hk == "pallas"
+            hk in ("pallas", "paired")
             or (hk == "auto" and config.hist_compute_dtype == "bfloat16"))
+        # "paired" (per-group-pair dots, no expansion matmul) benched
+        # slower than the expansion kernel on v5e; kept as an option
+        self.pallas_paired = self.use_pallas and hk == "paired"
+        self.pallas_block = 2048 if self.n_padded % 2048 == 0 else 1024
+        # int8 quantized training (see _hist_kernel_body_q): histogram
+        # matmuls on the int8 MXU with one grad/hess scale per tree.
+        # The int32 accumulator bounds rows at N*127 < 2^31.
+        self.use_quant = self.use_pallas and not self.pallas_paired \
+            and getattr(config, "quantized_grad", False) \
+            and self.n_padded * 127 < 2**31
+        if getattr(config, "quantized_grad", False) and self.use_pallas \
+                and not self.use_quant and not self.pallas_paired:
+            Log.warning("quantized_grad disabled: dataset exceeds the "
+                        "int32 histogram accumulator bound (~16.9M rows)")
         self._is_voting = (self.policy.mesh is not None
                            and config.tree_learner == "voting")
         self._train_tree = jax.jit(self._train_tree_impl)
@@ -320,11 +337,23 @@ class TreeGrower:
 
     # ------------------------------------------------------------------
     def _hist_kernel(self, grad, hess, counts, leaf_id, slots=None,
-                     num_leaves=None):
+                     num_leaves=None, quant=None):
         """Frontier histogram dispatch: Pallas on a real single chip,
         XLA one-hot contraction under meshes / CPU simulation."""
         L = self.num_leaves if num_leaves is None else num_leaves
+        if quant is not None:
+            wq, scales = quant
+            return compute_group_histograms_pallas_q(
+                self.bins, wq, scales, leaf_id,
+                num_leaves=L, max_group_bin=self.max_group_bin,
+                slots=slots)
         if self.use_pallas:
+            if self.pallas_paired:
+                # lower VMEM footprint permits the larger row block
+                return compute_group_histograms_pallas_paired(
+                    self.bins, grad, hess, counts, leaf_id,
+                    num_leaves=L, max_group_bin=self.max_group_bin,
+                    slots=slots, block=self.pallas_block)
             return compute_group_histograms_pallas(
                 self.bins, grad, hess, counts, leaf_id,
                 num_leaves=L, max_group_bin=self.max_group_bin,
@@ -402,20 +431,29 @@ class TreeGrower:
     def _train_tree_impl(self, grad, hess, counts, feature_mask):
         state = self._init_state(grad, hess, counts)
         if self._is_voting:
-            body_fn = self._round_voting
+            def body_fn(st):
+                return self._round_voting(st, grad, hess, counts,
+                                          feature_mask)
         else:
+            # gradients are fixed for the whole tree, so the int8
+            # quantization (one scale per channel) happens once here
+            quant = (quantize_gradients(grad, hess, counts)
+                     if self.use_quant else None)
             W = self.frontier
             parents0 = jnp.full((W,), -1, jnp.int32)
             rights0 = jnp.full((W,), -1, jnp.int32).at[0].set(0)
             state = self._refresh(state, parents0, rights0, grad, hess,
-                                  counts, feature_mask)
-            body_fn = self._round
+                                  counts, feature_mask, quant)
+
+            def body_fn(st):
+                return self._round(st, grad, hess, counts, feature_mask,
+                                   quant)
 
         def cond(st: GrowerState):
             return ~st.done
 
         def body(st: GrowerState):
-            return body_fn(st, grad, hess, counts, feature_mask)
+            return body_fn(st)
 
         final = jax.lax.while_loop(cond, body, state)
         tree = final.tree._replace(num_leaves=final.num_leaves)
@@ -444,7 +482,7 @@ class TreeGrower:
 
     # ------------------------------------------------------------------
     def _refresh(self, st: GrowerState, parents, rights, grad, hess,
-                 counts, feature_mask) -> GrowerState:
+                 counts, feature_mask, quant=None) -> GrowerState:
         """Histogram + split-finder pass over the new leaves of a round.
 
         ``rights`` are histogrammed directly from the data (one
@@ -459,19 +497,18 @@ class TreeGrower:
         cache = st.hist_cache
 
         right_hist = self._hist_kernel(grad, hess, counts, st.leaf_id,
-                                       slots=rights)
+                                       slots=rights, quant=quant)
         right_hist = self.policy.constrain_hist(right_hist)
         safe_p = jnp.clip(parents, 0, L - 1)
         left_hist = cache[safe_p] - right_hist
-        cache = cache.at[jnp.where(parents >= 0, parents, L)].set(
-            left_hist, mode="drop")
-        cache = cache.at[jnp.where(rights >= 0, rights, L)].set(
-            right_hist, mode="drop")
-
+        # one combined scatter (parent and right slots are disjoint) so
+        # XLA emits a single in-place update of the 5+ MB cache buffer
         new_slots = jnp.concatenate([parents, rights])          # (2W,)
+        h_new = jnp.concatenate([left_hist, right_hist])        # (2W,G,B,3)
+        cache = cache.at[jnp.where(new_slots >= 0, new_slots, L)].set(
+            h_new, mode="drop")
         safe = jnp.clip(new_slots, 0, L - 1)
         valid = new_slots >= 0
-        h_new = cache[safe]                                     # (2W,G,B,3)
         sg = st.leaf_sum_grad[safe]
         sh = st.leaf_sum_hess[safe]
         sc = st.leaf_count[safe]
@@ -550,59 +587,18 @@ class TreeGrower:
                            forced_cand=forced_cand)
 
     # ------------------------------------------------------------------
-    def _round(self, st: GrowerState, grad, hess, counts, feature_mask
-               ) -> GrowerState:
-        """One cached-candidate frontier round: select/apply splits from
-        the cache, then refresh histograms+candidates for new leaves."""
+    def _apply_selection(self, st: GrowerState, do_split, rank, k,
+                         best_gain, best_f, thr, dleft, lsg, lsh, lsc,
+                         lout, rout, cat_mask, forced_valid=None
+                         ) -> GrowerState:
+        """Apply the selected splits: scatter new internal nodes, update
+        child leaf state, propagate monotone constraints, re-label rows
+        (shared by the cached and voting rounds; the reference's
+        SerialTreeLearner::Split, serial_tree_learner.cpp:700-774).
+        All per-leaf args are (L,) chosen-split values."""
         L = self.num_leaves
         M = L - 1
-        W = self.frontier
-
-        best_gain = st.cand.gain
-        best_f = st.cand.feature
-        thr = st.cand.threshold
-        dleft = st.cand.default_left
-        lsg, lsh, lsc = st.cand.lsg, st.cand.lsh, st.cand.lsc
-        lout, rout = st.cand.lout, st.cand.rout
-        cat_mask = st.cand.cat_mask
-
-        forced_valid = None
-        if self.forced_count:
-            fc = st.forced_cand
-            s_node = jnp.clip(st.leaf_forced, 0, self.forced_count - 1)
-            ff = self.forced_feature[s_node]
-            forced_valid = (st.leaf_forced >= 0) & (fc.gain > NEG_INF)
-            best_f = jnp.where(forced_valid, ff, best_f)
-            best_gain = jnp.where(forced_valid, fc.gain, best_gain)
-            thr = jnp.where(forced_valid, fc.threshold, thr)
-            dleft = jnp.where(forced_valid, fc.default_left, dleft)
-            lsg = jnp.where(forced_valid, fc.lsg, lsg)
-            lsh = jnp.where(forced_valid, fc.lsh, lsh)
-            lsc = jnp.where(forced_valid, fc.lsc, lsc)
-            lout = jnp.where(forced_valid, fc.lout, lout)
-            rout = jnp.where(forced_valid, fc.rout, rout)
-            fmask = (jnp.arange(self.max_feature_bin, dtype=jnp.int32)[None]
-                     == fc.threshold[:, None])
-            cat_mask = jnp.where(forced_valid[:, None], fmask, cat_mask)
-
         slot = jnp.arange(L, dtype=jnp.int32)
-        active = slot < st.num_leaves
-        depth_ok = (self.max_depth <= 0) | \
-            (st.tree.leaf_depth < self.max_depth)
-        cand_m = active & depth_ok & (best_gain > 0.0)
-        if forced_valid is not None:
-            forced_valid = forced_valid & active
-            cand_m = cand_m | forced_valid
-
-        key = jnp.where(cand_m, best_gain, NEG_INF)
-        if forced_valid is not None:
-            key = jnp.where(forced_valid, jnp.inf, key)
-        order = jnp.argsort(-key)                   # best first, stable
-        rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
-        budget = L - st.num_leaves
-        do_split = cand_m & (rank < budget) & (rank < W)
-        k = do_split.sum().astype(jnp.int32)
-
         right_slot = st.num_leaves + rank            # valid where do_split
         node_id = (st.num_leaves - 1) + rank
 
@@ -704,8 +700,7 @@ class TreeGrower:
         num_leaves = st.num_leaves + k
         round_idx = st.round_idx + 1
         done = (k == 0) | (num_leaves >= L) | (round_idx >= self.max_rounds)
-
-        st2 = GrowerState(
+        return GrowerState(
             leaf_id=leaf_id, num_leaves=num_leaves, round_idx=round_idx,
             done=done, leaf_sum_grad=leaf_sum_grad,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
@@ -713,6 +708,63 @@ class TreeGrower:
             leaf_is_left=leaf_is_left, leaf_forced=leaf_forced, tree=tree,
             hist_cache=st.hist_cache, cand=st.cand,
             forced_cand=st.forced_cand)
+
+    # ------------------------------------------------------------------
+    def _round(self, st: GrowerState, grad, hess, counts, feature_mask,
+               quant=None) -> GrowerState:
+        """One cached-candidate frontier round: select/apply splits from
+        the cache, then refresh histograms+candidates for new leaves."""
+        L = self.num_leaves
+        W = self.frontier
+
+        best_gain = st.cand.gain
+        best_f = st.cand.feature
+        thr = st.cand.threshold
+        dleft = st.cand.default_left
+        lsg, lsh, lsc = st.cand.lsg, st.cand.lsh, st.cand.lsc
+        lout, rout = st.cand.lout, st.cand.rout
+        cat_mask = st.cand.cat_mask
+
+        forced_valid = None
+        if self.forced_count:
+            fc = st.forced_cand
+            s_node = jnp.clip(st.leaf_forced, 0, self.forced_count - 1)
+            ff = self.forced_feature[s_node]
+            forced_valid = (st.leaf_forced >= 0) & (fc.gain > NEG_INF)
+            best_f = jnp.where(forced_valid, ff, best_f)
+            best_gain = jnp.where(forced_valid, fc.gain, best_gain)
+            thr = jnp.where(forced_valid, fc.threshold, thr)
+            dleft = jnp.where(forced_valid, fc.default_left, dleft)
+            lsg = jnp.where(forced_valid, fc.lsg, lsg)
+            lsh = jnp.where(forced_valid, fc.lsh, lsh)
+            lsc = jnp.where(forced_valid, fc.lsc, lsc)
+            lout = jnp.where(forced_valid, fc.lout, lout)
+            rout = jnp.where(forced_valid, fc.rout, rout)
+            fmask = (jnp.arange(self.max_feature_bin, dtype=jnp.int32)[None]
+                     == fc.threshold[:, None])
+            cat_mask = jnp.where(forced_valid[:, None], fmask, cat_mask)
+
+        slot = jnp.arange(L, dtype=jnp.int32)
+        active = slot < st.num_leaves
+        depth_ok = (self.max_depth <= 0) | \
+            (st.tree.leaf_depth < self.max_depth)
+        cand_m = active & depth_ok & (best_gain > 0.0)
+        if forced_valid is not None:
+            forced_valid = forced_valid & active
+            cand_m = cand_m | forced_valid
+
+        key = jnp.where(cand_m, best_gain, NEG_INF)
+        if forced_valid is not None:
+            key = jnp.where(forced_valid, jnp.inf, key)
+        order = jnp.argsort(-key)                   # best first, stable
+        rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
+        budget = L - st.num_leaves
+        do_split = cand_m & (rank < budget) & (rank < W)
+        k = do_split.sum().astype(jnp.int32)
+
+        st2 = self._apply_selection(st, do_split, rank, k, best_gain,
+                                    best_f, thr, dleft, lsg, lsh, lsc,
+                                    lout, rout, cat_mask, forced_valid)
 
         # refresh histograms + candidates for the new leaves.  order[w]
         # is the leaf with split-rank w (its slot hosts the left child);
@@ -724,10 +776,10 @@ class TreeGrower:
         parents = jnp.where(split_ok, order[:W].astype(jnp.int32), -1)
         rights = jnp.where(split_ok, st.num_leaves + w_iota, -1)
         return jax.lax.cond(
-            done,
+            st2.done,
             lambda s: s,
             lambda s: self._refresh(s, parents, rights, grad, hess,
-                                    counts, feature_mask),
+                                    counts, feature_mask, quant),
             st2)
 
     # ==================================================================
@@ -840,118 +892,25 @@ class TreeGrower:
         do_split = cand_m & (rank < budget)
         k = do_split.sum().astype(jnp.int32)
 
-        right_slot = st.num_leaves + rank            # valid where do_split
-        node_id = (st.num_leaves - 1) + rank
-
         def at_leaf(arr2d):
             # res arrays live in the (possibly compacted) finder space
             return jnp.take_along_axis(arr2d, best_fc[:, None],
                                        axis=1)[:, 0]
 
         thr = at_leaf(res.threshold)
-        dleft = at_leaf(res.default_left)
-        lsg = at_leaf(res.left_sum_grad)
-        lsh = at_leaf(res.left_sum_hess)
-        lsc = at_leaf(res.left_count)
-        lout = at_leaf(res.left_output)
-        rout = at_leaf(res.right_output)
         cat_dir = at_leaf(res.cat_dir)
-        f_is_cat_leaf = self.f_is_cat[best_f]
-        f_missing_leaf = self.f_missing[best_f]
-        f_dbin_leaf = self.f_default_bin[best_f]
-        f_nb_leaf = self.f_num_bin[best_f]
-        f_group_leaf = self.f_group[best_f]
-        f_mono_leaf = self.f_monotone[best_f]
-
-        # categorical bitsets for chosen features
         if self.has_categorical:
             hist_chosen = jnp.take_along_axis(
                 hist, best_fc[:, None, None, None], axis=1)[:, 0]  # (L,B,3)
             cat_mask = build_cat_bitset(hist_chosen, thr, cat_dir,
-                                        f_nb_leaf, f_missing_leaf,
+                                        self.f_num_bin[best_f],
+                                        self.f_missing[best_f],
                                         self.cfg_scalars)
         else:
             cat_mask = jnp.zeros((L, B), bool)
 
-        # scatter new internal nodes (drop out-of-budget writes)
-        nid = jnp.where(do_split, node_id, M)
-        t = st.tree
-        parent_out = t.leaf_value
-        tree = t._replace(
-            node_feature=t.node_feature.at[nid].set(best_f, mode="drop"),
-            node_threshold=t.node_threshold.at[nid].set(thr, mode="drop"),
-            node_default_left=t.node_default_left.at[nid].set(
-                dleft, mode="drop"),
-            node_is_cat=t.node_is_cat.at[nid].set(f_is_cat_leaf,
-                                                  mode="drop"),
-            node_cat_mask=t.node_cat_mask.at[nid].set(cat_mask,
-                                                      mode="drop"),
-            node_gain=t.node_gain.at[nid].set(best_gain, mode="drop"),
-            node_value=t.node_value.at[nid].set(parent_out, mode="drop"),
-            node_weight=t.node_weight.at[nid].set(st.leaf_sum_hess,
-                                                  mode="drop"),
-            node_count=t.node_count.at[nid].set(st.leaf_count, mode="drop"),
-            node_left=t.node_left.at[nid].set(_encode_leaf(slot),
-                                              mode="drop"),
-            node_right=t.node_right.at[nid].set(_encode_leaf(right_slot),
-                                                mode="drop"),
-        )
-        has_parent = do_split & (t.leaf_parent >= 0)
-        p = jnp.where(has_parent, t.leaf_parent, M)
-        pl = jnp.where(has_parent & st.leaf_is_left, p, M)
-        pr = jnp.where(has_parent & ~st.leaf_is_left, p, M)
-        tree = tree._replace(
-            node_left=tree.node_left.at[pl].set(node_id, mode="drop"),
-            node_right=tree.node_right.at[pr].set(node_id, mode="drop"),
-        )
-
-        rsg = st.leaf_sum_grad - lsg
-        rsh = st.leaf_sum_hess - lsh
-        rsc = st.leaf_count - lsc
-        new_depth = t.leaf_depth + 1
-        rs = jnp.where(do_split, right_slot, L)
-
-        def upd(arr, left_val, right_val):
-            arr = arr.at[rs].set(right_val, mode="drop")
-            return jnp.where(do_split, left_val, arr)
-
-        leaf_sum_grad = upd(st.leaf_sum_grad, lsg, rsg)
-        leaf_sum_hess = upd(st.leaf_sum_hess, lsh, rsh)
-        leaf_count = upd(st.leaf_count, lsc, rsc)
-
-        mid = (lout + rout) / 2.0
-        is_num = ~f_is_cat_leaf
-        lmin = jnp.where(is_num & (f_mono_leaf < 0), mid, st.leaf_min_c)
-        lmax = jnp.where(is_num & (f_mono_leaf > 0), mid, st.leaf_max_c)
-        rmin = jnp.where(is_num & (f_mono_leaf > 0), mid, st.leaf_min_c)
-        rmax = jnp.where(is_num & (f_mono_leaf < 0), mid, st.leaf_max_c)
-        leaf_min_c = upd(st.leaf_min_c, lmin, rmin)
-        leaf_max_c = upd(st.leaf_max_c, lmax, rmax)
-
-        tree = tree._replace(
-            leaf_value=upd(t.leaf_value, lout, rout),
-            leaf_weight=upd(t.leaf_weight, lsh, rsh),
-            leaf_count=upd(t.leaf_count, lsc, rsc),
-            leaf_parent=upd(t.leaf_parent, node_id, node_id),
-            leaf_depth=upd(t.leaf_depth, new_depth, new_depth),
-        )
-        leaf_is_left = upd(st.leaf_is_left,
-                           jnp.ones(L, bool), jnp.zeros(L, bool))
-
-        g2f_leaf = self.g2f_lut[best_f]               # (L, GB)
-        leaf_id = apply_splits(
-            self.bins, st.leaf_id, do_split, f_group_leaf, g2f_leaf,
-            f_is_cat_leaf, thr, dleft, f_missing_leaf, f_dbin_leaf,
-            f_nb_leaf, cat_mask, right_slot)
-
-        num_leaves = st.num_leaves + k
-        round_idx = st.round_idx + 1
-        done = (k == 0) | (num_leaves >= L) | (round_idx >= self.max_rounds)
-        return GrowerState(
-            leaf_id=leaf_id, num_leaves=num_leaves, round_idx=round_idx,
-            done=done, leaf_sum_grad=leaf_sum_grad,
-            leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
-            leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c,
-            leaf_is_left=leaf_is_left, leaf_forced=st.leaf_forced,
-            tree=tree, hist_cache=st.hist_cache, cand=st.cand,
-            forced_cand=st.forced_cand)
+        return self._apply_selection(
+            st, do_split, rank, k, best_gain, best_f, thr,
+            at_leaf(res.default_left), at_leaf(res.left_sum_grad),
+            at_leaf(res.left_sum_hess), at_leaf(res.left_count),
+            at_leaf(res.left_output), at_leaf(res.right_output), cat_mask)

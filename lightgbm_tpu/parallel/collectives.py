@@ -114,3 +114,45 @@ class HostCollectives(Collectives):
 
     def simulate_allgather(self, per_shard_arrays, axis: int = 0):
         return np.concatenate(per_shard_arrays, axis=axis)
+
+
+class ExternalCollectives(HostCollectives):
+    """User-injected reduce-scatter/allgather callables — the direct
+    analog of LGBM_NetworkInitWithFunctions (reference c_api.h:760-762,
+    network.h:96).  Callables receive and return numpy arrays; used by
+    embedders that bring their own transport."""
+
+    def __init__(self, num_machines: int, rank: int,
+                 reduce_scatter_fn: Optional[Callable] = None,
+                 allgather_fn: Optional[Callable] = None):
+        super().__init__(shards=num_machines)
+        self.external_rank = rank
+        self.reduce_scatter_fn = reduce_scatter_fn
+        self.allgather_fn = allgather_fn
+
+    def simulate_reduce_scatter(self, per_shard_arrays, axis: int = 0):
+        if self.reduce_scatter_fn is None:
+            return super().simulate_reduce_scatter(per_shard_arrays, axis)
+        return self.reduce_scatter_fn(per_shard_arrays)
+
+    def simulate_allgather(self, per_shard_arrays, axis: int = 0):
+        if self.allgather_fn is None:
+            return super().simulate_allgather(per_shard_arrays, axis)
+        return self.allgather_fn(per_shard_arrays)
+
+
+_external: Optional[ExternalCollectives] = None
+
+
+def install_external(num_machines: int, rank: int,
+                     reduce_scatter_fn: Optional[Callable] = None,
+                     allgather_fn: Optional[Callable] = None) -> None:
+    """Install a process-global external backend (the
+    LGBM_NetworkInitWithFunctions seam, exposed via capi.py)."""
+    global _external
+    _external = ExternalCollectives(num_machines, rank,
+                                    reduce_scatter_fn, allgather_fn)
+
+
+def external() -> Optional[ExternalCollectives]:
+    return _external

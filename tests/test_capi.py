@@ -1,0 +1,98 @@
+"""C-API-surface tests (the reference's tests/c_api_test/test_.py
+analog: ctypes-level Dataset/Booster lifecycle, :59-255)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu.capi as capi
+import lightgbm_tpu as lgb
+
+
+def _mk_data(rng, n=500, f=5):
+    X = rng.randn(n, f)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def test_dataset_booster_lifecycle(rng, tmp_path):
+    X, y = _mk_data(rng)
+    dh = [None]
+    assert capi.LGBM_DatasetCreateFromMat(X, "max_bin=31", None, dh) == 0
+    assert capi.LGBM_DatasetSetField(dh[0], "label", y) == 0
+    nd, nf = [None], [None]
+    assert capi.LGBM_DatasetGetNumData(dh[0], nd) == 0
+    assert capi.LGBM_DatasetGetNumFeature(dh[0], nf) == 0
+    assert nd[0] == 500 and nf[0] == 5
+
+    bh = [None]
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=binary num_leaves=15 metric=binary_logloss "
+        "verbose=-1", bh) == 0, capi.LGBM_GetLastError()
+    for _ in range(10):
+        fin = [0]
+        assert capi.LGBM_BoosterUpdateOneIter(bh[0], fin) == 0
+    it = [None]
+    assert capi.LGBM_BoosterGetCurrentIteration(bh[0], it) == 0
+    assert it[0] == 10
+
+    ev = [None]
+    assert capi.LGBM_BoosterGetEval(bh[0], 0, ev) == 0
+    assert ev[0] and ev[0][0] < 0.6  # training logloss fell
+
+    # predict + save/load round trip
+    po = [None]
+    assert capi.LGBM_BoosterPredictForMat(bh[0], X[:32], 0, -1, po) == 0
+    path = str(tmp_path / "capi_model.txt")
+    assert capi.LGBM_BoosterSaveModel(bh[0], -1, path) == 0
+    ni, bh2 = [None], [None]
+    assert capi.LGBM_BoosterCreateFromModelfile(path, ni, bh2) == 0
+    po2 = [None]
+    assert capi.LGBM_BoosterPredictForMat(bh2[0], X[:32], 0, -1, po2) == 0
+    np.testing.assert_allclose(po[0], po2[0], rtol=1e-6)
+
+    # leaf index + contrib prediction types
+    pl = [None]
+    assert capi.LGBM_BoosterPredictForMat(bh[0], X[:8], 2, -1, pl) == 0
+    assert pl[0].shape == (8, 10)
+    pc = [None]
+    assert capi.LGBM_BoosterPredictForMat(bh[0], X[:8], 3, -1, pc) == 0
+    assert pc[0].shape == (8, 6)          # features + bias
+
+    assert capi.LGBM_BoosterFree(bh[0]) == 0
+    assert capi.LGBM_DatasetFree(dh[0]) == 0
+
+
+def test_error_convention():
+    out = [None]
+    rc = capi.LGBM_BoosterCreate(999999, "objective=binary", out)
+    assert rc == -1
+    assert "handle" in capi.LGBM_GetLastError()
+
+
+def test_custom_gradient_update(rng):
+    X, y = _mk_data(rng)
+    dh = [None]
+    assert capi.LGBM_DatasetCreateFromMat(X, "", None, dh) == 0
+    assert capi.LGBM_DatasetSetField(dh[0], "label", y) == 0
+    bh = [None]
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=regression num_leaves=7 verbose=-1", bh) == 0
+    grad = np.zeros(500, np.float32) - y.astype(np.float32)
+    hess = np.ones(500, np.float32)
+    fin = [0]
+    assert capi.LGBM_BoosterUpdateOneIterCustom(bh[0], grad, hess,
+                                                fin) == 0, \
+        capi.LGBM_GetLastError()
+
+
+def test_cvbooster(rng):
+    X, y = _mk_data(rng, n=400)
+    ds = lgb.Dataset(X, label=y)
+    res = lgb.cv({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                  "metric": "binary_logloss"}, ds, 8, nfold=3,
+                 return_cvbooster=True)
+    cvb = res["cvbooster"]
+    assert isinstance(cvb, lgb.CVBooster)
+    assert len(cvb.boosters) == 3
+    preds = cvb.predict(X[:16])
+    assert len(preds) == 3 and all(p.shape == (16,) for p in preds)
+    assert "binary_logloss-mean" in res

@@ -63,7 +63,7 @@ class RF(GBDT):
             for vs in self.valid_sets:
                 pv = self._predict_valid_fn(tree_arrays, vs.bins)
                 vs.scores = (vs.scores * t).at[k].add(pv) / (t + 1.0)
-            self._pending.append((tree_arrays, 1.0, 0.0))
+            self._pending.append(("tree", tree_arrays, 1.0, 0.0))
             self._tree_scale.append(1.0)
         self.iter_ += 1
         return False

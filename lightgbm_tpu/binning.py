@@ -77,24 +77,43 @@ def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
     rest_sample_cnt = total_cnt - int(counts[is_big].sum())
     mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
 
+    # The reference walks every distinct value accumulating counts until
+    # a boundary triggers (bin.cpp:104-136).  Equivalent but O(bins):
+    # jump straight to each boundary with searchsorted — a boundary at j
+    # is the first index where (a) j is big, (b) accumulated >= mean, or
+    # (c) j+1 is big and accumulated >= mean/2.
+    cum = np.cumsum(counts)                                # (D,)
+    rest_cum = np.cumsum(np.where(is_big, 0, counts))      # (D,)
+    big_pos = np.flatnonzero(is_big)                       # ascending
     uppers: List[float] = []
     lowers: List[float] = [float(distinct_values[0])]
-    cur = 0
-    for i in range(num_distinct - 1):
-        if not is_big[i]:
-            rest_sample_cnt -= int(counts[i])
-        cur += int(counts[i])
-        need_new = (is_big[i] or cur >= mean_bin_size or
-                    (is_big[i + 1] and cur >= max(1.0, mean_bin_size * 0.5)))
-        if need_new:
-            uppers.append(float(distinct_values[i]))
-            lowers.append(float(distinct_values[i + 1]))
-            if len(uppers) >= max_bin - 1:
-                break
-            cur = 0
-            if not is_big[i]:
-                rest_bin_cnt -= 1
-                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    i = 0
+    last = num_distinct - 1                                # exclusive walk end
+    while i < last and len(uppers) < max_bin - 1:
+        base = cum[i - 1] if i > 0 else 0
+        # (a) next big value at/after i
+        bi = np.searchsorted(big_pos, i)
+        j1 = int(big_pos[bi]) if bi < len(big_pos) else num_distinct
+        # (b) first j with cum[j] - base >= mean_bin_size
+        j2 = int(np.searchsorted(cum, base + mean_bin_size))
+        # (c) first big-successor position p-1 >= the half-mean point
+        half_at = int(np.searchsorted(cum, base + max(1.0,
+                                                      mean_bin_size * 0.5)))
+        bj = np.searchsorted(big_pos, max(i, half_at) + 1)
+        j3 = int(big_pos[bj]) - 1 if bj < len(big_pos) else num_distinct
+        # clamp to the walk position: when mean_bin_size hits 0 (all
+        # non-big samples exhausted) the scalar loop makes every
+        # remaining value its own bin, i.e. the boundary is at i itself
+        j = max(i, min(j1, j2, j3))
+        if j >= last:
+            break
+        uppers.append(float(distinct_values[j]))
+        lowers.append(float(distinct_values[j + 1]))
+        if not is_big[j]:
+            rest_bin_cnt -= 1
+            mean_bin_size = (rest_sample_cnt - rest_cum[j]) \
+                / max(rest_bin_cnt, 1)
+        i = j + 1
     for i in range(len(uppers)):
         val = _next_after_up((uppers[i] + lowers[i + 1]) / 2.0)
         if not bounds or not _double_equal_ordered(bounds[-1], val):
@@ -243,8 +262,7 @@ class BinMapper:
             idx = np.searchsorted(search_bounds[:-1] if len(search_bounds) else [],
                                   distinct, side="left")
             # idx = first bin whose upper bound >= value
-            for i in range(num_distinct):
-                cnt_in_bin[idx[i]] += counts[i]
+            np.add.at(cnt_in_bin, idx, counts)
             if self.missing_type == MISSING_NAN:
                 cnt_in_bin[self.num_bin - 1] = na_cnt
         else:
@@ -270,31 +288,40 @@ class BinMapper:
                             zero_cnt: int) -> Tuple[np.ndarray, np.ndarray]:
         """Distinct sorted values with the implicit zero spliced in at its
         ordered position carrying ``zero_cnt`` (reference bin.cpp:234-269).
-        Near-equal doubles (within one ulp) are merged keeping the larger."""
-        values = np.sort(values)
-        distinct: List[float] = []
-        counts: List[int] = []
-        if len(values) == 0 or (values[0] > 0.0 and zero_cnt > 0):
-            distinct.append(0.0)
-            counts.append(zero_cnt)
-        if len(values) > 0:
-            distinct.append(float(values[0]))
-            counts.append(1)
-        for i in range(1, len(values)):
-            prev, cur = float(values[i - 1]), float(values[i])
-            if not _double_equal_ordered(prev, cur):
-                if prev < 0.0 and cur > 0.0:
-                    distinct.append(0.0)
-                    counts.append(zero_cnt)
-                distinct.append(cur)
-                counts.append(1)
-            else:
-                distinct[-1] = cur  # keep the larger of near-equal values
-                counts[-1] += 1
-        if len(values) > 0 and values[-1] < 0.0 and zero_cnt > 0:
-            distinct.append(0.0)
-            counts.append(zero_cnt)
-        return np.asarray(distinct), np.asarray(counts, dtype=np.int64)
+        Near-equal doubles (within one ulp) are merged keeping the larger.
+        Vectorized: runs of near-equal values become groups (a group's
+        value is its max = last element); the zero splice lands at the
+        adjacent negative->positive group boundary."""
+        values = np.sort(np.asarray(values, dtype=np.float64))
+        n = len(values)
+        if n == 0:
+            return (np.asarray([0.0]),
+                    np.asarray([zero_cnt], dtype=np.int64))
+        new_grp = np.empty(n, dtype=bool)
+        new_grp[0] = True
+        # chain rule matches the scalar loop: compare each value to its
+        # RAW predecessor (merged groups keep the larger value)
+        new_grp[1:] = values[1:] > np.nextafter(values[:-1], _INF)
+        starts = np.flatnonzero(new_grp)
+        ends = np.append(starts[1:], n) - 1
+        distinct = values[ends]
+        counts = np.diff(np.append(starts, n)).astype(np.int64)
+        if values[0] > 0.0 and zero_cnt > 0:
+            distinct = np.concatenate([[0.0], distinct])
+            counts = np.concatenate([[zero_cnt], counts])
+        elif values[-1] < 0.0 and zero_cnt > 0:
+            distinct = np.concatenate([distinct, [0.0]])
+            counts = np.concatenate([counts, [zero_cnt]])
+        else:
+            # splice between the last negative and first positive group
+            # (suppressed when an exact-zero group sits between them,
+            # matching the scalar loop's strict sign checks)
+            k = int(np.searchsorted(distinct, 0.0, side="left"))
+            if 0 < k < len(distinct) and distinct[k - 1] < 0.0 \
+                    and distinct[k] > 0.0:
+                distinct = np.insert(distinct, k, 0.0)
+                counts = np.insert(counts, k, zero_cnt)
+        return distinct, counts.astype(np.int64)
 
     # ------------------------------------------------------------------
     def _fit_categorical(self, distinct: np.ndarray, counts: np.ndarray,

@@ -208,6 +208,9 @@ class LGBMModel(_SKBase):
         self._evals_result = evals_result
         self._best_iteration = self._Booster.best_iteration
         self._n_features = train_set.num_feature()
+        # sklearn's check_is_fitted detects fitted state from instance
+        # attributes with a trailing underscore
+        self.n_features_in_ = self._n_features
         return self
 
     def _process_label(self, y):
@@ -245,12 +248,14 @@ class LGBMModel(_SKBase):
         return self._n_features
 
 
-class LGBMRegressor(LGBMModel, _SKRegressor):
+class LGBMRegressor(_SKRegressor, LGBMModel):
+    # mixin first: sklearn's __sklearn_tags__/estimator_type resolution
+    # walks the MRO and the mixin must precede the BaseEstimator subclass
     def _default_objective(self):
         return "regression"
 
 
-class LGBMClassifier(LGBMModel, _SKClassifier):
+class LGBMClassifier(_SKClassifier, LGBMModel):
     def _default_objective(self):
         if self._n_classes is not None and self._n_classes > 2:
             return "multiclass"

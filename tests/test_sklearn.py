@@ -3,6 +3,7 @@ test_sklearn.py:39-205)."""
 import pickle
 
 import numpy as np
+import pytest
 from sklearn.datasets import load_breast_cancer, load_digits, make_regression
 from sklearn.metrics import log_loss, mean_squared_error
 from sklearn.model_selection import train_test_split
@@ -124,3 +125,41 @@ def test_early_stopping_sklearn():
           eval_metric="binary_logloss", early_stopping_rounds=5)
     assert m.best_iteration_ > 0
     assert m.booster_.num_trees() < 300
+
+
+def test_sklearn_check_estimator_basics():
+    """The reference integrates sklearn's own estimator checks
+    (reference test_sklearn.py:185 TestSklearn.test_sklearn_integration).
+    Run the core contract checks that don't require exotic input
+    handling (sparse matrices are out of scope for the TPU backend)."""
+    import numpy as np
+    from sklearn.base import clone, is_classifier, is_regressor
+    from sklearn.utils.validation import check_is_fitted
+    import lightgbm_tpu as lgb
+
+    reg = lgb.LGBMRegressor(n_estimators=5, num_leaves=7)
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7)
+    assert is_regressor(reg) and is_classifier(clf)
+    # get_params/set_params/clone round trip (sklearn contract)
+    p = reg.get_params()
+    assert p["n_estimators"] == 5
+    reg2 = clone(reg).set_params(n_estimators=3)
+    assert reg2.get_params()["n_estimators"] == 3
+    assert reg.get_params()["n_estimators"] == 5  # clone is independent
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(120, 4)
+    yr = X[:, 0] * 2.0 + rng.randn(120) * 0.1
+    yc = (X[:, 0] > 0).astype(int)
+    reg.fit(X, yr)
+    check_is_fitted(reg)
+    assert reg.predict(X).shape == (120,)
+    clf.fit(X, yc)
+    assert set(clf.classes_) == {0, 1}
+    proba = clf.predict_proba(X)
+    assert proba.shape == (120, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    # refitting with different data must reset state
+    X2 = rng.randn(80, 4)
+    reg.fit(X2, X2[:, 1])
+    assert reg.predict(X2).shape == (80,)

@@ -34,6 +34,16 @@ def make_data(n, f, seed=7):
 
 
 def main():
+    import jax
+    # persistent compile cache: the fused training step costs minutes to
+    # compile; cache hits make repeat bench runs start in seconds
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
     import lightgbm_tpu as lgb
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
@@ -66,7 +76,8 @@ def main():
     gbdt = GBDT(cfg, core)
     # multi-iteration fused chunks amortize the per-dispatch RPC cost
     # of the remote-attached TPU; same path engine.train uses headless
-    chunk = max(1, min(10, BENCH_ITERS // 2))
+    chunk = max(1, min(int(os.environ.get("BENCH_CHUNK", 10)),
+                       BENCH_ITERS // 2))
     # warmup: compile one chunk
     t0 = time.time()
     gbdt.train_chunk(chunk)

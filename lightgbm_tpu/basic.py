@@ -56,12 +56,17 @@ class Dataset:
                 self.weight = extras["weight"]
             if self.group is None and extras.get("group") is not None:
                 self.group = extras["group"]
-        data = _to_matrix(data)
-        feature_names, cat_indices = self._resolve_columns(data)
-
         ref_core = None
         if self.reference is not None:
             ref_core = self.reference.construct(config)
+        # validation frames must encode pandas categoricals against the
+        # TRAIN-time category lists (the reference aligns valid frames
+        # to the train categories and errors on mismatch)
+        train_cats = getattr(ref_core, "pandas_categorical", None)
+        pandas_cats = (train_cats if train_cats is not None
+                       else _pandas_categories(data))
+        data = _to_matrix(data, train_cats)
+        feature_names, cat_indices = self._resolve_columns(data)
 
         self._core = CoreDataset.from_matrix(
             data, label=label, weight=self.weight, group=self.group,
@@ -70,6 +75,7 @@ class Dataset:
             feature_names=feature_names, reference=ref_core)
         self._core._raw_data = None if self.free_raw_data else data
         self._core._categorical_features = cat_indices
+        self._core.pandas_categorical = pandas_cats
         return self._core
 
     # ------------------------------------------------------------------
@@ -195,18 +201,56 @@ def _is_pandas(obj) -> bool:
         hasattr(obj, "dtypes")
 
 
-def _to_matrix(data) -> np.ndarray:
+def _to_matrix(data, pandas_categorical=None) -> np.ndarray:
+    """Raw input -> float64 matrix.  Pandas category-dtype columns
+    encode as their category codes; when ``pandas_categorical`` (the
+    train-time category lists, in categorical-column order) is given,
+    codes are computed AGAINST THOSE categories so a predict-time frame
+    with reordered or fewer observed categories maps identically
+    (reference basic.py pandas_categorical handling); unseen categories
+    become NaN."""
     if isinstance(data, np.ndarray):
         return np.ascontiguousarray(data.astype(np.float64, copy=False))
+    if _is_pandas(data) and not hasattr(data, "columns"):
+        # a Series: single row of raw features
+        return np.ascontiguousarray(np.asarray(data, dtype=np.float64))
     if _is_pandas(data):
+        import pandas as pd
+        n_cat = sum(1 for c in data.columns
+                    if str(data[c].dtype) == "category")
+        if pandas_categorical is not None \
+                and n_cat != len(pandas_categorical):
+            raise ValueError(
+                "train and valid/predict dataset categorical_feature do "
+                f"not match: trained with {len(pandas_categorical)} "
+                f"categorical columns, got {n_cat}")
         cols = []
+        i_cat = 0
         for c in data.columns:
             col = data[c]
             if str(col.dtype) == "category":
-                cols.append(col.cat.codes.to_numpy().astype(np.float64))
+                if pandas_categorical is not None:
+                    cats = pandas_categorical[i_cat]
+                    codes = pd.Categorical(
+                        col, categories=cats).codes.astype(np.float64)
+                    codes[codes < 0] = np.nan
+                else:
+                    codes = col.cat.codes.to_numpy().astype(np.float64)
+                cols.append(codes)
+                i_cat += 1
             else:
                 cols.append(col.to_numpy().astype(np.float64))
         return np.ascontiguousarray(np.stack(cols, axis=1))
     if hasattr(data, "toarray"):  # scipy sparse
         return np.ascontiguousarray(data.toarray().astype(np.float64))
     return np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+
+
+def _pandas_categories(data):
+    """Category lists of category-dtype columns, in column order (the
+    reference's pandas_categorical model attribute)."""
+    if not _is_pandas(data):
+        return None
+    cats = [list(data[c].cat.categories) for c in data.columns
+            if str(data[c].dtype) == "category"]
+    return cats or None

@@ -59,6 +59,8 @@ class Booster:
         self.feature_names = train_set.feature_names
         self.feature_infos = train_set.feature_infos()
         self.max_feature_idx = train_set.num_total_features - 1
+        self.pandas_categorical = getattr(train_set, "pandas_categorical",
+                                          None)
         self.objective_str = self._objective_to_string()
         if init_model is not None:
             base = (Booster(model_file=init_model)
@@ -148,7 +150,10 @@ class Booster:
         """Host prediction on raw features (reference
         gbdt_prediction.cpp:9-100; SHAP via tree.PredictContrib;
         margin-based early stop prediction_early_stop.cpp:13-80)."""
-        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        from .basic import _to_matrix
+        # pandas categoricals encode against the TRAIN-time category
+        # lists so reordered/unseen predict-time categories map right
+        data = _to_matrix(data, getattr(self, "pandas_categorical", None))
         if data.ndim == 1:
             data = data[None, :]
         n = data.shape[0]
@@ -266,11 +271,27 @@ class Booster:
         text += "\nfeature importances:\n"
         for v, name in pairs:
             text += f"{name}={v}\n"
+        if getattr(self, "pandas_categorical", None):
+            # trailing mapping line, like the reference python package
+            import json as _json
+            text += "\npandas_categorical:%s\n" % _json.dumps(
+                self.pandas_categorical, default=str)
         return text
 
     # ------------------------------------------------------------------
     def _load_from_string(self, text: str) -> None:
         """reference gbdt_model_text.cpp:317+ LoadModelFromString."""
+        self.pandas_categorical = None
+        for line in reversed(text.rstrip().splitlines()[-3:]):
+            if line.startswith("pandas_categorical:"):
+                import json as _json
+                try:
+                    self.pandas_categorical = _json.loads(
+                        line[len("pandas_categorical:"):])
+                except ValueError:
+                    pass
+                text = text[:text.rfind("pandas_categorical:")]
+                break
         header, _, rest = text.partition("Tree=0")
         kv = {}
         for line in header.splitlines():

@@ -341,3 +341,84 @@ def test_prediction_early_stop():
                            pred_early_stop_freq=5,
                            pred_early_stop_margin=1e9)
     assert np.allclose(full, es_loose)
+
+
+def test_pandas_dataframe_and_categorical():
+    """Pandas input with categorical dtype (reference test_engine.py:482
+    test_pandas_categorical)."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(5)
+    n = 800
+    df = pd.DataFrame({
+        "a": rng.randn(n),
+        "b": pd.Categorical(rng.choice(["x", "y", "z"], n)),
+        "c": rng.randint(0, 5, n),
+    })
+    y = ((df["b"].cat.codes.values == 1) | (df["a"].values > 0.5)) \
+        .astype(float)
+    ds = lgb.Dataset(df, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15}, ds, 20, verbose_eval=False)
+    pred = bst.predict(df)
+    err = np.mean((pred > 0.5) != y)
+    assert err < 0.1
+
+
+def test_sliced_numpy_arrays():
+    """Non-contiguous inputs must work (reference test_engine.py:553)."""
+    rng = np.random.RandomState(6)
+    big = rng.randn(1000, 12)
+    X = big[::2, 1:9]                     # strided view
+    ywide = np.column_stack([(big[:, 1] > 0).astype(float)] * 2)
+    y = ywide[::2, 0]                     # genuinely strided label
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, label=y), 10,
+                    verbose_eval=False)
+    p = bst.predict(np.asfortranarray(X))  # fortran-order predict input
+    assert ((p > 0.5) == (y > 0.5)).mean() > 0.95
+
+
+def test_dataset_reference_chain():
+    """Validation Datasets share the training set's bin mappers
+    (reference test_engine.py:523 test_reference_chain)."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] > 0).astype(float)
+    dtrain = lgb.Dataset(X[:400], label=y[:400])
+    dval = lgb.Dataset(X[400:], label=y[400:], reference=dtrain)
+    er = {}
+    lgb.train({"objective": "binary", "metric": "binary_logloss",
+               "verbose": -1, "num_leaves": 7}, dtrain, 10,
+              valid_sets=[dval], evals_result=er, verbose_eval=False)
+    core_t, core_v = dtrain.construct(None), dval.construct(None)
+    assert core_v.mappers is core_t.mappers   # shared, not re-fit
+    assert len(er["valid_0"]["binary_logloss"]) == 10
+
+
+def test_pandas_categorical_remap_on_predict():
+    """Predict-time category order must not matter: codes are computed
+    against the TRAIN-time categories persisted on the model (the
+    reference's pandas_categorical attribute), surviving a save/load
+    round trip; unseen categories behave as missing."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(9)
+    n = 600
+    cats = ["red", "green", "blue"]
+    col = rng.choice(cats, n)
+    df = pd.DataFrame({"a": rng.randn(n), "b": pd.Categorical(col, cats)})
+    y = (col == "green").astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7}, lgb.Dataset(df, label=y), 20,
+                    verbose_eval=False)
+    # reversed category declaration: same values, different codes
+    df2 = pd.DataFrame({"a": df["a"],
+                        "b": pd.Categorical(col, cats[::-1])})
+    np.testing.assert_allclose(bst.predict(df), bst.predict(df2))
+    # round trip through the text model keeps the mapping
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m.txt")
+        bst.save_model(p)
+        bst2 = lgb.Booster(model_file=p)
+        assert bst2.pandas_categorical == [cats]
+        np.testing.assert_allclose(bst.predict(df2), bst2.predict(df2))

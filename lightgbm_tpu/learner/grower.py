@@ -4,18 +4,21 @@ Re-design of SerialTreeLearner's leaf-wise loop
 (reference: src/treelearner/serial_tree_learner.cpp:156-220 Train,
 :700-774 Split) for XLA's static-shape world.  One jitted function grows
 a whole tree: a ``lax.while_loop`` over frontier rounds where each round
-  1. splits every leaf whose CACHED best candidate clears the gain bar
+  1. refreshes the leaves created LAST round (queued in pend_*): builds
+     histograms ONLY for the new right children in one MXU pass
+     (ops/histogram.py, frontier-restricted), derives each left child
+     as parent-minus-right — the reference's histogram subtraction
+     trick (serial_tree_learner.cpp:505-507) with the histogram pool's
+     role played by a fixed (L, G, B, 3) HBM cache — and runs the split
+     finder on those 2*W leaves only, caching their best candidates
+     (the best_split_per_leaf_ analog),
+  2. splits every leaf whose cached candidate clears the gain bar
      (gain-ordered within the remaining leaf budget, so slot/node
      numbering matches the reference's sequential best-first allocation
      whenever the budget doesn't bind),
-  2. re-labels rows (ops/partition.py),
-  3. builds histograms ONLY for the newly created right children in one
-     MXU pass (ops/histogram.py, frontier-restricted), and derives each
-     left child as parent-minus-right — the reference's histogram
-     subtraction trick (serial_tree_learner.cpp:505-507) with the roles
-     of the histogram pool played by a fixed (L, G, B, 3) HBM cache,
-  4. runs the split finder only on the 2*W new leaves and caches their
-     best candidates (the best_split_per_leaf_ analog).
+  3. re-labels rows (ops/partition.py) and queues the new children for
+     the next round — so the final round's children are never
+     histogrammed at all (the while_loop exits first).
 Zero host round-trips inside a tree; the boosting loop stays on device
 too and only syncs for metric printing/early stopping.
 
@@ -119,6 +122,9 @@ class GrowerState(NamedTuple):
     hist_cache: jax.Array        # (L, G, Bg, 3) f32 — per-leaf group hists
     cand: SplitCand
     forced_cand: ForcedCand
+    pend_parents: jax.Array      # (W,) slots whose hist/cands are stale
+    pend_rights: jax.Array       # (W,) — refreshed at the NEXT round's
+    # start (so the final round's refresh is never computed at all)
 
 
 def _encode_leaf(leaf_slot):
@@ -413,7 +419,11 @@ class TreeGrower:
             lsg=jnp.zeros(L, jnp.float32), lsh=jnp.zeros(L, jnp.float32),
             lsc=jnp.zeros(L, jnp.float32), lout=jnp.zeros(L, jnp.float32),
             rout=jnp.zeros(L, jnp.float32))
+        W = self.frontier
         return GrowerState(
+            pend_parents=jnp.full((W,), -1, jnp.int32),
+            # the root is the first "new leaf" awaiting refresh
+            pend_rights=jnp.full((W,), -1, jnp.int32).at[0].set(0),
             leaf_id=leaf_id, num_leaves=jnp.int32(1),
             round_idx=jnp.int32(0), done=jnp.bool_(False),
             leaf_sum_grad=leaf_sum_grad, leaf_sum_hess=leaf_sum_hess,
@@ -439,11 +449,6 @@ class TreeGrower:
             # quantization (one scale per channel) happens once here
             quant = (quantize_gradients(grad, hess, counts)
                      if self.use_quant else None)
-            W = self.frontier
-            parents0 = jnp.full((W,), -1, jnp.int32)
-            rights0 = jnp.full((W,), -1, jnp.int32).at[0].set(0)
-            state = self._refresh(state, parents0, rights0, grad, hess,
-                                  counts, feature_mask, quant)
 
             def body_fn(st):
                 return self._round(st, grad, hess, counts, feature_mask,
@@ -707,15 +712,21 @@ class TreeGrower:
             leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c,
             leaf_is_left=leaf_is_left, leaf_forced=leaf_forced, tree=tree,
             hist_cache=st.hist_cache, cand=st.cand,
-            forced_cand=st.forced_cand)
+            forced_cand=st.forced_cand,
+            pend_parents=st.pend_parents, pend_rights=st.pend_rights)
 
     # ------------------------------------------------------------------
     def _round(self, st: GrowerState, grad, hess, counts, feature_mask,
                quant=None) -> GrowerState:
-        """One cached-candidate frontier round: select/apply splits from
-        the cache, then refresh histograms+candidates for new leaves."""
+        """One cached-candidate frontier round: refresh histograms +
+        candidates for the leaves created LAST round (pend_*), then
+        select/apply splits from the cache.  Refreshing at round start
+        means the final round's new leaves are never histogrammed at
+        all — the while_loop exits first."""
         L = self.num_leaves
         W = self.frontier
+        st = self._refresh(st, st.pend_parents, st.pend_rights, grad,
+                           hess, counts, feature_mask, quant)
 
         best_gain = st.cand.gain
         best_f = st.cand.feature
@@ -766,21 +777,14 @@ class TreeGrower:
                                     best_f, thr, dleft, lsg, lsh, lsc,
                                     lout, rout, cat_mask, forced_valid)
 
-        # refresh histograms + candidates for the new leaves.  order[w]
-        # is the leaf with split-rank w (its slot hosts the left child);
-        # the matching right child sits at num_leaves_old + w.  The
-        # final round's refresh would be discarded by the while_loop
-        # exit, so skip the (full data pass) under done.
+        # queue this round's new leaves for the NEXT round's refresh:
+        # order[w] is the leaf with split-rank w (its slot hosts the
+        # left child); the matching right child is num_leaves_old + w
         w_iota = jnp.arange(W, dtype=jnp.int32)
         split_ok = w_iota < k
         parents = jnp.where(split_ok, order[:W].astype(jnp.int32), -1)
         rights = jnp.where(split_ok, st.num_leaves + w_iota, -1)
-        return jax.lax.cond(
-            st2.done,
-            lambda s: s,
-            lambda s: self._refresh(s, parents, rights, grad, hess,
-                                    counts, feature_mask, quant),
-            st2)
+        return st2._replace(pend_parents=parents, pend_rights=rights)
 
     # ==================================================================
     # voting-parallel path (full-frontier formulation)

@@ -62,6 +62,10 @@ def main():
         # tolerance. Disable with BENCH_QUANTIZED=0.
         "quantized_grad": os.environ.get("BENCH_QUANTIZED", "1") != "0",
     }
+    # ad-hoc experiment overrides, e.g. BENCH_PARAMS='{"frontier_width":64}'
+    extra = os.environ.get("BENCH_PARAMS")
+    if extra:
+        params.update(json.loads(extra))
     cfg = Config.from_params(params)
     t0 = time.time()
     core = lgb.Dataset(X, label=y).construct(cfg)

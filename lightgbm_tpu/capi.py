@@ -287,7 +287,32 @@ def LGBM_BoosterFeatureImportance(handle, num_iteration: int = -1,
                                   out=None) -> int:
     """reference c_api.h:717-728; 0 = split counts, 1 = total gain."""
     out[0] = _get(handle).feature_importance(
-        importance_type="split" if importance_type == 0 else "gain")
+        importance_type="split" if importance_type == 0 else "gain",
+        num_iteration=num_iteration)
+    return 0
+
+
+@_api
+def LGBM_BoosterGetEvalCounts(handle, out=None) -> int:
+    """reference c_api.h:430-437: number of metrics per dataset (so C
+    callers can size the LGBM_BoosterGetEval result buffer)."""
+    bst = _get(handle)
+    if not bst.gbdt.train_metrics:
+        bst.gbdt.add_train_metrics()
+    out[0] = sum(len(m.names()) for m in bst.gbdt.train_metrics)
+    return 0
+
+
+@_api
+def LGBM_BoosterGetEvalNames(handle, out=None) -> int:
+    """reference c_api.h:439-446."""
+    bst = _get(handle)
+    if not bst.gbdt.train_metrics:
+        bst.gbdt.add_train_metrics()
+    names: List[str] = []
+    for m in bst.gbdt.train_metrics:
+        names.extend(m.names())
+    out[0] = names
     return 0
 
 

@@ -1,0 +1,62 @@
+"""Native embedding C API: compile a pure-C host against
+liblgbm_tpu.so and run the reference-style C-API workout
+(tests/native_capi_driver.c) in a subprocess with no Python on its
+stack — the seam R/Java hosts use (reference: R-package/src/
+lightgbm_R.cpp links lib_lightgbm the same way)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "lightgbm_tpu", "native")
+LIB = os.path.join(NATIVE, "liblgbm_tpu.so")
+DRIVER_SRC = os.path.join(REPO, "tests", "native_capi_driver.c")
+
+
+def _python_config(*flags):
+    exe = f"python{sys.version_info.major}.{sys.version_info.minor}-config"
+    for cand in (exe, "python3-config"):
+        try:
+            out = subprocess.run([cand, *flags], capture_output=True,
+                                 text=True, check=True)
+            return out.stdout.split()
+        except (OSError, subprocess.CalledProcessError):
+            continue
+    return None
+
+
+@pytest.fixture(scope="module")
+def native_lib(tmp_path_factory):
+    inc = _python_config("--includes")
+    ld = _python_config("--ldflags", "--embed")
+    if inc is None or ld is None:
+        pytest.skip("python-config not available")
+    src = os.path.join(NATIVE, "src", "capi", "c_api_embed.cpp")
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", *inc, src,
+         "-o", LIB, *ld],
+        capture_output=True, text=True)
+    assert build.returncode == 0, \
+        f"native capi build failed: {build.stderr[-2000:]}"
+    return LIB
+
+
+def test_c_host_end_to_end(native_lib, tmp_path):
+    exe = str(tmp_path / "capi_driver")
+    inc_dir = os.path.join(NATIVE, "include")
+    build = subprocess.run(
+        ["gcc", "-O1", DRIVER_SRC, "-I", inc_dir, "-o", exe,
+         "-L", NATIVE, "-llgbm_tpu", "-lm",
+         f"-Wl,-rpath,{NATIVE}"],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the embedded interpreter runs JAX on CPU — never the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    run = subprocess.run([exe, REPO], capture_output=True, text=True,
+                         env=env, timeout=600)
+    assert run.returncode == 0, f"stdout={run.stdout}\nstderr={run.stderr}"
+    assert "NATIVE_CAPI_OK" in run.stdout

@@ -259,12 +259,12 @@ class GBDT:
         vbins = tuple(vs.bins for vs in self.valid_sets)
 
         def step(scores, vscores, bag_mask, key, fmask, shrinkage,
-                 fresh_bag, sample_active):
+                 ohb=None, fresh_bag=False, sample_active=False):
             # sample_active is a static cache key mirroring
             # self._sample_active(), which _boost_one reads at trace time
             del sample_active
             return self._boost_one(scores, vscores, bag_mask, key, fmask,
-                                   shrinkage, fresh_bag, vbins)
+                                   shrinkage, fresh_bag, vbins, ohb)
 
         self._fused_step = jax.jit(
             step, static_argnames=("fresh_bag", "sample_active"),
@@ -279,7 +279,7 @@ class GBDT:
         return type(self).__name__ == "GBDT"
 
     def _boost_one(self, scores, vscores, bag_mask, key, fmask,
-                   shrinkage, fresh_bag, vbins):
+                   shrinkage, fresh_bag, vbins, ohb=None):
         """One boosting iteration's device body — shared by the
         per-iteration fused step and the multi-iteration chunk
         (``fresh_bag`` may be a python bool or a traced scalar)."""
@@ -303,7 +303,7 @@ class GBDT:
         new_vscores = list(vscores)
         for k in range(self.num_class):
             tree, leaf_id = self.grower._train_tree_impl(
-                g[k], h[k], counts, fmask[k])
+                g[k], h[k], counts, fmask[k], ohb)
             tree = self._finalize_tree(tree, leaf_id, k, scores, counts)
             # a no-split tree must contribute nothing (the reference
             # skips UpdateScore when num_leaves==1, gbdt.cpp:427-460)
@@ -329,15 +329,16 @@ class GBDT:
         vbins = tuple(vs.bins for vs in self.valid_sets)
         shrinkage = self.shrinkage_rate
 
-        def one_iter(carry, xs):
-            scores, vscores, bag_mask = carry
-            key, fmask, fresh_bag = xs
-            scores, vscores, bag_mask, trees, nl = self._boost_one(
-                scores, vscores, bag_mask, key, fmask, shrinkage,
-                fresh_bag, vbins)
-            return (scores, vscores, bag_mask), (trees, nl)
+        def chunk(scores, vscores, bag_mask, keys, fmasks, fresh_flags,
+                  ohb=None):
+            def one_iter(carry, xs):
+                scores, vscores, bag_mask = carry
+                key, fmask, fresh_bag = xs
+                scores, vscores, bag_mask, trees, nl = self._boost_one(
+                    scores, vscores, bag_mask, key, fmask, shrinkage,
+                    fresh_bag, vbins, ohb)
+                return (scores, vscores, bag_mask), (trees, nl)
 
-        def chunk(scores, vscores, bag_mask, keys, fmasks, fresh_flags):
             (scores, vscores, bag_mask), (trees, nls) = jax.lax.scan(
                 one_iter, (scores, vscores, bag_mask),
                 (keys, fmasks, fresh_flags))
@@ -368,7 +369,8 @@ class GBDT:
         self.timer.start("tree")
         scores, vscores, bag, trees, nls = self._fused_chunk(
             self.scores, tuple(vs.scores for vs in self.valid_sets),
-            self._bag_state, keys, fmasks, jnp.asarray(fresh))
+            self._bag_state, keys, fmasks, jnp.asarray(fresh),
+            self.grower.ohb)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
             vs.scores = s
@@ -417,6 +419,7 @@ class GBDT:
             self.scores, tuple(vs.scores for vs in self.valid_sets),
             self._bag_state, key, self._feature_masks(),
             jnp.asarray(self.shrinkage_rate, jnp.float32),
+            self.grower.ohb,
             fresh_bag=fresh_bag, sample_active=self._sample_active())
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):

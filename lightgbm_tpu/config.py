@@ -283,9 +283,15 @@ class Config:
     frontier_width: int = 0         # max splits applied per frontier round
     # (0 = auto: min(128, num_leaves-1) — one 128-lane MXU strip)
     hist_kernel: str = "auto"       # auto | pallas | paired | xla
+    hist_packed_dispatch: bool = True  # lax.cond to the channel-packed
+    # kernel on narrow frontiers (off: always the full-width kernel)
+    pallas_hist_block: int = 2048   # rows per Pallas histogram block
     quantized_grad: bool = False    # int8-MXU quantized histogram
     # construction (one grad/hess scale per tree; the TPU analog of
     # LightGBM v4 quantized training, arXiv 2207.09682) — TPU path only
+    hist_onehot_budget_mb: int = 4096  # HBM budget for the streamed
+    # (N, G*B) int8 bin one-hot; datasets over budget rebuild the
+    # one-hot in-kernel per round instead
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
     deterministic: bool = False

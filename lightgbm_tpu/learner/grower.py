@@ -41,13 +41,15 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from ..ops.histogram import (compute_group_histograms,
+from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
                              compute_group_histograms_pallas,
                              compute_group_histograms_pallas_paired,
                              compute_group_histograms_pallas_q,
+                             compute_group_histograms_pre,
+                             compute_group_histograms_pre_packed,
                              compute_leaf_totals, expand_feature_histograms,
-                             quantize_gradients)
-from ..ops.partition import apply_splits
+                             precompute_bin_onehot, quantize_gradients)
+from ..ops.partition import apply_splits, apply_splits_pallas
 from ..ops.split import (SplitResult, build_cat_bitset,
                          find_categorical_splits, find_numerical_splits,
                          gather_split_at_threshold)
@@ -167,7 +169,13 @@ class TreeGrower:
         bin_map, fix_bin = dataset.feature_bin_maps()
         self.bin_map = jnp.asarray(bin_map)
         self.fix_bin = jnp.asarray(fix_bin)
-        self.g2f_lut = jnp.asarray(self._build_g2f_lut(dataset))
+        lo, hi, shift, oor, dense_g2f = self._build_g2f_affine(dataset)
+        self.f_gb_lo = jnp.asarray(lo)
+        self.f_gb_hi = jnp.asarray(hi)
+        self.f_gb_shift = jnp.asarray(shift)
+        self.f_gb_oor = jnp.asarray(oor)
+        # dense (F, GB) form kept for the binned predict path
+        self.g2f_lut = jnp.asarray(dense_g2f)
 
         self.cfg_scalars: Dict[str, float] = dict(
             lambda_l1=config.lambda_l1, lambda_l2=config.lambda_l2,
@@ -184,11 +192,13 @@ class TreeGrower:
         # hard bound on frontier rounds (the while_loop exits early when
         # no leaf splits)
         self.max_rounds = config.num_leaves - 1
-        # frontier width: max splits applied per round.  128 keeps the
-        # kernel's leaf strip within one 128-lane tile, so a larger cap
-        # would cost MXU time without reducing round count in practice.
+        # frontier width: max splits applied per round.  126 = 3 strips
+        # of the channel-packed histogram kernel (3 x PACKED_STRIP), so
+        # every round's refresh runs at the cheapest lane packing for
+        # its width; a wider cap would not reduce round count in
+        # practice but would force the 3x-wider unpacked kernel.
         self.frontier = min(config.num_leaves - 1,
-                            config.frontier_width or 128)
+                            config.frontier_width or 126)
 
         # forced splits (reference serial_tree_learner.cpp:543-698
         # ForceSplits): JSON tree flattened to spec arrays; leaves carry
@@ -239,7 +249,8 @@ class TreeGrower:
         # "paired" (per-group-pair dots, no expansion matmul) benched
         # slower than the expansion kernel on v5e; kept as an option
         self.pallas_paired = self.use_pallas and hk == "paired"
-        self.pallas_block = 2048 if self.n_padded % 2048 == 0 else 1024
+        blk = int(getattr(config, "pallas_hist_block", 2048))
+        self.pallas_block = blk if self.n_padded % blk == 0 else 1024
         # int8 quantized training (see _hist_kernel_body_q): histogram
         # matmuls on the int8 MXU with one grad/hess scale per tree.
         # The int32 accumulator bounds rows at N*127 < 2^31.
@@ -250,6 +261,23 @@ class TreeGrower:
                 and not self.use_quant and not self.pallas_paired:
             Log.warning("quantized_grad disabled: dataset exceeds the "
                         "int32 histogram accumulator bound (~16.9M rows)")
+        # streamed-one-hot histogram path: materialize the (N, G*B)
+        # int8 bin one-hot once (it is constant for the whole training
+        # run) and stream it through the kernel instead of rebuilding
+        # it from the packed bins every round.  Gated on an HBM budget.
+        ohb_bytes = (self.n_padded * self.num_groups * self.max_group_bin)
+        budget = int(getattr(config, "hist_onehot_budget_mb", 4096)) << 20
+        self.use_pre_ohb = self.use_pallas and ohb_bytes <= budget
+        self.ohb = None
+        # trace-scoped override: callers thread the one-hot through
+        # their jit boundary as an ARGUMENT (a multi-hundred-MB closure
+        # constant sends XLA's constant-folding passes into minutes of
+        # compile time); _train_tree_impl pins the traced value here for
+        # the dynamic extent of its trace
+        self._ohb_arg = None
+        if self.use_pre_ohb:
+            self.ohb = precompute_bin_onehot(
+                self.bins, max_group_bin=self.max_group_bin)
         self._is_voting = (self.policy.mesh is not None
                            and config.tree_learner == "voting")
         self._train_tree = jax.jit(self._train_tree_impl)
@@ -306,25 +334,57 @@ class TreeGrower:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _build_g2f_lut(dataset: Dataset) -> np.ndarray:
-        """(F, GB) map: group bin -> this feature's bin (default bin for
-        group bins owned by bundle siblings / the shared slot)."""
+    def _build_g2f_affine(dataset: Dataset):
+        """Per-feature affine group-bin -> feature-bin map
+        ``fb = gb - shift if lo <= gb < hi else oor``.
+
+        This is the scalar form of the reference's min_bin/max_bin/bias
+        routing in DenseBin::Split (dense_bin.hpp:191-283): a feature's
+        bins occupy one contiguous group-bin range (identity for a
+        group it owns alone; offset for EFB bundle members whose
+        default collapsed into the shared slot 0), everything else
+        routes to the default bin.  Verified exhaustively against the
+        dense (F, GB) table at construction.
+        """
         F = dataset.num_features
         GB = dataset.max_group_bin
-        lut = np.zeros((F, GB), dtype=np.int32)
+        lo = np.zeros(F, dtype=np.int32)
+        hi = np.zeros(F, dtype=np.int32)
+        shift = np.zeros(F, dtype=np.int32)
+        oor = np.zeros(F, dtype=np.int32)
         for j, f in enumerate(dataset.features):
             if not f.collapsed_default:
-                lut[j] = np.minimum(np.arange(GB), f.num_bin - 1)
+                lo[j], hi[j] = 0, f.num_bin
+                shift[j], oor[j] = 0, f.num_bin - 1
             else:
-                lut[j, :] = f.default_bin
+                adj = 1 if f.mapper.default_bin == 0 else 0
+                lo[j] = f.offset
+                hi[j] = f.offset + f.num_bin - adj
+                shift[j] = f.offset - adj
+                oor[j] = f.default_bin
+        # cross-check against the dense table the affine form replaces
+        gb_iota = np.arange(GB, dtype=np.int32)[None, :]
+        affine = np.where(
+            (gb_iota >= lo[:, None]) & (gb_iota < hi[:, None]),
+            gb_iota - shift[:, None], oor[:, None])
+        dense = np.zeros((F, GB), dtype=np.int32)
+        for j, f in enumerate(dataset.features):
+            if not f.collapsed_default:
+                dense[j] = np.minimum(np.arange(GB), f.num_bin - 1)
+            else:
+                dense[j, :] = f.default_bin
                 adj = 1 if f.mapper.default_bin == 0 else 0
                 for b in range(f.num_bin):
                     if b == f.mapper.default_bin:
                         continue
                     gb = b + f.offset - adj
                     if gb < GB:
-                        lut[j, gb] = b
-        return lut
+                        dense[j, gb] = b
+        if not np.array_equal(affine, dense):  # pragma: no cover
+            bad = np.argwhere(affine != dense)
+            raise AssertionError(
+                f"affine g2f map diverges from dense table at {bad[:5]}")
+        return lo, hi, shift, oor, dense
 
     # ------------------------------------------------------------------
     def pad_rows(self, arr: np.ndarray, fill=0.0) -> np.ndarray:
@@ -339,7 +399,8 @@ class TreeGrower:
                    ) -> Tuple[TreeArrays, jax.Array]:
         """Grow one tree.  grad/hess/counts are (n_padded,) with zeros
         for out-of-bag and padded rows.  Returns (tree, final leaf_id)."""
-        return self._train_tree(grad, hess, counts, feature_mask)
+        return self._train_tree(grad, hess, counts, feature_mask,
+                                self.ohb)
 
     # ------------------------------------------------------------------
     def _hist_kernel(self, grad, hess, counts, leaf_id, slots=None,
@@ -347,6 +408,9 @@ class TreeGrower:
         """Frontier histogram dispatch: Pallas on a real single chip,
         XLA one-hot contraction under meshes / CPU simulation."""
         L = self.num_leaves if num_leaves is None else num_leaves
+        if self.use_pre_ohb:
+            return self._hist_kernel_pre(grad, hess, counts, leaf_id,
+                                         slots, L, quant)
         if quant is not None:
             wq, scales = quant
             return compute_group_histograms_pallas_q(
@@ -369,6 +433,60 @@ class TreeGrower:
             num_leaves=L, max_group_bin=self.max_group_bin,
             compute_dtype=self.config.hist_compute_dtype,
             chunk=self.chunk, slots=slots)
+
+    # ------------------------------------------------------------------
+    def _hist_kernel_pre(self, grad, hess, counts, leaf_id, slots, L,
+                         quant):
+        """Streamed-one-hot dispatch: channel-packed kernel when the
+        frontier is narrow (3x fewer MXU rows), full kernel otherwise.
+        The branch is a runtime lax.cond on the valid-slot count — the
+        early rounds of EVERY tree have 1..PACKED_STRIP new leaves."""
+        B = self.max_group_bin
+        ohb = self._ohb_arg if self._ohb_arg is not None else self.ohb
+        if quant is not None:
+            w, scales, q = quant[0], quant[1], True
+        else:
+            w = jnp.stack([grad, hess, counts], axis=1)
+            scales, q = None, False
+
+        def full(_):
+            return compute_group_histograms_pre(
+                ohb, w, scales, leaf_id, num_leaves=L,
+                max_group_bin=B, block=self.pallas_block, quant=q,
+                slots=slots)
+
+        if slots is None:
+            return full(None)
+        W = slots.shape[0]
+
+        def packed(strips):
+            def run(_):
+                h = compute_group_histograms_pre_packed(
+                    ohb, w, scales, leaf_id, slots, max_group_bin=B,
+                    block=self.pallas_block, strips=strips, quant=q)
+                cap = strips * PACKED_STRIP
+                if cap >= W:
+                    return h[:W]
+                pad = jnp.zeros((W - cap,) + h.shape[1:], h.dtype)
+                return jnp.concatenate([h, pad])
+            return run
+
+        if W <= PACKED_STRIP:
+            return packed(1)(None)
+        if not getattr(self.config, "hist_packed_dispatch", True):
+            return full(None)
+
+        # runtime dispatch on the valid-slot count: every round runs at
+        # the narrowest lane packing covering its frontier
+        k = jnp.sum(slots >= 0)
+        if W <= 2 * PACKED_STRIP:
+            return jax.lax.cond(k <= PACKED_STRIP, packed(1), packed(2),
+                                None)
+        wide = packed(3) if W <= 3 * PACKED_STRIP else full
+        return jax.lax.cond(
+            k <= PACKED_STRIP, packed(1),
+            lambda _: jax.lax.cond(k <= 2 * PACKED_STRIP, packed(2),
+                                   wide, None), None)
 
     # ------------------------------------------------------------------
     def _init_state(self, grad, hess, counts) -> GrowerState:
@@ -438,7 +556,18 @@ class TreeGrower:
             cand=cand, forced_cand=forced_cand)
 
     # ------------------------------------------------------------------
-    def _train_tree_impl(self, grad, hess, counts, feature_mask):
+    def _train_tree_impl(self, grad, hess, counts, feature_mask,
+                         ohb=None):
+        """``ohb`` is the streamed bin one-hot, threaded through the
+        caller's jit boundary as an argument (see _ohb_arg)."""
+        self._ohb_arg = ohb
+        try:
+            return self._train_tree_inner(grad, hess, counts,
+                                          feature_mask)
+        finally:
+            self._ohb_arg = None
+
+    def _train_tree_inner(self, grad, hess, counts, feature_mask):
         state = self._init_state(grad, hess, counts)
         if self._is_voting:
             def body_fn(st):
@@ -695,10 +824,14 @@ class TreeGrower:
         else:
             leaf_forced = st.leaf_forced
 
-        # row re-labeling
-        g2f_leaf = self.g2f_lut[best_f]               # (L, GB)
-        leaf_id = apply_splits(
-            self.bins, st.leaf_id, do_split, f_group_leaf, g2f_leaf,
+        # row re-labeling (per-leaf affine scalars; no (L, GB) table).
+        # Pallas router on a real chip keeps the leaf one-hot in VMEM;
+        # the XLA form serves CPU simulation and GSPMD meshes.
+        router = apply_splits
+        leaf_id = router(
+            self.bins, st.leaf_id, do_split, f_group_leaf,
+            self.f_gb_lo[best_f], self.f_gb_hi[best_f],
+            self.f_gb_shift[best_f], self.f_gb_oor[best_f],
             f_is_cat_leaf, thr, dleft, f_missing_leaf, f_dbin_leaf,
             f_nb_leaf, cat_mask, right_slot)
 

@@ -34,10 +34,10 @@ def _pick_chunk(n: int, num_groups: int, max_group_bin: int,
                 itemsize: int, target_bytes: int = 1 << 26) -> int:
     """Row-chunk size bounding the materialized one-hot to ~64 MB."""
     per_row = max(num_groups * max_group_bin * itemsize, 1)
-    chunk = max(1024, min(n, target_bytes // per_row))
-    # round to a multiple of 1024 for clean tiling (and so the Pallas
-    # kernel's 512-row blocks divide the padded row count)
-    return int(max(1024, (chunk // 1024) * 1024))
+    chunk = max(4096, min(n, target_bytes // per_row))
+    # round to a multiple of 4096 so every Pallas block size up to 4096
+    # divides the padded row count
+    return int(max(4096, (chunk // 4096) * 4096))
 
 
 @functools.partial(
@@ -228,7 +228,7 @@ def _slot_prep(num_leaves: int, slots: Optional[jax.Array]):
 
 def _run_hist_kernel(kern, bins, w, leaf_id, const_inputs, *, block,
                      m_leaf, m_pad, num_leaves, max_group_bin, out_dtype,
-                     interpret):
+                     interpret, raw_out=False):
     """Shared pallas_call plumbing: row-blocked (bins, w, leaf) inputs,
     VMEM-resident constants, one (m_pad, G*B) accumulator; returns the
     (L, G, B, 3) histogram view."""
@@ -249,6 +249,8 @@ def _run_hist_kernel(kern, bins, w, leaf_id, const_inputs, *, block,
         out_shape=jax.ShapeDtypeStruct((m_pad, gb), out_dtype),
         interpret=interpret,
     )(bins, w, leaf_id[:, None], *consts)
+    if raw_out:
+        return out
     # (3*m_leaf, G*B) channel-major -> (L, G, B, 3)
     hist = out.reshape(3, m_leaf, num_groups, max_group_bin)[:, :num_leaves]
     return jnp.transpose(hist, (1, 2, 3, 0))
@@ -404,6 +406,368 @@ def compute_group_histograms_pallas(bins: jax.Array, grad: jax.Array,
         m_leaf=m_leaf, m_pad=m_pad, num_leaves=num_leaves,
         max_group_bin=max_group_bin, out_dtype=jnp.float32,
         interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("max_group_bin",))
+def precompute_bin_onehot(bins: jax.Array, *,
+                          max_group_bin: int) -> jax.Array:
+    """(N, G) uint8 -> (N, G*B) int8 bin one-hot, HBM-resident.
+
+    The bin matrix never changes during training, so the one-hot RHS of
+    the histogram matmul can be materialized once per dataset and
+    streamed — deleting the per-round in-kernel expansion matmul +
+    compare (the dominant non-MXU cost).  Costs N*G*B bytes of HBM;
+    the grower gates usage on a memory budget and falls back to
+    on-the-fly generation for datasets where it doesn't fit."""
+    n, g = bins.shape
+    biota = jnp.arange(max_group_bin, dtype=jnp.int32)
+    oh = bins.astype(jnp.int32)[:, :, None] == biota[None, None, :]
+    return oh.reshape(n, g * max_group_bin).astype(jnp.int8)
+
+
+def _hist_kernel_body_pre(ohb_ref, w_ref, leaf_ref, slots_ref, out_ref, *,
+                          m_pad, quant):
+    """Streamed-one-hot kernel body: HBM traffic is the (C, G*B) int8
+    one-hot block (prefetched by the Pallas pipeline while the MXU
+    works), and the only compute is the lhs build + ONE dot."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    leaf = leaf_ref[:]                                   # (C, 1) int32
+    w = w_ref[:]                                         # (C, 3)
+    ohl = leaf == slots_ref[0:1, :]                      # (C, m_leaf)
+    if quant:
+        zero = jnp.zeros((), jnp.int32)
+        lhs = jnp.concatenate(
+            [jnp.where(ohl, w[:, 0:1], zero),
+             jnp.where(ohl, w[:, 1:2], zero),
+             jnp.where(ohl, w[:, 2:3], zero)], axis=1).astype(jnp.int8)
+        out_ref[:] += jax.lax.dot_general(
+            lhs, ohb_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    else:
+        zero = jnp.zeros((), jnp.float32)
+        lhs = jnp.concatenate(
+            [jnp.where(ohl, w[:, 0:1], zero),
+             jnp.where(ohl, w[:, 1:2], zero),
+             jnp.where(ohl, w[:, 2:3], zero)], axis=1).astype(jnp.bfloat16)
+        out_ref[:] += jax.lax.dot_general(
+            lhs, ohb_ref[:].astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _hist_kernel_body_pre_packed(ohb_ref, w_ref, leaf_ref, slots_ref,
+                                 out_ref, *, strip, strips, quant):
+    """Channel-packed kernel: the three weight channels share each
+    128-lane tile (lane = c*strip + l within a tile) instead of
+    occupying three separate tiles, cutting the dot's output rows — and
+    its MXU time — 3x for the same slot count.  ``strips`` tiles cover
+    up to strips*strip slots; with the frontier capped at 3*42 = 126
+    this kernel serves EVERY round of tree growth (the reference's
+    one-leaf-at-a-time learner has no analog — width adapts to the
+    frontier the way its smaller/larger-leaf trick adapts to leaf
+    sizes, serial_tree_learner.cpp:505-507)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    c = leaf_ref.shape[0]
+    m_pad = 128 * strips
+    leaf = leaf_ref[:]                                   # (C, 1) int32
+    w = w_ref[:]                                         # (C, 3)
+    # slots_ref tiles each strip's slot ids three times per 128-lane
+    # tile; lane -> channel is a boundary select on lane mod 128
+    ohl = leaf == slots_ref[0:1, :]                      # (C, m_pad)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (c, m_pad), 1) % 128
+    wl = jnp.where(lane < strip, w[:, 0:1],
+                   jnp.where(lane < 2 * strip, w[:, 1:2], w[:, 2:3]))
+    if quant:
+        lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
+        out_ref[:] += jax.lax.dot_general(
+            lhs, ohb_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    else:
+        lhs = jnp.where(ohl, wl,
+                        jnp.zeros((), jnp.float32)).astype(jnp.bfloat16)
+        out_ref[:] += jax.lax.dot_general(
+            lhs, ohb_ref[:].astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _run_hist_kernel_pre(kern, ohb, w, leaf_id, slot_row, *, block,
+                         m_pad, out_dtype, interpret):
+    """pallas_call plumbing for the streamed-one-hot bodies: the (N,
+    G*B) one-hot is row-blocked like the weights; output is the
+    (m_pad, G*B) VMEM accumulator."""
+    n, gb = ohb.shape
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    slot_row = jnp.asarray(slot_row)
+    out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, gb), lambda i: (i, 0)),
+            pl.BlockSpec((block, w.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec(slot_row.shape, lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((m_pad, gb), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, gb), out_dtype),
+        interpret=interpret,
+    )(ohb, w, leaf_id[:, None], slot_row)
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_leaves", "max_group_bin", "block",
+                              "quant", "interpret"))
+def compute_group_histograms_pre(
+        ohb: jax.Array, w: jax.Array, scales: Optional[jax.Array],
+        leaf_id: jax.Array, *, num_leaves: int, max_group_bin: int,
+        block: int = 1024, quant: bool = False, interpret: bool = False,
+        slots: Optional[jax.Array] = None) -> jax.Array:
+    """Histogram from a precomputed (N, G*B) one-hot (same output
+    contract as :func:`compute_group_histograms`).  ``w`` is the (N, 3)
+    weight matrix — float32 (grad, hess, cnt) or int32 quantized (then
+    ``scales`` dequantizes the int32 accumulator)."""
+    gb = ohb.shape[1]
+    num_groups = gb // max_group_bin
+    num_leaves, m_leaf, m_pad, slot_row = _slot_prep(num_leaves, slots)
+    kern = functools.partial(_hist_kernel_body_pre, m_pad=m_pad,
+                             quant=quant)
+    out = _run_hist_kernel_pre(
+        kern, ohb, w, leaf_id, slot_row, block=block, m_pad=m_pad,
+        out_dtype=jnp.int32 if quant else jnp.float32,
+        interpret=interpret)
+    hist = out.reshape(3, m_leaf, num_groups, max_group_bin)[:, :num_leaves]
+    hist = jnp.transpose(hist, (1, 2, 3, 0))
+    if quant:
+        hist = hist.astype(jnp.float32) * scales[None, None, None, :]
+    return hist
+
+
+def _hist_kernel_body_q_packed(bins_ref, wq_ref, leaf_ref, emat_ref,
+                               bcol_ref, slots_ref, out_ref, *, strip,
+                               strips, int8_bins):
+    """On-the-fly packed kernel: the bin one-hot is rebuilt in VMEM per
+    block (HBM stream is just the ~17 bytes/row packed bins) AND the
+    weight channels share each 128-lane tile (see
+    _hist_kernel_body_pre_packed).  This is the cheapest formulation
+    measured on v5e: the streamed-one-hot variants are HBM-bound on the
+    G*B-byte/row one-hot, while this one is MXU/VPU-bound at
+    ~1.4 bytes/row of traffic per covered slot strip."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    c = bins_ref.shape[0]
+    m_pad = 128 * strips
+    leaf = leaf_ref[:]                                   # (C, 1) int32
+    wq = wq_ref[:]                                       # (C, 3) int32
+    ohl = leaf == slots_ref[0:1, :]                      # (C, m_pad)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (c, m_pad), 1) % 128
+    wl = jnp.where(lane < strip, wq[:, 0:1],
+                   jnp.where(lane < 2 * strip, wq[:, 1:2], wq[:, 2:3]))
+    lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
+    if int8_bins:
+        binb = bins_ref[:].astype(jnp.int32).astype(jnp.int8)
+        rep = jax.lax.dot_general(                       # (C, G*B) i32
+            binb, emat_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    else:
+        binb = bins_ref[:].astype(jnp.int32).astype(jnp.bfloat16)
+        rep = jax.lax.dot_general(
+            binb, emat_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+    ohb = (rep == bcol_ref[0:1, :]).astype(jnp.int8)
+    out_ref[:] += jax.lax.dot_general(
+        lhs, ohb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_group_bin", "block", "strips",
+                              "interpret"))
+def compute_group_histograms_q_packed(
+        bins: jax.Array, wq: jax.Array, scales: jax.Array,
+        leaf_id: jax.Array, slots: jax.Array, *, max_group_bin: int,
+        block: int = 2048, strips: int = 1,
+        interpret: bool = False) -> jax.Array:
+    """Packed-lane on-the-fly int8 histogram: ``slots`` must hold at
+    most strips*PACKED_STRIP valid entries; returns
+    (strips*PACKED_STRIP, G, B, 3) following (padded) ``slots`` order."""
+    num_groups = bins.shape[1]
+    strip = PACKED_STRIP
+    cap = strip * strips
+    nslots = slots.shape[0]
+    if nslots < cap:
+        slots = jnp.concatenate(
+            [slots, jnp.full(cap - nslots, -2, jnp.int32)])
+    else:
+        slots = slots[:cap]
+    slots = jnp.where(slots >= 0, slots, -2)
+    tiles = []
+    pad2 = jnp.full(128 - 3 * strip, -2, jnp.int32)
+    for s in range(strips):
+        one = slots[s * strip:(s + 1) * strip]
+        tiles += [one, one, one, pad2]
+    slot_row = jnp.concatenate(tiles)[None, :]          # (1, 128*strips)
+    int8_bins = max_group_bin <= 127
+    kind = "i8" if int8_bins else "bf16_i32"
+    emat, bcol = _expansion_consts(num_groups, max_group_bin, kind)
+    kern = functools.partial(_hist_kernel_body_q_packed, strip=strip,
+                             strips=strips, int8_bins=int8_bins)
+    out = _run_hist_kernel(
+        kern, bins, wq, leaf_id, [emat, bcol, slot_row], block=block,
+        m_leaf=128 * strips, m_pad=128 * strips, num_leaves=cap,
+        max_group_bin=max_group_bin, out_dtype=jnp.int32,
+        interpret=interpret, raw_out=True)
+    per_ch = []
+    for ch in range(3):
+        rows = [out[s * 128 + ch * strip: s * 128 + (ch + 1) * strip]
+                for s in range(strips)]
+        per_ch.append(jnp.concatenate(rows) if strips > 1 else rows[0])
+    hist = jnp.stack(per_ch)                             # (3, cap, G*B)
+    hist = hist.reshape(3, cap, num_groups, max_group_bin)
+    hist = jnp.transpose(hist, (1, 2, 3, 0))
+    return hist.astype(jnp.float32) * scales[None, None, None, :]
+
+
+def _hist_kernel_body_pre_t(ohb_ref, wt_ref, leaf_ref, slots_ref, out_ref,
+                            *, m_pad, quant):
+    """Transposed-lhs variant: the (3*m_leaf, C) weighted one-hot is
+    BUILT row-major so the dot is a plain (M, K) @ (K, N) with no
+    in-kernel transpose.  leaf/weights arrive as (1, C)/(3, C) blocks."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    leaf = leaf_ref[:]                                   # (1, C) int32
+    wt = wt_ref[:]                                       # (3, C)
+    ohl = slots_ref[:] == leaf                           # (m_leaf, C)
+    if quant:
+        zero = jnp.zeros((), jnp.int32)
+        lhs = jnp.concatenate(
+            [jnp.where(ohl, wt[0:1, :], zero),
+             jnp.where(ohl, wt[1:2, :], zero),
+             jnp.where(ohl, wt[2:3, :], zero)], axis=0).astype(jnp.int8)
+        out_ref[:] += jax.lax.dot_general(
+            lhs, ohb_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    else:
+        zero = jnp.zeros((), jnp.float32)
+        lhs = jnp.concatenate(
+            [jnp.where(ohl, wt[0:1, :], zero),
+             jnp.where(ohl, wt[1:2, :], zero),
+             jnp.where(ohl, wt[2:3, :], zero)], axis=0).astype(jnp.bfloat16)
+        out_ref[:] += jax.lax.dot_general(
+            lhs, ohb_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_leaves", "max_group_bin", "block",
+                              "quant", "interpret"))
+def compute_group_histograms_pre_t(
+        ohb: jax.Array, w: jax.Array, scales: Optional[jax.Array],
+        leaf_id: jax.Array, *, num_leaves: int, max_group_bin: int,
+        block: int = 2048, quant: bool = False, interpret: bool = False,
+        slots: Optional[jax.Array] = None) -> jax.Array:
+    """Transposed-operand streamed-one-hot histogram (same contract as
+    :func:`compute_group_histograms_pre`)."""
+    n, gb = ohb.shape
+    num_groups = gb // max_group_bin
+    num_leaves, m_leaf, m_pad, slot_row = _slot_prep(num_leaves, slots)
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    slot_col = slot_row[0][:m_leaf][:, None]             # (m_leaf, 1)
+    wt = w.T                                             # (3, N)
+    leaf_row = leaf_id[None, :]                          # (1, N)
+    kern = functools.partial(_hist_kernel_body_pre_t, m_pad=m_pad,
+                             quant=quant)
+    out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, gb), lambda i: (i, 0)),
+            pl.BlockSpec((3, block), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec((m_leaf, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((m_pad, gb), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, gb),
+                                       jnp.int32 if quant else jnp.float32),
+        interpret=interpret,
+    )(ohb, wt, leaf_row, slot_col)
+    hist = out.reshape(3, m_leaf, num_groups, max_group_bin)[:, :num_leaves]
+    hist = jnp.transpose(hist, (1, 2, 3, 0))
+    if quant:
+        hist = hist.astype(jnp.float32) * scales[None, None, None, :]
+    return hist
+
+
+PACKED_STRIP = 42  # 3 channels x 42 slots fit one 128-lane tile
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_group_bin", "block", "strips", "quant",
+                              "interpret"))
+def compute_group_histograms_pre_packed(
+        ohb: jax.Array, w: jax.Array, scales: Optional[jax.Array],
+        leaf_id: jax.Array, slots: jax.Array, *, max_group_bin: int,
+        block: int = 1024, strips: int = 1, quant: bool = False,
+        interpret: bool = False) -> jax.Array:
+    """Channel-packed streamed-one-hot histogram: ``slots`` must hold
+    at most strips*PACKED_STRIP valid entries; returns
+    (strips*PACKED_STRIP, G, B, 3) with the slot axis following the
+    (padded) ``slots`` order."""
+    gb = ohb.shape[1]
+    num_groups = gb // max_group_bin
+    strip = PACKED_STRIP
+    cap = strip * strips
+    nslots = slots.shape[0]
+    if nslots < cap:
+        slots = jnp.concatenate(
+            [slots, jnp.full(cap - nslots, -2, jnp.int32)])
+    else:
+        slots = slots[:cap]
+    # -2 padding matches neither real leaves nor padded rows (-1)
+    slots = jnp.where(slots >= 0, slots, -2)
+    tiles = []
+    pad2 = jnp.full(128 - 3 * strip, -2, jnp.int32)
+    for s in range(strips):
+        one = slots[s * strip:(s + 1) * strip]
+        tiles += [one, one, one, pad2]
+    slot_row = jnp.concatenate(tiles)[None, :]          # (1, 128*strips)
+    kern = functools.partial(_hist_kernel_body_pre_packed, strip=strip,
+                             strips=strips, quant=quant)
+    out = _run_hist_kernel_pre(
+        kern, ohb, w, leaf_id, slot_row, block=block, m_pad=128 * strips,
+        out_dtype=jnp.int32 if quant else jnp.float32,
+        interpret=interpret)
+    # within tile s, lanes [c*strip, c*strip + strip) hold channel c of
+    # slots [s*strip, (s+1)*strip)
+    per_ch = []
+    for c in range(3):
+        rows = [out[s * 128 + c * strip: s * 128 + (c + 1) * strip]
+                for s in range(strips)]
+        per_ch.append(jnp.concatenate(rows) if strips > 1 else rows[0])
+    hist = jnp.stack(per_ch)                             # (3, cap, G*B)
+    hist = hist.reshape(3, cap, num_groups, max_group_bin)
+    hist = jnp.transpose(hist, (1, 2, 3, 0))
+    if quant:
+        hist = hist.astype(jnp.float32) * scales[None, None, None, :]
+    return hist
 
 
 def expand_feature_histograms(group_hist: jax.Array, bin_map: jax.Array,

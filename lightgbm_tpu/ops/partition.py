@@ -8,36 +8,59 @@ indices into contiguous per-leaf ranges, every row carries a ``leaf_id``
 and one vectorized pass re-labels the rows of every leaf split this
 round — recompute-with-masks beats in-place permutation on TPU.
 
-Routing semantics (full per-feature bin space, so the reference's
-min_bin/max_bin/bias adjustments vanish):
+Routing semantics (feature-bin space after the group->feature affine
+map; the reference's min_bin/max_bin/bias adjustments collapse into the
+(lo, hi, shift, oor) scalars):
   * NaN-missing: NaN bin (last) rides ``default_left``; other bins
     (including the zero/default bin) compare ``bin <= threshold``.
   * Zero-missing: the default(zero) bin rides ``default_left``; other
     bins compare.
   * None: plain compare.
-  * Categorical: ``cat_mask[bin]`` decides (bundle/out-of-range rows
-    resolve through the group->feature-bin LUT to the default bin,
-    reproducing the FindInBitset(default_bin) routing).
+  * Categorical: bit ``featbin`` of the packed left-set decides.
 
-Implementation note: arbitrary per-row gathers are slow on TPU, so the
-routing decision is evaluated ONCE per (leaf, group-bin) into a tiny
-``(L, GB)`` boolean table, which is then broadcast to rows with a
-leaf-one-hot matmul on the MXU — rows never index anything
-data-dependently.
+Implementation note: arbitrary per-row gathers are slow on TPU and a
+per-(leaf, group-bin) decision table costs an (N, GB) intermediate, so
+instead ONLY per-leaf scalars are broadcast to rows — one
+``(N, L) @ (L, ~20)`` exact-f32 matmul (the one-hot picks a single
+row, so every output is one table value, bit-exact under
+Precision.HIGHEST) — and the routing decision is evaluated per row
+with elementwise ops.  The group->feature bin map is affine per leaf:
+``featbin = gb - shift if lo <= gb < hi else oor`` (see
+TreeGrower._build_g2f_affine), which is what lets the (L, GB) table
+disappear.  Categorical left-sets ride along as ceil(B/8) packed byte
+columns.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 MISSING_NONE = 0
 MISSING_ZERO = 1
 MISSING_NAN = 2
 
 
+def pack_mask_bytes(mask: jax.Array) -> jax.Array:
+    """(L, B) bool -> (L, ceil(B/8)) packed little-endian byte floats
+    (each < 256, exact in f32)."""
+    L, B = mask.shape
+    nb = (B + 7) // 8
+    pad = nb * 8 - B
+    if pad:
+        mask = jnp.concatenate(
+            [mask, jnp.zeros((L, pad), bool)], axis=1)
+    bits = mask.reshape(L, nb, 8).astype(jnp.float32)
+    weights = (2.0 ** jnp.arange(8, dtype=jnp.float32))
+    return jnp.einsum("lnb,b->ln", bits, weights)
+
+
 def apply_splits(bins: jax.Array, leaf_id: jax.Array,
                  split_mask: jax.Array, feat_group: jax.Array,
-                 g2f_lut: jax.Array, is_cat: jax.Array,
+                 fb_lo: jax.Array, fb_hi: jax.Array, fb_shift: jax.Array,
+                 fb_oor: jax.Array, is_cat: jax.Array,
                  threshold: jax.Array, default_left: jax.Array,
                  missing_type: jax.Array, default_bin: jax.Array,
                  num_bin: jax.Array, cat_mask: jax.Array,
@@ -49,9 +72,9 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
       leaf_id: (N,) int32, negative = padded row (left untouched).
       split_mask: (L,) bool — leaves splitting this round.
       feat_group: (L,) int32 — group column of the chosen feature.
-      g2f_lut: (L, GB) int32 — group-bin -> feature-bin map of the
-        chosen feature (identity for unbundled groups; other features'
-        ranges and the shared slot 0 map to the default bin).
+      fb_lo/fb_hi/fb_shift/fb_oor: (L,) int32 — the chosen feature's
+        affine group-bin -> feature-bin map: ``gb - fb_shift`` inside
+        [fb_lo, fb_hi), else ``fb_oor``.
       is_cat/threshold/default_left/missing_type/default_bin/num_bin:
         (L,) chosen-split metadata gathered per leaf.
       cat_mask: (L, B) bool — categorical left-set in feature-bin space.
@@ -60,61 +83,189 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
     Returns: updated (N,) leaf_id (left child keeps the parent slot).
     """
     n, num_groups = bins.shape
-    L, gb_dim = g2f_lut.shape
-    b_dim = cat_mask.shape[1]
+    L = split_mask.shape[0]
 
-    # ---- per-(leaf, group-bin) decision table: tiny (L, GB) ops ----
-    fb = g2f_lut                                    # (L, GB) feature bins
-    is_nan_bin = fb == (num_bin[:, None] - 1)
-    is_def_bin = fb == default_bin[:, None]
-    cmp_left = fb <= threshold[:, None]
-    dleft = default_left[:, None]
-    mtype = missing_type[:, None]
-    num_left = jnp.where(
-        (mtype == MISSING_NAN) & is_nan_bin, dleft,
-        jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
-    cat_left = jnp.take_along_axis(cat_mask, jnp.clip(fb, 0, b_dim - 1),
-                                   axis=1)          # (L, GB)
-    decision = jnp.where(is_cat[:, None], cat_left, num_left)
+    cat_bytes = pack_mask_bytes(cat_mask)            # (L, nb)
+    nb = cat_bytes.shape[1]
 
-    # ---- broadcast per-leaf data to rows with ONE (N,L)@(L,GB+5) dot ----
-    # TPU matmuls run bf16 operand passes at default precision, so
-    # integer columns are split into hi/lo halves (< 256 each, exact in
-    # bf16); the one-hot picks exactly one term, so sums stay exact.
-    def _hi_lo(v):
-        v = v.astype(jnp.int32)
-        return ((v // 256).astype(jnp.float32)[:, None],
-                (v % 256).astype(jnp.float32)[:, None])
+    def col(v):
+        return v.astype(jnp.float32)[:, None]
 
-    fg_hi, fg_lo = _hi_lo(feat_group)
-    rs_hi, rs_lo = _hi_lo(right_slot)
-    # bf16 operands are exact here (0/1 decisions and hi/lo ints < 256)
-    # and halve the HBM traffic of the materialized (N, L) one-hot
+    # every column is an integer < 256 — exact in bf16 (right_slot is
+    # split hi/lo), so the broadcast dot runs on the fast bf16 MXU path
+    # and the materialized one-hot is half the bytes of f32
+    rs = right_slot.astype(jnp.int32)
     table = jnp.concatenate([
-        decision.astype(jnp.float32),
-        fg_hi, fg_lo, rs_hi, rs_lo,
-        split_mask.astype(jnp.float32)[:, None],
-    ], axis=1).astype(jnp.bfloat16)                 # (L, GB+5)
+        col(feat_group), col(threshold), col(default_left),
+        col(missing_type), col(default_bin), col(num_bin),
+        col(is_cat), col(rs // 256), col(rs % 256), col(split_mask),
+        col(fb_lo), col(fb_hi), col(fb_shift), col(fb_oor),
+        cat_bytes,
+    ], axis=1).astype(jnp.bfloat16)                  # (L, 14 + nb)
     safe_l = jnp.clip(leaf_id, 0, L - 1)
     ohl = (safe_l[:, None]
            == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
     rows = jnp.dot(ohl, table, preferred_element_type=jnp.float32)
-    d_rows = rows[:, :gb_dim]                       # (N, GB)
 
-    def _from_hi_lo(hi, lo):
-        return (hi.astype(jnp.int32) * 256 + lo.astype(jnp.int32))
+    def icol(i):
+        return rows[:, i].astype(jnp.int32)
 
-    grp_row = _from_hi_lo(rows[:, gb_dim], rows[:, gb_dim + 1])
-    rs_row = _from_hi_lo(rows[:, gb_dim + 2], rows[:, gb_dim + 3])
-    active = (rows[:, gb_dim + 4] > 0.5) & (leaf_id >= 0)
+    grp_row = icol(0)
+    thr_row = icol(1)
+    dleft_row = rows[:, 2] > 0.5
+    mtype_row = icol(3)
+    dbin_row = icol(4)
+    nbin_row = icol(5)
+    iscat_row = rows[:, 6] > 0.5
+    rs_row = icol(7) * 256 + icol(8)
+    active = (rows[:, 9] > 0.5) & (leaf_id >= 0)
+    lo_row, hi_row = icol(10), icol(11)
+    shift_row, oor_row = icol(12), icol(13)
 
-    # chosen-group bin per row, then its decision — masked sums instead
-    # of gathers (G and GB are small)
+    # chosen-group bin per row (masked sum instead of a gather; G small)
     gsel = grp_row[:, None] == jnp.arange(num_groups,
                                           dtype=jnp.int32)[None, :]
     gb = jnp.sum(jnp.where(gsel, bins.astype(jnp.int32), 0), axis=1)
-    bsel = gb[:, None] == jnp.arange(gb_dim, dtype=jnp.int32)[None, :]
-    go_left = jnp.sum(jnp.where(bsel, d_rows, 0.0), axis=1) > 0.5
+    fbin = jnp.where((gb >= lo_row) & (gb < hi_row), gb - shift_row,
+                     oor_row)                        # feature-bin space
 
+    # numerical routing
+    is_nan_bin = fbin == nbin_row - 1
+    is_def_bin = fbin == dbin_row
+    cmp_left = fbin <= thr_row
+    num_left = jnp.where(
+        (mtype_row == MISSING_NAN) & is_nan_bin, dleft_row,
+        jnp.where((mtype_row == MISSING_ZERO) & is_def_bin, dleft_row,
+                  cmp_left))
+
+    # categorical routing: extract bit fbin of the packed byte columns
+    byte_idx = fbin // 8
+    bsel = byte_idx[:, None] == jnp.arange(nb, dtype=jnp.int32)[None, :]
+    byte_val = jnp.sum(jnp.where(bsel, rows[:, 14:14 + nb], 0.0),
+                       axis=1).astype(jnp.int32)
+    cat_left = ((byte_val >> (fbin % 8)) & 1) == 1
+
+    go_left = jnp.where(iscat_row, cat_left, num_left)
     new_id = jnp.where(go_left, leaf_id, rs_row)
     return jnp.where(active, new_id, leaf_id).astype(jnp.int32)
+
+
+def _partition_table(split_mask, feat_group, fb_lo, fb_hi, fb_shift,
+                     fb_oor, is_cat, threshold, default_left, missing_type,
+                     default_bin, num_bin, cat_mask, right_slot):
+    """(L, 14+nb) bf16 leaf table for the Pallas router.  Every column
+    is an integer < 256 (bf16-exact); right_slot is split hi/lo."""
+    def col(v):
+        return v.astype(jnp.float32)[:, None]
+
+    rs = right_slot.astype(jnp.int32)
+    cat_bytes = pack_mask_bytes(cat_mask)
+    table = jnp.concatenate([
+        col(feat_group), col(threshold), col(default_left),
+        col(missing_type), col(default_bin), col(num_bin),
+        col(is_cat), col(rs // 256), col(rs % 256), col(split_mask),
+        col(fb_lo), col(fb_hi), col(fb_shift), col(fb_oor),
+        cat_bytes,
+    ], axis=1)
+    return table.astype(jnp.bfloat16), cat_bytes.shape[1]
+
+
+def _partition_kernel_body(bins_ref, leaf_ref, table_ref, out_ref, *,
+                           num_groups, nb):
+    """One row-block of split routing: the leaf one-hot and the
+    broadcast (C, K) table rows live only in VMEM — the HBM traffic is
+    the packed bins + leaf ids (~30 bytes/row), vs the ~4 KB/row an XLA
+    materialization of the one-hot costs."""
+    c = bins_ref.shape[0]
+    l_pad = table_ref.shape[0]
+    leaf = leaf_ref[:]                                   # (C, 1) int32
+    liota = jax.lax.broadcasted_iota(jnp.int32, (c, l_pad), 1)
+    ohl = (leaf == liota).astype(jnp.bfloat16)           # (C, Lpad)
+    rows = jax.lax.dot_general(
+        ohl, table_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (C, K)
+
+    def icol(i):
+        return rows[:, i:i + 1].astype(jnp.int32)
+
+    # Mosaic cannot select between 1-bit (bool) vectors — routing runs
+    # in 0/1 int32 arithmetic with bool predicates only
+    grp = icol(0)
+    thr = icol(1)
+    dleft = icol(2)
+    mtype = icol(3)
+    dbin = icol(4)
+    nbin = icol(5)
+    iscat = rows[:, 6:7] > 0.5
+    rs = icol(7) * 256 + icol(8)
+    active = (rows[:, 9:10] > 0.5) & (leaf >= 0)
+    lo, hi = icol(10), icol(11)
+    shift, oor = icol(12), icol(13)
+
+    giota = jax.lax.broadcasted_iota(jnp.int32, (c, num_groups), 1)
+    gsel = giota == grp
+    gb = jnp.sum(jnp.where(gsel, bins_ref[:].astype(jnp.int32), 0),
+                 axis=1, keepdims=True)                  # (C, 1)
+    fbin = jnp.where((gb >= lo) & (gb < hi), gb - shift, oor)
+
+    is_nan_bin = fbin == nbin - 1
+    is_def_bin = fbin == dbin
+    cmp_left = (fbin <= thr).astype(jnp.int32)
+    num_left = jnp.where(
+        (mtype == MISSING_NAN) & is_nan_bin, dleft,
+        jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
+
+    byte_idx = fbin // 8
+    niota = jax.lax.broadcasted_iota(jnp.int32, (c, nb), 1)
+    bsel = byte_idx == niota
+    byte_val = jnp.sum(
+        jnp.where(bsel, rows[:, 14:14 + nb], 0.0), axis=1,
+        keepdims=True).astype(jnp.int32)
+    cat_left = (byte_val >> (fbin % 8)) & 1
+
+    go_left = jnp.where(iscat, cat_left, num_left)
+    new_id = jnp.where(go_left > 0, leaf, rs)
+    out_ref[:] = jnp.where(active, new_id, leaf).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def apply_splits_pallas(bins: jax.Array, leaf_id: jax.Array,
+                        split_mask: jax.Array, feat_group: jax.Array,
+                        fb_lo: jax.Array, fb_hi: jax.Array,
+                        fb_shift: jax.Array, fb_oor: jax.Array,
+                        is_cat: jax.Array, threshold: jax.Array,
+                        default_left: jax.Array, missing_type: jax.Array,
+                        default_bin: jax.Array, num_bin: jax.Array,
+                        cat_mask: jax.Array, right_slot: jax.Array,
+                        block: int = 2048,
+                        interpret: bool = False) -> jax.Array:
+    """Pallas TPU router with the same contract as
+    :func:`apply_splits` (single device; N must divide by block)."""
+    n, num_groups = bins.shape
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    L = split_mask.shape[0]
+    l_pad = max(128, ((L + 127) // 128) * 128)
+    table, nb = _partition_table(
+        split_mask, feat_group, fb_lo, fb_hi, fb_shift, fb_oor, is_cat,
+        threshold, default_left, missing_type, default_bin, num_bin,
+        cat_mask, right_slot)
+    if l_pad > L:
+        table = jnp.concatenate(
+            [table, jnp.zeros((l_pad - L, table.shape[1]),
+                              jnp.bfloat16)])
+    kern = functools.partial(_partition_kernel_body,
+                             num_groups=num_groups, nb=nb)
+    out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, num_groups), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec(table.shape, lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        interpret=interpret,
+    )(bins, leaf_id[:, None], table)
+    return out[:, 0]

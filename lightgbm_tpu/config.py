@@ -292,6 +292,9 @@ class Config:
     hist_onehot_budget_mb: int = 4096  # HBM budget for the streamed
     # (N, G*B) int8 bin one-hot; datasets over budget rebuild the
     # one-hot in-kernel per round instead
+    hist_quant_onthefly: bool = True  # quantized path: rebuild the bin
+    # one-hot in-kernel (packed int8 lanes) instead of streaming the
+    # (N, G*B) one-hot from HBM — B x less HBM traffic per round
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
     deterministic: bool = False

@@ -47,9 +47,10 @@ from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
                              compute_group_histograms_pallas_q,
                              compute_group_histograms_pre,
                              compute_group_histograms_pre_packed,
+                             compute_group_histograms_q_packed,
                              compute_leaf_totals, expand_feature_histograms,
                              precompute_bin_onehot, quantize_gradients)
-from ..ops.partition import apply_splits, apply_splits_pallas
+from ..ops.partition import apply_splits
 from ..ops.split import (SplitResult, build_cat_bitset,
                          find_categorical_splits, find_numerical_splits,
                          gather_split_at_threshold)
@@ -261,13 +262,21 @@ class TreeGrower:
                 and not self.use_quant and not self.pallas_paired:
             Log.warning("quantized_grad disabled: dataset exceeds the "
                         "int32 histogram accumulator bound (~16.9M rows)")
+        # quantized frontier kernels rebuild the bin one-hot in VMEM
+        # from the packed bins (~G bytes/row of HBM traffic instead of
+        # the G*B-byte streamed one-hot) — the cheapest formulation
+        # measured on v5e
+        self.use_quant_otf = self.use_quant and getattr(
+            config, "hist_quant_onthefly", True)
         # streamed-one-hot histogram path: materialize the (N, G*B)
         # int8 bin one-hot once (it is constant for the whole training
         # run) and stream it through the kernel instead of rebuilding
         # it from the packed bins every round.  Gated on an HBM budget.
         ohb_bytes = (self.n_padded * self.num_groups * self.max_group_bin)
         budget = int(getattr(config, "hist_onehot_budget_mb", 4096)) << 20
-        self.use_pre_ohb = self.use_pallas and ohb_bytes <= budget
+        self.use_pre_ohb = (self.use_pallas and not self.pallas_paired
+                            and not self.use_quant_otf
+                            and ohb_bytes <= budget)
         self.ohb = None
         # trace-scoped override: callers thread the one-hot through
         # their jit boundary as an ARGUMENT (a multi-hundred-MB closure
@@ -408,6 +417,8 @@ class TreeGrower:
         """Frontier histogram dispatch: Pallas on a real single chip,
         XLA one-hot contraction under meshes / CPU simulation."""
         L = self.num_leaves if num_leaves is None else num_leaves
+        if quant is not None and self.use_quant_otf:
+            return self._hist_kernel_q_otf(leaf_id, slots, L, quant)
         if self.use_pre_ohb:
             return self._hist_kernel_pre(grad, hess, counts, leaf_id,
                                          slots, L, quant)
@@ -435,6 +446,64 @@ class TreeGrower:
             chunk=self.chunk, slots=slots)
 
     # ------------------------------------------------------------------
+    def _packed_dispatch(self, full, run_packed, slots, W):
+        """Shared narrow-frontier ladder: run at the narrowest lane
+        packing covering the valid slots.  ``full`` is a thunk for the
+        full-width kernel; ``run_packed(strips)`` runs the packed
+        kernel and returns its (strips*PACKED_STRIP, ...) output, which
+        is padded/truncated to W here.  The branch is a runtime
+        lax.cond on the valid-slot count — the early rounds of EVERY
+        tree have 1..PACKED_STRIP new leaves."""
+        def packed(strips):
+            def run(_):
+                h = run_packed(strips)
+                cap = strips * PACKED_STRIP
+                if cap >= W:
+                    return h[:W]
+                pad = jnp.zeros((W - cap,) + h.shape[1:], h.dtype)
+                return jnp.concatenate([h, pad])
+            return run
+
+        if not getattr(self.config, "hist_packed_dispatch", True):
+            return full(None)
+        if W <= PACKED_STRIP:
+            return packed(1)(None)
+
+        k = jnp.sum(slots >= 0)
+        if W <= 2 * PACKED_STRIP:
+            return jax.lax.cond(k <= PACKED_STRIP, packed(1), packed(2),
+                                None)
+        wide = packed(3) if W <= 3 * PACKED_STRIP else full
+        return jax.lax.cond(
+            k <= PACKED_STRIP, packed(1),
+            lambda _: jax.lax.cond(k <= 2 * PACKED_STRIP, packed(2),
+                                   wide, None), None)
+
+    # ------------------------------------------------------------------
+    def _hist_kernel_q_otf(self, leaf_id, slots, L, quant):
+        """Quantized on-the-fly dispatch: the packed-lane int8 kernel
+        rebuilds the bin one-hot in VMEM (HBM stream = the (N, G) packed
+        bins), at the narrowest lane packing covering the frontier."""
+        wq, scales = quant
+        B = self.max_group_bin
+
+        def full(_):
+            return compute_group_histograms_pallas_q(
+                self.bins, wq, scales, leaf_id, num_leaves=L,
+                max_group_bin=B, block=self.pallas_block, slots=slots)
+
+        if slots is None:
+            return full(None)
+
+        def run_packed(strips):
+            return compute_group_histograms_q_packed(
+                self.bins, wq, scales, leaf_id, slots,
+                max_group_bin=B, block=self.pallas_block, strips=strips)
+
+        return self._packed_dispatch(full, run_packed, slots,
+                                     slots.shape[0])
+
+    # ------------------------------------------------------------------
     def _hist_kernel_pre(self, grad, hess, counts, leaf_id, slots, L,
                          quant):
         """Streamed-one-hot dispatch: channel-packed kernel when the
@@ -457,36 +526,14 @@ class TreeGrower:
 
         if slots is None:
             return full(None)
-        W = slots.shape[0]
 
-        def packed(strips):
-            def run(_):
-                h = compute_group_histograms_pre_packed(
-                    ohb, w, scales, leaf_id, slots, max_group_bin=B,
-                    block=self.pallas_block, strips=strips, quant=q)
-                cap = strips * PACKED_STRIP
-                if cap >= W:
-                    return h[:W]
-                pad = jnp.zeros((W - cap,) + h.shape[1:], h.dtype)
-                return jnp.concatenate([h, pad])
-            return run
+        def run_packed(strips):
+            return compute_group_histograms_pre_packed(
+                ohb, w, scales, leaf_id, slots, max_group_bin=B,
+                block=self.pallas_block, strips=strips, quant=q)
 
-        if W <= PACKED_STRIP:
-            return packed(1)(None)
-        if not getattr(self.config, "hist_packed_dispatch", True):
-            return full(None)
-
-        # runtime dispatch on the valid-slot count: every round runs at
-        # the narrowest lane packing covering its frontier
-        k = jnp.sum(slots >= 0)
-        if W <= 2 * PACKED_STRIP:
-            return jax.lax.cond(k <= PACKED_STRIP, packed(1), packed(2),
-                                None)
-        wide = packed(3) if W <= 3 * PACKED_STRIP else full
-        return jax.lax.cond(
-            k <= PACKED_STRIP, packed(1),
-            lambda _: jax.lax.cond(k <= 2 * PACKED_STRIP, packed(2),
-                                   wide, None), None)
+        return self._packed_dispatch(full, run_packed, slots,
+                                     slots.shape[0])
 
     # ------------------------------------------------------------------
     def _init_state(self, grad, hess, counts) -> GrowerState:
@@ -825,10 +872,11 @@ class TreeGrower:
             leaf_forced = st.leaf_forced
 
         # row re-labeling (per-leaf affine scalars; no (L, GB) table).
-        # Pallas router on a real chip keeps the leaf one-hot in VMEM;
-        # the XLA form serves CPU simulation and GSPMD meshes.
-        router = apply_splits
-        leaf_id = router(
+        # A Pallas VMEM-one-hot router was benched on a v5e chip and
+        # lost to this XLA form (142 vs 96 ms/tree at 1M rows) — XLA
+        # fuses the routing elementwise ops into the one-hot dot, the
+        # hand kernel serialized them across 488 grid steps.
+        leaf_id = apply_splits(
             self.bins, st.leaf_id, do_split, f_group_leaf,
             self.f_gb_lo[best_f], self.f_gb_hi[best_f],
             self.f_gb_shift[best_f], self.f_gb_oor[best_f],

@@ -32,11 +32,8 @@ columns.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
 MISSING_NONE = 0
 MISSING_ZERO = 1
@@ -83,6 +80,9 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
     Returns: updated (N,) leaf_id (left child keeps the parent slot).
     """
     n, num_groups = bins.shape
+    if num_groups >= 65536:  # fg // 256 must stay bf16-exact
+        raise ValueError("apply_splits supports at most 65535 feature "
+                         f"groups, got {num_groups}")
     L = split_mask.shape[0]
 
     cat_bytes = pack_mask_bytes(cat_mask)            # (L, nb)
@@ -91,17 +91,20 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
     def col(v):
         return v.astype(jnp.float32)[:, None]
 
-    # every column is an integer < 256 — exact in bf16 (right_slot is
-    # split hi/lo), so the broadcast dot runs on the fast bf16 MXU path
-    # and the materialized one-hot is half the bytes of f32
+    # every column is an integer < 256 — exact in bf16 (right_slot AND
+    # feat_group are split hi/lo: feature groups are unbounded up to
+    # the hi byte's own bf16 limit of 65536 groups, asserted below), so
+    # the broadcast dot runs on the fast bf16 MXU path and the
+    # materialized one-hot is half the bytes of f32
     rs = right_slot.astype(jnp.int32)
+    fg = feat_group.astype(jnp.int32)
     table = jnp.concatenate([
-        col(feat_group), col(threshold), col(default_left),
+        col(fg // 256), col(fg % 256), col(threshold), col(default_left),
         col(missing_type), col(default_bin), col(num_bin),
         col(is_cat), col(rs // 256), col(rs % 256), col(split_mask),
         col(fb_lo), col(fb_hi), col(fb_shift), col(fb_oor),
         cat_bytes,
-    ], axis=1).astype(jnp.bfloat16)                  # (L, 14 + nb)
+    ], axis=1).astype(jnp.bfloat16)                  # (L, 15 + nb)
     safe_l = jnp.clip(leaf_id, 0, L - 1)
     ohl = (safe_l[:, None]
            == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
@@ -110,17 +113,17 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
     def icol(i):
         return rows[:, i].astype(jnp.int32)
 
-    grp_row = icol(0)
-    thr_row = icol(1)
-    dleft_row = rows[:, 2] > 0.5
-    mtype_row = icol(3)
-    dbin_row = icol(4)
-    nbin_row = icol(5)
-    iscat_row = rows[:, 6] > 0.5
-    rs_row = icol(7) * 256 + icol(8)
-    active = (rows[:, 9] > 0.5) & (leaf_id >= 0)
-    lo_row, hi_row = icol(10), icol(11)
-    shift_row, oor_row = icol(12), icol(13)
+    grp_row = icol(0) * 256 + icol(1)
+    thr_row = icol(2)
+    dleft_row = rows[:, 3] > 0.5
+    mtype_row = icol(4)
+    dbin_row = icol(5)
+    nbin_row = icol(6)
+    iscat_row = rows[:, 7] > 0.5
+    rs_row = icol(8) * 256 + icol(9)
+    active = (rows[:, 10] > 0.5) & (leaf_id >= 0)
+    lo_row, hi_row = icol(11), icol(12)
+    shift_row, oor_row = icol(13), icol(14)
 
     # chosen-group bin per row (masked sum instead of a gather; G small)
     gsel = grp_row[:, None] == jnp.arange(num_groups,
@@ -141,7 +144,7 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
     # categorical routing: extract bit fbin of the packed byte columns
     byte_idx = fbin // 8
     bsel = byte_idx[:, None] == jnp.arange(nb, dtype=jnp.int32)[None, :]
-    byte_val = jnp.sum(jnp.where(bsel, rows[:, 14:14 + nb], 0.0),
+    byte_val = jnp.sum(jnp.where(bsel, rows[:, 15:15 + nb], 0.0),
                        axis=1).astype(jnp.int32)
     cat_left = ((byte_val >> (fbin % 8)) & 1) == 1
 
@@ -149,123 +152,3 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
     new_id = jnp.where(go_left, leaf_id, rs_row)
     return jnp.where(active, new_id, leaf_id).astype(jnp.int32)
 
-
-def _partition_table(split_mask, feat_group, fb_lo, fb_hi, fb_shift,
-                     fb_oor, is_cat, threshold, default_left, missing_type,
-                     default_bin, num_bin, cat_mask, right_slot):
-    """(L, 14+nb) bf16 leaf table for the Pallas router.  Every column
-    is an integer < 256 (bf16-exact); right_slot is split hi/lo."""
-    def col(v):
-        return v.astype(jnp.float32)[:, None]
-
-    rs = right_slot.astype(jnp.int32)
-    cat_bytes = pack_mask_bytes(cat_mask)
-    table = jnp.concatenate([
-        col(feat_group), col(threshold), col(default_left),
-        col(missing_type), col(default_bin), col(num_bin),
-        col(is_cat), col(rs // 256), col(rs % 256), col(split_mask),
-        col(fb_lo), col(fb_hi), col(fb_shift), col(fb_oor),
-        cat_bytes,
-    ], axis=1)
-    return table.astype(jnp.bfloat16), cat_bytes.shape[1]
-
-
-def _partition_kernel_body(bins_ref, leaf_ref, table_ref, out_ref, *,
-                           num_groups, nb):
-    """One row-block of split routing: the leaf one-hot and the
-    broadcast (C, K) table rows live only in VMEM — the HBM traffic is
-    the packed bins + leaf ids (~30 bytes/row), vs the ~4 KB/row an XLA
-    materialization of the one-hot costs."""
-    c = bins_ref.shape[0]
-    l_pad = table_ref.shape[0]
-    leaf = leaf_ref[:]                                   # (C, 1) int32
-    liota = jax.lax.broadcasted_iota(jnp.int32, (c, l_pad), 1)
-    ohl = (leaf == liota).astype(jnp.bfloat16)           # (C, Lpad)
-    rows = jax.lax.dot_general(
-        ohl, table_ref[:], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)              # (C, K)
-
-    def icol(i):
-        return rows[:, i:i + 1].astype(jnp.int32)
-
-    # Mosaic cannot select between 1-bit (bool) vectors — routing runs
-    # in 0/1 int32 arithmetic with bool predicates only
-    grp = icol(0)
-    thr = icol(1)
-    dleft = icol(2)
-    mtype = icol(3)
-    dbin = icol(4)
-    nbin = icol(5)
-    iscat = rows[:, 6:7] > 0.5
-    rs = icol(7) * 256 + icol(8)
-    active = (rows[:, 9:10] > 0.5) & (leaf >= 0)
-    lo, hi = icol(10), icol(11)
-    shift, oor = icol(12), icol(13)
-
-    giota = jax.lax.broadcasted_iota(jnp.int32, (c, num_groups), 1)
-    gsel = giota == grp
-    gb = jnp.sum(jnp.where(gsel, bins_ref[:].astype(jnp.int32), 0),
-                 axis=1, keepdims=True)                  # (C, 1)
-    fbin = jnp.where((gb >= lo) & (gb < hi), gb - shift, oor)
-
-    is_nan_bin = fbin == nbin - 1
-    is_def_bin = fbin == dbin
-    cmp_left = (fbin <= thr).astype(jnp.int32)
-    num_left = jnp.where(
-        (mtype == MISSING_NAN) & is_nan_bin, dleft,
-        jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
-
-    byte_idx = fbin // 8
-    niota = jax.lax.broadcasted_iota(jnp.int32, (c, nb), 1)
-    bsel = byte_idx == niota
-    byte_val = jnp.sum(
-        jnp.where(bsel, rows[:, 14:14 + nb], 0.0), axis=1,
-        keepdims=True).astype(jnp.int32)
-    cat_left = (byte_val >> (fbin % 8)) & 1
-
-    go_left = jnp.where(iscat, cat_left, num_left)
-    new_id = jnp.where(go_left > 0, leaf, rs)
-    out_ref[:] = jnp.where(active, new_id, leaf).astype(jnp.int32)
-
-
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
-def apply_splits_pallas(bins: jax.Array, leaf_id: jax.Array,
-                        split_mask: jax.Array, feat_group: jax.Array,
-                        fb_lo: jax.Array, fb_hi: jax.Array,
-                        fb_shift: jax.Array, fb_oor: jax.Array,
-                        is_cat: jax.Array, threshold: jax.Array,
-                        default_left: jax.Array, missing_type: jax.Array,
-                        default_bin: jax.Array, num_bin: jax.Array,
-                        cat_mask: jax.Array, right_slot: jax.Array,
-                        block: int = 2048,
-                        interpret: bool = False) -> jax.Array:
-    """Pallas TPU router with the same contract as
-    :func:`apply_splits` (single device; N must divide by block)."""
-    n, num_groups = bins.shape
-    if n % block != 0:
-        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
-    L = split_mask.shape[0]
-    l_pad = max(128, ((L + 127) // 128) * 128)
-    table, nb = _partition_table(
-        split_mask, feat_group, fb_lo, fb_hi, fb_shift, fb_oor, is_cat,
-        threshold, default_left, missing_type, default_bin, num_bin,
-        cat_mask, right_slot)
-    if l_pad > L:
-        table = jnp.concatenate(
-            [table, jnp.zeros((l_pad - L, table.shape[1]),
-                              jnp.bfloat16)])
-    kern = functools.partial(_partition_kernel_body,
-                             num_groups=num_groups, nb=nb)
-    out = pl.pallas_call(
-        kern,
-        grid=(n // block,),
-        in_specs=[
-            pl.BlockSpec((block, num_groups), lambda i: (i, 0)),
-            pl.BlockSpec((block, 1), lambda i: (i, 0)),
-            pl.BlockSpec(table.shape, lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.int32),
-        interpret=interpret,
-    )(bins, leaf_id[:, None], table)
-    return out[:, 0]

@@ -1,6 +1,10 @@
 """Benchmark: Higgs-like binary training throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"auc", "auc_f32", "auc_delta"} — speed without an accuracy gate is
+not evidence, so the quantized path's AUC is measured against the f32
+path on a held-out split and must stay within 1e-3 (the reference's
+own GPU-vs-CPU tolerance, docs/GPU-Performance.rst:136-161).
 
 Baseline derivation (BASELINE.md): the reference trains HIGGS
 (10.5M rows x 28 features, 500 iters, 255 leaves) in 238.51 s on a
@@ -19,18 +23,105 @@ import numpy as np
 BENCH_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 BENCH_FEATURES = 28
 BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 100))
+VALID_ROWS = int(os.environ.get("BENCH_VALID_ROWS", 200_000))
 NUM_LEAVES = 255
 MAX_BIN = 63
 REF_SEC_PER_TREE_ROW = 238.51 / (500 * 10_500_000)
 
 
-def make_data(n, f, seed=7):
+def make_data(n, f, seed=7, w=None):
+    """Synthetic binary task.  ``w`` (the concept) defaults to a draw
+    from the same stream — pass the training run's w for a held-out
+    sample of the SAME concept."""
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f).astype(np.float32)
-    w = rng.randn(f) * (rng.rand(f) > 0.3)
+    if w is None:
+        w = rng.randn(f) * (rng.rand(f) > 0.3)
     logit = X[:, :f] @ w + 0.5 * np.sin(3 * X[:, 0]) * X[:, 1]
     y = (logit + rng.logistic(size=n) > 0).astype(np.float32)
-    return X.astype(np.float64), y
+    return X.astype(np.float64), y, w
+
+
+def auc_score(y, s):
+    """Tie-aware AUC (numpy; rank-sum formulation)."""
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    sorted_s = s[order]
+    n = len(s)
+    i = 0
+    while i < n:
+        j = i
+        while j + 1 < n and sorted_s[j + 1] == sorted_s[i]:
+            j += 1
+        ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    pos = y > 0
+    np_ = pos.sum()
+    nn = n - np_
+    if np_ == 0 or nn == 0:
+        return float("nan")
+    return float((ranks[pos].sum() - np_ * (np_ + 1) / 2) / (np_ * nn))
+
+
+def train_timed(cfg_params, X, y):
+    """Train BENCH_ITERS trees; returns (gbdt, cfg, dtrain, prep_s,
+    compile_s, per_tree_s)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+
+    cfg = Config.from_params(cfg_params)
+    t0 = time.time()
+    dtrain = lgb.Dataset(X, label=y)
+    core = dtrain.construct(cfg)
+    prep_s = time.time() - t0
+    gbdt = GBDT(cfg, core)
+
+    def drain():
+        np.asarray(gbdt.scores[:, :8])
+
+    chunk = max(1, min(int(os.environ.get("BENCH_CHUNK", 10)),
+                       BENCH_ITERS // 2))
+    t0 = time.time()
+    gbdt.train_chunk(chunk)
+    drain()
+    compile_s = time.time() - t0
+    n_chunks = max(1, (BENCH_ITERS - chunk) // chunk)
+    t0 = time.time()
+    for _ in range(n_chunks):
+        gbdt.train_chunk(chunk)
+    drain()
+    per_tree = (time.time() - t0) / (n_chunks * chunk)
+    return gbdt, cfg, dtrain, prep_s, compile_s, per_tree
+
+
+def heldout_scores(gbdt, cfg, vbins_np):
+    """Raw scores of the trained ensemble on a held-out binned matrix,
+    computed on device AFTER timing (one scan per pending tree stack)."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.predict import predict_binned
+
+    g = gbdt.grower
+    vbins = jnp.asarray(vbins_np)
+    shrink = gbdt.shrinkage_rate
+
+    @jax.jit
+    def acc_stack(total, stack):
+        def body(carry, tr):
+            pv = predict_binned(tr, vbins, g.f_group, g.g2f_lut,
+                                g.f_missing, g.f_default_bin, g.f_num_bin,
+                                max_steps=cfg.num_leaves)
+            return carry + shrink * pv, None
+        out, _ = jax.lax.scan(body, total, stack)
+        return out
+
+    total = jnp.full(vbins.shape[0], gbdt.init_score, jnp.float32)
+    for p in gbdt._pending:
+        assert p[0] == "stack", "bench expects chunked training"
+        for stack in p[1]:
+            total = acc_stack(total, stack)
+    return np.asarray(total)
 
 
 def main():
@@ -45,10 +136,9 @@ def main():
     except Exception:
         pass
     import lightgbm_tpu as lgb
-    from lightgbm_tpu.boosting.gbdt import GBDT
-    from lightgbm_tpu.config import Config
 
-    X, y = make_data(BENCH_ROWS, BENCH_FEATURES)
+    X, y, w = make_data(BENCH_ROWS, BENCH_FEATURES)
+    Xv, yv, _ = make_data(VALID_ROWS, BENCH_FEATURES, seed=8, w=w)
     params = {
         "objective": "binary", "num_leaves": NUM_LEAVES,
         "max_bin": MAX_BIN, "learning_rate": 0.1, "verbose": -1,
@@ -57,45 +147,43 @@ def main():
                                              "bfloat16"),
         # int8-MXU quantized histograms — the TPU analog of the
         # reference benchmarking its single-precision 63-bin GPU path
-        # (docs/GPU-Performance.rst:134-161); measured AUC delta vs the
-        # f32 path is ~1e-4, well inside the reference's GPU-vs-CPU
-        # tolerance. Disable with BENCH_QUANTIZED=0.
+        # (docs/GPU-Performance.rst:134-161); the JSON line reports the
+        # held-out AUC of this path AND the f32 path, asserting the
+        # delta stays within the reference's own GPU-vs-CPU tolerance
+        # of 1e-3.  Disable with BENCH_QUANTIZED=0.
         "quantized_grad": os.environ.get("BENCH_QUANTIZED", "1") != "0",
     }
     # ad-hoc experiment overrides, e.g. BENCH_PARAMS='{"frontier_width":64}'
     extra = os.environ.get("BENCH_PARAMS")
     if extra:
         params.update(json.loads(extra))
-    cfg = Config.from_params(params)
-    t0 = time.time()
-    core = lgb.Dataset(X, label=y).construct(cfg)
-    prep_s = time.time() - t0
 
-    def drain():
-        # jax.block_until_ready is not a reliable barrier on the
-        # remote-attached (axon) TPU platform — force a device->host
-        # read that depends on the full score state instead.
-        np.asarray(gbdt.scores[:, :8])
-
-    gbdt = GBDT(cfg, core)
-    # multi-iteration fused chunks amortize the per-dispatch RPC cost
-    # of the remote-attached TPU; same path engine.train uses headless
-    chunk = max(1, min(int(os.environ.get("BENCH_CHUNK", 10)),
-                       BENCH_ITERS // 2))
-    # warmup: compile one chunk
-    t0 = time.time()
-    gbdt.train_chunk(chunk)
-    drain()
-    compile_s = time.time() - t0
-
-    n_chunks = max(1, (BENCH_ITERS - chunk) // chunk)
-    t0 = time.time()
-    for _ in range(n_chunks):
-        gbdt.train_chunk(chunk)
-    drain()
-    train_s = time.time() - t0
-    per_tree = train_s / (n_chunks * chunk)
+    # ---- timed run (headline config) ----
+    gbdt, cfg, dtrain, prep_s, compile_s, per_tree = train_timed(
+        params, X, y)
     total_equiv = per_tree * BENCH_ITERS
+    vcore = lgb.Dataset(Xv, label=yv, reference=dtrain).construct(cfg)
+    auc = auc_score(yv, heldout_scores(gbdt, cfg, vcore.group_bins))
+
+    # ---- accuracy reference: the f32 (non-quantized) path ----
+    auc_f32 = auc
+    if params.get("quantized_grad"):
+        # free the timed run's device state (streamed one-hot etc.)
+        # before the second training run — two runs' buffers don't
+        # co-reside in HBM at 1M rows
+        import gc
+        del gbdt, dtrain
+        gc.collect()
+        p32 = dict(params, quantized_grad=False)
+        g32, c32, d32, _, _, _ = train_timed(p32, X, y)
+        v32 = lgb.Dataset(Xv, label=yv, reference=d32).construct(c32)
+        auc_f32 = auc_score(yv, heldout_scores(g32, c32, v32.group_bins))
+
+    delta = abs(auc - auc_f32)
+    if not (delta <= 1e-3):  # catches NaN too; survives python -O
+        raise SystemExit(
+            f"quantized AUC ({auc}) drifted {delta!r} from the f32 path "
+            f"({auc_f32}) — over the 1e-3 reference GPU-vs-CPU tolerance")
 
     ref_scaled = REF_SEC_PER_TREE_ROW * BENCH_ROWS * BENCH_ITERS
     result = {
@@ -103,6 +191,9 @@ def main():
         "value": round(total_equiv, 3),
         "unit": "s",
         "vs_baseline": round(ref_scaled / total_equiv, 3),
+        "auc": round(auc, 6),
+        "auc_f32": round(auc_f32, 6),
+        "auc_delta": round(delta, 6),
     }
     print(json.dumps(result))
     # diagnostics on stderr so the stdout contract stays one line

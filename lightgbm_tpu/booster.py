@@ -150,7 +150,22 @@ class Booster:
         """Host prediction on raw features (reference
         gbdt_prediction.cpp:9-100; SHAP via tree.PredictContrib;
         margin-based early stop prediction_early_stop.cpp:13-80)."""
-        from .basic import _to_matrix
+        from .basic import _is_sparse, _to_matrix
+        if _is_sparse(data):
+            # CSR prediction without whole-matrix densify (reference
+            # c_api.h:574 PredictForCSR): bounded row chunks keep the
+            # dense staging under ~128 MB regardless of width
+            csr = data.tocsr()
+            chunk = max(1, (128 << 20) // max(8 * csr.shape[1], 1))
+            parts = [self.predict(
+                np.asarray(csr[i:i + chunk].todense(), dtype=np.float64),
+                num_iteration=num_iteration, raw_score=raw_score,
+                pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                pred_early_stop=pred_early_stop,
+                pred_early_stop_freq=pred_early_stop_freq,
+                pred_early_stop_margin=pred_early_stop_margin)
+                for i in range(0, csr.shape[0], chunk)]
+            return np.concatenate(parts, axis=0)
         # pandas categoricals encode against the TRAIN-time category
         # lists so reordered/unseen predict-time categories map right
         data = _to_matrix(data, getattr(self, "pandas_categorical", None))

@@ -117,7 +117,16 @@ class GBDT:
         self._tree_scale: List[float] = []    # DART renorm per model idx
         self._applied_scale: List[float] = []  # scale baked into models[i]
         self._nl_window: List[jax.Array] = []  # deferred 1-leaf stop checks
+        # (entries are () or (n,) device arrays — kept stacked so a
+        # chunk never pays per-iteration slice dispatches)
+        self._nl_count = 0
         self._stop_check_every = 8
+        # threefry PRNGKey(seed) layout is [hi, lo] uint32 — verified
+        # once so chunk key batches can be built host-side in numpy
+        # (n PRNGKey dispatches per chunk each cost a remote RPC)
+        self._np_keys_ok = bool(np.array_equal(
+            np.asarray(jax.random.PRNGKey(7)),
+            np.array([0, 7], np.uint32)))
         self._fused_step = None
         self._fused_chunk = None
         self._fused_chunk_n = 0
@@ -358,10 +367,26 @@ class GBDT:
         use_bag = self._use_bagging_fused()
         if self._bag_state is None:
             self._bag_state = self._full_counts > 0
-        keys = jnp.stack([
-            jax.random.PRNGKey(int(self._iter_key_rng.randint(0, 2**31 - 1)))
-            for _ in range(n_iters)])
-        fmasks = jnp.stack([self._feature_masks() for _ in range(n_iters)])
+        seeds = np.asarray([self._iter_key_rng.randint(0, 2**31 - 1)
+                            for _ in range(n_iters)], np.uint32)
+        if self._np_keys_ok:
+            keys = jnp.asarray(np.stack(
+                [np.zeros(n_iters, np.uint32), seeds], axis=1))
+        else:  # pragma: no cover - unexpected key layout
+            keys = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+        if self.config.feature_fraction >= 1.0:
+            cache = getattr(self, "_chunk_fmasks", None)
+            if cache is None or cache.shape[0] != n_iters:
+                cache = jnp.ones(
+                    (n_iters, self.num_class, self.grower.num_features),
+                    bool)
+                self._chunk_fmasks = cache
+            fmasks = cache
+        else:
+            fmasks = jnp.asarray(np.stack(
+                [np.stack([self._feature_mask_np()
+                           for _ in range(self.num_class)])
+                 for _ in range(n_iters)]))
         fresh = np.zeros(n_iters, bool)
         if use_bag:
             for j in range(n_iters):
@@ -387,10 +412,11 @@ class GBDT:
             for stack in stacks:
                 self.device_trees.append(("stackref", stack, j))
                 self._tree_scale.append(1.0)
-        self._nl_window.extend(list(nls))
+        self._nl_window.append(nls)          # stays stacked on device
+        self._nl_count += n_iters
         self.iter_ += n_iters
         self.timer.stop("tree")
-        if len(self._nl_window) >= self._stop_check_every:
+        if self._nl_count >= self._stop_check_every:
             return self._check_stop_window()
         return False
 
@@ -432,10 +458,11 @@ class GBDT:
             self._pending.append(("tree", tree, self.shrinkage_rate, bias))
             self._tree_scale.append(1.0)
         self._nl_window.append(nl)
+        self._nl_count += 1
         self._after_iteration()
         self.iter_ += 1
         self.timer.stop("tree")
-        if len(self._nl_window) >= self._stop_check_every:
+        if self._nl_count >= self._stop_check_every:
             return self._check_stop_window()
         return False
 
@@ -501,8 +528,10 @@ class GBDT:
         zero score, so late rollback is exact)."""
         if not self._nl_window:
             return False
-        vals = np.asarray(jnp.stack(self._nl_window))
+        vals = np.asarray(jnp.concatenate(
+            [jnp.atleast_1d(x) for x in self._nl_window]))
         self._nl_window = []
+        self._nl_count = 0
         for j, v in enumerate(vals):
             if int(v) <= 1:
                 overrun = len(vals) - j

@@ -92,6 +92,44 @@ def LGBM_DatasetCreateFromMat(data, parameters: str, reference=None,
 
 
 @_api
+def LGBM_DatasetCreateFromCSR(indptr, indices, data, num_col: int,
+                              parameters: str, reference=None,
+                              out=None) -> int:
+    """reference c_api.h:147-180 (CSR rows).  Stays sparse end-to-end:
+    the Dataset bins CSC columns directly, never densifying the whole
+    matrix."""
+    from scipy import sparse as sp
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    mat = sp.csr_matrix(
+        (np.asarray(data, dtype=np.float64),
+         np.asarray(indices, dtype=np.int32),
+         np.asarray(indptr, dtype=np.int64)),
+        shape=(len(indptr) - 1, int(num_col)))
+    ds = Dataset(mat, reference=ref, params=params)
+    out[0] = _register(ds)
+    return 0
+
+
+@_api
+def LGBM_DatasetCreateFromCSC(col_ptr, indices, data, num_row: int,
+                              parameters: str, reference=None,
+                              out=None) -> int:
+    """reference c_api.h:183-216 (CSC columns)."""
+    from scipy import sparse as sp
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    mat = sp.csc_matrix(
+        (np.asarray(data, dtype=np.float64),
+         np.asarray(indices, dtype=np.int32),
+         np.asarray(col_ptr, dtype=np.int64)),
+        shape=(int(num_row), len(col_ptr) - 1))
+    ds = Dataset(mat, reference=ref, params=params)
+    out[0] = _register(ds)
+    return 0
+
+
+@_api
 def LGBM_DatasetCreateFromFile(filename: str, parameters: str,
                                reference=None, out=None) -> int:
     """reference c_api.h:53-66."""
@@ -254,6 +292,26 @@ def LGBM_BoosterPredictForMat(handle, data, predict_type: int = 0,
     bst = _get(handle)
     out[0] = bst.predict(np.asarray(data, dtype=np.float64),
                          num_iteration=num_iteration,
+                         raw_score=(predict_type == 1),
+                         pred_leaf=(predict_type == 2),
+                         pred_contrib=(predict_type == 3))
+    return 0
+
+
+@_api
+def LGBM_BoosterPredictForCSR(handle, indptr, indices, data, num_col: int,
+                              predict_type: int = 0,
+                              num_iteration: int = -1, out=None) -> int:
+    """reference c_api.h:574-607: CSR prediction (row-chunked densify
+    inside Booster.predict — never the whole matrix)."""
+    from scipy import sparse as sp
+    bst = _get(handle)
+    mat = sp.csr_matrix(
+        (np.asarray(data, dtype=np.float64),
+         np.asarray(indices, dtype=np.int32),
+         np.asarray(indptr, dtype=np.int64)),
+        shape=(len(indptr) - 1, int(num_col)))
+    out[0] = bst.predict(mat, num_iteration=num_iteration,
                          raw_score=(predict_type == 1),
                          pred_leaf=(predict_type == 2),
                          pred_contrib=(predict_type == 3))

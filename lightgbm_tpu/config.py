@@ -295,6 +295,12 @@ class Config:
     hist_quant_onthefly: bool = True  # quantized path: rebuild the bin
     # one-hot in-kernel (packed int8 lanes) instead of streaming the
     # (N, G*B) one-hot from HBM — B x less HBM traffic per round
+    hist_fused_route: bool = True   # apply pending split routing inside
+    # the next round's histogram kernel (single chip, streamed one-hot)
+    # instead of a separate XLA routing pass per round
+    force_pallas_interpret: bool = False  # test seam: run the Pallas
+    # kernel paths (incl. the fused-route grower wiring) in interpret
+    # mode on CPU — slow, for CI coverage of the TPU-only code paths
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
     deterministic: bool = False

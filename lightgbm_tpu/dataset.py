@@ -177,13 +177,26 @@ class Dataset:
                     categorical_features: Optional[Sequence[int]] = None,
                     feature_names: Optional[Sequence[str]] = None,
                     reference: Optional["Dataset"] = None) -> "Dataset":
-        """Build from an in-memory float matrix — the analog of
-        LGBM_DatasetCreateFromMat -> CostructFromSampleData
-        (reference c_api.cpp:424+, dataset_loader.cpp:488-610)."""
+        """Build from an in-memory float matrix or a scipy sparse
+        matrix — the analog of LGBM_DatasetCreateFromMat / FromCSR/CSC
+        -> CostructFromSampleData (reference c_api.cpp:424+,
+        dataset_loader.cpp:488-610; sparse classes
+        src/io/sparse_bin.hpp:68-456).
+
+        Sparse input is NEVER densified whole: sampling, EFB conflict
+        counting and bin-matrix construction all walk the CSC columns,
+        so host memory is bounded by nnz + the packed (N, G) uint8
+        output (the per-bundle-densify design — the uint8 matrix IS the
+        HBM-resident training representation)."""
         config = config or Config()
-        data = np.asarray(data, dtype=np.float64)
-        if data.ndim != 2:
-            raise ValueError("data must be 2-dimensional")
+        sparse = hasattr(data, "tocsc") and hasattr(data, "nnz")
+        if sparse:
+            data = data.tocsc()
+            data.sort_indices()
+        else:
+            data = np.asarray(data, dtype=np.float64)
+            if data.ndim != 2:
+                raise ValueError("data must be 2-dimensional")
         num_data, num_features = data.shape
 
         self = cls()
@@ -206,7 +219,9 @@ class Dataset:
             self._build_groups(reference=reference)
         else:
             cat_set = set(categorical_features or [])
-            sample_vals, total_cnt, sample_rows = _sample_feature_values(
+            sampler = (_sample_feature_values_sparse if sparse
+                       else _sample_feature_values)
+            sample_vals, total_cnt, sample_rows = sampler(
                 data, config.bin_construct_sample_cnt, config.data_random_seed)
             self.mappers = find_bin_mappers(
                 sample_vals, total_cnt, config.max_bin, config.min_data_in_bin,
@@ -220,7 +235,10 @@ class Dataset:
             self._build_groups(reference=None, sample_nonzero=sample_rows,
                                sample_cnt=total_cnt)
 
-        self._bin_data(data)
+        if sparse:
+            self._bin_data_sparse(data)
+        else:
+            self._bin_data(data)
         self._raw_data = data
         self._categorical_features = list(categorical_features or [])
         self.metadata = Metadata(num_data)
@@ -300,6 +318,37 @@ class Dataset:
                 is_default = col == f.mapper.default_bin
                 keep = ~is_default
                 out[keep, f.group] = gb[keep].astype(np.uint8)
+        self.group_bins = out
+
+    # ------------------------------------------------------------------
+    def _bin_data_sparse(self, csc) -> None:
+        """Bin a CSC matrix column-by-column into the packed (N, G)
+        uint8 matrix: implicit zeros land in each feature's zero bin
+        (== its default bin, the GreedyFindBin contract) without ever
+        materializing a dense float column (reference sparse path:
+        src/io/sparse_bin.hpp Push / feature_group.h:128-136)."""
+        N = self.num_data
+        G = self.num_groups
+        out = np.zeros((N, G), dtype=np.uint8)
+        indptr, indices, values = csc.indptr, csc.indices, csc.data
+        for f in self.features:
+            m = self.mappers[f.feature_idx]
+            j = f.feature_idx
+            rows = indices[indptr[j]:indptr[j + 1]]
+            vals = values[indptr[j]:indptr[j + 1]]
+            col = m.value_to_bin(vals.astype(np.float64))
+            zero_bin = int(np.asarray(
+                m.value_to_bin(np.zeros(1)))[0])
+            if not f.collapsed_default:
+                if zero_bin != 0:
+                    out[:, f.group] = zero_bin
+                out[rows, f.group] = col.astype(np.uint8)
+            else:
+                gb = col + f.offset
+                if m.default_bin == 0:
+                    gb -= 1
+                keep = col != m.default_bin
+                out[rows[keep], f.group] = gb[keep].astype(np.uint8)
         self.group_bins = out
 
     # ------------------------------------------------------------------
@@ -404,6 +453,35 @@ def _sample_feature_values(data: np.ndarray, sample_cnt: int, seed: int
         keep = np.isnan(col) | (np.abs(col) > 1e-35)
         out.append(col[keep])
         rows.append(np.nonzero(keep)[0])
+    return out, total, rows
+
+
+def _sample_feature_values_sparse(csc, sample_cnt: int, seed: int
+                                  ) -> Tuple[List[np.ndarray], int,
+                                             List[np.ndarray]]:
+    """Sparse analog of :func:`_sample_feature_values`: row-sample the
+    CSC matrix (via a CSR slice) and collect each column's stored
+    values/rows — zeros stay implicit, exactly the reference sampling
+    contract (dataset_loader.cpp:649-754 + bin.cpp:207)."""
+    num_data = csc.shape[0]
+    if num_data > sample_cnt:
+        rng = np.random.RandomState(seed)
+        idx = rng.choice(num_data, size=sample_cnt, replace=False)
+        idx.sort()
+        sample = csc.tocsr()[idx].tocsc()
+        sample.sort_indices()
+    else:
+        sample = csc
+    total = sample.shape[0]
+    indptr, indices, values = sample.indptr, sample.indices, sample.data
+    out = []
+    rows = []
+    for j in range(sample.shape[1]):
+        v = values[indptr[j]:indptr[j + 1]].astype(np.float64)
+        r = indices[indptr[j]:indptr[j + 1]]
+        keep = np.isnan(v) | (np.abs(v) > 1e-35)
+        out.append(v[keep])
+        rows.append(r[keep].astype(np.int64))
     return out, total, rows
 
 

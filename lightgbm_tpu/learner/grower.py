@@ -42,6 +42,7 @@ import numpy as np
 from ..config import Config
 from ..dataset import Dataset
 from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
+                             compute_group_histograms_fused,
                              compute_group_histograms_pallas,
                              compute_group_histograms_pallas_paired,
                              compute_group_histograms_pallas_q,
@@ -50,7 +51,8 @@ from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
                              compute_group_histograms_q_packed,
                              compute_leaf_totals, expand_feature_histograms,
                              precompute_bin_onehot, quantize_gradients)
-from ..ops.partition import apply_splits
+from ..ops.partition import (apply_route_table, apply_splits,
+                             build_route_table)
 from ..ops.split import (SplitResult, build_cat_bitset,
                          find_categorical_splits, find_numerical_splits,
                          gather_split_at_threshold)
@@ -128,6 +130,9 @@ class GrowerState(NamedTuple):
     pend_parents: jax.Array      # (W,) slots whose hist/cands are stale
     pend_rights: jax.Array       # (W,) — refreshed at the NEXT round's
     # start (so the final round's refresh is never computed at all)
+    route_tab: jax.Array         # (L, 15+nb) f32 PENDING route table
+    # (fused-kernel path: the splits selected this round re-label rows
+    # lazily inside the next round's histogram kernel; all-zero = no-op)
 
 
 def _encode_leaf(leaf_slot):
@@ -236,9 +241,15 @@ class TreeGrower:
         if hk not in ("auto", "pallas", "paired", "xla"):
             Log.warning(f"unknown hist_kernel={hk!r}; using 'auto'")
             hk = "auto"
+        # test seam: interpret-mode Pallas on CPU exercises the SAME
+        # grower wiring (fused route carry, quant transpose, exit-time
+        # route application) the real chip runs
+        self._interp = bool(getattr(config, "force_pallas_interpret",
+                                    False))
         pallas_ok = (
             self.policy.mesh is None
-            and jax.default_backend() in ("tpu", "axon")
+            and (jax.default_backend() in ("tpu", "axon")
+                 or self._interp)
             and self.n_padded % 1024 == 0)
         if hk in ("pallas", "paired") and not pallas_ok:
             Log.warning(f"hist_kernel={hk} unavailable here (needs a "
@@ -274,10 +285,22 @@ class TreeGrower:
         # it from the packed bins every round.  Gated on an HBM budget.
         ohb_bytes = (self.n_padded * self.num_groups * self.max_group_bin)
         budget = int(getattr(config, "hist_onehot_budget_mb", 4096)) << 20
+        # fused route+histogram kernel (single chip): the pending split
+        # routing is applied INSIDE the next round's histogram pass, so
+        # the separate per-round apply_splits pass disappears.  Needs
+        # the streamed one-hot (HBM budget) and a frontier that fits
+        # the packed strip ladder.
+        self.use_fused = (self.use_pallas and not self.pallas_paired
+                          and self.frontier <= 3 * PACKED_STRIP
+                          and ohb_bytes <= budget
+                          and getattr(config, "hist_fused_route", True))
+        self.use_quant_otf = (self.use_quant_otf and not self.use_fused)
         self.use_pre_ohb = (self.use_pallas and not self.pallas_paired
                             and not self.use_quant_otf
                             and ohb_bytes <= budget)
         self.ohb = None
+        self.binsT = (jnp.asarray(bins_np.T) if self.use_fused else None)
+        self._route_cols = 15 + (self.max_feature_bin + 7) // 8
         # trace-scoped override: callers thread the one-hot through
         # their jit boundary as an ARGUMENT (a multi-hundred-MB closure
         # constant sends XLA's constant-folding passes into minutes of
@@ -480,6 +503,50 @@ class TreeGrower:
                                    wide, None), None)
 
     # ------------------------------------------------------------------
+    def _hist_kernel_fused(self, st: "GrowerState", rights, grad, hess,
+                           counts, quant):
+        """Fused route+histogram ladder: one Pallas pass both re-labels
+        every row by the pending route table and accumulates the new
+        right children's histograms, at the narrowest strip packing
+        covering the frontier.  Returns (hist (W, G, B, 3), new
+        leaf_id)."""
+        B = self.max_group_bin
+        W = rights.shape[0]
+        ohb = self._ohb_arg if self._ohb_arg is not None else self.ohb
+        if quant is not None:
+            wT, scales, q = quant[0], quant[1], True    # (3, N) int32
+        else:
+            wT = jnp.stack([grad, hess, counts], axis=0)
+            scales, q = None, False
+
+        def run(strips):
+            def go(_):
+                # block=2048 measured fastest on v5e (4096 fits scoped
+                # VMEM for 1-strip but benched 16% slower — the DMA
+                # pipeline prefers the finer granularity)
+                h, leaf2 = compute_group_histograms_fused(
+                    ohb, self.binsT, wT, scales, st.leaf_id,
+                    st.route_tab, rights, max_group_bin=B,
+                    block=self.pallas_block, strips=strips, quant=q,
+                    interpret=self._interp)
+                cap = strips * PACKED_STRIP
+                if cap >= W:
+                    return h[:W], leaf2
+                pad = jnp.zeros((W - cap,) + h.shape[1:], h.dtype)
+                return jnp.concatenate([h, pad]), leaf2
+            return go
+
+        if W <= PACKED_STRIP:
+            return run(1)(None)
+        k = jnp.sum(rights >= 0)
+        if W <= 2 * PACKED_STRIP:
+            return jax.lax.cond(k <= PACKED_STRIP, run(1), run(2), None)
+        return jax.lax.cond(
+            k <= PACKED_STRIP, run(1),
+            lambda _: jax.lax.cond(k <= 2 * PACKED_STRIP, run(2), run(3),
+                                   None), None)
+
+    # ------------------------------------------------------------------
     def _hist_kernel_q_otf(self, leaf_id, slots, L, quant):
         """Quantized on-the-fly dispatch: the packed-lane int8 kernel
         rebuilds the bin one-hot in VMEM (HBM stream = the (N, G) packed
@@ -586,6 +653,7 @@ class TreeGrower:
             rout=jnp.zeros(L, jnp.float32))
         W = self.frontier
         return GrowerState(
+            route_tab=jnp.zeros((L, self._route_cols), jnp.float32),
             pend_parents=jnp.full((W,), -1, jnp.int32),
             # the root is the first "new leaf" awaiting refresh
             pend_rights=jnp.full((W,), -1, jnp.int32).at[0].set(0),
@@ -625,6 +693,9 @@ class TreeGrower:
             # quantization (one scale per channel) happens once here
             quant = (quantize_gradients(grad, hess, counts)
                      if self.use_quant else None)
+            if quant is not None and self.use_fused:
+                # the fused kernel streams weights lane-major
+                quant = (quant[0].T, quant[1])          # (3, N)
 
             def body_fn(st):
                 return self._round(st, grad, hess, counts, feature_mask,
@@ -637,8 +708,14 @@ class TreeGrower:
             return body_fn(st)
 
         final = jax.lax.while_loop(cond, body, state)
+        leaf_id = final.leaf_id
+        if self.use_fused:
+            # the last round's selected splits were never routed (the
+            # loop exited before the next refresh) — apply them once
+            leaf_id = apply_route_table(self.bins, leaf_id,
+                                        final.route_tab)
         tree = final.tree._replace(num_leaves=final.num_leaves)
-        return tree, final.leaf_id
+        return tree, leaf_id
 
     # ------------------------------------------------------------------
     def _run_finders(self, hist, sum_grad, sum_hess, count, min_c, max_c,
@@ -677,8 +754,15 @@ class TreeGrower:
         cfg = self.cfg_scalars
         cache = st.hist_cache
 
-        right_hist = self._hist_kernel(grad, hess, counts, st.leaf_id,
-                                       slots=rights, quant=quant)
+        if self.use_fused:
+            # the pending route (last round's splits) is applied INSIDE
+            # the histogram kernel just before each row contributes
+            right_hist, new_leaf = self._hist_kernel_fused(
+                st, rights, grad, hess, counts, quant)
+            st = st._replace(leaf_id=new_leaf)
+        else:
+            right_hist = self._hist_kernel(grad, hess, counts, st.leaf_id,
+                                           slots=rights, quant=quant)
         right_hist = self.policy.constrain_hist(right_hist)
         safe_p = jnp.clip(parents, 0, L - 1)
         left_hist = cache[safe_p] - right_hist
@@ -871,17 +955,25 @@ class TreeGrower:
         else:
             leaf_forced = st.leaf_forced
 
-        # row re-labeling (per-leaf affine scalars; no (L, GB) table).
-        # A Pallas VMEM-one-hot router was benched on a v5e chip and
-        # lost to this XLA form (142 vs 96 ms/tree at 1M rows) — XLA
-        # fuses the routing elementwise ops into the one-hot dot, the
-        # hand kernel serialized them across 488 grid steps.
-        leaf_id = apply_splits(
-            self.bins, st.leaf_id, do_split, f_group_leaf,
-            self.f_gb_lo[best_f], self.f_gb_hi[best_f],
-            self.f_gb_shift[best_f], self.f_gb_oor[best_f],
-            f_is_cat_leaf, thr, dleft, f_missing_leaf, f_dbin_leaf,
-            f_nb_leaf, cat_mask, right_slot)
+        # row re-labeling.  Fused path: only BUILD the route table —
+        # the next round's histogram kernel applies it in its own data
+        # stream (the loop exit applies the last pending table in
+        # _train_tree_inner).  Non-fused (CPU sim / GSPMD meshes): the
+        # XLA router runs now.  A Pallas VMEM-one-hot standalone router
+        # was benched on a v5e chip and lost to the XLA form (142 vs
+        # 96 ms/tree at 1M rows), which is what motivated fusing the
+        # routing into the histogram kernel instead.
+        route_args = (do_split, f_group_leaf,
+                      self.f_gb_lo[best_f], self.f_gb_hi[best_f],
+                      self.f_gb_shift[best_f], self.f_gb_oor[best_f],
+                      f_is_cat_leaf, thr, dleft, f_missing_leaf,
+                      f_dbin_leaf, f_nb_leaf, cat_mask, right_slot)
+        if self.use_fused:
+            leaf_id = st.leaf_id
+            route_tab = build_route_table(*route_args)
+        else:
+            leaf_id = apply_splits(self.bins, st.leaf_id, *route_args)
+            route_tab = st.route_tab
 
         num_leaves = st.num_leaves + k
         round_idx = st.round_idx + 1
@@ -893,7 +985,7 @@ class TreeGrower:
             leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c,
             leaf_is_left=leaf_is_left, leaf_forced=leaf_forced, tree=tree,
             hist_cache=st.hist_cache, cand=st.cand,
-            forced_cand=st.forced_cand,
+            forced_cand=st.forced_cand, route_tab=route_tab,
             pend_parents=st.pend_parents, pend_rights=st.pend_rights)
 
     # ------------------------------------------------------------------

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from .partition import MISSING_NAN, MISSING_ZERO
+
 
 def _pick_chunk(n: int, num_groups: int, max_group_bin: int,
                 itemsize: int, target_bytes: int = 1 << 26) -> int:
@@ -605,39 +607,19 @@ def compute_group_histograms_q_packed(
     most strips*PACKED_STRIP valid entries; returns
     (strips*PACKED_STRIP, G, B, 3) following (padded) ``slots`` order."""
     num_groups = bins.shape[1]
-    strip = PACKED_STRIP
-    cap = strip * strips
-    nslots = slots.shape[0]
-    if nslots < cap:
-        slots = jnp.concatenate(
-            [slots, jnp.full(cap - nslots, -2, jnp.int32)])
-    else:
-        slots = slots[:cap]
-    slots = jnp.where(slots >= 0, slots, -2)
-    tiles = []
-    pad2 = jnp.full(128 - 3 * strip, -2, jnp.int32)
-    for s in range(strips):
-        one = slots[s * strip:(s + 1) * strip]
-        tiles += [one, one, one, pad2]
-    slot_row = jnp.concatenate(tiles)[None, :]          # (1, 128*strips)
+    cap = PACKED_STRIP * strips
+    slot_row = _pack_slot_tiles(slots, strips)[None, :]  # (1, 128*strips)
     int8_bins = max_group_bin <= 127
     kind = "i8" if int8_bins else "bf16_i32"
     emat, bcol = _expansion_consts(num_groups, max_group_bin, kind)
-    kern = functools.partial(_hist_kernel_body_q_packed, strip=strip,
+    kern = functools.partial(_hist_kernel_body_q_packed, strip=PACKED_STRIP,
                              strips=strips, int8_bins=int8_bins)
     out = _run_hist_kernel(
         kern, bins, wq, leaf_id, [emat, bcol, slot_row], block=block,
         m_leaf=128 * strips, m_pad=128 * strips, num_leaves=cap,
         max_group_bin=max_group_bin, out_dtype=jnp.int32,
         interpret=interpret, raw_out=True)
-    per_ch = []
-    for ch in range(3):
-        rows = [out[s * 128 + ch * strip: s * 128 + (ch + 1) * strip]
-                for s in range(strips)]
-        per_ch.append(jnp.concatenate(rows) if strips > 1 else rows[0])
-    hist = jnp.stack(per_ch)                             # (3, cap, G*B)
-    hist = hist.reshape(3, cap, num_groups, max_group_bin)
-    hist = jnp.transpose(hist, (1, 2, 3, 0))
+    hist = _unpack_strip_channels(out, strips, num_groups, max_group_bin)
     return hist.astype(jnp.float32) * scales[None, None, None, :]
 
 
@@ -719,6 +701,45 @@ def compute_group_histograms_pre_t(
 PACKED_STRIP = 42  # 3 channels x 42 slots fit one 128-lane tile
 
 
+def _pack_slot_tiles(slots: jax.Array, strips: int) -> jax.Array:
+    """(W,) frontier slots -> (128*strips,) channel-packed tile layout:
+    within tile s, the strip of slots [s*strip, (s+1)*strip) repeats
+    three times (one per weight channel) followed by -2 padding; -2
+    matches neither real leaves nor padded rows (-1)."""
+    strip = PACKED_STRIP
+    cap = strip * strips
+    nslots = slots.shape[0]
+    if nslots < cap:
+        slots = jnp.concatenate(
+            [slots, jnp.full(cap - nslots, -2, jnp.int32)])
+    else:
+        slots = slots[:cap]
+    slots = jnp.where(slots >= 0, slots, -2)
+    tiles = []
+    pad2 = jnp.full(128 - 3 * strip, -2, jnp.int32)
+    for s in range(strips):
+        one = slots[s * strip:(s + 1) * strip]
+        tiles += [one, one, one, pad2]
+    return jnp.concatenate(tiles)
+
+
+def _unpack_strip_channels(out: jax.Array, strips: int, num_groups: int,
+                           max_group_bin: int) -> jax.Array:
+    """(128*strips, G*B) packed kernel accumulator -> (cap, G, B, 3):
+    within tile s, lanes [c*strip, (c+1)*strip) hold channel c of slots
+    [s*strip, (s+1)*strip)."""
+    strip = PACKED_STRIP
+    cap = strip * strips
+    per_ch = []
+    for ch in range(3):
+        rows = [out[s * 128 + ch * strip: s * 128 + (ch + 1) * strip]
+                for s in range(strips)]
+        per_ch.append(jnp.concatenate(rows) if strips > 1 else rows[0])
+    hist = jnp.stack(per_ch)                             # (3, cap, G*B)
+    hist = hist.reshape(3, cap, num_groups, max_group_bin)
+    return jnp.transpose(hist, (1, 2, 3, 0))
+
+
 @functools.partial(
     jax.jit, static_argnames=("max_group_bin", "block", "strips", "quant",
                               "interpret"))
@@ -733,41 +754,184 @@ def compute_group_histograms_pre_packed(
     (padded) ``slots`` order."""
     gb = ohb.shape[1]
     num_groups = gb // max_group_bin
-    strip = PACKED_STRIP
-    cap = strip * strips
-    nslots = slots.shape[0]
-    if nslots < cap:
-        slots = jnp.concatenate(
-            [slots, jnp.full(cap - nslots, -2, jnp.int32)])
-    else:
-        slots = slots[:cap]
-    # -2 padding matches neither real leaves nor padded rows (-1)
-    slots = jnp.where(slots >= 0, slots, -2)
-    tiles = []
-    pad2 = jnp.full(128 - 3 * strip, -2, jnp.int32)
-    for s in range(strips):
-        one = slots[s * strip:(s + 1) * strip]
-        tiles += [one, one, one, pad2]
-    slot_row = jnp.concatenate(tiles)[None, :]          # (1, 128*strips)
-    kern = functools.partial(_hist_kernel_body_pre_packed, strip=strip,
-                             strips=strips, quant=quant)
+    slot_row = _pack_slot_tiles(slots, strips)[None, :]  # (1, 128*strips)
+    kern = functools.partial(_hist_kernel_body_pre_packed,
+                             strip=PACKED_STRIP, strips=strips,
+                             quant=quant)
     out = _run_hist_kernel_pre(
         kern, ohb, w, leaf_id, slot_row, block=block, m_pad=128 * strips,
         out_dtype=jnp.int32 if quant else jnp.float32,
         interpret=interpret)
-    # within tile s, lanes [c*strip, c*strip + strip) hold channel c of
-    # slots [s*strip, (s+1)*strip)
-    per_ch = []
-    for c in range(3):
-        rows = [out[s * 128 + c * strip: s * 128 + (c + 1) * strip]
-                for s in range(strips)]
-        per_ch.append(jnp.concatenate(rows) if strips > 1 else rows[0])
-    hist = jnp.stack(per_ch)                             # (3, cap, G*B)
-    hist = hist.reshape(3, cap, num_groups, max_group_bin)
-    hist = jnp.transpose(hist, (1, 2, 3, 0))
+    hist = _unpack_strip_channels(out, strips, num_groups, max_group_bin)
     if quant:
         hist = hist.astype(jnp.float32) * scales[None, None, None, :]
     return hist
+
+
+def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
+                       slots_ref, hist_ref, leaf_out_ref, *, strip,
+                       strips, quant, num_groups, nb):
+    """Route-then-histogram kernel: one row-block applies the PENDING
+    per-leaf route table (the splits selected last round) to its rows,
+    writes the new leaf ids, and accumulates the frontier histogram
+    from the streamed one-hot block — the separate XLA routing pass
+    (apply_route_table: a materialized (N, L) one-hot dot + an extra
+    (N, G) bins read, ~2 ms/round at 1M rows) disappears into the
+    histogram's own data stream.
+
+    Transposed orientation throughout: per-row scalars are (1, C) lane
+    vectors, one-hots are built (rows, C) by broadcasting an iota
+    COLUMN against a (1, C) row — no in-kernel transposes, and the
+    row-blocked inputs (leaf, weights, bins) arrive lane-major so XLA
+    never copies them into sublane-padded (N, 1) layouts.
+
+    Column layout of routeT_ref follows ops/partition.py
+    ROUTE_FIXED_COLS (fg hi/lo, thr, dleft, mtype, dbin, nbin, iscat,
+    rs hi/lo, active, fb lo/hi/shift/oor, cat bytes)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    c = ohb_ref.shape[0]
+    l_pad = routeT_ref.shape[1]
+    m_pad = 128 * strips
+
+    # --- routing prologue -------------------------------------------
+    leaf = leafT_ref[:]                                  # (1, C) int32
+    liota = jax.lax.broadcasted_iota(jnp.int32, (l_pad, c), 0)
+    ohl_route = (liota == leaf).astype(jnp.bfloat16)     # (Lpad, C)
+    scal = jax.lax.dot_general(                          # (K, C) f32
+        routeT_ref[:].astype(jnp.bfloat16), ohl_route,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    def irow(k):
+        return scal[k:k + 1, :].astype(jnp.int32)        # (1, C)
+
+    grp = irow(0) * 256 + irow(1)
+    thr = irow(2)
+    dleft = irow(3)
+    mtype = irow(4)
+    dbin = irow(5)
+    nbin = irow(6)
+    iscat = scal[7:8, :] > 0.5
+    rs = irow(8) * 256 + irow(9)
+    active = (scal[10:11, :] > 0.5) & (leaf >= 0)
+    lo, hi = irow(11), irow(12)
+    shift, oor = irow(13), irow(14)
+
+    giota = jax.lax.broadcasted_iota(jnp.int32, (num_groups, c), 0)
+    gsel = giota == grp                                  # (G, C)
+    gb = jnp.sum(jnp.where(gsel, binsT_ref[:].astype(jnp.int32), 0),
+                 axis=0, keepdims=True)                  # (1, C)
+    fbin = jnp.where((gb >= lo) & (gb < hi), gb - shift, oor)
+
+    is_nan_bin = fbin == nbin - 1
+    is_def_bin = fbin == dbin
+    cmp_left = (fbin <= thr).astype(jnp.int32)
+    num_left = jnp.where(
+        (mtype == MISSING_NAN) & is_nan_bin, dleft,
+        jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
+
+    byte_idx = fbin // 8
+    niota = jax.lax.broadcasted_iota(jnp.int32, (nb, c), 0)
+    bsel = niota == byte_idx
+    byte_val = jnp.sum(
+        jnp.where(bsel, scal[15:15 + nb, :], 0.0), axis=0,
+        keepdims=True).astype(jnp.int32)
+    cat_left = (byte_val >> (fbin % 8)) & 1
+
+    go_left = jnp.where(iscat, cat_left, num_left)
+    new_leaf = jnp.where(active, jnp.where(go_left > 0, leaf, rs), leaf)
+    leaf_out_ref[:] = new_leaf
+
+    # --- histogram (channel-packed lanes along ROWS) ----------------
+    slot_col = slots_ref[:]                              # (m_pad, 1)
+    ohl = slot_col == new_leaf                           # (m_pad, C)
+    riota = jax.lax.broadcasted_iota(jnp.int32, (m_pad, 1), 0) % 128
+    w = wT_ref[:]                                        # (3, C)
+    wl = jnp.where(riota < strip, w[0:1, :],
+                   jnp.where(riota < 2 * strip, w[1:2, :], w[2:3, :]))
+    if quant:
+        lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
+        hist_ref[:] += jax.lax.dot_general(
+            lhs, ohb_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    else:
+        lhs = jnp.where(ohl, wl,
+                        jnp.zeros((), jnp.float32)).astype(jnp.bfloat16)
+        hist_ref[:] += jax.lax.dot_general(
+            lhs, ohb_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_group_bin", "block", "strips", "quant",
+                              "interpret"))
+def compute_group_histograms_fused(
+        ohb: jax.Array, binsT: jax.Array, wT: jax.Array,
+        scales: Optional[jax.Array], leaf_id: jax.Array,
+        route_tab: jax.Array, slots: jax.Array, *, max_group_bin: int,
+        block: int = 2048, strips: int = 1, quant: bool = False,
+        interpret: bool = False):
+    """Fused route+histogram: returns ``(hist, new_leaf)`` where
+    ``hist`` is (strips*PACKED_STRIP, G, B, 3) following (padded)
+    ``slots`` order and ``new_leaf`` the (N,) post-route leaf ids.
+
+    Args:
+      ohb: (N, G*B) int8 streamed bin one-hot.
+      binsT: (G, N) uint8 TRANSPOSED packed bins (routing reads the
+        chosen group's bin per row as a lane vector).
+      wT: (3, N) weight channels — float32 (grad, hess, cnt) or int32
+        quantized (then ``scales`` dequantizes).
+      leaf_id: (N,) int32 pre-route leaf ids.
+      route_tab: (L, 15+ceil(B_f/8)) f32 route table from
+        ops/partition.py build_route_table; an all-zero table routes
+        nothing (active column = 0).
+      slots: (W,) int32 frontier slots, W <= strips*PACKED_STRIP.
+    """
+    n, gb_cols = ohb.shape
+    num_groups = gb_cols // max_group_bin
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    slot_col = _pack_slot_tiles(slots, strips)[:, None]  # (128*strips, 1)
+
+    L, K = route_tab.shape
+    l_pad = max(128, ((L + 127) // 128) * 128)
+    routeT = jnp.zeros((K, l_pad), jnp.float32).at[:, :L].set(route_tab.T)
+    m_pad = 128 * strips
+
+    kern = functools.partial(_fused_kernel_body, strip=PACKED_STRIP,
+                             strips=strips, quant=quant,
+                             num_groups=num_groups, nb=K - 15)
+    hist, leaf_out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, gb_cols), lambda i: (i, 0)),
+            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((3, block), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec(routeT.shape, lambda i: (0, 0)),
+            pl.BlockSpec(slot_col.shape, lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m_pad, gb_cols), lambda i: (0, 0)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, gb_cols),
+                                 jnp.int32 if quant else jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+        ],
+        interpret=interpret,
+    )(ohb, binsT, wT, leaf_id[None, :], routeT, slot_col)
+    out = _unpack_strip_channels(hist, strips, num_groups,
+                                 max_group_bin).astype(jnp.float32)
+    if quant:
+        out = out * scales[None, None, None, :]
+    return out, leaf_out[0]
 
 
 def expand_feature_histograms(group_hist: jax.Array, bin_map: jax.Array,

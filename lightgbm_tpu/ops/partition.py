@@ -54,6 +54,121 @@ def pack_mask_bytes(mask: jax.Array) -> jax.Array:
     return jnp.einsum("lnb,b->ln", bits, weights)
 
 
+# fixed route-table column layout (shared by the XLA router below and
+# the fused Pallas histogram kernel's routing prologue):
+#   0 fg_hi, 1 fg_lo, 2 threshold, 3 default_left, 4 missing_type,
+#   5 default_bin, 6 num_bin, 7 is_cat, 8 rs_hi, 9 rs_lo,
+#   10 active(split_mask), 11 fb_lo, 12 fb_hi, 13 fb_shift, 14 fb_oor,
+#   15.. cat bytes (ceil(B/8) packed little-endian)
+ROUTE_FIXED_COLS = 15
+
+
+def build_route_table(split_mask: jax.Array, feat_group: jax.Array,
+                      fb_lo: jax.Array, fb_hi: jax.Array,
+                      fb_shift: jax.Array, fb_oor: jax.Array,
+                      is_cat: jax.Array, threshold: jax.Array,
+                      default_left: jax.Array, missing_type: jax.Array,
+                      default_bin: jax.Array, num_bin: jax.Array,
+                      cat_mask: jax.Array,
+                      right_slot: jax.Array) -> jax.Array:
+    """(L, 15 + ceil(B/8)) f32 per-leaf routing table.
+
+    Every column is an integer < 256 — exact in bf16 (right_slot AND
+    feat_group are split hi/lo: feature groups are unbounded up to the
+    hi byte's own bf16 limit of 65536, asserted by apply_splits), so a
+    leaf one-hot can broadcast the table to rows on the fast bf16 MXU
+    path."""
+    def col(v):
+        return v.astype(jnp.float32)[:, None]
+
+    rs = right_slot.astype(jnp.int32)
+    fg = feat_group.astype(jnp.int32)
+    cat_bytes = pack_mask_bytes(cat_mask)            # (L, nb)
+    return jnp.concatenate([
+        col(fg // 256), col(fg % 256), col(threshold), col(default_left),
+        col(missing_type), col(default_bin), col(num_bin),
+        col(is_cat), col(rs // 256), col(rs % 256), col(split_mask),
+        col(fb_lo), col(fb_hi), col(fb_shift), col(fb_oor),
+        cat_bytes,
+    ], axis=1)
+
+
+def route_rows(rows, leaf_id, gb):
+    """Routing decision of the XLA router: ``rows`` is the per-row
+    broadcast of the route table ((N, 15+nb) f32), ``gb`` the per-row
+    bin of the chosen group.  Returns the updated leaf id.
+
+    NOTE: ops/histogram.py _fused_kernel_body carries a TRANSPOSED
+    duplicate of this logic (scalars live as (K, C) rows there; Mosaic
+    can't share this row-orientation code) — any semantic change here
+    MUST be mirrored there; tests/test_histogram_kernel.py's fused
+    parity test pins the two together."""
+    nb = rows.shape[-1] - ROUTE_FIXED_COLS
+
+    def icol(i):
+        return rows[..., i].astype(jnp.int32)
+
+    thr_row = icol(2)
+    dleft_row = rows[..., 3] > 0.5
+    mtype_row = icol(4)
+    dbin_row = icol(5)
+    nbin_row = icol(6)
+    iscat_row = rows[..., 7] > 0.5
+    rs_row = icol(8) * 256 + icol(9)
+    active = (rows[..., 10] > 0.5) & (leaf_id >= 0)
+    lo_row, hi_row = icol(11), icol(12)
+    shift_row, oor_row = icol(13), icol(14)
+
+    fbin = jnp.where((gb >= lo_row) & (gb < hi_row), gb - shift_row,
+                     oor_row)                        # feature-bin space
+
+    # numerical routing
+    is_nan_bin = fbin == nbin_row - 1
+    is_def_bin = fbin == dbin_row
+    cmp_left = fbin <= thr_row
+    num_left = jnp.where(
+        (mtype_row == MISSING_NAN) & is_nan_bin, dleft_row,
+        jnp.where((mtype_row == MISSING_ZERO) & is_def_bin, dleft_row,
+                  cmp_left))
+
+    # categorical routing: extract bit fbin of the packed byte columns
+    byte_idx = fbin[..., None] // 8
+    bsel = byte_idx == jnp.arange(nb, dtype=jnp.int32)
+    byte_val = jnp.sum(
+        jnp.where(bsel, rows[..., ROUTE_FIXED_COLS:], 0.0),
+        axis=-1).astype(jnp.int32)
+    cat_left = ((byte_val >> (fbin % 8)) & 1) == 1
+
+    go_left = jnp.where(iscat_row, cat_left, num_left)
+    new_id = jnp.where(go_left, leaf_id, rs_row)
+    return jnp.where(active, new_id, leaf_id).astype(jnp.int32)
+
+
+def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
+                      table: jax.Array) -> jax.Array:
+    """Re-label rows from a packed (L, 15+nb) route table (XLA form:
+    the one-hot broadcast dot materializes; the fused Pallas histogram
+    kernel runs the same table in VMEM)."""
+    n, num_groups = bins.shape
+    if num_groups >= 65536:  # fg // 256 must stay bf16-exact
+        raise ValueError("apply_splits supports at most 65535 feature "
+                         f"groups, got {num_groups}")
+    L = table.shape[0]
+    safe_l = jnp.clip(leaf_id, 0, L - 1)
+    ohl = (safe_l[:, None]
+           == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
+    rows = jnp.dot(ohl, table.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+
+    grp_row = (rows[:, 0].astype(jnp.int32) * 256
+               + rows[:, 1].astype(jnp.int32))
+    # chosen-group bin per row (masked sum instead of a gather; G small)
+    gsel = grp_row[:, None] == jnp.arange(num_groups,
+                                          dtype=jnp.int32)[None, :]
+    gb = jnp.sum(jnp.where(gsel, bins.astype(jnp.int32), 0), axis=1)
+    return route_rows(rows, leaf_id, gb)
+
+
 def apply_splits(bins: jax.Array, leaf_id: jax.Array,
                  split_mask: jax.Array, feat_group: jax.Array,
                  fb_lo: jax.Array, fb_hi: jax.Array, fb_shift: jax.Array,
@@ -79,76 +194,9 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
 
     Returns: updated (N,) leaf_id (left child keeps the parent slot).
     """
-    n, num_groups = bins.shape
-    if num_groups >= 65536:  # fg // 256 must stay bf16-exact
-        raise ValueError("apply_splits supports at most 65535 feature "
-                         f"groups, got {num_groups}")
-    L = split_mask.shape[0]
-
-    cat_bytes = pack_mask_bytes(cat_mask)            # (L, nb)
-    nb = cat_bytes.shape[1]
-
-    def col(v):
-        return v.astype(jnp.float32)[:, None]
-
-    # every column is an integer < 256 — exact in bf16 (right_slot AND
-    # feat_group are split hi/lo: feature groups are unbounded up to
-    # the hi byte's own bf16 limit of 65536 groups, asserted below), so
-    # the broadcast dot runs on the fast bf16 MXU path and the
-    # materialized one-hot is half the bytes of f32
-    rs = right_slot.astype(jnp.int32)
-    fg = feat_group.astype(jnp.int32)
-    table = jnp.concatenate([
-        col(fg // 256), col(fg % 256), col(threshold), col(default_left),
-        col(missing_type), col(default_bin), col(num_bin),
-        col(is_cat), col(rs // 256), col(rs % 256), col(split_mask),
-        col(fb_lo), col(fb_hi), col(fb_shift), col(fb_oor),
-        cat_bytes,
-    ], axis=1).astype(jnp.bfloat16)                  # (L, 15 + nb)
-    safe_l = jnp.clip(leaf_id, 0, L - 1)
-    ohl = (safe_l[:, None]
-           == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
-    rows = jnp.dot(ohl, table, preferred_element_type=jnp.float32)
-
-    def icol(i):
-        return rows[:, i].astype(jnp.int32)
-
-    grp_row = icol(0) * 256 + icol(1)
-    thr_row = icol(2)
-    dleft_row = rows[:, 3] > 0.5
-    mtype_row = icol(4)
-    dbin_row = icol(5)
-    nbin_row = icol(6)
-    iscat_row = rows[:, 7] > 0.5
-    rs_row = icol(8) * 256 + icol(9)
-    active = (rows[:, 10] > 0.5) & (leaf_id >= 0)
-    lo_row, hi_row = icol(11), icol(12)
-    shift_row, oor_row = icol(13), icol(14)
-
-    # chosen-group bin per row (masked sum instead of a gather; G small)
-    gsel = grp_row[:, None] == jnp.arange(num_groups,
-                                          dtype=jnp.int32)[None, :]
-    gb = jnp.sum(jnp.where(gsel, bins.astype(jnp.int32), 0), axis=1)
-    fbin = jnp.where((gb >= lo_row) & (gb < hi_row), gb - shift_row,
-                     oor_row)                        # feature-bin space
-
-    # numerical routing
-    is_nan_bin = fbin == nbin_row - 1
-    is_def_bin = fbin == dbin_row
-    cmp_left = fbin <= thr_row
-    num_left = jnp.where(
-        (mtype_row == MISSING_NAN) & is_nan_bin, dleft_row,
-        jnp.where((mtype_row == MISSING_ZERO) & is_def_bin, dleft_row,
-                  cmp_left))
-
-    # categorical routing: extract bit fbin of the packed byte columns
-    byte_idx = fbin // 8
-    bsel = byte_idx[:, None] == jnp.arange(nb, dtype=jnp.int32)[None, :]
-    byte_val = jnp.sum(jnp.where(bsel, rows[:, 15:15 + nb], 0.0),
-                       axis=1).astype(jnp.int32)
-    cat_left = ((byte_val >> (fbin % 8)) & 1) == 1
-
-    go_left = jnp.where(iscat_row, cat_left, num_left)
-    new_id = jnp.where(go_left, leaf_id, rs_row)
-    return jnp.where(active, new_id, leaf_id).astype(jnp.int32)
+    table = build_route_table(
+        split_mask, feat_group, fb_lo, fb_hi, fb_shift, fb_oor, is_cat,
+        threshold, default_left, missing_type, default_bin, num_bin,
+        cat_mask, right_slot)
+    return apply_route_table(bins, leaf_id, table)
 

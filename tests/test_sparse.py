@@ -1,0 +1,135 @@
+"""Sparse (scipy CSR/CSC) ingestion: no whole-matrix densify, parity
+with the dense path (reference sparse classes
+src/io/sparse_bin.hpp:68-456, c_api.h:147-216/574)."""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sp = pytest.importorskip("scipy.sparse")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.config import Config  # noqa: E402
+
+
+def _sparse_task(n=2000, f=40, density=0.05, seed=0):
+    rng = np.random.RandomState(seed)
+    X = sp.random(n, f, density=density, random_state=rng,
+                  data_rvs=lambda k: rng.randn(k) + 2.0).tocsr()
+    d = np.asarray(X.todense())
+    y = (d[:, 0] - d[:, 1] + 0.5 * d[:, 2] > 0.2).astype(float)
+    return X, d, y
+
+
+class _NoDensify(sp.csr_matrix):
+    """CSR wrapper that refuses whole-matrix densify."""
+
+    def toarray(self, *a, **k):
+        raise AssertionError("whole-matrix densify attempted")
+
+    todense = toarray
+
+
+def test_sparse_train_matches_dense():
+    X, d, y = _sparse_task()
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    b_sp = lgb.train(params, lgb.Dataset(X, label=y), 10,
+                     verbose_eval=False)
+    b_dn = lgb.train(params, lgb.Dataset(d, label=y), 10,
+                     verbose_eval=False)
+    # same mappers + same bins -> identical models
+    np.testing.assert_allclose(b_sp.predict(d), b_dn.predict(d),
+                               atol=1e-6)
+
+
+def test_sparse_never_densified_during_construct_and_train():
+    X, d, y = _sparse_task(1000, 25)
+    guarded = _NoDensify(X)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7}
+    bst = lgb.train(params, lgb.Dataset(guarded, label=y), 5,
+                    verbose_eval=False)
+    acc = ((bst.predict(d) > 0.5) == y).mean()
+    assert acc > 0.7
+
+
+def test_sparse_predict_matches_dense_predict():
+    X, d, y = _sparse_task()
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 8,
+                    verbose_eval=False)
+    np.testing.assert_allclose(bst.predict(X), bst.predict(d), atol=0)
+    # leaf/contrib modes chunk identically
+    np.testing.assert_array_equal(bst.predict(X, pred_leaf=True),
+                                  bst.predict(d, pred_leaf=True))
+
+
+def test_sparse_onehot_columns_bundle():
+    rng = np.random.RandomState(3)
+    z = rng.randint(0, 12, 1500)
+    onehot = sp.csr_matrix(
+        (np.ones(1500), (np.arange(1500), z)), shape=(1500, 12))
+    dense_cols = sp.csr_matrix(rng.randn(1500, 2))
+    X = sp.hstack([onehot, dense_cols]).tocsr()
+    y = np.isin(z, [2, 5]).astype(float)
+    cfg = Config.from_params({"objective": "binary", "verbose": -1})
+    core = lgb.Dataset(X, label=y).construct(cfg)
+    assert core.num_groups < core.num_features
+
+
+def test_capi_csr_roundtrip():
+    from lightgbm_tpu import capi
+    X, d, y = _sparse_task(800, 20)
+    out = [None]
+    rc = capi.LGBM_DatasetCreateFromCSR(
+        X.indptr, X.indices, X.data, X.shape[1],
+        "objective=binary verbose=-1 num_leaves=7", out=out)
+    assert rc == 0
+    ds = out[0]
+    capi.LGBM_DatasetSetField(ds, "label", y)
+    bh = [None]
+    assert capi.LGBM_BoosterCreate(
+        ds, "objective=binary verbose=-1 num_leaves=7", out=bh) == 0
+    for _ in range(5):
+        capi.LGBM_BoosterUpdateOneIter(bh[0], [None])
+    pred = [None]
+    assert capi.LGBM_BoosterPredictForCSR(
+        bh[0], X.indptr, X.indices, X.data, X.shape[1], out=pred) == 0
+    assert pred[0].shape[0] == X.shape[0]
+    dense_pred = [None]
+    capi.LGBM_BoosterPredictForMat(bh[0], d, out=dense_pred)
+    np.testing.assert_allclose(pred[0], dense_pred[0], atol=0)
+
+
+def test_large_sparse_construct_bounded_rss():
+    """100k x 10k, 99.9%-sparse construct stays under 2 GB peak RSS —
+    run in a subprocess so the parent's allocations don't pollute
+    ru_maxrss (VERDICT: the dense float64 equivalent alone is 8 GB)."""
+    code = r"""
+import resource, sys
+import numpy as np
+from scipy import sparse as sp
+rng = np.random.RandomState(0)
+n, f = 100_000, 10_000
+nnz = 1_000_000
+rows = rng.randint(0, n, nnz).astype(np.int32)
+cols = rng.randint(0, f, nnz).astype(np.int32)
+vals = rng.randn(nnz)
+X = sp.csr_matrix((vals, (rows, cols)), shape=(n, f))
+y = (np.asarray(X[:, 0].todense()).ravel() + rng.randn(n) > 0)
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+cfg = Config.from_params({"objective": "binary", "verbose": -1,
+                          "max_bin": 15})
+core = lgb.Dataset(X, label=y.astype(float)).construct(cfg)
+assert core.group_bins.shape[0] == n
+peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+print("peak_mb", peak_mb)
+assert peak_mb < 2048, peak_mb
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr

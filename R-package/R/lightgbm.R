@@ -1,0 +1,69 @@
+# R wrappers over the TPU framework's C ABI (mirrors the reference
+# R-package surface: lgb.Dataset / lgb.train / predict / lgb.save /
+# lgb.load — reference R-package/R/*.R over src/lightgbm_R.cpp).
+#
+# Load order: dyn.load("lightgbm_R.so") (built with R CMD SHLIB, see
+# ../README.md), which itself links liblgbm_tpu.so.
+
+.params_str <- function(params) {
+  if (length(params) == 0L) return("")
+  paste(sprintf("%s=%s", names(params),
+                vapply(params, function(v) paste(v, collapse = ","),
+                       character(1L))),
+        collapse = " ")
+}
+
+lgb.Dataset <- function(data, label = NULL, params = list()) {
+  pstr <- .params_str(params)
+  if (is.character(data)) {
+    h <- .Call("LGBM_R_DatasetCreateFromFile", data, pstr)
+  } else {
+    storage.mode(data) <- "double"
+    h <- .Call("LGBM_R_DatasetCreateFromMat", data, nrow(data),
+               ncol(data), pstr)
+  }
+  if (!is.null(label)) {
+    .Call("LGBM_R_DatasetSetField", h, "label", as.double(label))
+  }
+  structure(list(handle = h), class = "lgb.Dataset")
+}
+
+lgb.train <- function(params, data, nrounds = 100L) {
+  stopifnot(inherits(data, "lgb.Dataset"))
+  h <- .Call("LGBM_R_BoosterCreate", data$handle, .params_str(params))
+  for (i in seq_len(nrounds)) {
+    finished <- .Call("LGBM_R_BoosterUpdateOneIter", h)
+    if (finished != 0L) break
+  }
+  structure(list(handle = h), class = "lgb.Booster")
+}
+
+predict.lgb.Booster <- function(object, data, rawscore = FALSE,
+                                num_iteration = -1L, ...) {
+  storage.mode(data) <- "double"
+  .Call("LGBM_R_BoosterPredictForMat", object$handle, data,
+        nrow(data), ncol(data), if (rawscore) 1L else 0L,
+        as.integer(num_iteration))
+}
+
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  .Call("LGBM_R_BoosterSaveModel", booster$handle,
+        as.integer(num_iteration), filename)
+  invisible(booster)
+}
+
+lgb.load <- function(filename) {
+  h <- .Call("LGBM_R_BoosterCreateFromModelfile", filename)
+  structure(list(handle = h), class = "lgb.Booster")
+}
+
+lgb.Dataset.free <- function(dataset) {
+  .Call("LGBM_R_DatasetFree", dataset$handle)
+  invisible(NULL)
+}
+
+lgb.Booster.free <- function(booster) {
+  .Call("LGBM_R_BoosterFree", booster$handle)
+  invisible(NULL)
+}

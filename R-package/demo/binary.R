@@ -1,0 +1,24 @@
+# Smoke: train + predict the reference binary example through the
+# C ABI.  Run from the repo root after building the shim (README):
+#   Rscript R-package/demo/binary.R
+source("R-package/R/lightgbm.R")
+dyn.load("R-package/src/lightgbm_R.so")
+
+raw <- as.matrix(read.table("/root/reference/examples/binary_classification/binary.train"))
+y <- raw[, 1]
+X <- raw[, -1]
+
+ds <- lgb.Dataset(X, label = y)
+bst <- lgb.train(list(objective = "binary", num_leaves = 31,
+                      learning_rate = 0.1, verbose = -1), ds,
+                 nrounds = 20L)
+p <- predict(bst, X)
+acc <- mean((p > 0.5) == (y > 0.5))
+cat(sprintf("train accuracy: %.4f\n", acc))
+stopifnot(acc > 0.9)
+
+lgb.save(bst, "/tmp/r_model.txt")
+bst2 <- lgb.load("/tmp/r_model.txt")
+p2 <- predict(bst2, X)
+stopifnot(max(abs(p - p2)) < 1e-10)
+cat("save/load roundtrip ok\n")

@@ -1,0 +1,155 @@
+/* .Call shims bridging R to the TPU framework's C ABI
+ * (liblgbm_tpu.so, the embedded-CPython LGBM_* surface —
+ * lightgbm_tpu/native/src/capi/c_api_embed.cpp).
+ *
+ * Mirrors the surface of the reference's R-package/src/lightgbm_R.cpp
+ * (628 LoC): Dataset create/field/free, Booster create/train/predict/
+ * save/load.  Handles are EXTPTRSXP; errors raise R conditions via
+ * LGBM_GetLastError.
+ *
+ * Build (needs R): R CMD SHLIB lightgbm_R.cpp -L<repo>/lightgbm_tpu/native \
+ *                  -llgbm_tpu -Wl,-rpath,<repo>/lightgbm_tpu/native
+ */
+#include <R.h>
+#include <Rinternals.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+extern "C" {
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+const char* LGBM_GetLastError(void);
+int LGBM_DatasetCreateFromFile(const char*, const char*, DatasetHandle,
+                               DatasetHandle*);
+int LGBM_DatasetCreateFromMat(const void*, int, int32_t, int32_t, int,
+                              const char*, DatasetHandle, DatasetHandle*);
+int LGBM_DatasetSetField(DatasetHandle, const char*, const void*, int, int);
+int LGBM_DatasetFree(DatasetHandle);
+int LGBM_BoosterCreate(DatasetHandle, const char*, BoosterHandle*);
+int LGBM_BoosterCreateFromModelfile(const char*, int*, BoosterHandle*);
+int LGBM_BoosterUpdateOneIter(BoosterHandle, int*);
+int LGBM_BoosterGetNumClasses(BoosterHandle, int*);
+int LGBM_BoosterSaveModel(BoosterHandle, int, const char*);
+int LGBM_BoosterPredictForMat(BoosterHandle, const void*, int, int32_t,
+                              int32_t, int, int, int, const char*,
+                              int64_t*, double*);
+int LGBM_BoosterFree(BoosterHandle);
+}
+
+#define C_API_DTYPE_FLOAT64 1
+#define CHECK_CALL(x) \
+  if ((x) != 0) Rf_error("lightgbm_tpu: %s", LGBM_GetLastError());
+
+static void* get_handle(SEXP h) {
+  void* p = R_ExternalPtrAddr(h);
+  if (p == nullptr) Rf_error("lightgbm_tpu: handle is null (freed?)");
+  return p;
+}
+
+extern "C" {
+
+SEXP LGBM_R_DatasetCreateFromMat(SEXP mat, SEXP nrow, SEXP ncol,
+                                 SEXP parameters) {
+  DatasetHandle h = nullptr;
+  CHECK_CALL(LGBM_DatasetCreateFromMat(
+      REAL(mat), C_API_DTYPE_FLOAT64, (int32_t)Rf_asInteger(nrow),
+      (int32_t)Rf_asInteger(ncol), 0 /* column-major (R layout) */,
+      CHAR(Rf_asChar(parameters)), nullptr, &h));
+  SEXP out = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_DatasetCreateFromFile(SEXP filename, SEXP parameters) {
+  DatasetHandle h = nullptr;
+  CHECK_CALL(LGBM_DatasetCreateFromFile(CHAR(Rf_asChar(filename)),
+                                        CHAR(Rf_asChar(parameters)),
+                                        nullptr, &h));
+  SEXP out = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_DatasetSetField(SEXP handle, SEXP name, SEXP data) {
+  const char* nm = CHAR(Rf_asChar(name));
+  int n = Rf_length(data);
+  // labels/weights arrive as R doubles; the ABI takes float32
+  std::string buf(sizeof(float) * (size_t)n, '\0');
+  float* f = reinterpret_cast<float*>(&buf[0]);
+  for (int i = 0; i < n; ++i) f[i] = (float)REAL(data)[i];
+  CHECK_CALL(LGBM_DatasetSetField(get_handle(handle), nm, f, n,
+                                  0 /* float32 */));
+  return R_NilValue;
+}
+
+SEXP LGBM_R_DatasetFree(SEXP handle) {
+  if (R_ExternalPtrAddr(handle) != nullptr) {
+    CHECK_CALL(LGBM_DatasetFree(get_handle(handle)));
+    R_ClearExternalPtr(handle);
+  }
+  return R_NilValue;
+}
+
+SEXP LGBM_R_BoosterCreate(SEXP train_data, SEXP parameters) {
+  BoosterHandle h = nullptr;
+  CHECK_CALL(LGBM_BoosterCreate(get_handle(train_data),
+                                CHAR(Rf_asChar(parameters)), &h));
+  SEXP out = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_BoosterCreateFromModelfile(SEXP filename) {
+  BoosterHandle h = nullptr;
+  int iters = 0;
+  CHECK_CALL(LGBM_BoosterCreateFromModelfile(CHAR(Rf_asChar(filename)),
+                                             &iters, &h));
+  SEXP out = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_BoosterUpdateOneIter(SEXP handle) {
+  int finished = 0;
+  CHECK_CALL(LGBM_BoosterUpdateOneIter(get_handle(handle), &finished));
+  return Rf_ScalarInteger(finished);
+}
+
+SEXP LGBM_R_BoosterSaveModel(SEXP handle, SEXP num_iteration,
+                             SEXP filename) {
+  CHECK_CALL(LGBM_BoosterSaveModel(get_handle(handle),
+                                   Rf_asInteger(num_iteration),
+                                   CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+SEXP LGBM_R_BoosterPredictForMat(SEXP handle, SEXP mat, SEXP nrow,
+                                 SEXP ncol, SEXP predict_type,
+                                 SEXP num_iteration) {
+  int32_t nr = (int32_t)Rf_asInteger(nrow);
+  int32_t nc = (int32_t)Rf_asInteger(ncol);
+  // multiclass predictions return nrow * num_class values
+  int num_class = 1;
+  CHECK_CALL(LGBM_BoosterGetNumClasses(get_handle(handle), &num_class));
+  if (num_class < 1) num_class = 1;
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (long)nr * num_class));
+  int64_t out_len = 0;
+  CHECK_CALL(LGBM_BoosterPredictForMat(
+      get_handle(handle), REAL(mat), C_API_DTYPE_FLOAT64, nr, nc,
+      0 /* column-major */, Rf_asInteger(predict_type),
+      Rf_asInteger(num_iteration), "", &out_len, REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_BoosterFree(SEXP handle) {
+  if (R_ExternalPtrAddr(handle) != nullptr) {
+    CHECK_CALL(LGBM_BoosterFree(get_handle(handle)));
+    R_ClearExternalPtr(handle);
+  }
+  return R_NilValue;
+}
+
+}  // extern "C"

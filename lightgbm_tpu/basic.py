@@ -47,6 +47,30 @@ class Dataset:
             config = Config.from_params(self.params)
         data = self.data
         label = self.label
+        streaming_ok = (isinstance(data, str)
+                        and config.use_two_round_loading
+                        and self.reference is None
+                        and not isinstance(self.categorical_feature,
+                                           (list, tuple)))
+        if (isinstance(data, str) and config.use_two_round_loading
+                and not streaming_ok):
+            Log.warning("two_round loading does not support reference-"
+                        "aligned or explicitly-categorical datasets yet; "
+                        "falling back to in-RAM loading")
+        if streaming_ok:
+            # two-round streaming: the float matrix never exists
+            from .data_loader import load_file_streaming
+            self._core = load_file_streaming(data, config)
+            if self.label is not None:
+                self._core.metadata.set_label(self.label)
+            if self.weight is not None:
+                self._core.metadata.set_weight(self.weight)
+            if self.group is not None:
+                self._core.metadata.set_group(self.group)
+            if self.init_score is not None:
+                self._core.metadata.set_init_score(self.init_score)
+            self._core.pandas_categorical = None
+            return self._core
         if isinstance(data, str):
             from .data_loader import load_file
             data, label_from_file, extras = load_file(data, config)

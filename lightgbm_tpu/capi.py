@@ -130,6 +130,76 @@ def LGBM_DatasetCreateFromCSC(col_ptr, indices, data, num_row: int,
 
 
 @_api
+def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices,
+                                        num_col: int, num_per_col,
+                                        num_sample_row: int,
+                                        num_total_row: int,
+                                        parameters: str, out=None) -> int:
+    """reference c_api.h:68-97: fit mappers from per-column samples and
+    await PushRows chunks.  ``sample_data``/``sample_indices`` are
+    per-column lists (values, row indices within the sample)."""
+    from .dataset import Dataset as CoreDataset
+    from .config import Config
+    params = _parse_params(parameters)
+    cfg = Config.from_params(params)
+    vals = [np.asarray(sample_data[j], dtype=np.float64)[:num_per_col[j]]
+            for j in range(num_col)]
+    rows = [np.asarray(sample_indices[j], dtype=np.int64)[:num_per_col[j]]
+            for j in range(num_col)]
+    core = CoreDataset.from_sampled_columns(
+        vals, rows, int(num_sample_row), int(num_total_row), config=cfg)
+    out[0] = _register(_PushableDataset(core))
+    return 0
+
+
+class _PushableDataset:
+    """Wrapper so Booster creation accepts a pushed core dataset (the
+    lazy-Dataset protocol expects .construct()/set_field)."""
+
+    def __init__(self, core):
+        self._core = core
+
+    def construct(self, config=None):
+        return self._core
+
+    def set_field(self, name, data):
+        self._core.metadata.set_field(name, data)
+        return self
+
+    def get_field(self, name):
+        return self._core.metadata.get_field(name)
+
+    def num_data(self):
+        return self._core.num_data
+
+    def num_feature(self):
+        return self._core.num_total_features
+
+
+@_api
+def LGBM_DatasetPushRows(handle, data, num_row: int, num_col: int,
+                         start_row: int) -> int:
+    """reference c_api.h:100-120."""
+    ds = _get(handle)
+    chunk = np.asarray(data, dtype=np.float64).reshape(num_row, num_col)
+    ds._core.push_rows(chunk, int(start_row))
+    if ds._core._pushed_rows >= ds._core.num_data:
+        ds._core.finish_load()
+    return 0
+
+
+@_api
+def LGBM_DatasetPushRowsByCSR(handle, indptr, indices, data,
+                              num_col: int, start_row: int) -> int:
+    """reference c_api.h:122-145."""
+    ds = _get(handle)
+    ds._core.push_rows_csr(indptr, indices, data, int(start_row))
+    if ds._core._pushed_rows >= ds._core.num_data:
+        ds._core.finish_load()
+    return 0
+
+
+@_api
 def LGBM_DatasetCreateFromFile(filename: str, parameters: str,
                                reference=None, out=None) -> int:
     """reference c_api.h:53-66."""

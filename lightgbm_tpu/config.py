@@ -250,6 +250,8 @@ class Config:
     categorical_column: str = ""
     is_pre_partition: bool = False
     use_two_round_loading: bool = False
+    streaming_chunk_rows: int = 65536  # rows per two-round/PushRows
+    # text chunk (bounds peak float-row memory during streaming load)
     is_save_binary_file: bool = False
     is_enable_sparse: bool = True
     enable_bundle: bool = True    # EFB
@@ -258,7 +260,6 @@ class Config:
     min_data_in_group: int = 100
     use_missing: bool = True
     zero_as_missing: bool = False
-    two_round: bool = False
     num_iteration_predict: int = -1
     is_predict_raw_score: bool = False
     is_predict_leaf_index: bool = False
@@ -289,6 +290,12 @@ class Config:
     quantized_grad: bool = False    # int8-MXU quantized histogram
     # construction (one grad/hess scale per tree; the TPU analog of
     # LightGBM v4 quantized training, arXiv 2207.09682) — TPU path only
+    histogram_pool_size: float = -1.0  # MB bound on the per-leaf
+    # histogram cache (reference config.h:216 + the LRU HistogramPool,
+    # feature_histogram.hpp:653-823).  -1 = unbounded.  When the
+    # (num_leaves, G, B, 3) f32 cache exceeds the bound, the grower
+    # drops histogram subtraction and computes BOTH children of every
+    # split directly from the data (2x histogram passes, no cache).
     hist_onehot_budget_mb: int = 4096  # HBM budget for the streamed
     # (N, G*B) int8 bin one-hot; datasets over budget rebuild the
     # one-hot in-kernel per round instead

@@ -46,6 +46,66 @@ def detect_format(sample_lines: List[str]) -> str:
     return fmt
 
 
+def qid_to_group_sizes(qid: np.ndarray) -> np.ndarray:
+    """Per-row query ids -> per-query sizes in APPEARANCE order (rows
+    of one query must be contiguous, the reference contract;
+    np.unique's sorted order would misassign boundaries for descending
+    qids)."""
+    qid = np.asarray(qid)
+    if len(qid) == 0:
+        return np.zeros(0, dtype=np.int64)
+    change = np.flatnonzero(qid[1:] != qid[:-1])
+    bounds = np.concatenate([[0], change + 1, [len(qid)]])
+    sizes = np.diff(bounds)
+    starts = qid[bounds[:-1]]
+    if len(np.unique(starts)) != len(starts):
+        Log.fatal("query/group column is not contiguous: the same qid "
+                  "appears in non-adjacent row blocks")
+    return sizes
+
+
+def _resolve_file_columns(config: Config, names: Optional[List[str]],
+                          ncol: int):
+    """Shared label/weight/group/ignore column-role resolution
+    (reference dataset_loader.cpp:23-158)."""
+    label_col = _resolve_single(config.label_column, names, default=0)
+    weight_cols = _parse_column_spec(config.weight_column, names)
+    group_cols = _parse_column_spec(config.group_column, names)
+    ignore_cols = set(_parse_column_spec(config.ignore_column, names))
+    used = [i for i in range(ncol)
+            if i != label_col and i not in weight_cols
+            and i not in group_cols and i not in ignore_cols]
+    return label_col, weight_cols, group_cols, used
+
+
+def _load_side_files(path: str, extras: Dict) -> Dict:
+    """Side files <data>.weight / .query / .init
+    (reference metadata.cpp:23-26); existing keys win."""
+    wf = path + ".weight"
+    if os.path.exists(wf) and extras.get("weight") is None:
+        extras["weight"] = np.loadtxt(wf, dtype=np.float32).reshape(-1)
+    qf = path + ".query"
+    if os.path.exists(qf) and extras.get("group") is None:
+        extras["group"] = np.loadtxt(qf, dtype=np.int64).reshape(-1)
+    inf = path + ".init"
+    if os.path.exists(inf):
+        extras["init_score"] = np.loadtxt(inf,
+                                          dtype=np.float64).reshape(-1)
+    return extras
+
+
+def split_sample_columns(sample: np.ndarray):
+    """Per-column non-zero/NaN values + their row indices — the shared
+    sampling contract (zeros implicit; reference bin.cpp:207)."""
+    vals, rows = [], []
+    for j in range(sample.shape[1]):
+        col = sample[:, j]
+        keep = np.isnan(col) | (np.abs(col) > 1e-35)
+        vals.append(col[keep])
+        rows.append(np.nonzero(keep)[0].astype(np.int64))
+    return vals, rows
+
+
 def _parse_column_spec(spec: str, names: Optional[List[str]]) -> List[int]:
     """Resolve 'name:' or index column specs (reference
     dataset_loader.cpp:23-158)."""
@@ -97,15 +157,8 @@ def load_file(path: str, config: Config
                              skiprows=1 if has_header else 0,
                              ndmin=2, dtype=np.float64,
                              converters=None, encoding=None)
-        label_col = _resolve_single(config.label_column, names, default=0)
-        weight_cols = _parse_column_spec(config.weight_column, names)
-        group_cols = _parse_column_spec(config.group_column, names)
-        ignore_cols = set(_parse_column_spec(config.ignore_column, names))
-
-        ncol = raw.shape[1]
-        used = [i for i in range(ncol)
-                if i != label_col and i not in weight_cols
-                and i not in group_cols and i not in ignore_cols]
+        label_col, weight_cols, group_cols, used = _resolve_file_columns(
+            config, names, raw.shape[1])
         X = raw[:, used]
         label = raw[:, label_col] if label_col is not None else None
         extras: Dict = {}
@@ -114,23 +167,131 @@ def load_file(path: str, config: Config
         if group_cols:
             # group column holds per-row query ids -> convert to sizes
             qid = raw[:, group_cols[0]].astype(np.int64)
-            _, counts = np.unique(qid, return_counts=True)
-            extras["group"] = counts
+            extras["group"] = qid_to_group_sizes(qid)
     else:
         X, label = _load_libsvm(path)
         extras = {}
 
-    # side files (reference metadata.cpp:23-26)
-    wf = path + ".weight"
-    if os.path.exists(wf) and "weight" not in extras:
-        extras["weight"] = np.loadtxt(wf, dtype=np.float32).reshape(-1)
-    qf = path + ".query"
-    if os.path.exists(qf) and "group" not in extras:
-        extras["group"] = np.loadtxt(qf, dtype=np.int64).reshape(-1)
-    inf = path + ".init"
-    if os.path.exists(inf):
-        extras["init_score"] = np.loadtxt(inf, dtype=np.float64).reshape(-1)
-    return X, label, extras
+    return X, label, _load_side_files(path, extras)
+
+
+def load_file_streaming(path: str, config: Config):
+    """Two-round streaming construction: the float matrix never exists
+    (reference two_round_loading, src/io/dataset_loader.cpp:180-265).
+
+    Round 1 reservoir-samples up to ``bin_construct_sample_cnt`` parsed
+    rows while counting lines; bin mappers and EFB bundles are fitted
+    from the samples.  Round 2 re-reads the file in chunks, pushing
+    binned rows straight into the packed (N, G) uint8 matrix.  Peak
+    host memory = samples + one chunk + the uint8 matrix.
+
+    Returns a constructed CoreDataset (metadata from label/weight/group
+    columns and side files already applied).
+    """
+    from .dataset import Dataset as CoreDataset
+
+    with open(path) as f:
+        first_lines = [f.readline() for _ in range(20)]
+    has_header = config.has_header
+    header_line = first_lines[0] if has_header else None
+    data_sample = first_lines[1:] if has_header else first_lines
+    fmt = detect_format([ln for ln in data_sample if ln])
+    if fmt == "libsvm":
+        # libsvm files are sparse — route through the sparse in-RAM
+        # path (bounded by nnz) rather than two-round
+        X, label, extras = load_file(path, config)
+        ds = CoreDataset.from_matrix(X, label=label,
+                                     weight=extras.get("weight"),
+                                     group=extras.get("group"),
+                                     init_score=extras.get("init_score"),
+                                     config=config)
+        return ds
+    sep = "\t" if fmt == "tsv" else ","
+    names = None
+    if header_line is not None:
+        names = [c.strip() for c in header_line.strip().split(sep)]
+
+    def parse_lines(lines):
+        return np.loadtxt(lines, delimiter=sep, ndmin=2, dtype=np.float64)
+
+    # ---- round 1: count + reservoir sample ----
+    sample_cnt = config.bin_construct_sample_cnt
+    rng = np.random.RandomState(config.data_random_seed)
+    reservoir: List[str] = []
+    n_rows = 0
+    with open(path) as f:
+        if has_header:
+            f.readline()
+        for line in f:
+            if not line.strip():
+                continue
+            if n_rows < sample_cnt:
+                reservoir.append(line)
+            else:
+                j = rng.randint(0, n_rows + 1)
+                if j < sample_cnt:
+                    reservoir[j] = line
+            n_rows += 1
+    sample_raw = parse_lines(reservoir)
+    label_col, weight_cols, group_cols, used = _resolve_file_columns(
+        config, names, sample_raw.shape[1])
+    sample_X = sample_raw[:, used]
+    sample_vals, sample_rows = split_sample_columns(sample_X)
+
+    ds = CoreDataset.from_sampled_columns(
+        sample_vals, sample_rows, sample_X.shape[0], n_rows,
+        config=config,
+        feature_names=[names[i] for i in used] if names else None)
+
+    # ---- round 2: stream chunks into the bin matrix ----
+    chunk_rows = max(1, int(config.streaming_chunk_rows))
+    label = np.zeros(n_rows, dtype=np.float64)
+    weight = np.zeros(n_rows, dtype=np.float32) if weight_cols else None
+    qid = np.zeros(n_rows, dtype=np.int64) if group_cols else None
+    row = 0
+    with open(path) as f:
+        if has_header:
+            f.readline()
+        buf: List[str] = []
+        for line in f:
+            if not line.strip():
+                continue
+            buf.append(line)
+            if len(buf) >= chunk_rows:
+                row = _push_text_chunk(ds, parse_lines(buf), used,
+                                       label_col, weight_cols, group_cols,
+                                       label, weight, qid, row)
+                buf = []
+        if buf:
+            row = _push_text_chunk(ds, parse_lines(buf), used, label_col,
+                                   weight_cols, group_cols, label, weight,
+                                   qid, row)
+    ds.finish_load()
+    ds.metadata.set_label(label)
+    extras = _load_side_files(path, {
+        "weight": weight,
+        "group": qid_to_group_sizes(qid) if qid is not None else None,
+    })
+    if extras.get("weight") is not None:
+        ds.metadata.set_weight(extras["weight"])
+    if extras.get("group") is not None:
+        ds.metadata.set_group(extras["group"])
+    if extras.get("init_score") is not None:
+        ds.metadata.set_init_score(extras["init_score"])
+    return ds
+
+
+def _push_text_chunk(ds, raw, used, label_col, weight_cols, group_cols,
+                     label, weight, qid, row):
+    n = raw.shape[0]
+    ds.push_rows(raw[:, used], row)
+    if label_col is not None:
+        label[row:row + n] = raw[:, label_col]
+    if weight_cols:
+        weight[row:row + n] = raw[:, weight_cols[0]]
+    if group_cols:
+        qid[row:row + n] = raw[:, group_cols[0]].astype(np.int64)
+    return row + n
 
 
 def _resolve_single(spec: str, names: Optional[List[str]],

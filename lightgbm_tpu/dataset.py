@@ -251,6 +251,117 @@ class Dataset:
         return self
 
     # ------------------------------------------------------------------
+    @classmethod
+    def from_sampled_columns(cls, sample_vals: List[np.ndarray],
+                             sample_rows: List[np.ndarray],
+                             total_sample: int, num_data: int,
+                             config: Optional[Config] = None,
+                             categorical_features=None,
+                             feature_names=None) -> "Dataset":
+        """Streaming construction, step 1: fit bin mappers from sampled
+        per-column values, allocate the packed (N, G) uint8 matrix, and
+        return a dataset awaiting ``push_rows`` chunks + ``finish_load``
+        — the two-round / LGBM_DatasetCreateFromSampledColumn +
+        PushRows protocol (reference c_api.h:68-145,
+        dataset_loader.cpp:180-265).  The float matrix never exists:
+        peak host memory is samples + one chunk + the uint8 matrix.
+
+        Args:
+          sample_vals: per-feature sampled non-zero (or NaN) values.
+          sample_rows: per-feature row indices of those values within
+            the sample (feeds EFB conflict counting).
+          total_sample: number of sampled rows (zeros implicit).
+          num_data: full row count being pushed.
+        """
+        from .binning import find_bin_mappers
+        config = config or Config()
+        self = cls()
+        self.config = config
+        self.num_data = num_data
+        self.num_total_features = len(sample_vals)
+        self.max_bin = config.max_bin
+        self.feature_names = list(feature_names) if feature_names else [
+            f"Column_{i}" for i in range(len(sample_vals))]
+        cat_set = set(categorical_features or [])
+        self.mappers = find_bin_mappers(
+            sample_vals, total_sample, config.max_bin,
+            config.min_data_in_bin, config.min_data_in_leaf, cat_set,
+            config.use_missing, config.zero_as_missing)
+        self.used_features = [i for i, m in enumerate(self.mappers)
+                              if not m.is_trivial]
+        self._build_groups(reference=None, sample_nonzero=sample_rows,
+                           sample_cnt=total_sample)
+        self.group_bins = np.zeros((num_data, self.num_groups),
+                                   dtype=np.uint8)
+        # prefill implicit-zero bins so sparse (CSR) pushes only write
+        # stored entries; dense pushes overwrite every cell anyway
+        for f in self.features:
+            if not f.collapsed_default:
+                zb = int(np.asarray(
+                    self.mappers[f.feature_idx].value_to_bin(
+                        np.zeros(1)))[0])
+                if zb != 0:
+                    self.group_bins[:, f.group] = zb
+        self.metadata = Metadata(num_data)
+        self._categorical_features = list(categorical_features or [])
+        self._resolve_monotone(config)
+        self._pushed_rows = 0
+        return self
+
+    def push_rows(self, chunk: np.ndarray, row_start: int) -> None:
+        """Streaming construction, step 2: bin one dense float chunk
+        (reference LGBM_DatasetPushRows, c_api.h:100-120)."""
+        chunk = np.asarray(chunk, dtype=np.float64)
+        if chunk.ndim == 1:
+            chunk = chunk[None, :]
+        self._bin_rows_dense(chunk, row_start)
+        self._pushed_rows = max(getattr(self, "_pushed_rows", 0),
+                                row_start + chunk.shape[0])
+
+    def push_rows_csr(self, indptr, indices, values,
+                      row_start: int) -> None:
+        """Streaming CSR chunk push (reference LGBM_DatasetPushRowsByCSR,
+        c_api.h:122-145): only stored entries are written; implicit
+        zeros were prefilled at creation."""
+        indptr = np.asarray(indptr, dtype=np.int64)
+        indices = np.asarray(indices, dtype=np.int32)
+        values = np.asarray(values, dtype=np.float64)
+        nrows = len(indptr) - 1
+        row_of = np.repeat(np.arange(nrows, dtype=np.int64),
+                           np.diff(indptr)) + row_start
+        order = np.argsort(indices, kind="stable")
+        cols_s, rows_s, vals_s = indices[order], row_of[order], values[order]
+        bounds = np.searchsorted(cols_s, np.arange(
+            self.num_total_features + 1))
+        for f in self.features:
+            j = f.feature_idx
+            lo, hi = bounds[j], bounds[j + 1]
+            if lo == hi:
+                continue
+            m = self.mappers[j]
+            col = m.value_to_bin(vals_s[lo:hi])
+            rr = rows_s[lo:hi]
+            if not f.collapsed_default:
+                self.group_bins[rr, f.group] = col.astype(np.uint8)
+            else:
+                gb = col + f.offset
+                if m.default_bin == 0:
+                    gb -= 1
+                keep = col != m.default_bin
+                self.group_bins[rr[keep], f.group] = gb[keep].astype(
+                    np.uint8)
+        self._pushed_rows = max(getattr(self, "_pushed_rows", 0),
+                                row_start + nrows)
+
+    def finish_load(self) -> "Dataset":
+        """End of streaming pushes (reference FinishLoad)."""
+        pushed = getattr(self, "_pushed_rows", self.num_data)
+        if pushed < self.num_data:
+            Log.warning(f"finish_load: only {pushed} of {self.num_data} "
+                        "rows were pushed")
+        return self
+
+    # ------------------------------------------------------------------
     def _build_groups(self, reference: Optional["Dataset"],
                       sample_nonzero: Optional[List[np.ndarray]] = None,
                       sample_cnt: int = 0) -> None:
@@ -300,9 +411,16 @@ class Dataset:
 
     # ------------------------------------------------------------------
     def _bin_data(self, data: np.ndarray) -> None:
-        N = self.num_data
-        G = self.num_groups
-        out = np.zeros((N, G), dtype=np.uint8)
+        self.group_bins = np.zeros((self.num_data, self.num_groups),
+                                   dtype=np.uint8)
+        self._bin_rows_dense(data, 0)
+
+    def _bin_rows_dense(self, data: np.ndarray, row_start: int) -> None:
+        """Bin a dense float chunk into group_bins[row_start:...] —
+        shared by whole-matrix construction and the PushRows streaming
+        path (reference Dataset::PushOneRow via FeatureGroup::PushData,
+        feature_group.h:128-136)."""
+        out = self.group_bins[row_start:row_start + data.shape[0]]
         for f in self.features:
             col = self.mappers[f.feature_idx].value_to_bin(
                 data[:, f.feature_idx])
@@ -318,7 +436,6 @@ class Dataset:
                 is_default = col == f.mapper.default_bin
                 keep = ~is_default
                 out[keep, f.group] = gb[keep].astype(np.uint8)
-        self.group_bins = out
 
     # ------------------------------------------------------------------
     def _bin_data_sparse(self, csc) -> None:
@@ -445,15 +562,9 @@ def _sample_feature_values(data: np.ndarray, sample_cnt: int, seed: int
         sample = data[idx]
     else:
         sample = data
-    total = sample.shape[0]
-    out = []
-    rows = []
-    for j in range(data.shape[1]):
-        col = sample[:, j]
-        keep = np.isnan(col) | (np.abs(col) > 1e-35)
-        out.append(col[keep])
-        rows.append(np.nonzero(keep)[0])
-    return out, total, rows
+    from .data_loader import split_sample_columns
+    out, rows = split_sample_columns(sample)
+    return out, sample.shape[0], rows
 
 
 def _sample_feature_values_sparse(csc, sample_cnt: int, seed: int

@@ -206,6 +206,23 @@ class TreeGrower:
         self.frontier = min(config.num_leaves - 1,
                             config.frontier_width or 126)
 
+        # histogram memory governance (reference histogram_pool_size,
+        # config.h:216 + HistogramPool LRU): when the per-leaf cache
+        # exceeds the budget, drop histogram subtraction and compute
+        # BOTH children of every split directly (2x histogram passes,
+        # no (L, G, B, 3) cache)
+        cache_mb = (self.num_leaves * self.num_groups *
+                    self.max_group_bin * 3 * 4) / (1 << 20)
+        pool = float(getattr(config, "histogram_pool_size", -1.0))
+        self.use_hist_cache = pool < 0 or cache_mb <= pool
+        if not self.use_hist_cache:
+            from ..utils.log import Log as _Log
+            _Log.warning(
+                f"histogram cache ({cache_mb:.0f} MB) exceeds "
+                f"histogram_pool_size ({pool:.0f} MB); disabling "
+                "histogram subtraction (children computed directly — "
+                "~2x histogram passes)")
+
         # forced splits (reference serial_tree_learner.cpp:543-698
         # ForceSplits): JSON tree flattened to spec arrays; leaves carry
         # a spec index through growth and split at the forced
@@ -667,7 +684,8 @@ class TreeGrower:
             leaf_forced=leaf_forced,
             tree=tree,
             hist_cache=jnp.zeros(
-                (L, self.num_groups, self.max_group_bin, 3), jnp.float32),
+                (L if self.use_hist_cache else 1, self.num_groups,
+                 self.max_group_bin, 3), jnp.float32),
             cand=cand, forced_cand=forced_cand)
 
     # ------------------------------------------------------------------
@@ -765,13 +783,26 @@ class TreeGrower:
                                            slots=rights, quant=quant)
         right_hist = self.policy.constrain_hist(right_hist)
         safe_p = jnp.clip(parents, 0, L - 1)
-        left_hist = cache[safe_p] - right_hist
-        # one combined scatter (parent and right slots are disjoint) so
-        # XLA emits a single in-place update of the 5+ MB cache buffer
+        if self.use_hist_cache:
+            left_hist = cache[safe_p] - right_hist
+        elif self.use_fused:
+            # no-cache mode: the parent slot now hosts the LEFT child's
+            # rows (routing already applied; re-application is
+            # idempotent), so a direct pass replaces the subtraction
+            left_hist, _ = self._hist_kernel_fused(
+                st, parents, grad, hess, counts, quant)
+            left_hist = self.policy.constrain_hist(left_hist)
+        else:
+            left_hist = self._hist_kernel(grad, hess, counts, st.leaf_id,
+                                          slots=parents, quant=quant)
+            left_hist = self.policy.constrain_hist(left_hist)
         new_slots = jnp.concatenate([parents, rights])          # (2W,)
         h_new = jnp.concatenate([left_hist, right_hist])        # (2W,G,B,3)
-        cache = cache.at[jnp.where(new_slots >= 0, new_slots, L)].set(
-            h_new, mode="drop")
+        if self.use_hist_cache:
+            # one combined scatter (parent and right slots are disjoint)
+            # so XLA emits a single in-place update of the cache buffer
+            cache = cache.at[jnp.where(new_slots >= 0, new_slots, L)].set(
+                h_new, mode="drop")
         safe = jnp.clip(new_slots, 0, L - 1)
         valid = new_slots >= 0
         sg = st.leaf_sum_grad[safe]

@@ -1,0 +1,80 @@
+"""2-process jax.distributed exercise on CPU: rendezvous, gathered-
+sample bin finding (identical mappers on every host), per-host row
+binning (the redesign of reference dataset_loader.cpp:424-456,
+523-605).  Runs real separate processes — the seam the round-1 review
+flagged as never exercised."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys, hashlib
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from lightgbm_tpu.parallel import distributed as D
+D.initialize(coordinator_address=coord, num_processes=nproc,
+             process_id=pid)
+assert jax.process_count() == nproc
+# deterministic global data; each host holds its own row shard
+rng = np.random.RandomState(0)
+X = rng.randn(2000, 6)
+X[rng.rand(2000, 6) < 0.3] = 0.0
+y = (X[:, 0] > 0).astype(float)
+shard = slice(pid * 1000, (pid + 1) * 1000)
+from lightgbm_tpu.config import Config
+cfg = Config.from_params({"objective": "binary", "verbose": -1})
+ds = D.construct_sharded(X[shard], label=y[shard], config=cfg)
+# mappers must be bit-identical across hosts
+h = hashlib.sha256("|".join(ds.feature_infos()).encode()).hexdigest()
+bins_h = hashlib.sha256(ds.group_bins.tobytes()).hexdigest()
+print(f"RANK {pid} mappers {h} bins {bins_h} rows {ds.num_data} "
+      f"groups {ds.num_groups}", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_distributed_binning(tmp_path):
+    port = _free_port()
+    coord = f"localhost:{port}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, coord, "2", str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.skip("jax.distributed CPU rendezvous timed out here")
+        if p.returncode != 0:
+            if "distributed" in err.lower() and "support" in err.lower():
+                pytest.skip(f"jax.distributed unsupported: {err[-300:]}")
+            raise AssertionError(out + err)
+        outs.append(out)
+    lines = {ln.split()[1]: ln.split() for o in outs
+             for ln in o.splitlines() if ln.startswith("RANK")}
+    assert set(lines) == {"0", "1"}
+    # identical mappers + groups on both hosts...
+    assert lines["0"][3] == lines["1"][3]
+    assert lines["0"][9] == lines["1"][9]
+    # ...but DIFFERENT local bin shards (each host binned its own rows)
+    assert lines["0"][5] != lines["1"][5]
+    assert lines["0"][7] == lines["1"][7] == "1000"

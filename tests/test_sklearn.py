@@ -120,11 +120,12 @@ def test_grid_search_compatible():
 def test_early_stopping_sklearn():
     X, y = load_breast_cancer(return_X_y=True)
     X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=0)
-    m = lgb.LGBMClassifier(n_estimators=300, silent=True)
+    m = lgb.LGBMClassifier(n_estimators=120, silent=True,
+                           learning_rate=0.3)
     m.fit(X_tr, y_tr, eval_set=[(X_te, y_te)],
           eval_metric="binary_logloss", early_stopping_rounds=5)
     assert m.best_iteration_ > 0
-    assert m.booster_.num_trees() < 300
+    assert m.booster_.num_trees() < 120
 
 
 def test_sklearn_check_estimator_basics():

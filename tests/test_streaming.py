@@ -1,0 +1,184 @@
+"""Streaming / two-round construction (reference
+src/io/dataset_loader.cpp:180-265, c_api.h:68-145 PushRows): the float
+matrix never exists; peak memory = samples + one chunk + uint8 bins."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Dataset as CoreDataset
+
+
+def _write_csv(path, X, y):
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+
+
+@pytest.fixture(scope="module")
+def csv_task(tmp_path_factory):
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 8)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    p = tmp_path_factory.mktemp("stream") / "train.csv"
+    _write_csv(p, X, y)
+    return str(p), X, y
+
+
+def test_two_round_matches_in_ram_loading(csv_task):
+    path, X, y = csv_task
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "bin_construct_sample_cnt": 5000}
+    cfg1 = Config.from_params(params)
+    core_ram = lgb.Dataset(path).construct(cfg1)
+    cfg2 = Config.from_params(dict(params, two_round=True,
+                                   streaming_chunk_rows=512))
+    core_stream = lgb.Dataset(path).construct(cfg2)
+    # identical sample => identical mappers => identical bin matrix
+    np.testing.assert_array_equal(core_ram.group_bins,
+                                  core_stream.group_bins)
+    np.testing.assert_array_equal(core_ram.metadata.label,
+                                  core_stream.metadata.label)
+
+
+def test_two_round_trains(csv_task):
+    path, X, y = csv_task
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "two_round": True, "streaming_chunk_rows": 700}
+    bst = lgb.train(params, lgb.Dataset(path), 10, verbose_eval=False)
+    acc = ((bst.predict(X) > 0.5) == y).mean()
+    assert acc > 0.9
+
+
+def test_two_round_binary_cache_roundtrip(csv_task, tmp_path):
+    """Streamed construction -> binary cache -> reload: bit-equal."""
+    from lightgbm_tpu.dataset_io import load_binary, save_binary
+    path, _, _ = csv_task
+    cfg = Config.from_params({"objective": "binary", "verbose": -1,
+                              "two_round": True,
+                              "streaming_chunk_rows": 512})
+    core = lgb.Dataset(path).construct(cfg)
+    bp = tmp_path / "train.bin"
+    save_binary(core, str(bp))
+    core2 = load_binary(str(bp))
+    np.testing.assert_array_equal(core.group_bins, core2.group_bins)
+    np.testing.assert_array_equal(core.metadata.label,
+                                  core2.metadata.label)
+
+
+def test_push_rows_dense_matches_matrix():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1200, 6)
+    X[rng.rand(1200, 6) < 0.3] = 0.0   # exercise EFB + default bins
+    cfg = Config.from_params({"objective": "binary", "verbose": -1})
+    ref = CoreDataset.from_matrix(X, label=np.zeros(1200), config=cfg)
+
+    keep = [np.isnan(X[:, j]) | (np.abs(X[:, j]) > 1e-35)
+            for j in range(6)]
+    vals = [X[:, j][keep[j]] for j in range(6)]
+    rows = [np.nonzero(keep[j])[0] for j in range(6)]
+    ds = CoreDataset.from_sampled_columns(vals, rows, 1200, 1200,
+                                          config=cfg)
+    for s in range(0, 1200, 300):
+        ds.push_rows(X[s:s + 300], s)
+    ds.finish_load()
+    np.testing.assert_array_equal(ds.group_bins, ref.group_bins)
+
+
+def test_push_rows_csr_matches_dense_push():
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(2)
+    X = np.where(rng.rand(900, 10) < 0.1, rng.randn(900, 10), 0.0)
+    cfg = Config.from_params({"objective": "binary", "verbose": -1})
+    ref = CoreDataset.from_matrix(X, label=np.zeros(900), config=cfg)
+    keep = [np.abs(X[:, j]) > 1e-35 for j in range(10)]
+    ds = CoreDataset.from_sampled_columns(
+        [X[:, j][keep[j]] for j in range(10)],
+        [np.nonzero(keep[j])[0] for j in range(10)], 900, 900, config=cfg)
+    csr = sp.csr_matrix(X)
+    for s in range(0, 900, 250):
+        part = csr[s:s + 250]
+        ds.push_rows_csr(part.indptr, part.indices, part.data, s)
+    ds.finish_load()
+    np.testing.assert_array_equal(ds.group_bins, ref.group_bins)
+
+
+def test_capi_sampled_column_push_flow():
+    from lightgbm_tpu import capi
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] > 0).astype(float)
+    keep = [np.abs(X[:, j]) > 1e-35 for j in range(5)]
+    vals = [X[:, j][keep[j]] for j in range(5)]
+    rows = [np.nonzero(keep[j])[0] for j in range(5)]
+    out = [None]
+    assert capi.LGBM_DatasetCreateFromSampledColumn(
+        vals, rows, 5, [len(v) for v in vals], 600, 600,
+        "objective=binary verbose=-1 num_leaves=7", out=out) == 0
+    h = out[0]
+    assert capi.LGBM_DatasetPushRows(h, X[:300], 300, 5, 0) == 0
+    assert capi.LGBM_DatasetPushRows(h, X[300:], 300, 5, 300) == 0
+    capi.LGBM_DatasetSetField(h, "label", y)
+    bh = [None]
+    assert capi.LGBM_BoosterCreate(
+        h, "objective=binary verbose=-1 num_leaves=7", out=bh) == 0
+    for _ in range(5):
+        capi.LGBM_BoosterUpdateOneIter(bh[0], [None])
+    pred = [None]
+    capi.LGBM_BoosterPredictForMat(bh[0], X, out=pred)
+    assert (((pred[0] > 0.5) == y).mean()) > 0.9
+
+
+def test_streaming_construct_bounded_rss(tmp_path):
+    """A CSV several times larger than the RSS budget constructs via
+    two-round within the budget (subprocess for a clean ru_maxrss)."""
+    code = r"""
+import numpy as np, os, sys
+
+def vmrss_mb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+path = sys.argv[1]
+rng = np.random.RandomState(0)
+# write ~600 MB of text: 1.5M rows x 25 cols in streamed chunks
+with open(path, "w") as f:
+    for _ in range(75):
+        chunk = rng.randn(20000, 26).astype(np.float32)
+        np.savetxt(f, chunk, delimiter=",", fmt="%.6g")
+write_mb = os.path.getsize(path) / 1e6
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+cfg = Config.from_params({"objective": "regression", "verbose": -1,
+                          "two_round": True, "max_bin": 63,
+                          "bin_construct_sample_cnt": 20000})
+core = lgb.Dataset(path).construct(cfg)
+assert core.num_data == 1_500_000, core.num_data
+rss_mb = vmrss_mb()
+print("csv_mb", write_mb, "rss_mb", rss_mb)
+# full float64 matrix alone would be 300 MB; text in RAM ~600 MB.
+# budget: uint8 bins (37.5 MB) + chunk + samples + interpreter << 600
+assert rss_mb < 600, rss_mb
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", code, str(tmp_path / "big.csv")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=1200)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_qid_group_sizes_appearance_order():
+    """Descending/unsorted qids keep appearance order (np.unique's
+    sorted counts misassigned boundaries)."""
+    from lightgbm_tpu.data_loader import qid_to_group_sizes
+    np.testing.assert_array_equal(
+        qid_to_group_sizes(np.array([5, 5, 3, 3, 3])), [2, 3])
+    np.testing.assert_array_equal(
+        qid_to_group_sizes(np.array([7, 2, 2, 9])), [1, 2, 1])
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        qid_to_group_sizes(np.array([1, 1, 2, 1]))  # non-contiguous

@@ -61,6 +61,8 @@ class Dataset:
             # two-round streaming: the float matrix never exists
             from .data_loader import load_file_streaming
             self._core = load_file_streaming(data, config)
+            if isinstance(self.feature_name, (list, tuple)):
+                self._core.feature_names = list(self.feature_name)
             if self.label is not None:
                 self._core.metadata.set_label(self.label)
             if self.weight is not None:

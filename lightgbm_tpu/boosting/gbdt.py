@@ -120,7 +120,12 @@ class GBDT:
         # (entries are () or (n,) device arrays — kept stacked so a
         # chunk never pays per-iteration slice dispatches)
         self._nl_count = 0
-        self._stop_check_every = 8
+        # deferred no-split stop detection: each check is a device->host
+        # pull (a full RPC round trip on a remote-attached chip, ~60 ms
+        # measured) — amortize it far beyond the reference's every-
+        # iteration check; 1-leaf trees contribute exactly zero score,
+        # so the late rollback is exact (see _check_stop_window)
+        self._stop_check_every = 64
         # threefry PRNGKey(seed) layout is [hi, lo] uint32 — verified
         # once so chunk key batches can be built host-side in numpy
         # (n PRNGKey dispatches per chunk each cost a remote RPC)
@@ -369,7 +374,16 @@ class GBDT:
             self._bag_state = self._full_counts > 0
         seeds = np.asarray([self._iter_key_rng.randint(0, 2**31 - 1)
                             for _ in range(n_iters)], np.uint32)
-        if self._np_keys_ok:
+        if self._np_keys_ok and not use_bag and not self._sample_active():
+            # keys unused by the chunk body (no bagging draw, no GOSS
+            # sampling): reuse a cached device array and skip the
+            # per-chunk host->device transfer entirely
+            cache = getattr(self, "_chunk_keys", None)
+            if cache is None or cache.shape[0] != n_iters:
+                cache = jnp.zeros((n_iters, 2), jnp.uint32)
+                self._chunk_keys = cache
+            keys = cache
+        elif self._np_keys_ok:
             keys = jnp.asarray(np.stack(
                 [np.zeros(n_iters, np.uint32), seeds], axis=1))
         else:  # pragma: no cover - unexpected key layout
@@ -387,14 +401,22 @@ class GBDT:
                 [np.stack([self._feature_mask_np()
                            for _ in range(self.num_class)])
                  for _ in range(n_iters)]))
-        fresh = np.zeros(n_iters, bool)
         if use_bag:
+            fresh = np.zeros(n_iters, bool)
             for j in range(n_iters):
                 fresh[j] = (self.iter_ + j) % cfg.bagging_freq == 0
+        else:
+            # all-False flags never change: cache the device constant
+            cache = getattr(self, "_chunk_fresh", None)
+            if cache is None or cache.shape[0] != n_iters:
+                cache = jnp.zeros(n_iters, bool)
+                self._chunk_fresh = cache
+            fresh = cache
         self.timer.start("tree")
         scores, vscores, bag, trees, nls = self._fused_chunk(
             self.scores, tuple(vs.scores for vs in self.valid_sets),
-            self._bag_state, keys, fmasks, jnp.asarray(fresh),
+            self._bag_state, keys, fmasks,
+            fresh if isinstance(fresh, jax.Array) else jnp.asarray(fresh),
             self.grower.ohb)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):

@@ -46,6 +46,22 @@ def detect_format(sample_lines: List[str]) -> str:
     return fmt
 
 
+def _sniff_text_file(path: str, config: Config):
+    """Shared format/header sniffing for both loaders: returns
+    (fmt, sep, names) from the file's first lines."""
+    with open(path) as f:
+        first_lines = [f.readline() for _ in range(20)]
+    has_header = config.has_header
+    header_line = first_lines[0] if has_header else None
+    data_sample = first_lines[1:] if has_header else first_lines
+    fmt = detect_format([ln for ln in data_sample if ln])
+    sep = "\t" if fmt == "tsv" else ","
+    names = None
+    if header_line is not None:
+        names = [c.strip() for c in header_line.strip().split(sep)]
+    return fmt, sep, names
+
+
 def qid_to_group_sizes(qid: np.ndarray) -> np.ndarray:
     """Per-row query ids -> per-query sizes in APPEARANCE order (rows
     of one query must be contiguous, the reference contract;
@@ -135,20 +151,10 @@ def load_file(path: str, config: Config
     weight / group arrays from columns or side files.
     """
     # native fast path for csv/tsv when the C++ loader is built
-    with open(path) as f:
-        first_lines = [f.readline() for _ in range(20)]
     has_header = config.has_header
-    header_line = first_lines[0] if has_header else None
-    data_sample = first_lines[1:] if has_header else first_lines
-    fmt = detect_format([l for l in data_sample if l])
-
-    names = None
-    if header_line is not None:
-        sep = "\t" if fmt == "tsv" else ","
-        names = [c.strip() for c in header_line.strip().split(sep)]
+    fmt, sep, names = _sniff_text_file(path, config)
 
     if fmt in ("csv", "tsv"):
-        sep = "\t" if fmt == "tsv" else ","
         try:
             from .native import text_loader
             raw = text_loader.load_csv(path, sep, 1 if has_header else 0)
@@ -190,12 +196,8 @@ def load_file_streaming(path: str, config: Config):
     """
     from .dataset import Dataset as CoreDataset
 
-    with open(path) as f:
-        first_lines = [f.readline() for _ in range(20)]
     has_header = config.has_header
-    header_line = first_lines[0] if has_header else None
-    data_sample = first_lines[1:] if has_header else first_lines
-    fmt = detect_format([ln for ln in data_sample if ln])
+    fmt, sep, names = _sniff_text_file(path, config)
     if fmt == "libsvm":
         # libsvm files are sparse — route through the sparse in-RAM
         # path (bounded by nnz) rather than two-round
@@ -206,10 +208,6 @@ def load_file_streaming(path: str, config: Config):
                                      init_score=extras.get("init_score"),
                                      config=config)
         return ds
-    sep = "\t" if fmt == "tsv" else ","
-    names = None
-    if header_line is not None:
-        names = [c.strip() for c in header_line.strip().split(sep)]
 
     def parse_lines(lines):
         return np.loadtxt(lines, delimiter=sep, ndmin=2, dtype=np.float64)

@@ -86,7 +86,8 @@ def construct_sharded(local_data: np.ndarray, label=None, weight=None,
     caller assembles the global array over the mesh with
     ``jax.make_array_from_process_local_data``.
     """
-    from ..dataset import Dataset as CoreDataset, _sample_feature_values
+    from ..data_loader import split_sample_columns
+    from ..dataset import Dataset as CoreDataset
     config = config or Config()
     local_data = np.asarray(local_data, dtype=np.float64)
     local_sample = sample_local_rows(
@@ -95,35 +96,21 @@ def construct_sharded(local_data: np.ndarray, label=None, weight=None,
         config.data_random_seed)
     combined = allgather_samples(local_sample)
 
-    ds = CoreDataset()
-    ds.config = config
-    ds.num_data = local_data.shape[0]
-    ds.num_total_features = local_data.shape[1]
-    ds.max_bin = config.max_bin
-    ds.feature_names = list(feature_names) if feature_names else [
-        f"Column_{i}" for i in range(local_data.shape[1])]
-    from ..binning import find_bin_mappers
-    # per-feature sampled values from the COMBINED sample (zeros
-    # implicit, same contract as single-host construction)
-    sample_vals, total_cnt, sample_rows = _sample_feature_values(
-        combined, combined.shape[0], config.data_random_seed)
-    cat_set = set(categorical_features or [])
-    ds.mappers = find_bin_mappers(
-        sample_vals, total_cnt, config.max_bin, config.min_data_in_bin,
-        config.min_data_in_leaf, cat_set, config.use_missing,
-        config.zero_as_missing)
-    ds.used_features = [i for i, m in enumerate(ds.mappers)
-                        if not m.is_trivial]
-    ds._build_groups(reference=None, sample_nonzero=sample_rows,
-                     sample_cnt=total_cnt)
-    ds._bin_data(local_data)          # LOCAL rows only
-    from ..dataset import Metadata
-    ds.metadata = Metadata(local_data.shape[0])
+    # the COMBINED sample drives mapper + EFB fitting (bit-equal on
+    # every host); construction then reuses the single-host streaming
+    # machinery with one local "push" of this host's rows
+    sample_vals, sample_rows = split_sample_columns(combined)
+    ds = CoreDataset.from_sampled_columns(
+        sample_vals, sample_rows, combined.shape[0],
+        local_data.shape[0], config=config,
+        categorical_features=categorical_features,
+        feature_names=feature_names)
+    ds.push_rows(local_data, 0)
+    ds.finish_load()
     if label is not None:
         ds.metadata.set_label(np.asarray(label))
     ds.metadata.set_weight(weight)
     ds.metadata.set_group(group)
-    ds._resolve_monotone(config)
     return ds
 
 

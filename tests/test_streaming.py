@@ -137,12 +137,23 @@ def test_streaming_construct_bounded_rss(tmp_path):
     code = r"""
 import numpy as np, os, sys
 
-def vmrss_mb():
+import resource
+
+BASE_PEAK_MB = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+def peak_or_rss_mb():
+    # Peak RSS when the starting high-water mark is clean; otherwise
+    # (an inherited/polluted watermark, observed as identical ~2.1 GB
+    # baselines under a loaded suite) fall back to current VmRSS,
+    # which still catches persistent whole-matrix densification.
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    if BASE_PEAK_MB < 400:
+        return peak
     with open("/proc/self/status") as f:
         for line in f:
             if line.startswith("VmRSS:"):
                 return int(line.split()[1]) / 1024.0
-    return 0.0
+    return peak
 path = sys.argv[1]
 rng = np.random.RandomState(0)
 # write ~600 MB of text: 1.5M rows x 25 cols in streamed chunks
@@ -158,8 +169,8 @@ cfg = Config.from_params({"objective": "regression", "verbose": -1,
                           "bin_construct_sample_cnt": 20000})
 core = lgb.Dataset(path).construct(cfg)
 assert core.num_data == 1_500_000, core.num_data
-rss_mb = vmrss_mb()
-print("csv_mb", write_mb, "rss_mb", rss_mb)
+rss_mb = peak_or_rss_mb()
+print("csv_mb", write_mb, "rss_mb", rss_mb, "base", BASE_PEAK_MB)
 # full float64 matrix alone would be 300 MB; text in RAM ~600 MB.
 # budget: uint8 bins (37.5 MB) + chunk + samples + interpreter << 600
 assert rss_mb < 600, rss_mb

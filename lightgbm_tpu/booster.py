@@ -146,10 +146,18 @@ class Booster:
                 pred_contrib: bool = False,
                 pred_early_stop: bool = False,
                 pred_early_stop_freq: int = 10,
-                pred_early_stop_margin: float = 10.0) -> np.ndarray:
-        """Host prediction on raw features (reference
+                pred_early_stop_margin: float = 10.0,
+                device: Optional[bool] = None) -> np.ndarray:
+        """Prediction on raw features (reference
         gbdt_prediction.cpp:9-100; SHAP via tree.PredictContrib;
-        margin-based early stop prediction_early_stop.cpp:13-80)."""
+        margin-based early stop prediction_early_stop.cpp:13-80).
+
+        ``device``: None (auto) routes large batch predictions of
+        in-session models through the accelerator — the input is binned
+        with the training mappers and the device-resident trees are
+        evaluated in one scanned program (the TPU analog of the
+        reference's OMP batch predict, c_api.cpp:200).  True forces it
+        (tests), False forces the host path."""
         from .basic import _is_sparse, _to_matrix
         if _is_sparse(data):
             # CSR prediction without whole-matrix densify (reference
@@ -173,6 +181,14 @@ class Booster:
             data = data[None, :]
         n = data.shape[0]
         k = max(self.num_tree_per_iteration, 1)
+
+        if (not pred_leaf and not pred_contrib and not pred_early_stop
+                and self._can_device_predict(n, num_iteration, device)):
+            raw = self._device_predict_raw(data, num_iteration)[:, None]
+            if not raw_score and not self.average_output:
+                raw = self._convert_output(raw)
+            return raw[:, 0]
+
         models = self._used_models(num_iteration)
 
         if pred_leaf:
@@ -210,6 +226,100 @@ class Booster:
             # RF leaf outputs are already in converted space
             raw = self._convert_output(raw)
         return raw[:, 0] if k == 1 else raw
+
+    def _n_used_trees(self, num_iteration: int) -> int:
+        k = max(self.num_tree_per_iteration, 1)
+        total = (len(self.gbdt.device_trees) if self.gbdt is not None
+                 else len(self.models))
+        if num_iteration is None or num_iteration <= 0:
+            if self.best_iteration > 0:
+                num_iteration = self.best_iteration
+            else:
+                return total
+        return min(total, num_iteration * k)
+
+    def _can_device_predict(self, n: int, num_iteration: int,
+                            device: Optional[bool]) -> bool:
+        """Batch device predict is valid for single-class in-session
+        models with uniform tree scaling (no DART renorm, no foreign
+        init_model trees, not RF averaging)."""
+        if device is False or self.gbdt is None:
+            return False
+        g = self.gbdt
+        ok = (self.num_tree_per_iteration == 1
+              and not self.average_output
+              and g._scale_offset == 0
+              and len(g.device_trees) > 0
+              and all(s == 1.0 for s in g._tree_scale))
+        if not ok:
+            return False
+        if device is True:
+            return True
+        import jax
+        n_trees = self._n_used_trees(num_iteration)
+        return (jax.default_backend() in ("tpu", "axon")
+                and n * n_trees >= 2_000_000)
+
+    def _device_predict_raw(self, data: np.ndarray,
+                            num_iteration: int) -> np.ndarray:
+        """Raw scores via the accelerator: bin the input against the
+        training mappers, then accumulate a lax.scan of predict_binned
+        over the device-resident tree stacks."""
+        import jax
+        import jax.numpy as jnp
+        from .ops.predict import predict_binned
+
+        g = self.gbdt
+        gr = g.grower
+        cfg = g.config
+        vcore = Dataset.from_matrix(np.asarray(data, dtype=np.float64),
+                                    config=cfg, reference=g.train_set)
+        vbins = jnp.asarray(vcore.group_bins)
+        n_trees = self._n_used_trees(num_iteration)
+        shrinks = g._tree_shrink[:n_trees]
+
+        def acc_stack(total, stack, shrink_arr):
+            def body(carry, xs):
+                tr, s = xs
+                pv = predict_binned(tr, vbins, gr.f_group, gr.g2f_lut,
+                                    gr.f_missing, gr.f_default_bin,
+                                    gr.f_num_bin,
+                                    max_steps=cfg.num_leaves)
+                return carry + s * pv, None
+            out, _ = jax.lax.scan(body, total, (stack, shrink_arr))
+            return out
+
+        acc_jit = jax.jit(acc_stack)
+        # iter-0 trained in session => the boost_from_average bias is
+        # NOT folded into the device trees (flush folds it host-side)
+        total = jnp.full(vbins.shape[0], np.float32(g.init_score))
+        i = 0
+        entries = g.device_trees[:n_trees]
+        while i < len(entries):
+            e = entries[i]
+            if isinstance(e, tuple) and e and e[0] == "stackref":
+                stack = e[1]
+                j0 = e[2]
+                j1 = j0
+                while (i + (j1 - j0) + 1 < len(entries)
+                       and isinstance(entries[i + (j1 - j0) + 1], tuple)
+                       and entries[i + (j1 - j0) + 1][0] == "stackref"
+                       and entries[i + (j1 - j0) + 1][1] is stack
+                       and entries[i + (j1 - j0) + 1][2] == j1 + 1):
+                    j1 += 1
+                count = j1 - j0 + 1
+                part = jax.tree_util.tree_map(
+                    lambda x: x[j0:j0 + count], stack)
+                sh = jnp.asarray(np.asarray(
+                    shrinks[i:i + count], np.float32))
+                total = acc_jit(total, part, sh)
+                i += count
+            else:
+                part = jax.tree_util.tree_map(lambda x: x[None], e)
+                sh = jnp.asarray(np.asarray(shrinks[i:i + 1], np.float32))
+                total = acc_jit(total, part, sh)
+                i += 1
+        return np.asarray(total)
 
     def _used_models(self, num_iteration: int) -> List[Tree]:
         self._sync_models()

@@ -115,6 +115,8 @@ class GBDT:
         self._pending: List[Tuple[TreeArrays, float, float]] = []
         self._scale_offset = 0   # foreign (init_model) trees precede ours
         self._tree_scale: List[float] = []    # DART renorm per model idx
+        self._tree_shrink: List[float] = []   # shrinkage at train time
+        # (feeds the batched device predict; reset_parameter may vary it)
         self._applied_scale: List[float] = []  # scale baked into models[i]
         self._nl_window: List[jax.Array] = []  # deferred 1-leaf stop checks
         # (entries are () or (n,) device arrays — kept stacked so a
@@ -434,6 +436,7 @@ class GBDT:
             for stack in stacks:
                 self.device_trees.append(("stackref", stack, j))
                 self._tree_scale.append(1.0)
+                self._tree_shrink.append(self.shrinkage_rate)
         self._nl_window.append(nls)          # stays stacked on device
         self._nl_count += n_iters
         self.iter_ += n_iters
@@ -479,6 +482,7 @@ class GBDT:
             self.device_trees.append(tree)
             self._pending.append(("tree", tree, self.shrinkage_rate, bias))
             self._tree_scale.append(1.0)
+            self._tree_shrink.append(self.shrinkage_rate)
         self._nl_window.append(nl)
         self._nl_count += 1
         self._after_iteration()
@@ -532,6 +536,7 @@ class GBDT:
             self._pending.append(("tree", tree_arrays,
                                   self.shrinkage_rate, bias))
             self._tree_scale.append(1.0)
+            self._tree_shrink.append(self.shrinkage_rate)
             nl = jnp.maximum(nl, tree_arrays.num_leaves)
         self.timer.stop("tree")
         self._nl_window.append(nl)
@@ -763,6 +768,8 @@ class GBDT:
         for k in reversed(range(self.num_class)):
             tree_arrays = self._materialize_devtree(self.device_trees.pop())
             self._tree_scale.pop()
+            if self._tree_shrink:
+                self._tree_shrink.pop()
             self.scores = self.scores.at[k].add(
                 -shrinkage * self._predict_valid_fn(
                     tree_arrays, self.grower.bins))

@@ -65,6 +65,7 @@ class RF(GBDT):
                 vs.scores = (vs.scores * t).at[k].add(pv) / (t + 1.0)
             self._pending.append(("tree", tree_arrays, 1.0, 0.0))
             self._tree_scale.append(1.0)
+            self._tree_shrink.append(1.0)
         self.iter_ += 1
         return False
 

@@ -9,15 +9,21 @@ hang on) the real accelerator tunnel.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# LGBM_TPU_ONCHIP=1 runs the suite against the real chip (for
+# tests/test_tpu_onchip.py's Mosaic-numerics parity checks)
+_ONCHIP = os.environ.get("LGBM_TPU_ONCHIP") == "1"
+
+if not _ONCHIP:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ONCHIP:
+    jax.config.update("jax_platforms", "cpu")
 # persistent compile cache: every TreeGrower instance re-jits its tree
 # function, so without this the suite recompiles identical shapes
 # dozens of times (round-1 suite exceeded 25 min; compiles dominated)

@@ -427,3 +427,23 @@ def test_pandas_categorical_remap_on_predict():
         bst2 = lgb.Booster(model_file=p)
         assert bst2.pandas_categorical == [cats]
         np.testing.assert_allclose(bst.predict(df2), bst2.predict(df2))
+
+
+def test_device_predict_matches_host():
+    """Batched device prediction (binned input + scanned device trees)
+    must match the host per-tree walk exactly (reference batch predict
+    c_api.cpp:200; VERDICT weak #9)."""
+    X_train, X_test, y_train, _ = _binary_data()
+    ds = lgb.Dataset(X_train, label=y_train)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15}, ds, 12, verbose_eval=False)
+    host = bst.predict(X_test, device=False)
+    dev = bst.predict(X_test, device=True)
+    np.testing.assert_allclose(dev, host, atol=1e-6)
+    host_raw = bst.predict(X_test, raw_score=True, device=False)
+    dev_raw = bst.predict(X_test, raw_score=True, device=True)
+    np.testing.assert_allclose(dev_raw, host_raw, atol=1e-6)
+    # num_iteration slicing agrees too
+    np.testing.assert_allclose(
+        bst.predict(X_test, num_iteration=5, device=True),
+        bst.predict(X_test, num_iteration=5, device=False), atol=1e-6)

@@ -1,0 +1,100 @@
+"""REAL-CHIP Pallas kernel parity — the analog of the reference's
+GPU_DEBUG_COMPARE CPU-vs-GPU histogram comparator
+(gpu_tree_learner.cpp:1020-1044).  The interpret-mode tests in
+test_histogram_kernel.py pin kernel SEMANTICS on CPU; these pin the
+Mosaic-compiled numerics on actual TPU hardware.  Skipped on CPU CI;
+run manually on a chip (`JAX_PLATFORMS= pytest tests/test_tpu_onchip.py`)
+— last recorded run in PARITY.md.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+if jax.default_backend() not in ("tpu", "axon"):
+    pytest.skip("needs a real TPU chip", allow_module_level=True)
+
+from lightgbm_tpu.ops.histogram import (  # noqa: E402
+    compute_group_histograms, compute_group_histograms_fused,
+    compute_group_histograms_pallas, compute_group_histograms_q_packed,
+    precompute_bin_onehot, quantize_gradients)
+from lightgbm_tpu.ops.partition import (apply_route_table,  # noqa: E402
+                                        build_route_table)
+
+
+@pytest.fixture(scope="module")
+def case():
+    rng = np.random.RandomState(0)
+    N, G, B, L = 8192, 12, 63, 31
+    bins = jnp.asarray(rng.randint(0, B, (N, G)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray(np.abs(rng.randn(N)).astype(np.float32))
+    cnt = jnp.asarray((rng.rand(N) > 0.2).astype(np.float32))
+    leaf = jnp.asarray(rng.randint(-1, L, N).astype(np.int32))
+    ref = compute_group_histograms(bins, grad, hess, cnt, leaf,
+                                   num_leaves=L, max_group_bin=B,
+                                   compute_dtype="float32", chunk=8192)
+    return bins, grad, hess, cnt, leaf, ref, (N, G, B, L)
+
+
+def _close(ref, got, tol=5e-3):
+    scale = float(jnp.max(jnp.abs(ref))) + 1.0
+    return float(jnp.max(jnp.abs(ref - got))) / scale < tol
+
+
+def test_onchip_pallas_expansion_kernel(case):
+    bins, grad, hess, cnt, leaf, ref, (N, G, B, L) = case
+    got = compute_group_histograms_pallas(
+        bins, grad, hess, cnt, leaf, num_leaves=L, max_group_bin=B,
+        block=1024)
+    assert _close(ref, got)
+    # count channel exact (0/1 weights are bf16-exact)
+    assert float(jnp.max(jnp.abs(ref[..., 2] - got[..., 2]))) == 0.0
+
+
+def test_onchip_quantized_packed_kernel(case):
+    bins, grad, hess, cnt, leaf, ref, (N, G, B, L) = case
+    wq, scales = quantize_gradients(grad, hess, cnt)
+    slots = jnp.arange(31, dtype=jnp.int32)
+    got = compute_group_histograms_q_packed(
+        bins, wq, scales, leaf, slots, max_group_bin=B, block=1024)
+    # int8 quantization: tolerance = quantization step * sqrt(rows/leaf)
+    assert _close(ref, got[:31], tol=2e-2)
+    assert float(jnp.max(jnp.abs(ref[..., 2] - got[:31, ..., 2]))) == 0.0
+
+
+def test_onchip_fused_route_hist(case):
+    """Fused kernel on chip: routing BIT-IDENTICAL to the XLA router,
+    histogram within bf16 operand tolerance."""
+    bins, grad, hess, cnt, leaf, ref, (N, G, B, L) = case
+    rng = np.random.RandomState(1)
+    sm = np.zeros(L, bool)
+    sm[:6] = True
+    tab = build_route_table(
+        jnp.asarray(sm),
+        jnp.asarray(rng.randint(0, G, L).astype(np.int32)),
+        jnp.zeros(L, jnp.int32), jnp.full(L, B, jnp.int32),
+        jnp.zeros(L, jnp.int32), jnp.full(L, B - 1, jnp.int32),
+        jnp.asarray(np.array([0, 1] * 15 + [0], bool)),
+        jnp.asarray(rng.randint(0, B, L).astype(np.int32)),
+        jnp.asarray(rng.rand(L) > 0.5),
+        jnp.asarray(rng.randint(0, 3, L).astype(np.int32)),
+        jnp.asarray(rng.randint(0, 4, L).astype(np.int32)),
+        jnp.full(L, B, jnp.int32),
+        jnp.asarray(rng.rand(L, B) > 0.5),
+        jnp.asarray((np.arange(L) + 40).astype(np.int32)))
+    want_leaf = apply_route_table(bins, leaf, tab)
+    want = compute_group_histograms(
+        bins, grad, hess, cnt, want_leaf, num_leaves=128,
+        max_group_bin=B, compute_dtype="float32", chunk=8192)
+
+    ohb = precompute_bin_onehot(bins, max_group_bin=B)
+    wT = jnp.stack([grad, hess, cnt], axis=0)
+    slots = jnp.arange(42, dtype=jnp.int32)
+    got_hist, got_leaf = compute_group_histograms_fused(
+        ohb, jnp.asarray(np.asarray(bins).T), wT, None, leaf, tab,
+        slots, max_group_bin=B, block=1024, strips=1, quant=False)
+    np.testing.assert_array_equal(np.asarray(got_leaf),
+                                  np.asarray(want_leaf))
+    assert _close(want[:42], got_hist)

@@ -8,6 +8,7 @@ the reference's (gbdt_model_text.cpp:235-315).
 """
 from __future__ import annotations
 
+import functools
 import re
 from typing import Any, Dict, List, Optional, Union
 
@@ -19,6 +20,33 @@ from .tree import Tree
 from .utils.log import Log
 
 MODEL_VERSION = "v2"
+
+_ACC_FN = None
+
+
+def _acc_fn():
+    """Module-level jitted tree-stack accumulator for the device
+    predict path: one compilation per (shapes, max_steps), shared by
+    every Booster and every predict() call (a per-call closure would
+    re-trace each time)."""
+    global _ACC_FN
+    if _ACC_FN is None:
+        import jax
+        from .ops.predict import predict_binned
+
+        @functools.partial(jax.jit, static_argnames=("max_steps",))
+        def acc(total, stack, shrink_arr, vbins, f_group, g2f_lut,
+                f_missing, f_default_bin, f_num_bin, *, max_steps):
+            def body(carry, xs):
+                tr, sh = xs
+                pv = predict_binned(tr, vbins, f_group, g2f_lut,
+                                    f_missing, f_default_bin, f_num_bin,
+                                    max_steps=max_steps)
+                return carry + sh * pv, None
+            out, _ = jax.lax.scan(body, total, (stack, shrink_arr))
+            return out
+        _ACC_FN = acc
+    return _ACC_FN
 
 
 class Booster:
@@ -156,8 +184,10 @@ class Booster:
         in-session models through the accelerator — the input is binned
         with the training mappers and the device-resident trees are
         evaluated in one scanned program (the TPU analog of the
-        reference's OMP batch predict, c_api.cpp:200).  True forces it
-        (tests), False forces the host path."""
+        reference's OMP batch predict, c_api.cpp:200).  The device path
+        accumulates in float32 (the host walk uses float64), so raw
+        scores may differ at ~1e-6 relative.  True forces it, False
+        forces the host path."""
         from .basic import _is_sparse, _to_matrix
         if _is_sparse(data):
             # CSR prediction without whole-matrix densify (reference
@@ -171,7 +201,8 @@ class Booster:
                 pred_leaf=pred_leaf, pred_contrib=pred_contrib,
                 pred_early_stop=pred_early_stop,
                 pred_early_stop_freq=pred_early_stop_freq,
-                pred_early_stop_margin=pred_early_stop_margin)
+                pred_early_stop_margin=pred_early_stop_margin,
+                device=device)
                 for i in range(0, csr.shape[0], chunk)]
             return np.concatenate(parts, axis=0)
         # pandas categoricals encode against the TRAIN-time category
@@ -227,16 +258,22 @@ class Booster:
             raw = self._convert_output(raw)
         return raw[:, 0] if k == 1 else raw
 
-    def _n_used_trees(self, num_iteration: int) -> int:
+    def _resolve_tree_count(self, total: int, num_iteration: int) -> int:
+        """Shared num_iteration/best_iteration -> tree-count resolution
+        (used by both the host and device predict paths so they can
+        never slice different counts)."""
         k = max(self.num_tree_per_iteration, 1)
-        total = (len(self.gbdt.device_trees) if self.gbdt is not None
-                 else len(self.models))
         if num_iteration is None or num_iteration <= 0:
             if self.best_iteration > 0:
                 num_iteration = self.best_iteration
             else:
                 return total
         return min(total, num_iteration * k)
+
+    def _n_used_trees(self, num_iteration: int) -> int:
+        total = (len(self.gbdt.device_trees) if self.gbdt is not None
+                 else len(self.models))
+        return self._resolve_tree_count(total, num_iteration)
 
     def _can_device_predict(self, n: int, num_iteration: int,
                             device: Optional[bool]) -> bool:
@@ -267,7 +304,6 @@ class Booster:
         over the device-resident tree stacks."""
         import jax
         import jax.numpy as jnp
-        from .ops.predict import predict_binned
 
         g = self.gbdt
         gr = g.grower
@@ -278,18 +314,12 @@ class Booster:
         n_trees = self._n_used_trees(num_iteration)
         shrinks = g._tree_shrink[:n_trees]
 
-        def acc_stack(total, stack, shrink_arr):
-            def body(carry, xs):
-                tr, s = xs
-                pv = predict_binned(tr, vbins, gr.f_group, gr.g2f_lut,
-                                    gr.f_missing, gr.f_default_bin,
-                                    gr.f_num_bin,
-                                    max_steps=cfg.num_leaves)
-                return carry + s * pv, None
-            out, _ = jax.lax.scan(body, total, (stack, shrink_arr))
-            return out
+        acc = _acc_fn()
 
-        acc_jit = jax.jit(acc_stack)
+        def acc_jit(total, part, sh):
+            return acc(total, part, sh, vbins, gr.f_group, gr.g2f_lut,
+                       gr.f_missing, gr.f_default_bin, gr.f_num_bin,
+                       max_steps=cfg.num_leaves)
         # iter-0 trained in session => the boost_from_average bias is
         # NOT folded into the device trees (flush folds it host-side)
         total = jnp.full(vbins.shape[0], np.float32(g.init_score))
@@ -323,13 +353,8 @@ class Booster:
 
     def _used_models(self, num_iteration: int) -> List[Tree]:
         self._sync_models()
-        k = max(self.num_tree_per_iteration, 1)
-        if num_iteration is None or num_iteration <= 0:
-            if self.best_iteration > 0:
-                num_iteration = self.best_iteration
-            else:
-                return self.models
-        return self.models[:num_iteration * k]
+        return self.models[:self._resolve_tree_count(len(self.models),
+                                                     num_iteration)]
 
     def _add_init_and_average(self, raw, num_models):
         if self.average_output and num_models:

@@ -3,8 +3,9 @@ GPU_DEBUG_COMPARE CPU-vs-GPU histogram comparator
 (gpu_tree_learner.cpp:1020-1044).  The interpret-mode tests in
 test_histogram_kernel.py pin kernel SEMANTICS on CPU; these pin the
 Mosaic-compiled numerics on actual TPU hardware.  Skipped on CPU CI;
-run manually on a chip (`JAX_PLATFORMS= pytest tests/test_tpu_onchip.py`)
-— last recorded run in PARITY.md.
+run manually on a chip (`LGBM_TPU_ONCHIP=1 pytest tests/test_tpu_onchip.py`
+— the env var stops conftest from forcing the CPU backend); last
+recorded run in PARITY.md.
 """
 import numpy as np
 import pytest

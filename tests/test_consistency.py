@@ -42,19 +42,19 @@ def test_cli_python_consistency(example, tmp_path, monkeypatch):
     monkeypatch.chdir(ROOT)
     model_path = str(tmp_path / "cli_model.txt")
     cli_run([f"config={conf}", f"output_model={model_path}",
-             "num_iterations=10", "verbose=-1"])
+             "num_iterations=6", "verbose=-1"])
     cli_bst = lgb.Booster(model_file=model_path)
 
     # ---- Python training with the same config ----
     kv = parse_args([f"config={conf}"])
-    kv.update({"num_iterations": "10", "verbose": "-1"})
+    kv.update({"num_iterations": "6", "verbose": "-1"})
     kv.pop("output_model", None)
     kv.pop("config", None)
     kv.pop("task", None)
     data_path = os.path.join(ROOT, kv.pop("data"))
     kv.pop("valid_data", None)
     ds = lgb.Dataset(data_path, params=dict(kv))
-    py_bst = lgb.train(dict(kv), ds, 10, verbose_eval=False)
+    py_bst = lgb.train(dict(kv), ds, 6, verbose_eval=False)
 
     # ---- predictions agree to 5 decimals (reference standard) ----
     raw = np.loadtxt(data_path, delimiter="\t")

@@ -65,7 +65,7 @@ def auc_score(y, s):
 
 def train_timed(cfg_params, X, y):
     """Train BENCH_ITERS trees; returns (gbdt, cfg, dtrain, prep_s,
-    compile_s, per_tree_s)."""
+    compile_s, per_tree_s, cold_total_s)."""
     import lightgbm_tpu as lgb
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
@@ -91,8 +91,13 @@ def train_timed(cfg_params, X, y):
     for _ in range(n_chunks):
         gbdt.train_chunk(chunk)
     drain()
-    per_tree = (time.time() - t0) / (n_chunks * chunk)
-    return gbdt, cfg, dtrain, prep_s, compile_s, per_tree
+    steady_s = time.time() - t0
+    per_tree = steady_s / (n_chunks * chunk)
+    # the economics a first-time user actually pays: dataset prep +
+    # first (compiling) chunk + the remaining chunks, as measured —
+    # NOT the warm per-tree extrapolation the headline `value` reports
+    cold_total_s = prep_s + compile_s + steady_s
+    return gbdt, cfg, dtrain, prep_s, compile_s, per_tree, cold_total_s
 
 
 def heldout_scores(gbdt, cfg, vbins_np):
@@ -159,8 +164,8 @@ def main():
         params.update(json.loads(extra))
 
     # ---- timed run (headline config) ----
-    gbdt, cfg, dtrain, prep_s, compile_s, per_tree = train_timed(
-        params, X, y)
+    (gbdt, cfg, dtrain, prep_s, compile_s, per_tree,
+     cold_total_s) = train_timed(params, X, y)
     total_equiv = per_tree * BENCH_ITERS
     vcore = lgb.Dataset(Xv, label=yv, reference=dtrain).construct(cfg)
     auc = auc_score(yv, heldout_scores(gbdt, cfg, vcore.group_bins))
@@ -175,7 +180,7 @@ def main():
         del gbdt, dtrain
         gc.collect()
         p32 = dict(params, quantized_grad=False)
-        g32, c32, d32, _, _, _ = train_timed(p32, X, y)
+        g32, c32, d32, _, _, _, _ = train_timed(p32, X, y)
         v32 = lgb.Dataset(Xv, label=yv, reference=d32).construct(c32)
         auc_f32 = auc_score(yv, heldout_scores(g32, c32, v32.group_bins))
 
@@ -194,6 +199,11 @@ def main():
         "auc": round(auc, 6),
         "auc_f32": round(auc_f32, 6),
         "auc_delta": round(delta, 6),
+        # honest cold-run economics (VERDICT r2 weak#1): `value` is the
+        # warm per-tree extrapolation; these are what a cold run pays
+        "prep_s": round(prep_s, 3),
+        "compile_s": round(compile_s, 3),
+        "cold_total_s": round(cold_total_s, 3),
     }
     print(json.dumps(result))
     # diagnostics on stderr so the stdout contract stays one line

@@ -57,6 +57,10 @@ class Booster:
                  init_model=None, custom_objective: bool = False):
         self.config = config or Config()
         self.gbdt = None
+        # set when host-side tree arrays are mutated after training
+        # (refit): the device-resident stacks are then stale and the
+        # batched device predict must not serve from them
+        self._device_stale = False
         self.best_iteration = -1
         self.models: List[Tree] = []
         self.feature_names: List[str] = []
@@ -280,7 +284,7 @@ class Booster:
         """Batch device predict is valid for single-class in-session
         models with uniform tree scaling (no DART renorm, no foreign
         init_model trees, not RF averaging)."""
-        if device is False or self.gbdt is None:
+        if device is False or self.gbdt is None or self._device_stale:
             return False
         g = self.gbdt
         ok = (self.num_tree_per_iteration == 1
@@ -570,6 +574,11 @@ class Booster:
                 tree.leaf_value[leaf] = out * shrink
                 tree.leaf_count[leaf] = int(mask.sum())
             scores[:, cls] += tree.leaf_value[lp]
+        # host trees diverged from the device stacks — the in-session
+        # device predict is disabled from here on (predict falls back
+        # to the host walk; a refitted model saved and re-loaded gets
+        # the loaded-model device path instead)
+        self._device_stale = True
         return self
 
     # ------------------------------------------------------------------

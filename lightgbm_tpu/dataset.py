@@ -315,8 +315,12 @@ class Dataset:
         if chunk.ndim == 1:
             chunk = chunk[None, :]
         self._bin_rows_dense(chunk, row_start)
-        self._pushed_rows = max(getattr(self, "_pushed_rows", 0),
-                                row_start + chunk.shape[0])
+        # actual pushed-row COUNT (not a high-water mark): chunks may
+        # arrive in any order (reference allows thread-partitioned
+        # arbitrary start_row), so only the sum of chunk sizes can tell
+        # when every row has arrived
+        self._pushed_rows = getattr(self, "_pushed_rows", 0) \
+            + chunk.shape[0]
 
     def push_rows_csr(self, indptr, indices, values,
                       row_start: int) -> None:
@@ -350,8 +354,7 @@ class Dataset:
                 keep = col != m.default_bin
                 self.group_bins[rr[keep], f.group] = gb[keep].astype(
                     np.uint8)
-        self._pushed_rows = max(getattr(self, "_pushed_rows", 0),
-                                row_start + nrows)
+        self._pushed_rows = getattr(self, "_pushed_rows", 0) + nrows
 
     def finish_load(self) -> "Dataset":
         """End of streaming pushes (reference FinishLoad)."""

@@ -558,12 +558,14 @@ def _hist_kernel_body_q_packed(bins_ref, wq_ref, leaf_ref, emat_ref,
                                bcol_ref, slots_ref, out_ref, *, strip,
                                strips, int8_bins):
     """On-the-fly packed kernel: the bin one-hot is rebuilt in VMEM per
-    block (HBM stream is just the ~17 bytes/row packed bins) AND the
+    block (HBM stream is just the ~G bytes/row packed bins) AND the
     weight channels share each 128-lane tile (see
-    _hist_kernel_body_pre_packed).  This is the cheapest formulation
-    measured on v5e: the streamed-one-hot variants are HBM-bound on the
-    G*B-byte/row one-hot, while this one is MXU/VPU-bound at
-    ~1.4 bytes/row of traffic per covered slot strip."""
+    _hist_kernel_body_pre_packed).  Regime (docs/ROOFLINE.md table):
+    this is the FALLBACK for datasets whose resident one-hot exceeds
+    the HBM budget — its VMEM rebuild (expansion matmul + full-width
+    compare) makes it VPU-bound and ~3.5x slower per pass than
+    streaming a resident one-hot at the bench shape, but its HBM
+    footprint is O(N*G) instead of O(N*G*B)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -621,81 +623,6 @@ def compute_group_histograms_q_packed(
         interpret=interpret, raw_out=True)
     hist = _unpack_strip_channels(out, strips, num_groups, max_group_bin)
     return hist.astype(jnp.float32) * scales[None, None, None, :]
-
-
-def _hist_kernel_body_pre_t(ohb_ref, wt_ref, leaf_ref, slots_ref, out_ref,
-                            *, m_pad, quant):
-    """Transposed-lhs variant: the (3*m_leaf, C) weighted one-hot is
-    BUILT row-major so the dot is a plain (M, K) @ (K, N) with no
-    in-kernel transpose.  leaf/weights arrive as (1, C)/(3, C) blocks."""
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    leaf = leaf_ref[:]                                   # (1, C) int32
-    wt = wt_ref[:]                                       # (3, C)
-    ohl = slots_ref[:] == leaf                           # (m_leaf, C)
-    if quant:
-        zero = jnp.zeros((), jnp.int32)
-        lhs = jnp.concatenate(
-            [jnp.where(ohl, wt[0:1, :], zero),
-             jnp.where(ohl, wt[1:2, :], zero),
-             jnp.where(ohl, wt[2:3, :], zero)], axis=0).astype(jnp.int8)
-        out_ref[:] += jax.lax.dot_general(
-            lhs, ohb_ref[:], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
-    else:
-        zero = jnp.zeros((), jnp.float32)
-        lhs = jnp.concatenate(
-            [jnp.where(ohl, wt[0:1, :], zero),
-             jnp.where(ohl, wt[1:2, :], zero),
-             jnp.where(ohl, wt[2:3, :], zero)], axis=0).astype(jnp.bfloat16)
-        out_ref[:] += jax.lax.dot_general(
-            lhs, ohb_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("num_leaves", "max_group_bin", "block",
-                              "quant", "interpret"))
-def compute_group_histograms_pre_t(
-        ohb: jax.Array, w: jax.Array, scales: Optional[jax.Array],
-        leaf_id: jax.Array, *, num_leaves: int, max_group_bin: int,
-        block: int = 2048, quant: bool = False, interpret: bool = False,
-        slots: Optional[jax.Array] = None) -> jax.Array:
-    """Transposed-operand streamed-one-hot histogram (same contract as
-    :func:`compute_group_histograms_pre`)."""
-    n, gb = ohb.shape
-    num_groups = gb // max_group_bin
-    num_leaves, m_leaf, m_pad, slot_row = _slot_prep(num_leaves, slots)
-    if n % block != 0:
-        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
-    slot_col = slot_row[0][:m_leaf][:, None]             # (m_leaf, 1)
-    wt = w.T                                             # (3, N)
-    leaf_row = leaf_id[None, :]                          # (1, N)
-    kern = functools.partial(_hist_kernel_body_pre_t, m_pad=m_pad,
-                             quant=quant)
-    out = pl.pallas_call(
-        kern,
-        grid=(n // block,),
-        in_specs=[
-            pl.BlockSpec((block, gb), lambda i: (i, 0)),
-            pl.BlockSpec((3, block), lambda i: (0, i)),
-            pl.BlockSpec((1, block), lambda i: (0, i)),
-            pl.BlockSpec((m_leaf, 1), lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((m_pad, gb), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((m_pad, gb),
-                                       jnp.int32 if quant else jnp.float32),
-        interpret=interpret,
-    )(ohb, wt, leaf_row, slot_col)
-    hist = out.reshape(3, m_leaf, num_groups, max_group_bin)[:, :num_leaves]
-    hist = jnp.transpose(hist, (1, 2, 3, 0))
-    if quant:
-        hist = hist.astype(jnp.float32) * scales[None, None, None, :]
-    return hist
 
 
 PACKED_STRIP = 42  # 3 channels x 42 slots fit one 128-lane tile

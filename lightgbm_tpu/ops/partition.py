@@ -151,8 +151,10 @@ def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
     kernel runs the same table in VMEM)."""
     n, num_groups = bins.shape
     if num_groups >= 65536:  # fg // 256 must stay bf16-exact
-        raise ValueError("apply_splits supports at most 65535 feature "
-                         f"groups, got {num_groups}")
+        raise ValueError(
+            "apply_route_table (split routing) supports at most 65535 "
+            f"feature groups, got {num_groups} — the route table encodes "
+            "the group index as two bf16-exact bytes (hi/lo)")
     L = table.shape[0]
     safe_l = jnp.clip(leaf_id, 0, L - 1)
     ohl = (safe_l[:, None]

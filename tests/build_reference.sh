@@ -1,0 +1,19 @@
+#!/bin/sh
+# Build the reference LightGBM CLI (CPU-only) into .refbuild/ so the
+# interop parity tests (tests/test_reference_parity.py) can run.  The
+# binary is deliberately NOT committed to git (opaque 1.7 MB ELF,
+# platform-specific); run this once per checkout:
+#
+#   sh tests/build_reference.sh [/path/to/reference]
+#
+# Takes a few minutes on one core.
+set -e
+REF_SRC="${1:-/root/reference}"
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+BUILD_DIR="$REPO_ROOT/.refbuild"
+mkdir -p "$BUILD_DIR"
+cd "$BUILD_DIR"
+cmake -DCMAKE_BUILD_TYPE=Release -DUSE_OPENMP=ON "$REF_SRC" \
+    > cmake.log 2>&1
+make -j"$(nproc)" lightgbm > make.log 2>&1
+echo "built: $BUILD_DIR/lightgbm"

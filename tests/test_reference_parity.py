@@ -1,8 +1,8 @@
 """True interop tests against the ACTUAL reference binary.
 
-The reference CLI (v2.1.1) is built CPU-only into .refbuild/ (cmake
-/root/reference; binary relocated into the repo).  These tests convert
-"claimed-compatible" into "proven":
+The reference CLI (v2.1.1) is built CPU-only into .refbuild/ (run
+``sh tests/build_reference.sh`` once per checkout — the binary is not
+committed).  These tests convert "claimed-compatible" into "proven":
   * a model file produced by the reference binary loads through
     ``Booster(model_file=...)`` and predicts identically to the
     reference's own ``task=predict`` output (5-decimal standard of the
@@ -10,8 +10,8 @@ The reference CLI (v2.1.1) is built CPU-only into .refbuild/ (cmake
   * a model file produced by THIS framework is accepted by the
     reference binary and predicts identically there.
 
-Skipped when the binary is absent (e.g. a fresh clone without the
-.refbuild step: ``cmake /root/reference && make lightgbm``).
+Skipped when the binary is absent (fresh clone): build it with
+``sh tests/build_reference.sh``.
 """
 import os
 import subprocess
@@ -26,7 +26,8 @@ REF_BIN = os.path.join(os.path.dirname(__file__), "..", ".refbuild",
 REF_EXAMPLES = "/root/reference/examples"
 
 pytestmark = pytest.mark.skipif(
-    not os.path.exists(REF_BIN), reason="reference binary not built")
+    not os.path.exists(REF_BIN),
+    reason="reference binary not built — run: sh tests/build_reference.sh")
 
 
 def _run_ref(cwd, *args):

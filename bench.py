@@ -172,7 +172,8 @@ def main():
 
     # ---- accuracy reference: the f32 (non-quantized) path ----
     auc_f32 = auc
-    if params.get("quantized_grad"):
+    if params.get("quantized_grad") and \
+            os.environ.get("BENCH_SKIP_F32") != "1":
         # free the timed run's device state (streamed one-hot etc.)
         # before the second training run — two runs' buffers don't
         # co-reside in HBM at 1M rows

@@ -150,6 +150,10 @@ class Booster:
 
     def rollback_one_iter(self):
         self.gbdt.rollback_one_iter()
+        # a later update() can restore the same tree COUNT with a
+        # different tree — a length-keyed stack cache would serve the
+        # rolled-back ensemble
+        self._raw_stack_cache = None
 
     def _sync_models(self) -> None:
         """Materialize any device-resident trees into self.models
@@ -217,12 +221,24 @@ class Booster:
         n = data.shape[0]
         k = max(self.num_tree_per_iteration, 1)
 
-        if (not pred_leaf and not pred_contrib and not pred_early_stop
-                and self._can_device_predict(n, num_iteration, device)):
-            raw = self._device_predict_raw(data, num_iteration)[:, None]
-            if not raw_score and not self.average_output:
-                raw = self._convert_output(raw)
-            return raw[:, 0]
+        if not pred_leaf and not pred_contrib and not pred_early_stop:
+            if self._can_device_predict(n, num_iteration, device):
+                # in-session single-class fast path: binned device scan
+                raw = self._device_predict_raw(data, num_iteration)[:, None]
+                if not raw_score and not self.average_output:
+                    raw = self._convert_output(raw)
+                return raw[:, 0]
+            if self._can_device_predict_loaded(n, num_iteration, device):
+                # every OTHER model kind (file-loaded, multiclass, DART
+                # -renormalized, init_model-merged, RF): raw-feature
+                # stacked walk (reference c_api.cpp:177-211 batch
+                # predict covers all models; so does this)
+                raw, used = self._device_predict_loaded(data,
+                                                        num_iteration)
+                raw = self._add_init_and_average(raw, used)
+                if not raw_score and not self.average_output:
+                    raw = self._convert_output(raw)
+                return raw[:, 0] if k == 1 else raw
 
         models = self._used_models(num_iteration)
 
@@ -354,6 +370,52 @@ class Booster:
                 total = acc_jit(total, part, sh)
                 i += 1
         return np.asarray(total)
+
+    def _can_device_predict_loaded(self, n: int, num_iteration: int,
+                                   device: Optional[bool]) -> bool:
+        """Raw-feature stacked device predict: valid for any model with
+        host trees (loaded, multiclass, DART, init_model, RF)."""
+        if device is False:
+            return False
+        total = len(self.models) or (
+            len(self.gbdt.device_trees) if self.gbdt is not None else 0)
+        if total == 0:
+            return False
+        if device is True:
+            return True
+        import jax
+        n_trees = self._resolve_tree_count(total, num_iteration)
+        return (jax.default_backend() in ("tpu", "axon")
+                and n * n_trees >= 2_000_000)
+
+    def _device_predict_loaded(self, data: np.ndarray,
+                               num_iteration: int):
+        """Raw scores via the stacked raw-feature walk.  Returns
+        ((n, k) float64 raw scores, used tree count).  Accumulation is
+        float32 (documented device-predict precision); decisions match
+        the host walk exactly via the two-float threshold compare."""
+        import jax
+        import jax.numpy as jnp
+
+        from .ops.predict import (predict_raw_ensemble, split_hi_lo,
+                                  stack_host_trees)
+
+        self._sync_models()
+        count = self._resolve_tree_count(len(self.models), num_iteration)
+        cache = getattr(self, "_raw_stack_cache", None)
+        if cache is None or cache[0] != len(self.models):
+            cache = (len(self.models), stack_host_trees(self.models))
+            self._raw_stack_cache = cache
+        stack = cache[1]
+        if count < len(self.models):
+            stack = jax.tree_util.tree_map(lambda x: x[:count], stack)
+        k = max(self.num_tree_per_iteration, 1)
+        cls = jnp.arange(count, dtype=jnp.int32) % k
+        Xhi, Xlo = split_hi_lo(data)
+        out = predict_raw_ensemble(
+            stack, jnp.asarray(Xhi), jnp.asarray(Xlo), cls,
+            jnp.zeros((k, data.shape[0]), jnp.float32))
+        return np.asarray(out).T.astype(np.float64), count
 
     def _used_models(self, num_iteration: int) -> List[Tree]:
         self._sync_models()
@@ -542,7 +604,11 @@ class Booster:
         if self.num_tree_per_iteration > 1:
             params.setdefault("num_class", self.num_tree_per_iteration)
         config = Config.from_params(params)
-        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        from .basic import _is_sparse
+        if not _is_sparse(data):
+            # sparse stays sparse — refit only reads the data through
+            # predict(pred_leaf=True), which densifies in bounded chunks
+            data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
         n = data.shape[0]
         objective = create_objective(config)
         meta = Metadata(n)
@@ -574,11 +640,11 @@ class Booster:
                 tree.leaf_value[leaf] = out * shrink
                 tree.leaf_count[leaf] = int(mask.sum())
             scores[:, cls] += tree.leaf_value[lp]
-        # host trees diverged from the device stacks — the in-session
-        # device predict is disabled from here on (predict falls back
-        # to the host walk; a refitted model saved and re-loaded gets
-        # the loaded-model device path instead)
+        # host trees diverged from the in-session device stacks;
+        # invalidate both device paths' caches (the raw-stack path
+        # rebuilds from the refitted host trees on next use)
         self._device_stale = True
+        self._raw_stack_cache = None
         return self
 
     # ------------------------------------------------------------------

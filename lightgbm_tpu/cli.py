@@ -89,7 +89,7 @@ def _task_predict(params, config: Config) -> None:
         pred_leaf=config.is_predict_leaf_index,
         pred_contrib=config.is_predict_contrib)
     out = np.atleast_2d(np.asarray(pred))
-    if out.shape[0] == 1 and len(X) != 1:
+    if out.shape[0] == 1 and X.shape[0] != 1:
         out = out.T
     with open(config.output_result, "w") as f:
         for row in (out if out.ndim > 1 else out[:, None]):

@@ -299,6 +299,11 @@ class Config:
     hist_onehot_budget_mb: int = 4096  # HBM budget for the streamed
     # (N, G*B) int8 bin one-hot; datasets over budget rebuild the
     # one-hot in-kernel per round instead
+    hist_onehot_pack: int = 0       # one-hot columns per stored byte
+    # (planar sub-byte packing, widened in-VMEM by the kernels): 1, 2
+    # or 4; 0 = auto — the largest pack dividing G*B that fits the
+    # budget, which both cuts the per-pass HBM stream and lets
+    # HIGGS-scale (10.5M-row) one-hots stay resident on a 16 GB chip
     hist_quant_onthefly: bool = True  # quantized path: rebuild the bin
     # one-hot in-kernel (packed int8 lanes) instead of streaming the
     # (N, G*B) one-hot from HBM — B x less HBM traffic per round

@@ -300,31 +300,44 @@ def _resolve_single(spec: str, names: Optional[List[str]],
     return default
 
 
-def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
-    labels = []
-    rows: List[Dict[int, float]] = []
+def _load_libsvm(path: str):
+    """Parse a libsvm file to CSR (reference src/io/parser.hpp:87-126
+    LibSVMParser).  Memory is bounded by nnz — the dense (N, max_feat)
+    matrix is never materialized, so a wide 99%-sparse file (news20:
+    15k x 1.3M) parses in ~nnz floats instead of OOMing; downstream
+    Dataset construction walks the CSC columns (dataset.py
+    _bin_data_sparse) without densifying either."""
+    from array import array
+
+    from scipy import sparse as sp
+
+    labels = array("d")
+    indptr = array("q", [0])
+    indices = array("q")
+    values = array("d")
     max_feat = -1
     with open(path) as f:
         for line in f:
-            line = line.strip()
-            if not line:
-                continue
             toks = line.split()
+            if not toks:
+                continue
             start = 0
             if ":" not in toks[0]:
                 labels.append(float(toks[0]))
                 start = 1
             else:
                 labels.append(0.0)
-            row = {}
             for t in toks[start:]:
                 k, v = t.split(":", 1)
                 idx = int(k)
-                row[idx] = float(v)
-                max_feat = max(max_feat, idx)
-            rows.append(row)
-    X = np.zeros((len(rows), max_feat + 1), dtype=np.float64)
-    for i, row in enumerate(rows):
-        for k, v in row.items():
-            X[i, k] = v
-    return X, np.asarray(labels, dtype=np.float64)
+                indices.append(idx)
+                values.append(float(v))
+                if idx > max_feat:
+                    max_feat = idx
+            indptr.append(len(indices))
+    X = sp.csr_matrix(
+        (np.frombuffer(values, dtype=np.float64),
+         np.frombuffer(indices, dtype=np.int64),
+         np.frombuffer(indptr, dtype=np.int64)),
+        shape=(len(labels), max_feat + 1))
+    return X, np.frombuffer(labels, dtype=np.float64)

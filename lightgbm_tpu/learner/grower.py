@@ -50,7 +50,9 @@ from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
                              compute_group_histograms_pre_packed,
                              compute_group_histograms_q_packed,
                              compute_leaf_totals, expand_feature_histograms,
-                             precompute_bin_onehot, quantize_gradients)
+                             precompute_bin_onehot,
+                             precompute_bin_onehot_packed,
+                             quantize_gradients)
 from ..ops.partition import (apply_route_table, apply_splits,
                              build_route_table)
 from ..ops.split import (SplitResult, build_cat_bitset,
@@ -300,8 +302,35 @@ class TreeGrower:
         # int8 bin one-hot once (it is constant for the whole training
         # run) and stream it through the kernel instead of rebuilding
         # it from the packed bins every round.  Gated on an HBM budget.
-        ohb_bytes = (self.n_padded * self.num_groups * self.max_group_bin)
+        # Sub-byte packing (hist_onehot_pack) stores `pack` one-hot
+        # columns per byte (planar layout, widened in-VMEM): pack-x
+        # less HBM footprint AND per-pass stream — at 10.5M x 28 x 63
+        # the full one-hot is 17.2 GB (over a 16 GB v5e) while pack=4
+        # is 4.3 GB and stays resident.
+        gbtot = self.num_groups * self.max_group_bin
         budget = int(getattr(config, "hist_onehot_budget_mb", 4096)) << 20
+
+        from ..ops.histogram import _round_up
+
+        def _ohb_bytes(p):
+            width = gbtot if p == 1 else _round_up(gbtot // p, 128)
+            return self.n_padded * width
+
+        pk_cfg = int(getattr(config, "hist_onehot_pack", 0) or 0)
+        if pk_cfg in (1, 2, 4) and gbtot % pk_cfg == 0:
+            self.ohb_pack = pk_cfg
+        else:
+            if pk_cfg:
+                Log.warning(f"hist_onehot_pack={pk_cfg} invalid for "
+                            f"G*B={gbtot}; auto-selecting")
+            # auto: the pack with the smallest resident/streamed bytes;
+            # ties break toward the SMALLER pack (less 128-lane plane
+            # padding waste — for small G*B packing is a pessimization
+            # and this reduces to pack=1)
+            self.ohb_pack = min(
+                (p for p in (1, 2, 4) if gbtot % p == 0),
+                key=lambda p: (_ohb_bytes(p), p))
+        ohb_bytes = _ohb_bytes(self.ohb_pack)
         # fused route+histogram kernel (single chip): the pending split
         # routing is applied INSIDE the next round's histogram pass, so
         # the separate per-round apply_splits pass disappears.  Needs
@@ -325,8 +354,13 @@ class TreeGrower:
         # the dynamic extent of its trace
         self._ohb_arg = None
         if self.use_pre_ohb:
-            self.ohb = precompute_bin_onehot(
-                self.bins, max_group_bin=self.max_group_bin)
+            if self.ohb_pack == 1:
+                self.ohb = precompute_bin_onehot(
+                    self.bins, max_group_bin=self.max_group_bin)
+            else:
+                self.ohb = precompute_bin_onehot_packed(
+                    self.bins, max_group_bin=self.max_group_bin,
+                    pack=self.ohb_pack)
         self._is_voting = (self.policy.mesh is not None
                            and config.tree_learner == "voting")
         self._train_tree = jax.jit(self._train_tree_impl)
@@ -545,7 +579,8 @@ class TreeGrower:
                     ohb, self.binsT, wT, scales, st.leaf_id,
                     st.route_tab, rights, max_group_bin=B,
                     block=self.pallas_block, strips=strips, quant=q,
-                    interpret=self._interp)
+                    interpret=self._interp, pack=self.ohb_pack,
+                    num_groups=self.num_groups)
                 cap = strips * PACKED_STRIP
                 if cap >= W:
                     return h[:W], leaf2
@@ -606,7 +641,8 @@ class TreeGrower:
             return compute_group_histograms_pre(
                 ohb, w, scales, leaf_id, num_leaves=L,
                 max_group_bin=B, block=self.pallas_block, quant=q,
-                slots=slots)
+                slots=slots, pack=self.ohb_pack,
+                num_groups=self.num_groups)
 
         if slots is None:
             return full(None)
@@ -614,7 +650,8 @@ class TreeGrower:
         def run_packed(strips):
             return compute_group_histograms_pre_packed(
                 ohb, w, scales, leaf_id, slots, max_group_bin=B,
-                block=self.pallas_block, strips=strips, quant=q)
+                block=self.pallas_block, strips=strips, quant=q,
+                pack=self.ohb_pack, num_groups=self.num_groups)
 
         return self._packed_dispatch(full, run_packed, slots,
                                      slots.shape[0])

@@ -427,11 +427,80 @@ def precompute_bin_onehot(bins: jax.Array, *,
     return oh.reshape(n, g * max_group_bin).astype(jnp.int8)
 
 
+@functools.partial(jax.jit, static_argnames=("max_group_bin", "pack"))
+def precompute_bin_onehot_packed(bins: jax.Array, *, max_group_bin: int,
+                                 pack: int) -> jax.Array:
+    """(N, G) uint8 -> (N, G*B/pack) int8 PLANAR sub-byte one-hot.
+
+    ``pack`` one-hot columns share each byte: byte j of a row carries
+    full-column ``p*GBp + j`` in bit-field p (GBp = G*B/pack, field
+    width 8/pack bits — each field holds 0 or 1).  The histogram
+    kernels widen the planes back in VMEM with shift+mask (int ops the
+    VPU does natively — the sub-byte MXU operands Mosaic rejects are
+    never needed) and run one dot per plane into a lane-aligned output
+    slice.  This cuts the streamed one-hot's HBM footprint AND
+    bandwidth pack-x: the 17.2 GB full one-hot of a HIGGS-scale
+    (10.5M x 28 x 63) dataset becomes 4.3 GB at pack=4 — it fits a
+    16 GB v5e with room for the training state.  G*B must divide by
+    pack (the grower's auto-selection guarantees it).
+
+    The returned plane width is padded up to a 128-lane multiple with
+    zero bytes so every widened plane — and every per-plane output
+    slice in the kernels — is tile-aligned (Mosaic rejects unaligned
+    lane slices)."""
+    n, g = bins.shape
+    gb = g * max_group_bin
+    if gb % pack:
+        raise ValueError(f"pack ({pack}) must divide G*B ({gb})")
+    gbp = gb // pack
+    gbp_pad = _round_up(gbp, 128)
+    bits = 8 // pack
+    shifts = jnp.asarray([1 << (p * bits) for p in range(pack)],
+                         dtype=jnp.int8)
+    biota = jnp.arange(max_group_bin, dtype=jnp.int32)
+    # row-chunked so the transient full-width one-hot stays ~100 MB
+    chunk = max(1, (1 << 27) // max(gb, 1))
+    chunk = min(n, max(256, (chunk // 256) * 256))
+    pad = (-n) % chunk
+    bins_p = jnp.pad(bins, ((0, pad), (0, 0)))
+
+    def one_chunk(bc):
+        oh = (bc.astype(jnp.int32)[:, :, None]
+              == biota[None, None, :]).astype(jnp.int8)
+        oh = oh.reshape(bc.shape[0], pack, gbp)
+        packed = jnp.einsum("cpj,p->cj", oh, shifts,
+                            preferred_element_type=jnp.int8)
+        return jnp.pad(packed, ((0, 0), (0, gbp_pad - gbp)))
+
+    out = jax.lax.map(one_chunk,
+                      bins_p.reshape(-1, chunk, g)).reshape(-1, gbp_pad)
+    return out[:n]
+
+
+def _unpack_ohb_planes(pk: jax.Array, pack: int, out_dtype):
+    """(C, GBp) planar-packed block -> list of ``pack`` (C, GBp) 0/1
+    planes in ``out_dtype`` (int8 for the quantized dot, bfloat16
+    otherwise).  In-VMEM widening: one int32 cast, then shift+mask per
+    plane — cheap VPU work against the pack-x HBM traffic saved."""
+    if pack == 1:
+        return [pk if out_dtype == jnp.int8 else pk.astype(out_dtype)]
+    bits = 8 // pack
+    pki = pk.astype(jnp.int32)
+    return [((pki >> (p * bits)) & 1).astype(out_dtype)
+            for p in range(pack)]
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
 def _hist_kernel_body_pre(ohb_ref, w_ref, leaf_ref, slots_ref, out_ref, *,
-                          m_pad, quant):
-    """Streamed-one-hot kernel body: HBM traffic is the (C, G*B) int8
+                          m_pad, quant, pack=1):
+    """Streamed-one-hot kernel body: HBM traffic is the (C, G*B[/pack])
     one-hot block (prefetched by the Pallas pipeline while the MXU
-    works), and the only compute is the lhs build + ONE dot."""
+    works), and the only compute is the lhs build + one dot per plane
+    (sub-byte planes widened in VMEM, see
+    precompute_bin_onehot_packed)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -447,22 +516,28 @@ def _hist_kernel_body_pre(ohb_ref, w_ref, leaf_ref, slots_ref, out_ref, *,
             [jnp.where(ohl, w[:, 0:1], zero),
              jnp.where(ohl, w[:, 1:2], zero),
              jnp.where(ohl, w[:, 2:3], zero)], axis=1).astype(jnp.int8)
-        out_ref[:] += jax.lax.dot_general(
-            lhs, ohb_ref[:], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
+        rdt, odt = jnp.int8, jnp.int32
     else:
         zero = jnp.zeros((), jnp.float32)
         lhs = jnp.concatenate(
             [jnp.where(ohl, w[:, 0:1], zero),
              jnp.where(ohl, w[:, 1:2], zero),
              jnp.where(ohl, w[:, 2:3], zero)], axis=1).astype(jnp.bfloat16)
-        out_ref[:] += jax.lax.dot_general(
-            lhs, ohb_ref[:].astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        rdt, odt = jnp.bfloat16, jnp.float32
+    gbp_pad = ohb_ref.shape[1]
+    for p, plane in enumerate(_unpack_ohb_planes(ohb_ref[:], pack, rdt)):
+        contrib = jax.lax.dot_general(
+            lhs, plane, (((0,), (0,)), ((), ())),
+            preferred_element_type=odt)
+        if pack == 1:
+            out_ref[:] += contrib
+        else:
+            out_ref[:, p * gbp_pad:(p + 1) * gbp_pad] += contrib
 
 
 def _hist_kernel_body_pre_packed(ohb_ref, w_ref, leaf_ref, slots_ref,
-                                 out_ref, *, strip, strips, quant):
+                                 out_ref, *, strip, strips, quant,
+                                 pack=1):
     """Channel-packed kernel: the three weight channels share each
     128-lane tile (lane = c*strip + l within a tile) instead of
     occupying three separate tiles, cutting the dot's output rows — and
@@ -471,7 +546,12 @@ def _hist_kernel_body_pre_packed(ohb_ref, w_ref, leaf_ref, slots_ref,
     this kernel serves EVERY round of tree growth (the reference's
     one-leaf-at-a-time learner has no analog — width adapts to the
     frontier the way its smaller/larger-leaf trick adapts to leaf
-    sizes, serial_tree_learner.cpp:505-507)."""
+    sizes, serial_tree_learner.cpp:505-507).
+
+    ``pack`` > 1: ohb_ref is the planar sub-byte one-hot
+    (precompute_bin_onehot_packed, plane width pre-padded to a lane
+    multiple); each widened plane dots into its own aligned
+    plane-width slice of out_ref."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -490,23 +570,32 @@ def _hist_kernel_body_pre_packed(ohb_ref, w_ref, leaf_ref, slots_ref,
                    jnp.where(lane < 2 * strip, w[:, 1:2], w[:, 2:3]))
     if quant:
         lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
-        out_ref[:] += jax.lax.dot_general(
-            lhs, ohb_ref[:], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
+        rdt, odt = jnp.int8, jnp.int32
     else:
         lhs = jnp.where(ohl, wl,
                         jnp.zeros((), jnp.float32)).astype(jnp.bfloat16)
-        out_ref[:] += jax.lax.dot_general(
-            lhs, ohb_ref[:].astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        rdt, odt = jnp.bfloat16, jnp.float32
+    gbp_pad = ohb_ref.shape[1]
+    planes = _unpack_ohb_planes(ohb_ref[:], pack, rdt)
+    for p, plane in enumerate(planes):
+        contrib = jax.lax.dot_general(
+            lhs, plane, (((0,), (0,)), ((), ())),
+            preferred_element_type=odt)
+        if pack == 1:
+            out_ref[:] += contrib
+        else:
+            out_ref[:, p * gbp_pad:(p + 1) * gbp_pad] += contrib
 
 
 def _run_hist_kernel_pre(kern, ohb, w, leaf_id, slot_row, *, block,
-                         m_pad, out_dtype, interpret):
+                         m_pad, out_dtype, interpret, out_cols=None):
     """pallas_call plumbing for the streamed-one-hot bodies: the (N,
-    G*B) one-hot is row-blocked like the weights; output is the
-    (m_pad, G*B) VMEM accumulator."""
-    n, gb = ohb.shape
+    G*B[/pack]) one-hot is row-blocked like the weights; output is the
+    (m_pad, out_cols) VMEM accumulator (out_cols = pack * plane
+    width for packed inputs, else the one-hot width)."""
+    n, gbc = ohb.shape
+    if out_cols is None:
+        out_cols = gbc
     if n % block != 0:
         raise ValueError(f"N ({n}) must be a multiple of block ({block})")
     slot_row = jnp.asarray(slot_row)
@@ -514,39 +603,59 @@ def _run_hist_kernel_pre(kern, ohb, w, leaf_id, slot_row, *, block,
         kern,
         grid=(n // block,),
         in_specs=[
-            pl.BlockSpec((block, gb), lambda i: (i, 0)),
+            pl.BlockSpec((block, gbc), lambda i: (i, 0)),
             pl.BlockSpec((block, w.shape[1]), lambda i: (i, 0)),
             pl.BlockSpec((block, 1), lambda i: (i, 0)),
             pl.BlockSpec(slot_row.shape, lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((m_pad, gb), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((m_pad, gb), out_dtype),
+        out_specs=pl.BlockSpec((m_pad, out_cols), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, out_cols), out_dtype),
         interpret=interpret,
     )(ohb, w, leaf_id[:, None], slot_row)
     return out
 
 
+def _departition_planes(out: jax.Array, pack: int, gb: int) -> jax.Array:
+    """(m_pad, pack*gbp_pad) per-plane-sliced accumulator ->
+    (m_pad, gb) full-width histogram (drops each plane's lane
+    padding)."""
+    if pack == 1:
+        return out
+    gbp = gb // pack
+    gbp_pad = out.shape[1] // pack
+    return jnp.concatenate(
+        [out[:, p * gbp_pad:p * gbp_pad + gbp] for p in range(pack)],
+        axis=1)
+
+
 @functools.partial(
     jax.jit, static_argnames=("num_leaves", "max_group_bin", "block",
-                              "quant", "interpret"))
+                              "quant", "interpret", "pack", "num_groups"))
 def compute_group_histograms_pre(
         ohb: jax.Array, w: jax.Array, scales: Optional[jax.Array],
         leaf_id: jax.Array, *, num_leaves: int, max_group_bin: int,
         block: int = 1024, quant: bool = False, interpret: bool = False,
-        slots: Optional[jax.Array] = None) -> jax.Array:
-    """Histogram from a precomputed (N, G*B) one-hot (same output
-    contract as :func:`compute_group_histograms`).  ``w`` is the (N, 3)
-    weight matrix — float32 (grad, hess, cnt) or int32 quantized (then
-    ``scales`` dequantizes the int32 accumulator)."""
-    gb = ohb.shape[1]
-    num_groups = gb // max_group_bin
+        slots: Optional[jax.Array] = None, pack: int = 1,
+        num_groups: Optional[int] = None) -> jax.Array:
+    """Histogram from a precomputed (N, G*B[/pack]) one-hot (same
+    output contract as :func:`compute_group_histograms`).  ``w`` is the
+    (N, 3) weight matrix — float32 (grad, hess, cnt) or int32 quantized
+    (then ``scales`` dequantizes the int32 accumulator).  ``pack`` > 1
+    requires ``num_groups``."""
+    if pack == 1:
+        num_groups = ohb.shape[1] // max_group_bin
+    elif num_groups is None:
+        raise ValueError("num_groups is required when pack > 1")
+    gb = num_groups * max_group_bin
     num_leaves, m_leaf, m_pad, slot_row = _slot_prep(num_leaves, slots)
     kern = functools.partial(_hist_kernel_body_pre, m_pad=m_pad,
-                             quant=quant)
+                             quant=quant, pack=pack)
     out = _run_hist_kernel_pre(
         kern, ohb, w, leaf_id, slot_row, block=block, m_pad=m_pad,
         out_dtype=jnp.int32 if quant else jnp.float32,
-        interpret=interpret)
+        interpret=interpret,
+        out_cols=None if pack == 1 else pack * ohb.shape[1])
+    out = _departition_planes(out, pack, gb)
     hist = out.reshape(3, m_leaf, num_groups, max_group_bin)[:, :num_leaves]
     hist = jnp.transpose(hist, (1, 2, 3, 0))
     if quant:
@@ -669,26 +778,35 @@ def _unpack_strip_channels(out: jax.Array, strips: int, num_groups: int,
 
 @functools.partial(
     jax.jit, static_argnames=("max_group_bin", "block", "strips", "quant",
-                              "interpret"))
+                              "interpret", "pack", "num_groups"))
 def compute_group_histograms_pre_packed(
         ohb: jax.Array, w: jax.Array, scales: Optional[jax.Array],
         leaf_id: jax.Array, slots: jax.Array, *, max_group_bin: int,
         block: int = 1024, strips: int = 1, quant: bool = False,
-        interpret: bool = False) -> jax.Array:
+        interpret: bool = False, pack: int = 1,
+        num_groups: Optional[int] = None) -> jax.Array:
     """Channel-packed streamed-one-hot histogram: ``slots`` must hold
     at most strips*PACKED_STRIP valid entries; returns
     (strips*PACKED_STRIP, G, B, 3) with the slot axis following the
-    (padded) ``slots`` order."""
-    gb = ohb.shape[1]
-    num_groups = gb // max_group_bin
+    (padded) ``slots`` order.  ``pack`` > 1 streams the planar
+    sub-byte one-hot from :func:`precompute_bin_onehot_packed`
+    (``num_groups`` is then required — the lane-padded plane width no
+    longer encodes G)."""
+    if pack == 1:
+        num_groups = ohb.shape[1] // max_group_bin
+    elif num_groups is None:
+        raise ValueError("num_groups is required when pack > 1")
+    gb = num_groups * max_group_bin
     slot_row = _pack_slot_tiles(slots, strips)[None, :]  # (1, 128*strips)
     kern = functools.partial(_hist_kernel_body_pre_packed,
                              strip=PACKED_STRIP, strips=strips,
-                             quant=quant)
+                             quant=quant, pack=pack)
     out = _run_hist_kernel_pre(
         kern, ohb, w, leaf_id, slot_row, block=block, m_pad=128 * strips,
         out_dtype=jnp.int32 if quant else jnp.float32,
-        interpret=interpret)
+        interpret=interpret,
+        out_cols=None if pack == 1 else pack * ohb.shape[1])
+    out = _departition_planes(out, pack, gb)
     hist = _unpack_strip_channels(out, strips, num_groups, max_group_bin)
     if quant:
         hist = hist.astype(jnp.float32) * scales[None, None, None, :]
@@ -697,7 +815,7 @@ def compute_group_histograms_pre_packed(
 
 def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
                        slots_ref, hist_ref, leaf_out_ref, *, strip,
-                       strips, quant, num_groups, nb):
+                       strips, quant, num_groups, nb, pack=1):
     """Route-then-histogram kernel: one row-block applies the PENDING
     per-leaf route table (the splits selected last round) to its rows,
     writes the new leaf ids, and accumulates the frontier histogram
@@ -782,32 +900,40 @@ def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
                    jnp.where(riota < 2 * strip, w[1:2, :], w[2:3, :]))
     if quant:
         lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
-        hist_ref[:] += jax.lax.dot_general(
-            lhs, ohb_ref[:], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
+        rdt, odt = jnp.int8, jnp.int32
     else:
         lhs = jnp.where(ohl, wl,
                         jnp.zeros((), jnp.float32)).astype(jnp.bfloat16)
-        hist_ref[:] += jax.lax.dot_general(
-            lhs, ohb_ref[:].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        rdt, odt = jnp.bfloat16, jnp.float32
+    gbp_pad = ohb_ref.shape[1]
+    for p, plane in enumerate(_unpack_ohb_planes(ohb_ref[:], pack, rdt)):
+        contrib = jax.lax.dot_general(
+            lhs, plane, (((1,), (0,)), ((), ())),
+            preferred_element_type=odt)
+        if pack == 1:
+            hist_ref[:] += contrib
+        else:
+            hist_ref[:, p * gbp_pad:(p + 1) * gbp_pad] += contrib
 
 
 @functools.partial(
     jax.jit, static_argnames=("max_group_bin", "block", "strips", "quant",
-                              "interpret"))
+                              "interpret", "pack", "num_groups"))
 def compute_group_histograms_fused(
         ohb: jax.Array, binsT: jax.Array, wT: jax.Array,
         scales: Optional[jax.Array], leaf_id: jax.Array,
         route_tab: jax.Array, slots: jax.Array, *, max_group_bin: int,
         block: int = 2048, strips: int = 1, quant: bool = False,
-        interpret: bool = False):
+        interpret: bool = False, pack: int = 1,
+        num_groups: Optional[int] = None):
     """Fused route+histogram: returns ``(hist, new_leaf)`` where
     ``hist`` is (strips*PACKED_STRIP, G, B, 3) following (padded)
     ``slots`` order and ``new_leaf`` the (N,) post-route leaf ids.
 
     Args:
-      ohb: (N, G*B) int8 streamed bin one-hot.
+      ohb: (N, G*B) int8 streamed bin one-hot, or its (N, G*B/pack)
+        planar sub-byte packing when ``pack`` > 1 (``num_groups`` is
+        then required).
       binsT: (G, N) uint8 TRANSPOSED packed bins (routing reads the
         chosen group's bin per row as a lane vector).
       wT: (3, N) weight channels — float32 (grad, hess, cnt) or int32
@@ -818,8 +944,13 @@ def compute_group_histograms_fused(
         nothing (active column = 0).
       slots: (W,) int32 frontier slots, W <= strips*PACKED_STRIP.
     """
-    n, gb_cols = ohb.shape
-    num_groups = gb_cols // max_group_bin
+    n, ohb_cols = ohb.shape
+    if pack == 1:
+        num_groups = ohb_cols // max_group_bin
+    elif num_groups is None:
+        raise ValueError("num_groups is required when pack > 1")
+    gb = num_groups * max_group_bin
+    out_cols = ohb_cols if pack == 1 else pack * ohb_cols
     if n % block != 0:
         raise ValueError(f"N ({n}) must be a multiple of block ({block})")
     slot_col = _pack_slot_tiles(slots, strips)[:, None]  # (128*strips, 1)
@@ -831,12 +962,12 @@ def compute_group_histograms_fused(
 
     kern = functools.partial(_fused_kernel_body, strip=PACKED_STRIP,
                              strips=strips, quant=quant,
-                             num_groups=num_groups, nb=K - 15)
+                             num_groups=num_groups, nb=K - 15, pack=pack)
     hist, leaf_out = pl.pallas_call(
         kern,
         grid=(n // block,),
         in_specs=[
-            pl.BlockSpec((block, gb_cols), lambda i: (i, 0)),
+            pl.BlockSpec((block, ohb_cols), lambda i: (i, 0)),
             pl.BlockSpec((num_groups, block), lambda i: (0, i)),
             pl.BlockSpec((3, block), lambda i: (0, i)),
             pl.BlockSpec((1, block), lambda i: (0, i)),
@@ -844,16 +975,17 @@ def compute_group_histograms_fused(
             pl.BlockSpec(slot_col.shape, lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((m_pad, gb_cols), lambda i: (0, 0)),
+            pl.BlockSpec((m_pad, out_cols), lambda i: (0, 0)),
             pl.BlockSpec((1, block), lambda i: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((m_pad, gb_cols),
+            jax.ShapeDtypeStruct((m_pad, out_cols),
                                  jnp.int32 if quant else jnp.float32),
             jax.ShapeDtypeStruct((1, n), jnp.int32),
         ],
         interpret=interpret,
     )(ohb, binsT, wT, leaf_id[None, :], routeT, slot_col)
+    hist = _departition_planes(hist, pack, gb)
     out = _unpack_strip_channels(hist, strips, num_groups,
                                  max_group_bin).astype(jnp.float32)
     if quant:

@@ -1,0 +1,157 @@
+"""Microbench: streamed one-hot histogram kernels vs sub-byte packing.
+
+Run on a real TPU chip.  Compares per-pass time of the channel-packed
+streamed-one-hot kernel (and the fused route+hist kernel) at
+pack = 1 / 2 / 4 against the on-the-fly quantized kernel, at the bench
+shape (1M x 28 groups x 63 bins, 42-slot frontier strip).  Correctness
+is asserted against the pack=1 result before timing.
+
+Usage: python scripts/kbench_pack.py [rows]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import (
+    PACKED_STRIP, compute_group_histograms_fused,
+    compute_group_histograms_pre_packed, compute_group_histograms_q_packed,
+    precompute_bin_onehot, precompute_bin_onehot_packed)
+from lightgbm_tpu.ops.partition import ROUTE_FIXED_COLS
+
+
+def bench(fn, *args, reps=10, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    if reps == 1:
+        # big-output case: don't keep two results alive at once
+        out = None
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0, out
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args, **kw))
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_003_520
+    g, b = 28, 63
+    gb = g * b
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, b, (n, g), dtype=np.uint8))
+    binsT = jnp.asarray(np.asarray(bins).T)
+    leaf = jnp.asarray(rng.randint(0, PACKED_STRIP, n, dtype=np.int32))
+    wq = jnp.asarray(
+        np.stack([rng.randint(-127, 128, n), rng.randint(0, 128, n),
+                  np.ones(n)], axis=1).astype(np.int32))
+    scales = jnp.ones(3, jnp.float32)
+    slots = jnp.arange(PACKED_STRIP, dtype=jnp.int32)
+
+    t, ohb1 = bench(precompute_bin_onehot, bins, max_group_bin=b, reps=1)
+    print(f"precompute pack=1: {t*1e3:.1f} ms  {ohb1.nbytes/2**20:.0f} MB")
+    packs = {1: ohb1}
+    for pk in (2, 4):
+        if gb % pk:
+            continue
+        t, o = bench(precompute_bin_onehot_packed, bins, max_group_bin=b,
+                     pack=pk, reps=1)
+        print(f"precompute pack={pk}: {t*1e3:.1f} ms "
+              f"{o.nbytes/2**20:.0f} MB")
+        packs[pk] = o
+
+    # per-call walls on the remote-attached chip carry ~60-100 ms of
+    # dispatch overhead; real training amortizes it inside one jitted
+    # while_loop, so each kernel is timed as 20 passes inside ONE jit
+    # (slots rolled per iteration to defeat loop-hoisting/CSE)
+    LOOPS = 20
+
+    import functools as ft
+
+    def loop_time(call, *args):
+        # each iteration's slots depend on the previous histogram so the
+        # loop body cannot be overlapped/elided (matches training, where
+        # round i+1's frontier depends on round i's splits)
+        @jax.jit
+        def many(*a):
+            def body(i, carry):
+                acc, s = carry
+                h = call(s, *a)
+                v = h[0, 0, 0, 0]
+                bump = jnp.where(jnp.isfinite(v), 0, 1).astype(jnp.int32)
+                return acc + v, jnp.roll(slots + bump, i)
+            out, _ = jax.lax.fori_loop(0, LOOPS, body,
+                                       (jnp.float32(0.0), slots))
+            return out
+        jax.block_until_ready(many(*args))
+        t0 = time.perf_counter()
+        jax.block_until_ready(many(*args))
+        return (time.perf_counter() - t0) / LOOPS
+
+    ref = None
+    print("\n-- pre_packed (streamed, strips=1, quant) --")
+    for pk, ohb in packs.items():
+        h = compute_group_histograms_pre_packed(
+            ohb, wq, scales, leaf, slots, max_group_bin=b, block=2048,
+            strips=1, quant=True, pack=pk, num_groups=g)
+        if ref is None:
+            ref = np.asarray(h)
+        else:
+            err = np.abs(np.asarray(h) - ref).max()
+            assert err == 0.0, f"pack={pk} mismatch {err}"
+        t = loop_time(
+            lambda s, o, pk=pk: compute_group_histograms_pre_packed(
+                o, wq, scales, leaf, s, max_group_bin=b, block=2048,
+                strips=1, quant=True, pack=pk, num_groups=g), ohb)
+        print(f"pack={pk}: {t*1e3:.2f} ms/pass")
+
+    print("\n-- q_packed (on-the-fly rebuild, quant) --")
+    h = compute_group_histograms_q_packed(bins, wq, scales, leaf, slots,
+                                          max_group_bin=b, block=2048,
+                                          strips=1)
+    err = np.abs(np.asarray(h) - ref).max()
+    assert err == 0.0, f"otf mismatch {err}"
+    t = loop_time(lambda s, bn: compute_group_histograms_q_packed(
+        bn, wq, scales, leaf, s, max_group_bin=b, block=2048, strips=1),
+        bins)
+    print(f"otf: {t*1e3:.2f} ms/pass")
+
+    print("\n-- fused route+hist (strips=1, quant) --")
+    nb = 15 + (b + 7) // 8
+    route = jnp.zeros((255, nb), jnp.float32)  # inactive: route no-op
+    wT = jnp.asarray(np.asarray(wq).T)
+    ref_f = None
+    for pk, ohb in packs.items():
+        h, lf = compute_group_histograms_fused(
+            ohb, binsT, wT, scales, leaf, route, slots, max_group_bin=b,
+            block=2048, strips=1, quant=True, pack=pk, num_groups=g)
+        if ref_f is None:
+            ref_f = np.asarray(h)
+            assert np.array_equal(np.asarray(lf), np.asarray(leaf))
+        else:
+            err = np.abs(np.asarray(h) - ref_f).max()
+            assert err == 0.0, f"fused pack={pk} mismatch {err}"
+        t = loop_time(
+            lambda s, o, pk=pk: compute_group_histograms_fused(
+                o, binsT, wT, scales, leaf, route, s, max_group_bin=b,
+                block=2048, strips=1, quant=True, pack=pk,
+                num_groups=g)[0], ohb)
+        print(f"pack={pk}: {t*1e3:.2f} ms/pass")
+    err = np.abs(ref_f - ref).max()
+    assert err == 0.0, f"fused vs pre mismatch {err}"
+    print("\nall correctness checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Raw-feature stacked device predict parity: every model kind the
+in-session binned path cannot serve (file-loaded, multiclass, DART,
+init_model-merged, categorical, refit) must produce scores matching the
+host per-tree walk (reference c_api.cpp:177-211 batch predict covers
+every model; so must the device path).  The walk itself is pure XLA, so
+``device=True`` exercises the identical code on the CPU backend."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _task(n=600, f=8, seed=0, with_nan=True, with_cat=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    if with_cat:
+        X[:, -1] = rng.randint(0, 12, n)
+    if with_nan:
+        X[rng.rand(n, f) < 0.05] = np.nan
+        if with_cat:
+            X[:, -1] = np.where(np.isnan(X[:, -1]),
+                                rng.randint(0, 12, n), X[:, -1])
+    y = (np.nan_to_num(X[:, 0]) - 0.5 * np.nan_to_num(X[:, 1])
+         + 0.1 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def _assert_device_matches_host(bst, X, **kw):
+    host = bst.predict(X, device=False, **kw)
+    dev = bst.predict(X, device=True, **kw)
+    np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-7)
+
+
+def test_loaded_model_device_predict(tmp_path):
+    X, y = _task()
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 31, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), 20, verbose_eval=False)
+    fn = str(tmp_path / "m.txt")
+    bst.save_model(fn)
+    loaded = lgb.Booster(model_file=fn)
+    _assert_device_matches_host(loaded, X)
+    _assert_device_matches_host(loaded, X, raw_score=True)
+    # num_iteration slicing resolves identically on both paths
+    _assert_device_matches_host(loaded, X, num_iteration=7)
+
+
+def test_multiclass_device_predict():
+    X, y2 = _task(with_nan=False)
+    y = (np.digitize(X[:, 0], [-0.5, 0.5])).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbose": -1, "num_leaves": 15},
+                    lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    host = bst.predict(X, device=False)
+    dev = bst.predict(X, device=True)
+    assert dev.shape == (X.shape[0], 3)
+    np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-6)
+
+
+def test_dart_device_predict():
+    X, y = _task(with_nan=False)
+    bst = lgb.train({"objective": "binary", "boosting": "dart",
+                     "verbose": -1, "num_leaves": 15, "drop_rate": 0.5,
+                     "seed": 3}, lgb.Dataset(X, label=y), 10,
+                    verbose_eval=False)
+    _assert_device_matches_host(bst, X)
+
+
+def test_categorical_device_predict():
+    X, y = _task(with_cat=True)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5,
+                     "max_cat_to_onehot": 2},
+                    lgb.Dataset(X, label=y,
+                                categorical_feature=[X.shape[1] - 1]),
+                    15, verbose_eval=False)
+    _assert_device_matches_host(bst, X)
+
+
+def test_init_model_merged_device_predict():
+    X, y = _task(with_nan=False)
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 15}
+    base = lgb.train(p, lgb.Dataset(X, label=y), 5, verbose_eval=False)
+    cont = lgb.train(p, lgb.Dataset(X, label=y), 5, verbose_eval=False,
+                     init_model=base)
+    _assert_device_matches_host(cont, X)
+
+
+def test_refit_then_device_predict():
+    X, y = _task(with_nan=False)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15}, lgb.Dataset(X, label=y), 8,
+                    verbose_eval=False)
+    bst.refit(X, y)
+    # refit invalidates the stale caches; the raw-stack path rebuilds
+    # from the refitted host trees
+    _assert_device_matches_host(bst, X)
+
+
+def test_midpoint_threshold_exactness():
+    """Rows landing exactly on the f32 neighbour of an f64 midpoint
+    threshold must route the same on device (two-float compare) as on
+    the host float64 walk."""
+    rng = np.random.RandomState(7)
+    # f32-representable data with adjacent values around every split
+    X = rng.randn(2000, 3).astype(np.float32).astype(np.float64)
+    y = (X[:, 0] > 0.1).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 63, "min_data_in_leaf": 1,
+                     "min_sum_hessian_in_leaf": 1e-3},
+                    lgb.Dataset(X, label=y), 10, verbose_eval=False)
+    leaf_host = bst.predict(X, pred_leaf=True)
+    # the device path must place every row in the same leaf: compare
+    # raw scores bitwise at f32 resolution
+    host = bst.predict(X, device=False, raw_score=True)
+    dev = bst.predict(X, device=True, raw_score=True)
+    np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-7)
+    assert leaf_host.shape[1] == 10

@@ -129,6 +129,82 @@ def test_fused_route_hist_quant_interpret():
     np.testing.assert_allclose(np.asarray(got_hist)[:4], want, rtol=1e-6)
 
 
+def test_subbyte_packed_onehot_matches_full():
+    """precompute_bin_onehot_packed planes widen back to the exact
+    full-width one-hot (planar layout + lane padding)."""
+    from lightgbm_tpu.ops.histogram import (precompute_bin_onehot,
+                                            precompute_bin_onehot_packed)
+    rng = np.random.RandomState(4)
+    N, G, B = 300, 4, 8
+    gb = G * B
+    bins = jnp.asarray(rng.randint(0, B, (N, G)).astype(np.uint8))
+    full = np.asarray(precompute_bin_onehot(bins, max_group_bin=B))
+    for pack in (2, 4):
+        gbp = gb // pack
+        gbp_pad = ((gbp + 127) // 128) * 128
+        packed = np.asarray(precompute_bin_onehot_packed(
+            bins, max_group_bin=B, pack=pack))
+        assert packed.shape == (N, gbp_pad)
+        bits = 8 // pack
+        for p in range(pack):
+            plane = (packed.astype(np.int32) >> (p * bits)) & 1
+            np.testing.assert_array_equal(
+                plane[:, :gbp], full[:, p * gbp:(p + 1) * gbp])
+            assert (plane[:, gbp:] == 0).all()
+
+
+def test_subbyte_streamed_kernels_match_pack1_interpret():
+    """pre / pre_packed / fused kernels give identical histograms from
+    the sub-byte packed one-hot (quant path: exact int accumulation)."""
+    from lightgbm_tpu.ops.histogram import (
+        PACKED_STRIP, compute_group_histograms_fused,
+        compute_group_histograms_pre, compute_group_histograms_pre_packed,
+        precompute_bin_onehot, precompute_bin_onehot_packed,
+        quantize_gradients)
+    rng = np.random.RandomState(6)
+    N, G, B, L = 512, 4, 8, 10
+    bins = rng.randint(0, B, (N, G)).astype(np.uint8)
+    grad = rng.randn(N).astype(np.float32)
+    hess = np.abs(rng.randn(N)).astype(np.float32)
+    cnt = np.ones(N, np.float32)
+    leaf = rng.randint(-1, 8, N).astype(np.int32)
+    wq, scales = quantize_gradients(jnp.asarray(grad), jnp.asarray(hess),
+                                    jnp.asarray(cnt))
+    slots = jnp.asarray(np.array([0, 3, 5, -1, 7, 2], np.int32))
+    tab = jnp.zeros((L, 15 + (B + 7) // 8), jnp.float32)
+    ohb1 = precompute_bin_onehot(jnp.asarray(bins), max_group_bin=B)
+    ref_pre = None
+    ref_pp = None
+    ref_fu = None
+    for pack in (1, 2, 4):
+        ohb = (ohb1 if pack == 1 else precompute_bin_onehot_packed(
+            jnp.asarray(bins), max_group_bin=B, pack=pack))
+        h_pre = np.asarray(compute_group_histograms_pre(
+            ohb, wq, scales, jnp.asarray(leaf), num_leaves=L,
+            max_group_bin=B, block=256, quant=True, slots=slots,
+            interpret=True, pack=pack, num_groups=G))
+        h_pp = np.asarray(compute_group_histograms_pre_packed(
+            ohb, wq, scales, jnp.asarray(leaf), slots, max_group_bin=B,
+            block=256, strips=1, quant=True, interpret=True, pack=pack,
+            num_groups=G))[:slots.shape[0]]
+        h_fu, lf = compute_group_histograms_fused(
+            ohb, jnp.asarray(bins.T), wq.T, scales, jnp.asarray(leaf),
+            tab, slots, max_group_bin=B, block=256, strips=1, quant=True,
+            interpret=True, pack=pack, num_groups=G)
+        h_fu = np.asarray(h_fu)[:slots.shape[0]]
+        np.testing.assert_array_equal(np.asarray(lf), leaf)
+        if pack == 1:
+            ref_pre, ref_pp, ref_fu = h_pre, h_pp, h_fu
+        else:
+            np.testing.assert_array_equal(h_pre, ref_pre)
+            np.testing.assert_array_equal(h_pp, ref_pp)
+            np.testing.assert_array_equal(h_fu, ref_fu)
+    # the three kernel families agree with each other (all outputs are
+    # slot-ordered; negative slots are zero rows everywhere)
+    np.testing.assert_allclose(ref_pp, ref_pre, rtol=1e-6)
+    np.testing.assert_allclose(ref_fu, ref_pre, rtol=1e-6)
+
+
 def test_fused_grower_wiring_interpret_matches_xla_path():
     """The TPU-only fused-route grower wiring (route_tab round-carry,
     exit-time apply_route_table, quantized weight transpose) runs on

@@ -58,6 +58,64 @@ lgb.load <- function(filename) {
   structure(list(handle = h), class = "lgb.Booster")
 }
 
+lgb.cv <- function(params, data, label, nrounds = 100L, nfold = 5L,
+                   eval = function(pred, y) mean((pred - y)^2),
+                   stratified = FALSE, seed = 0L) {
+  # k-fold cross validation over the raw matrix (reference
+  # R-package/R/lgb.cv.R); returns per-fold boosters + the eval score
+  # of each fold's held-out predictions
+  stopifnot(is.matrix(data), nrow(data) == length(label))
+  set.seed(seed)
+  n <- nrow(data)
+  if (stratified) {
+    # interleave within label groups so folds share the class balance
+    ord <- order(label, stats::runif(n))
+    folds <- integer(n)
+    folds[ord] <- rep_len(seq_len(nfold), n)
+  } else {
+    folds <- sample(rep_len(seq_len(nfold), n))
+  }
+  boosters <- vector("list", nfold)
+  scores <- numeric(nfold)
+  for (k in seq_len(nfold)) {
+    tr <- folds != k
+    dtrain <- lgb.Dataset(data[tr, , drop = FALSE], label = label[tr],
+                          params = params)
+    bst <- lgb.train(params, dtrain, nrounds)
+    pred <- predict(bst, data[!tr, , drop = FALSE])
+    scores[k] <- eval(pred, label[!tr])
+    boosters[[k]] <- bst
+    lgb.Dataset.free(dtrain)
+  }
+  structure(list(boosters = boosters, scores = scores,
+                 mean_score = mean(scores), sd_score = stats::sd(scores)),
+            class = "lgb.CVBooster")
+}
+
+lgb.importance <- function(booster) {
+  # split-count feature importances, parsed from the model text's
+  # "feature importances:" footer (same data the reference's
+  # lgb.importance reads via the dump; reference R-package/R/
+  # lgb.importance.R)
+  stopifnot(inherits(booster, "lgb.Booster"))
+  tmp <- tempfile(fileext = ".txt")
+  on.exit(unlink(tmp))
+  lgb.save(booster, tmp)
+  lines <- readLines(tmp)
+  at <- which(lines == "feature importances:")
+  if (length(at) == 0L) {
+    return(data.frame(Feature = character(0), Frequency = numeric(0),
+                      stringsAsFactors = FALSE))
+  }
+  body <- lines[(at[1] + 1L):length(lines)]
+  body <- body[grepl("=", body, fixed = TRUE)]
+  parts <- strsplit(body, "=", fixed = TRUE)
+  data.frame(
+    Feature = vapply(parts, `[`, character(1L), 1L),
+    Frequency = as.numeric(vapply(parts, `[`, character(1L), 2L)),
+    stringsAsFactors = FALSE)
+}
+
 lgb.Dataset.free <- function(dataset) {
   .Call("LGBM_R_DatasetFree", dataset$handle)
   invisible(NULL)

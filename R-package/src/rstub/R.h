@@ -1,0 +1,3 @@
+/* stub R.h — see Rinternals.h */
+#pragma once
+#include "Rinternals.h"

@@ -1,0 +1,58 @@
+/* Minimal R C-API stub — just enough of Rinternals to EXECUTE the
+ * .Call shim (lightgbm_R.cpp) outside an R interpreter.  The CI image
+ * has no R, so the shim is driven by a plain C host
+ * (tests/r_host_driver.c) against this implementation; where a real R
+ * exists, the same shim builds against the real headers unchanged
+ * (test_r_demo_trains_and_predicts).
+ *
+ * The SEXP model: one tagged struct covering the vector kinds the shim
+ * touches (real vectors, scalar ints, strings, external pointers). */
+#pragma once
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define REALSXP 14
+#define INTSXP 13
+#define CHARSXP 9
+#define EXTPTRSXP 22
+
+typedef struct SEXPREC {
+  int sexptype;
+  long length;
+  double* real;
+  int ival;
+  const char* str;
+  void* ptr;
+} SEXPREC;
+typedef struct SEXPREC* SEXP;
+
+extern SEXP R_NilValue;
+
+SEXP R_MakeExternalPtr(void* p, SEXP tag, SEXP prot);
+void* R_ExternalPtrAddr(SEXP h);
+void R_ClearExternalPtr(SEXP h);
+void Rf_error(const char* fmt, ...);
+int Rf_asInteger(SEXP x);
+SEXP Rf_asChar(SEXP x);
+const char* R_CHAR_impl(SEXP x);
+#define CHAR(x) R_CHAR_impl(x)
+int Rf_length(SEXP x);
+double* REAL(SEXP x);
+SEXP Rf_allocVector(unsigned type, long n);
+SEXP Rf_ScalarInteger(int v);
+
+/* GC protection is a no-op outside R */
+#define PROTECT(x) (x)
+#define UNPROTECT(n) ((void)(n))
+
+/* host-side helpers (not part of R's API; used by the C driver) */
+SEXP RStub_MakeReal(const double* v, long n);
+SEXP RStub_MakeInt(int v);
+SEXP RStub_MakeString(const char* s);
+
+#ifdef __cplusplus
+}
+#endif

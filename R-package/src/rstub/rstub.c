@@ -1,0 +1,84 @@
+/* Implementation of the minimal R C-API stub (see Rinternals.h). */
+#include "Rinternals.h"
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static SEXPREC nil_obj = {0, 0, NULL, 0, NULL, NULL};
+SEXP R_NilValue = &nil_obj;
+
+static SEXP new_sexp(int type) {
+  SEXP s = (SEXP)calloc(1, sizeof(SEXPREC));
+  if (!s) {
+    fprintf(stderr, "rstub: out of memory\n");
+    exit(3);
+  }
+  s->sexptype = type;
+  return s;
+}
+
+SEXP R_MakeExternalPtr(void* p, SEXP tag, SEXP prot) {
+  (void)tag;
+  (void)prot;
+  SEXP s = new_sexp(EXTPTRSXP);
+  s->ptr = p;
+  return s;
+}
+
+void* R_ExternalPtrAddr(SEXP h) { return h ? h->ptr : NULL; }
+
+void R_ClearExternalPtr(SEXP h) {
+  if (h) h->ptr = NULL;
+}
+
+void Rf_error(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "R error: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(3); /* a real R longjmps to the top level; the host just dies */
+}
+
+int Rf_asInteger(SEXP x) { return x->sexptype == REALSXP && x->length
+                                   ? (int)x->real[0] : x->ival; }
+
+SEXP Rf_asChar(SEXP x) { return x; }
+
+const char* R_CHAR_impl(SEXP x) { return x->str ? x->str : ""; }
+
+int Rf_length(SEXP x) { return (int)x->length; }
+
+double* REAL(SEXP x) { return x->real; }
+
+SEXP Rf_allocVector(unsigned type, long n) {
+  SEXP s = new_sexp((int)type);
+  s->length = n;
+  if (type == REALSXP) s->real = (double*)calloc((size_t)n, sizeof(double));
+  return s;
+}
+
+SEXP Rf_ScalarInteger(int v) {
+  SEXP s = new_sexp(INTSXP);
+  s->length = 1;
+  s->ival = v;
+  return s;
+}
+
+SEXP RStub_MakeReal(const double* v, long n) {
+  SEXP s = Rf_allocVector(REALSXP, n);
+  memcpy(s->real, v, (size_t)n * sizeof(double));
+  return s;
+}
+
+SEXP RStub_MakeInt(int v) { return Rf_ScalarInteger(v); }
+
+SEXP RStub_MakeString(const char* str) {
+  SEXP s = new_sexp(CHARSXP);
+  s->length = (long)strlen(str);
+  s->str = strdup(str);
+  return s;
+}

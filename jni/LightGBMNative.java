@@ -1,0 +1,45 @@
+package com.lightgbm.tpu;
+
+/**
+ * Java surface over the TPU framework's C ABI (liblgbm_tpu.so via
+ * liblgbm_tpu_jni.so) — the analog of the reference's SWIG-generated
+ * lightgbmlib (swig/lightgbmlib.i).
+ *
+ * Build (needs a JDK):
+ *   gcc -shared -fPIC -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+ *       jni/lightgbm_jni.c -L lightgbm_tpu/native -llgbm_tpu \
+ *       -Wl,-rpath,$PWD/lightgbm_tpu/native -o liblgbm_tpu_jni.so
+ *   javac jni/LightGBMNative.java
+ *
+ * Example:
+ *   long ds = LightGBMNative.datasetCreateFromMat(x, n, f,
+ *       "objective=binary");
+ *   LightGBMNative.datasetSetField(ds, "label", y);
+ *   long bst = LightGBMNative.boosterCreate(ds, "objective=binary");
+ *   for (int i = 0; i < 100; i++)
+ *       LightGBMNative.boosterUpdateOneIter(bst);
+ *   double[] pred = LightGBMNative.boosterPredictForMat(bst, x, n, f,
+ *       0, -1);
+ */
+public final class LightGBMNative {
+    static {
+        System.loadLibrary("lgbm_tpu_jni");
+    }
+
+    private LightGBMNative() {}
+
+    public static native long datasetCreateFromMat(
+        double[] data, int nrow, int ncol, String params);
+    public static native void datasetSetField(
+        long handle, String field, double[] data);
+    public static native void datasetFree(long handle);
+    public static native long boosterCreate(long dataset, String params);
+    public static native long boosterCreateFromModelfile(String filename);
+    public static native int boosterUpdateOneIter(long handle);
+    public static native void boosterSaveModel(
+        long handle, int numIteration, String filename);
+    public static native double[] boosterPredictForMat(
+        long handle, double[] data, int nrow, int ncol,
+        int predictType, int numIteration);
+    public static native void boosterFree(long handle);
+}

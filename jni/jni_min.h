@@ -1,0 +1,63 @@
+/* JNI header shim: with a real JDK the genuine <jni.h> is used (the
+ * binding then carries the exact ABI a JVM expects); without one, a
+ * minimal self-consistent subset lets the binding COMPILE AND RUN
+ * against the fake-JNIEnv host (tests/jni_host_driver.c) — every line
+ * of the JNI functions executes, no JVM required.  Mirrors the role of
+ * the reference's swig/lightgbmlib.i (which also just marshals arrays
+ * and strings over the LGBM_* C ABI). */
+#pragma once
+
+#if defined(__has_include)
+#if __has_include(<jni.h>)
+#define LGBM_TPU_REAL_JNI 1
+#include <jni.h>
+#endif
+#endif
+
+#ifndef LGBM_TPU_REAL_JNI
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef double jdouble;
+typedef uint8_t jboolean;
+typedef int32_t jsize;
+
+typedef struct _jobject* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jarray jdoubleArray;
+typedef jobject jthrowable;
+
+struct JNINativeInterface_;
+typedef const struct JNINativeInterface_* JNIEnv;
+
+/* only the slots the binding uses; the stub host fills them with its
+ * own implementations.  (Real-JVM builds never see this struct.) */
+struct JNINativeInterface_ {
+  jclass (*FindClass)(JNIEnv*, const char*);
+  jint (*ThrowNew)(JNIEnv*, jclass, const char*);
+  const char* (*GetStringUTFChars)(JNIEnv*, jstring, jboolean*);
+  void (*ReleaseStringUTFChars)(JNIEnv*, jstring, const char*);
+  jsize (*GetArrayLength)(JNIEnv*, jarray);
+  jdoubleArray (*NewDoubleArray)(JNIEnv*, jsize);
+  jdouble* (*GetDoubleArrayElements)(JNIEnv*, jdoubleArray, jboolean*);
+  void (*ReleaseDoubleArrayElements)(JNIEnv*, jdoubleArray, jdouble*,
+                                     jint);
+  void (*SetDoubleArrayRegion)(JNIEnv*, jdoubleArray, jsize, jsize,
+                               const jdouble*);
+};
+
+#define JNIEXPORT
+#define JNICALL
+#define JNI_ABORT 2
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* !LGBM_TPU_REAL_JNI */

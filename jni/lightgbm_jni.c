@@ -1,0 +1,191 @@
+/* JNI binding over the LGBM_* C ABI (liblgbm_tpu.so) — the TPU
+ * framework's analog of the reference's swig/lightgbmlib.i Java
+ * wrapper: marshal Java strings/arrays, forward to the C API, raise
+ * RuntimeException on nonzero status.
+ *
+ * Builds two ways:
+ *   - real JDK: gcc -shared -fPIC -I$JAVA_HOME/include ...
+ *     lightgbm_jni.c -llgbm_tpu  (jni_min.h defers to <jni.h>)
+ *   - no JDK (this CI image): the same file compiles against the
+ *     stub JNI subset and is EXECUTED by tests/jni_host_driver.c,
+ *     which fabricates a JNIEnv function table.
+ *
+ * Java class: com.lightgbm.tpu.LightGBMNative (jni/LightGBMNative.java)
+ */
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "jni_min.h"
+
+/* LGBM_* C ABI (lightgbm_tpu/native/include/lgbm_tpu_c_api.h) */
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+extern const char* LGBM_GetLastError(void);
+extern int LGBM_DatasetCreateFromMat(const void*, int, int32_t, int32_t,
+                                     int, const char*, DatasetHandle,
+                                     DatasetHandle*);
+extern int LGBM_DatasetSetField(DatasetHandle, const char*, const void*,
+                                int, int);
+extern int LGBM_DatasetFree(DatasetHandle);
+extern int LGBM_BoosterCreate(DatasetHandle, const char*, BoosterHandle*);
+extern int LGBM_BoosterCreateFromModelfile(const char*, int*,
+                                           BoosterHandle*);
+extern int LGBM_BoosterUpdateOneIter(BoosterHandle, int*);
+extern int LGBM_BoosterGetNumClasses(BoosterHandle, int*);
+extern int LGBM_BoosterGetCurrentIteration(BoosterHandle, int*);
+extern int LGBM_BoosterSaveModel(BoosterHandle, int, const char*);
+extern int LGBM_BoosterPredictForMat(BoosterHandle, const void*, int,
+                                     int32_t, int32_t, int, int, int,
+                                     const char*, int64_t*, double*);
+extern int LGBM_BoosterFree(BoosterHandle);
+
+#define C_API_DTYPE_FLOAT64 1
+
+static void throw_on_error(JNIEnv* env, int status) {
+  if (status != 0) {
+    jclass exc = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, exc, LGBM_GetLastError());
+  }
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetCreateFromMat(
+    JNIEnv* env, jclass cls, jdoubleArray data, jint nrow, jint ncol,
+    jstring params) {
+  (void)cls;
+  jdouble* d = (*env)->GetDoubleArrayElements(env, data, NULL);
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  DatasetHandle h = NULL;
+  int rc = LGBM_DatasetCreateFromMat(d, C_API_DTYPE_FLOAT64, nrow, ncol,
+                                     1 /* row-major (Java layout) */, p,
+                                     NULL, &h);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  (*env)->ReleaseDoubleArrayElements(env, data, d, JNI_ABORT);
+  throw_on_error(env, rc);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetSetField(
+    JNIEnv* env, jclass cls, jlong handle, jstring field,
+    jdoubleArray data) {
+  (void)cls;
+  const char* f = (*env)->GetStringUTFChars(env, field, NULL);
+  jsize n = (*env)->GetArrayLength(env, data);
+  jdouble* d = (*env)->GetDoubleArrayElements(env, data, NULL);
+  float* buf = (float*)malloc(sizeof(float) * (size_t)n);
+  for (jsize i = 0; i < n; ++i) buf[i] = (float)d[i];
+  int rc = LGBM_DatasetSetField((DatasetHandle)(intptr_t)handle, f, buf,
+                                (int)n, 0 /* float32 */);
+  free(buf);
+  (*env)->ReleaseDoubleArrayElements(env, data, d, JNI_ABORT);
+  (*env)->ReleaseStringUTFChars(env, field, f);
+  throw_on_error(env, rc);
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetFree(JNIEnv* env, jclass cls,
+                                                 jlong handle) {
+  (void)cls;
+  throw_on_error(env,
+                 LGBM_DatasetFree((DatasetHandle)(intptr_t)handle));
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterCreate(JNIEnv* env,
+                                                   jclass cls,
+                                                   jlong dataset,
+                                                   jstring params) {
+  (void)cls;
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  BoosterHandle h = NULL;
+  int rc = LGBM_BoosterCreate((DatasetHandle)(intptr_t)dataset, p, &h);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  throw_on_error(env, rc);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterCreateFromModelfile(
+    JNIEnv* env, jclass cls, jstring filename) {
+  (void)cls;
+  const char* f = (*env)->GetStringUTFChars(env, filename, NULL);
+  int iters = 0;
+  BoosterHandle h = NULL;
+  int rc = LGBM_BoosterCreateFromModelfile(f, &iters, &h);
+  (*env)->ReleaseStringUTFChars(env, filename, f);
+  throw_on_error(env, rc);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterUpdateOneIter(JNIEnv* env,
+                                                          jclass cls,
+                                                          jlong handle) {
+  (void)cls;
+  int finished = 0;
+  throw_on_error(env, LGBM_BoosterUpdateOneIter(
+      (BoosterHandle)(intptr_t)handle, &finished));
+  return (jint)finished;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterSaveModel(
+    JNIEnv* env, jclass cls, jlong handle, jint num_iteration,
+    jstring filename) {
+  (void)cls;
+  const char* f = (*env)->GetStringUTFChars(env, filename, NULL);
+  int rc = LGBM_BoosterSaveModel((BoosterHandle)(intptr_t)handle,
+                                 (int)num_iteration, f);
+  (*env)->ReleaseStringUTFChars(env, filename, f);
+  throw_on_error(env, rc);
+}
+
+JNIEXPORT jdoubleArray JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterPredictForMat(
+    JNIEnv* env, jclass cls, jlong handle, jdoubleArray data, jint nrow,
+    jint ncol, jint predict_type, jint num_iteration) {
+  (void)cls;
+  int num_class = 1;
+  throw_on_error(env, LGBM_BoosterGetNumClasses(
+      (BoosterHandle)(intptr_t)handle, &num_class));
+  if (num_class < 1) num_class = 1;
+  /* worst-case output size by predict type: 0/1 normal/raw ->
+   * nrow*num_class; 2 leaf index -> nrow*num_trees; 3 contrib ->
+   * nrow*(ncol+1)*num_class */
+  size_t per_row = (size_t)num_class;
+  if (predict_type == 2) {
+    int iters = 0;
+    throw_on_error(env, LGBM_BoosterGetCurrentIteration(
+        (BoosterHandle)(intptr_t)handle, &iters));
+    per_row = (size_t)(iters < 1 ? 1 : iters) * (size_t)num_class;
+  } else if (predict_type == 3) {
+    per_row = (size_t)(ncol + 1) * (size_t)num_class;
+  }
+  jdouble* d = (*env)->GetDoubleArrayElements(env, data, NULL);
+  double* out = (double*)malloc(sizeof(double) * (size_t)nrow * per_row);
+  int64_t out_len = 0;
+  int rc = LGBM_BoosterPredictForMat(
+      (BoosterHandle)(intptr_t)handle, d, C_API_DTYPE_FLOAT64, nrow,
+      ncol, 1 /* row-major */, (int)predict_type, (int)num_iteration,
+      "", &out_len, out);
+  (*env)->ReleaseDoubleArrayElements(env, data, d, JNI_ABORT);
+  if (rc != 0) {
+    free(out);
+    throw_on_error(env, rc);
+    return NULL;
+  }
+  jdoubleArray res = (*env)->NewDoubleArray(env, (jsize)out_len);
+  (*env)->SetDoubleArrayRegion(env, res, 0, (jsize)out_len, out);
+  free(out);
+  return res;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterFree(JNIEnv* env, jclass cls,
+                                                 jlong handle) {
+  (void)cls;
+  throw_on_error(env,
+                 LGBM_BoosterFree((BoosterHandle)(intptr_t)handle));
+}

@@ -15,6 +15,7 @@ on one.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -71,6 +72,19 @@ class GBDT:
             self.objective.init(train_set.metadata, self.num_data)
 
         self.grower = TreeGrower(train_set, config)
+        # multi-host (finalize_global): device metadata arrays must
+        # follow the assembled per-host-padded row layout, sharded
+        self._mh = self.grower._mh_local is not None
+        if self._mh and self.objective is not None:
+            if self.objective.is_renew_tree_output:
+                Log.fatal(
+                    "multi-host training does not support "
+                    "RenewTreeOutput objectives (l1/huber/quantile/"
+                    f"mape) yet — got {self.objective.name}; the "
+                    "percentile refit needs a global sort across hosts")
+            self.objective.repad_device_arrays(
+                lambda a: self.grower.policy.place_rows(
+                    self.grower.pad_rows(a)))
         self.models: List[Tree] = []
         self.device_trees: List[TreeArrays] = []   # kept for DART drops
         self.iter_ = 0
@@ -87,15 +101,14 @@ class GBDT:
             if abs(self.init_score) > 1e-15:
                 Log.info(f"Start training from score {self.init_score:f}")
 
-        n_pad = self.grower.n_padded
         base = np.zeros((self.num_class, self.num_data), dtype=np.float32)
         if has_init:
             base += train_set.metadata.init_score.reshape(
                 self.num_class, self.num_data).astype(np.float32)
         base += self.init_score
-        pad = np.zeros((self.num_class, n_pad - self.num_data),
-                       dtype=np.float32)
-        self.scores = jnp.asarray(np.concatenate([base, pad], axis=1))
+        padded = np.stack([self.grower.pad_rows(base[c])
+                           for c in range(self.num_class)])
+        self.scores = self.grower.policy.place_score_rows(padded)
 
         # per-phase wall-clock accounting (the TIMETAG analog,
         # reference gbdt.cpp:21-29/52-61); reported at Log.debug level
@@ -145,14 +158,62 @@ class GBDT:
 
         # row weights as count channel (bagging multiplies into this)
         w = train_set.metadata.weight
-        self._full_counts = jnp.asarray(self.grower.pad_rows(
-            np.ones(self.num_data, dtype=np.float32)))
-        self._weights_dev = (None if w is None else jnp.asarray(
-            self.grower.pad_rows(w.astype(np.float32))))
+        self._full_counts = self.grower.policy.place_rows(
+            self.grower.pad_rows(np.ones(self.num_data,
+                                         dtype=np.float32)))
+        self._weights_dev = (None if w is None else
+                             self.grower.policy.place_rows(
+                                 self.grower.pad_rows(
+                                     w.astype(np.float32))))
         self._bag_mask: Optional[jax.Array] = None
+
+        # multi-host: globally-sharded arrays may NOT be captured as
+        # jit closure constants (tracing fetches their value, which
+        # spans non-addressable devices) — they are threaded through
+        # the jit boundary as an explicit pytree argument and bound to
+        # their usual attributes for the dynamic extent of the trace
+        # (the grower's _ohb_arg pattern)
+        self._captives = None
+        if self._mh:
+            obj_caps = {}
+            if self.objective is not None:
+                obj_caps = {k: v for k, v in
+                            self.objective.__dict__.items()
+                            if k.endswith("_dev")
+                            and isinstance(v, jax.Array)}
+            self._captives = {
+                "bins": self.grower.bins,
+                "rv": self.grower._row_valid,
+                "fc": self._full_counts,
+                "w": self._weights_dev,
+                "obj": obj_caps,
+            }
+
+    @contextmanager
+    def _bound_captives(self, cap):
+        if cap is None:
+            yield
+            return
+        g, obj = self.grower, self.objective
+        saved = (g.bins, g._row_valid, self._full_counts,
+                 self._weights_dev,
+                 {k: obj.__dict__[k] for k in cap["obj"]})
+        g.bins, g._row_valid = cap["bins"], cap["rv"]
+        self._full_counts, self._weights_dev = cap["fc"], cap["w"]
+        obj.__dict__.update(cap["obj"])
+        try:
+            yield
+        finally:
+            (g.bins, g._row_valid, self._full_counts,
+             self._weights_dev) = saved[:4]
+            obj.__dict__.update(saved[4])
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_set: Dataset, name: str) -> None:
+        if self._mh:
+            Log.fatal("multi-host training does not support validation "
+                      "sets yet (metric scores live sharded across "
+                      "hosts) — evaluate after training instead")
         metrics = create_metrics(self.config)
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
@@ -168,15 +229,21 @@ class GBDT:
     # ------------------------------------------------------------------
     def _compute_gradients(self, scores):
         """scores: (K, n_padded) -> (K, n_padded) grad/hess, zero-padded."""
-        n = self.num_data
-        s = scores[:, :n]
+        if self._mh:
+            # multi-host layout: per-host padding blocks are interleaved
+            # — the objective's device arrays were re-padded to match,
+            # so gradients run full-width (padded rows produce values
+            # that never count: their leaf_id is -1)
+            s = scores
+        else:
+            s = scores[:, :self.num_data]
         if self.num_class == 1:
             g, h = self.objective.get_gradients(s[0])
             g, h = g[None, :], h[None, :]
         else:
             g, h = self.objective.get_gradients(s.T)
             g, h = g.T, h.T
-        pad = scores.shape[1] - n
+        pad = scores.shape[1] - s.shape[1]
         if pad:
             g = jnp.pad(g, ((0, 0), (0, pad)))
             h = jnp.pad(h, ((0, 0), (0, pad)))
@@ -275,12 +342,15 @@ class GBDT:
         vbins = tuple(vs.bins for vs in self.valid_sets)
 
         def step(scores, vscores, bag_mask, key, fmask, shrinkage,
-                 ohb=None, fresh_bag=False, sample_active=False):
+                 ohb=None, cap=None, fresh_bag=False,
+                 sample_active=False):
             # sample_active is a static cache key mirroring
             # self._sample_active(), which _boost_one reads at trace time
             del sample_active
-            return self._boost_one(scores, vscores, bag_mask, key, fmask,
-                                   shrinkage, fresh_bag, vbins, ohb)
+            with self._bound_captives(cap):
+                return self._boost_one(scores, vscores, bag_mask, key,
+                                       fmask, shrinkage, fresh_bag,
+                                       vbins, ohb)
 
         self._fused_step = jax.jit(
             step, static_argnames=("fresh_bag", "sample_active"),
@@ -318,15 +388,22 @@ class GBDT:
         nl = jnp.int32(1)
         new_vscores = list(vscores)
         for k in range(self.num_class):
-            tree, leaf_id = self.grower._train_tree_impl(
+            tree, leaf_id, row_val = self.grower._train_tree_impl(
                 g[k], h[k], counts, fmask[k], ohb)
             tree = self._finalize_tree(tree, leaf_id, k, scores, counts)
             # a no-split tree must contribute nothing (the reference
             # skips UpdateScore when num_leaves==1, gbdt.cpp:427-460)
             ok = (tree.num_leaves > 1).astype(jnp.float32)
             tree = tree._replace(leaf_value=tree.leaf_value * ok)
-            delta = leaf_value_broadcast(leaf_id,
-                                         tree.leaf_value) * shrinkage
+            renew = (self.objective is not None
+                     and self.objective.is_renew_tree_output)
+            if row_val is not None and not renew:
+                # fused path: the exit-route already carried each row's
+                # leaf value — skip the separate (N, L) broadcast
+                delta = row_val * ok * shrinkage
+            else:
+                delta = leaf_value_broadcast(leaf_id,
+                                             tree.leaf_value) * shrinkage
             scores = scores.at[k].add(delta)
             for i, vb in enumerate(vbins):
                 pv = self._predict_valid(tree, vb)
@@ -346,7 +423,7 @@ class GBDT:
         shrinkage = self.shrinkage_rate
 
         def chunk(scores, vscores, bag_mask, keys, fmasks, fresh_flags,
-                  ohb=None):
+                  ohb=None, cap=None):
             def one_iter(carry, xs):
                 scores, vscores, bag_mask = carry
                 key, fmask, fresh_bag = xs
@@ -355,9 +432,10 @@ class GBDT:
                     fresh_bag, vbins, ohb)
                 return (scores, vscores, bag_mask), (trees, nl)
 
-            (scores, vscores, bag_mask), (trees, nls) = jax.lax.scan(
-                one_iter, (scores, vscores, bag_mask),
-                (keys, fmasks, fresh_flags))
+            with self._bound_captives(cap):
+                (scores, vscores, bag_mask), (trees, nls) = jax.lax.scan(
+                    one_iter, (scores, vscores, bag_mask),
+                    (keys, fmasks, fresh_flags))
             return scores, vscores, bag_mask, trees, nls
 
         return jax.jit(chunk, donate_argnums=(0, 1))
@@ -419,7 +497,7 @@ class GBDT:
             self.scores, tuple(vs.scores for vs in self.valid_sets),
             self._bag_state, keys, fmasks,
             fresh if isinstance(fresh, jax.Array) else jnp.asarray(fresh),
-            self.grower.ohb)
+            self.grower.ohb, self._captives)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
             vs.scores = s
@@ -470,7 +548,7 @@ class GBDT:
             self.scores, tuple(vs.scores for vs in self.valid_sets),
             self._bag_state, key, self._feature_masks(),
             jnp.asarray(self.shrinkage_rate, jnp.float32),
-            self.grower.ohb,
+            self.grower.ohb, self._captives,
             fresh_bag=fresh_bag, sample_active=self._sample_active())
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
@@ -518,7 +596,7 @@ class GBDT:
         nl = jnp.int32(1)
         for k in range(self.num_class):
             feature_mask = self._feature_mask()
-            tree_arrays, leaf_id = self.grower.train_tree(
+            tree_arrays, leaf_id, _ = self.grower.train_tree(
                 g[k], h[k], counts, feature_mask)
             tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k,
                                               self.scores, counts)

@@ -488,3 +488,197 @@ def LGBM_NetworkInitWithFunctions(num_machines: int, rank: int,
                                  reduce_scatter_ext_fun,
                                  allgather_ext_fun)
     return 0
+
+
+# ---------------------------------------------------------------------------
+# getter tail (reference c_api.h:316-739) — the long tail third-party
+# bindings end up needing
+# ---------------------------------------------------------------------------
+@_api
+def LGBM_DatasetGetSubset(handle, used_row_indices, num_used_row_indices,
+                          parameters: str, out=None) -> int:
+    """reference c_api.h:195-210 — bagging-style row subset sharing the
+    parent's bin mappers."""
+    ds = _get(handle)
+    idx = np.asarray(used_row_indices,
+                     dtype=np.int64)[:int(num_used_row_indices)]
+    sub = ds.subset(idx, params=_parse_params(parameters) or None)
+    out[0] = _register(sub)
+    return 0
+
+
+@_api
+def LGBM_DatasetSetFeatureNames(handle, feature_names,
+                                num_feature_names: int) -> int:
+    """reference c_api.h:212-218."""
+    ds = _get(handle)
+    names = [str(feature_names[i]) for i in range(int(num_feature_names))]
+    ds.feature_name = names
+    core = getattr(ds, "_core", None)
+    if core is not None and not callable(getattr(core, "construct", None)):
+        core.feature_names = names
+    return 0
+
+
+@_api
+def LGBM_DatasetGetFeatureNames(handle, out_strs=None, out_len=None
+                                ) -> int:
+    """reference c_api.h:220-230 (out_strs: list receiving the
+    names)."""
+    ds = _get(handle)
+    names = None
+    core = getattr(ds, "_core", None)
+    if core is not None:
+        names = getattr(core, "feature_names", None)
+    if names is None:
+        names = getattr(ds, "feature_name", None)
+    if names in (None, "auto"):
+        names = []
+    out_strs[:] = list(names)
+    if out_len is not None:
+        out_len[0] = len(names)
+    return 0
+
+
+@_api
+def LGBM_BoosterMerge(handle, other_handle) -> int:
+    """reference c_api.h:330-338 — append the other booster's trees."""
+    bst = _get(handle)
+    other = _get(other_handle)
+    bst._sync_models()
+    other._sync_models()
+    import copy as _copy
+    # deep copies: merged trees must not alias the source booster's
+    # mutable Tree objects (SetLeafValue on one would corrupt the other)
+    bst.models.extend(_copy.deepcopy(t) for t in other.models)
+    if bst.gbdt is not None:
+        # keep the per-model scale bookkeeping aligned so later
+        # flushes can reconcile (the foreign trees are final: scale 1)
+        for _ in other.models:
+            bst.gbdt._tree_scale.append(1.0)
+            bst.gbdt._applied_scale.append(1.0)
+    bst._raw_stack_cache = None
+    bst._device_stale = True   # in-session stacks no longer match
+    return 0
+
+
+@_api
+def LGBM_BoosterNumberOfTotalModel(handle, out_models=None) -> int:
+    """reference c_api.h:376-383."""
+    out_models[0] = _get(handle).num_trees()
+    return 0
+
+
+@_api
+def LGBM_BoosterGetNumPredict(handle, data_idx: int,
+                              out_len=None) -> int:
+    """reference c_api.h:520-530 — prediction count for train (0) or
+    valid set data_idx-1."""
+    bst = _get(handle)
+    g = bst.gbdt
+    if data_idx == 0:
+        n = g.num_data
+    else:
+        n = g.valid_sets[data_idx - 1].num_data
+    out_len[0] = n * max(bst.num_tree_per_iteration, 1)
+    return 0
+
+
+@_api
+def LGBM_BoosterGetPredict(handle, data_idx: int, out_len=None,
+                           out_result=None) -> int:
+    """reference c_api.h:532-548 / gbdt.cpp:691-728 GetPredictAt:
+    converted (sigmoid/softmax) scores of the training set (0) or
+    validation set data_idx-1, class-major."""
+    bst = _get(handle)
+    g = bst.gbdt
+    if data_idx == 0:
+        raw = np.asarray(g.scores[:, :g.num_data], dtype=np.float64)
+    else:
+        vs = g.valid_sets[data_idx - 1]
+        raw = np.asarray(vs.scores[:, :vs.num_data], dtype=np.float64)
+    k = max(bst.num_tree_per_iteration, 1)
+    conv = raw.T  # (n, k)
+    if not bst.average_output:
+        conv = bst._convert_output(conv)
+    flat = np.asarray(conv).T.reshape(-1)  # class-major like reference
+    n = flat.shape[0]
+    if out_result is not None:
+        out_result[:n] = flat
+    if out_len is not None:
+        out_len[0] = n
+    return 0
+
+
+@_api
+def LGBM_BoosterGetLeafValue(handle, tree_idx: int, leaf_idx: int,
+                             out_val=None) -> int:
+    """reference c_api.h:433-443."""
+    bst = _get(handle)
+    bst._sync_models()
+    out_val[0] = float(bst.models[int(tree_idx)].leaf_value[int(leaf_idx)])
+    return 0
+
+
+@_api
+def LGBM_BoosterSetLeafValue(handle, tree_idx: int, leaf_idx: int,
+                             val: float) -> int:
+    """reference c_api.h:445-456 — host-tree mutation invalidates the
+    device predict caches (same staleness rule as refit)."""
+    bst = _get(handle)
+    bst._sync_models()
+    bst.models[int(tree_idx)].leaf_value[int(leaf_idx)] = float(val)
+    bst._device_stale = True
+    bst._raw_stack_cache = None
+    return 0
+
+
+@_api
+def LGBM_BoosterResetParameter(handle, parameters: str) -> int:
+    """reference c_api.h:395-403 — currently learning_rate (the
+    parameter the reference's reset path exercises in tests) plus any
+    plain config scalars."""
+    bst = _get(handle)
+    params = _parse_params(parameters)
+    if "learning_rate" in params:
+        bst.gbdt.shrinkage_rate = float(params["learning_rate"])
+    for k, v in params.items():
+        if hasattr(bst.config, k) and k != "learning_rate":
+            cur = getattr(bst.config, k)
+            try:
+                if isinstance(cur, bool):
+                    # bool('false') is True — parse the string forms
+                    setattr(bst.config, k, str(v).lower()
+                            in ("1", "true", "yes", "on"))
+                else:
+                    setattr(bst.config, k, type(cur)(v))
+            except (TypeError, ValueError):
+                pass
+    return 0
+
+
+@_api
+def LGBM_BoosterPredictForFile(handle, data_filename: str,
+                               data_has_header: int, predict_type: int,
+                               num_iteration: int, parameter: str,
+                               result_filename: str) -> int:
+    """reference c_api.h:495-518 — batch file prediction written as
+    one row per line (tab-separated for multi-output)."""
+    bst = _get(handle)
+    from .config import Config as _Config
+    from .data_loader import load_file
+    cfg = _Config.from_params(dict(_parse_params(parameter),
+                                   has_header=bool(data_has_header)))
+    X, _, _ = load_file(str(data_filename), cfg)
+    pred = bst.predict(
+        X, num_iteration=int(num_iteration),
+        raw_score=predict_type == 1, pred_leaf=predict_type == 2,
+        pred_contrib=predict_type == 3)
+    out = np.atleast_2d(np.asarray(pred))
+    if out.shape[0] == 1 and X.shape[0] != 1:
+        out = out.T
+    with open(str(result_filename), "w") as f:
+        for row in (out if out.ndim > 1 else out[:, None]):
+            f.write("\t".join(f"{v:g}" for v in np.atleast_1d(row))
+                    + "\n")
+    return 0

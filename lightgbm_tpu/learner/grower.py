@@ -236,18 +236,47 @@ class TreeGrower:
         n = dataset.num_data
         from ..ops.histogram import _pick_chunk
         cdt = jnp.dtype(config.hist_compute_dtype)
+        on_tpu = jax.default_backend() in ("tpu", "axon")
         self.chunk = _pick_chunk(n, self.num_groups, self.max_group_bin,
-                                 cdt.itemsize)
-        self.n_padded = ((n + self.chunk - 1) // self.chunk) * self.chunk
+                                 cdt.itemsize,
+                                 min_chunk=4096 if on_tpu else 1024)
         self.num_data = n
-        pad = self.n_padded - n
-        bins_np = dataset.group_bins
-        if pad:
-            bins_np = np.concatenate(
-                [bins_np, np.zeros((pad, bins_np.shape[1]), dtype=np.uint8)])
-        self.bins = self.policy.place_rows(bins_np)
-        self._row_valid = self.policy.place_rows(
-            np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
+        # multi-host: this process holds only ITS row shard of the bin
+        # matrix (parallel/distributed.py finalize_global); every host
+        # pads its shard to a whole chunk multiple and the global
+        # layout interleaves per-host padding blocks (host0 rows,
+        # host0 pad, host1 rows, ...).  pad_rows() reproduces that
+        # layout for global metadata arrays.
+        self._mh_local: Optional[int] = getattr(
+            dataset, "_mh_local_rows", None) if getattr(
+                dataset, "_multihost", False) else None
+        if self._mh_local is not None:
+            self._mh_nproc = max(1, self.policy.nproc)
+            per_host = ((self._mh_local + self.chunk - 1)
+                        // self.chunk) * self.chunk
+            self._mh_per_host = per_host
+            self.n_padded = per_host * self._mh_nproc
+            loc_pad = per_host - self._mh_local
+            bins_local = np.concatenate(
+                [dataset.group_bins,
+                 np.zeros((loc_pad, dataset.group_bins.shape[1]),
+                          dtype=np.uint8)])
+            self.bins = self.policy.place_local_rows(bins_local)
+            self._row_valid = self.policy.place_local_rows(
+                np.concatenate([np.ones(self._mh_local, bool),
+                                np.zeros(loc_pad, bool)]))
+        else:
+            self.n_padded = ((n + self.chunk - 1)
+                             // self.chunk) * self.chunk
+            pad = self.n_padded - n
+            bins_np = dataset.group_bins
+            if pad:
+                bins_np = np.concatenate(
+                    [bins_np,
+                     np.zeros((pad, bins_np.shape[1]), dtype=np.uint8)])
+            self.bins = self.policy.place_rows(bins_np)
+            self._row_valid = self.policy.place_rows(
+                np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
         # the Pallas kernel path: single TPU device only (its sequential
         # -grid accumulation is a Mosaic property); the XLA formulation
         # stays for CPU simulation, GSPMD meshes (where the sharded
@@ -471,6 +500,16 @@ class TreeGrower:
 
     # ------------------------------------------------------------------
     def pad_rows(self, arr: np.ndarray, fill=0.0) -> np.ndarray:
+        """Pad a global row array to n_padded.  Multi-host: padding is
+        interleaved per host to match the assembled shard layout."""
+        if self._mh_local is not None:
+            nl, ph = self._mh_local, self._mh_per_host
+            pad_shape = (ph - nl,) + tuple(arr.shape[1:])
+            parts = []
+            for h in range(self._mh_nproc):
+                parts.append(arr[h * nl:(h + 1) * nl])
+                parts.append(np.full(pad_shape, fill, dtype=arr.dtype))
+            return np.concatenate(parts)
         pad = self.n_padded - self.num_data
         if pad == 0:
             return arr
@@ -479,9 +518,11 @@ class TreeGrower:
     # ------------------------------------------------------------------
     def train_tree(self, grad: jax.Array, hess: jax.Array,
                    counts: jax.Array, feature_mask: jax.Array
-                   ) -> Tuple[TreeArrays, jax.Array]:
+                   ) -> Tuple[TreeArrays, jax.Array, Optional[jax.Array]]:
         """Grow one tree.  grad/hess/counts are (n_padded,) with zeros
-        for out-of-bag and padded rows.  Returns (tree, final leaf_id)."""
+        for out-of-bag and padded rows.  Returns (tree, final leaf_id,
+        per-row post-route leaf value or None — see
+        _train_tree_inner)."""
         return self._train_tree(grad, hess, counts, feature_mask,
                                 self.ohb)
 
@@ -764,13 +805,19 @@ class TreeGrower:
 
         final = jax.lax.while_loop(cond, body, state)
         leaf_id = final.leaf_id
+        row_val = None
         if self.use_fused:
             # the last round's selected splits were never routed (the
-            # loop exited before the next refresh) — apply them once
-            leaf_id = apply_route_table(self.bins, leaf_id,
-                                        final.route_tab)
+            # loop exited before the next refresh) — apply them once,
+            # and ride the per-row POST-route leaf value on the same
+            # (N, L) one-hot dot so the boosting score update needs no
+            # separate leaf_value_broadcast pass (callers ignore
+            # row_val when RenewTreeOutput will change leaf values)
+            leaf_id, row_val = apply_route_table(
+                self.bins, leaf_id, final.route_tab,
+                values=final.tree.leaf_value)
         tree = final.tree._replace(num_leaves=final.num_leaves)
-        return tree, leaf_id
+        return tree, leaf_id, row_val
 
     # ------------------------------------------------------------------
     def _run_finders(self, hist, sum_grad, sum_hess, count, min_c, max_c,

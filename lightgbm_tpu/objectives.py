@@ -109,6 +109,19 @@ class Objective:
             return grad, hess
         return grad * self._weight_dev, hess * self._weight_dev
 
+    def repad_device_arrays(self, pad_place) -> None:
+        """Multi-host layout fixup: every (num_data,)-leading device
+        array (the ``*_dev`` convention) is re-padded to the assembled
+        global row layout (per-host padding blocks) and placed
+        row-sharded over the mesh.  Host-side stats (label means,
+        percentiles) were already computed from the unpadded global
+        metadata in init().  ``pad_place(np_arr) -> placed array``."""
+        for name, val in list(self.__dict__.items()):
+            if (name.endswith("_dev") and val is not None
+                    and getattr(val, "ndim", 0) >= 1
+                    and val.shape[0] == self.num_data):
+                self.__dict__[name] = pad_place(np.asarray(val))
+
     def renew_leaf_values(self, residual_fn, leaf_id, num_leaves):
         raise NotImplementedError
 

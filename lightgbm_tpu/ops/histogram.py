@@ -33,13 +33,17 @@ from .partition import MISSING_NAN, MISSING_ZERO
 
 
 def _pick_chunk(n: int, num_groups: int, max_group_bin: int,
-                itemsize: int, target_bytes: int = 1 << 26) -> int:
-    """Row-chunk size bounding the materialized one-hot to ~64 MB."""
+                itemsize: int, target_bytes: int = 1 << 26,
+                min_chunk: int = 4096) -> int:
+    """Row-chunk size bounding the materialized one-hot to ~64 MB.
+
+    ``min_chunk`` also sets the padding granularity: 4096 on real TPU
+    (every Pallas block size up to 4096 must divide the padded row
+    count), 1024 elsewhere — a 569-row test dataset padded to 4096
+    rows pays 7x the row work on the CPU backend for nothing."""
     per_row = max(num_groups * max_group_bin * itemsize, 1)
-    chunk = max(4096, min(n, target_bytes // per_row))
-    # round to a multiple of 4096 so every Pallas block size up to 4096
-    # divides the padded row count
-    return int(max(4096, (chunk // 4096) * 4096))
+    chunk = max(min_chunk, min(n, target_bytes // per_row))
+    return int(max(min_chunk, (chunk // min_chunk) * min_chunk))
 
 
 @functools.partial(
@@ -478,16 +482,35 @@ def precompute_bin_onehot_packed(bins: jax.Array, *, max_group_bin: int,
 
 
 def _unpack_ohb_planes(pk: jax.Array, pack: int, out_dtype):
-    """(C, GBp) planar-packed block -> list of ``pack`` (C, GBp) 0/1
-    planes in ``out_dtype`` (int8 for the quantized dot, bfloat16
-    otherwise).  In-VMEM widening: one int32 cast, then shift+mask per
-    plane — cheap VPU work against the pack-x HBM traffic saved."""
+    """(C, GBp) planar-packed block -> list of ``pack`` (plane, shift)
+    pairs in ``out_dtype`` (int8 for the quantized dot, bfloat16
+    otherwise).  The plane holds values {0, 2^shift} — extraction is a
+    SINGLE int8 AND per element (the full 0/1 widen costs 3 VPU ops
+    per element: and, !=0, cast — measured as the pass bottleneck once
+    the stream is packed).  The caller divides the 2^shift factor out
+    of the post-dot (m_pad, GBp) result, ~4 orders of magnitude fewer
+    elements; the int32 quant descale is an exact arithmetic shift
+    (every accumulated value is a multiple of 2^shift)."""
     if pack == 1:
-        return [pk if out_dtype == jnp.int8 else pk.astype(out_dtype)]
+        return [(pk if out_dtype == jnp.int8 else pk.astype(out_dtype),
+                 0)]
     bits = 8 // pack
-    pki = pk.astype(jnp.int32)
-    return [((pki >> (p * bits)) & 1).astype(out_dtype)
-            for p in range(pack)]
+    out = []
+    for p in range(pack):
+        masked = pk & jnp.int8(1 << (p * bits))
+        out.append((masked if out_dtype == jnp.int8
+                    else masked.astype(out_dtype), p * bits))
+    return out
+
+
+def _descale_contrib(contrib: jax.Array, shift: int) -> jax.Array:
+    """Divide the 2^shift plane scaling out of a post-dot block (exact
+    for both the int32 arithmetic-shift and the f32 multiply)."""
+    if shift == 0:
+        return contrib
+    if contrib.dtype == jnp.int32:
+        return jax.lax.shift_right_arithmetic(contrib, shift)
+    return contrib * jnp.float32(1.0 / (1 << shift))
 
 
 def _round_up(x: int, m: int) -> int:
@@ -525,10 +548,11 @@ def _hist_kernel_body_pre(ohb_ref, w_ref, leaf_ref, slots_ref, out_ref, *,
              jnp.where(ohl, w[:, 2:3], zero)], axis=1).astype(jnp.bfloat16)
         rdt, odt = jnp.bfloat16, jnp.float32
     gbp_pad = ohb_ref.shape[1]
-    for p, plane in enumerate(_unpack_ohb_planes(ohb_ref[:], pack, rdt)):
-        contrib = jax.lax.dot_general(
+    for p, (plane, sh) in enumerate(
+            _unpack_ohb_planes(ohb_ref[:], pack, rdt)):
+        contrib = _descale_contrib(jax.lax.dot_general(
             lhs, plane, (((0,), (0,)), ((), ())),
-            preferred_element_type=odt)
+            preferred_element_type=odt), sh)
         if pack == 1:
             out_ref[:] += contrib
         else:
@@ -577,10 +601,10 @@ def _hist_kernel_body_pre_packed(ohb_ref, w_ref, leaf_ref, slots_ref,
         rdt, odt = jnp.bfloat16, jnp.float32
     gbp_pad = ohb_ref.shape[1]
     planes = _unpack_ohb_planes(ohb_ref[:], pack, rdt)
-    for p, plane in enumerate(planes):
-        contrib = jax.lax.dot_general(
+    for p, (plane, sh) in enumerate(planes):
+        contrib = _descale_contrib(jax.lax.dot_general(
             lhs, plane, (((0,), (0,)), ((), ())),
-            preferred_element_type=odt)
+            preferred_element_type=odt), sh)
         if pack == 1:
             out_ref[:] += contrib
         else:
@@ -906,10 +930,11 @@ def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
                         jnp.zeros((), jnp.float32)).astype(jnp.bfloat16)
         rdt, odt = jnp.bfloat16, jnp.float32
     gbp_pad = ohb_ref.shape[1]
-    for p, plane in enumerate(_unpack_ohb_planes(ohb_ref[:], pack, rdt)):
-        contrib = jax.lax.dot_general(
+    for p, (plane, sh) in enumerate(
+            _unpack_ohb_planes(ohb_ref[:], pack, rdt)):
+        contrib = _descale_contrib(jax.lax.dot_general(
             lhs, plane, (((1,), (0,)), ((), ())),
-            preferred_element_type=odt)
+            preferred_element_type=odt), sh)
         if pack == 1:
             hist_ref[:] += contrib
         else:

@@ -93,10 +93,11 @@ def build_route_table(split_mask: jax.Array, feat_group: jax.Array,
     ], axis=1)
 
 
-def route_rows(rows, leaf_id, gb):
+def route_rows(rows, leaf_id, gb, with_decision=False):
     """Routing decision of the XLA router: ``rows`` is the per-row
     broadcast of the route table ((N, 15+nb) f32), ``gb`` the per-row
-    bin of the chosen group.  Returns the updated leaf id.
+    bin of the chosen group.  Returns the updated leaf id (plus the
+    went-right mask when ``with_decision``).
 
     NOTE: ops/histogram.py _fused_kernel_body carries a TRANSPOSED
     duplicate of this logic (scalars live as (K, C) rows there; Mosaic
@@ -141,14 +142,36 @@ def route_rows(rows, leaf_id, gb):
 
     go_left = jnp.where(iscat_row, cat_left, num_left)
     new_id = jnp.where(go_left, leaf_id, rs_row)
-    return jnp.where(active, new_id, leaf_id).astype(jnp.int32)
+    routed = jnp.where(active, new_id, leaf_id).astype(jnp.int32)
+    if with_decision:
+        return routed, active & ~go_left
+    return routed
+
+
+def _split3_bf16(v: jax.Array) -> list:
+    """f32 (L,) -> three bf16-exact f32 columns summing to v at ~f32
+    precision (the leaf_value_broadcast trick, ops/histogram.py)."""
+    hi = v.astype(jnp.bfloat16)
+    r1 = v - hi.astype(jnp.float32)
+    mid = r1.astype(jnp.bfloat16)
+    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    return [hi.astype(jnp.float32)[:, None],
+            mid.astype(jnp.float32)[:, None],
+            lo.astype(jnp.float32)[:, None]]
 
 
 def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
-                      table: jax.Array) -> jax.Array:
+                      table: jax.Array, values=None):
     """Re-label rows from a packed (L, 15+nb) route table (XLA form:
     the one-hot broadcast dot materializes; the fused Pallas histogram
-    kernel runs the same table in VMEM)."""
+    kernel runs the same table in VMEM).
+
+    With ``values`` ((L,) f32 leaf values) the POST-route per-row value
+    rides the same one-hot dot as six extra bf16-split columns (keep
+    and right-child variants), fusing the score update's separate
+    (N, L) leaf_value_broadcast into this pass — one (N, L) one-hot
+    materialization instead of two per tree.  Returns
+    ``(new_leaf, row_value)`` then (row_value 0.0 on padded rows)."""
     n, num_groups = bins.shape
     if num_groups >= 65536:  # fg // 256 must stay bf16-exact
         raise ValueError(
@@ -156,11 +179,21 @@ def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
             f"feature groups, got {num_groups} — the route table encodes "
             "the group index as two bf16-exact bytes (hi/lo)")
     L = table.shape[0]
+    ncols = table.shape[1]
+    if values is not None:
+        rs_l = (table[:, 8].astype(jnp.int32) * 256
+                + table[:, 9].astype(jnp.int32))
+        v_keep = values
+        v_right = values[jnp.clip(rs_l, 0, values.shape[0] - 1)]
+        table = jnp.concatenate(
+            [table] + _split3_bf16(v_keep) + _split3_bf16(v_right),
+            axis=1)
     safe_l = jnp.clip(leaf_id, 0, L - 1)
     ohl = (safe_l[:, None]
            == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
-    rows = jnp.dot(ohl, table.astype(jnp.bfloat16),
-                   preferred_element_type=jnp.float32)
+    rows_all = jnp.dot(ohl, table.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+    rows = rows_all[:, :ncols]
 
     grp_row = (rows[:, 0].astype(jnp.int32) * 256
                + rows[:, 1].astype(jnp.int32))
@@ -168,7 +201,17 @@ def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
     gsel = grp_row[:, None] == jnp.arange(num_groups,
                                           dtype=jnp.int32)[None, :]
     gb = jnp.sum(jnp.where(gsel, bins.astype(jnp.int32), 0), axis=1)
-    return route_rows(rows, leaf_id, gb)
+    if values is None:
+        return route_rows(rows, leaf_id, gb)
+    new_leaf, went_right = route_rows(rows, leaf_id, gb,
+                                      with_decision=True)
+    vk = (rows_all[:, ncols] + rows_all[:, ncols + 1]
+          + rows_all[:, ncols + 2])
+    vr = (rows_all[:, ncols + 3] + rows_all[:, ncols + 4]
+          + rows_all[:, ncols + 5])
+    row_value = jnp.where(went_right, vr, vk)
+    row_value = jnp.where(leaf_id >= 0, row_value, 0.0)
+    return new_leaf, row_value
 
 
 def apply_splits(bins: jax.Array, leaf_id: jax.Array,

@@ -114,6 +114,52 @@ def construct_sharded(local_data: np.ndarray, label=None, weight=None,
     return ds
 
 
+def finalize_global(ds):
+    """Promote a per-host shard dataset (construct_sharded) into the
+    GLOBAL training view: metadata (labels/weights — bytes-per-row
+    small) is allgathered into assembled global row order (host 0's
+    rows, then host 1's, ...), ``num_data`` becomes the global count,
+    while ``group_bins`` stays THIS host's rows — the grower assembles
+    the global HBM array over the mesh with
+    ``jax.make_array_from_process_local_data`` (the redesign of
+    reference data_parallel_tree_learner.cpp:117-246, where each
+    machine trains on its shard and histograms are reduce-scattered).
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    from ..dataset import Metadata
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return ds
+    n_local = ds.num_data
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.array([n_local], dtype=np.int64))).ravel()
+    if not (counts == counts[0]).all():
+        Log.fatal("multi-host training requires equal row shards per "
+                  f"host, got {counts.tolist()} — pad the tail shard")
+    if ds.metadata.query_boundaries is not None:
+        Log.fatal("multi-host ranking (query groups) is not supported "
+                  "yet — queries must not span hosts")
+    n_global = int(counts.sum())
+    md = Metadata(n_global)
+    md.label = np.asarray(multihost_utils.process_allgather(
+        np.ascontiguousarray(ds.metadata.label))).reshape(-1) \
+        .astype(np.float32)
+    if ds.metadata.weight is not None:
+        md.weight = np.asarray(multihost_utils.process_allgather(
+            np.ascontiguousarray(ds.metadata.weight))).reshape(-1) \
+            .astype(np.float32)
+    if ds.metadata.init_score is not None:
+        md.init_score = np.asarray(multihost_utils.process_allgather(
+            np.ascontiguousarray(ds.metadata.init_score))).reshape(-1)
+    ds.metadata = md
+    ds._mh_local_rows = n_local
+    ds._multihost = True
+    ds.num_data = n_global
+    return ds
+
+
 def _num_processes() -> int:
     import jax
     try:

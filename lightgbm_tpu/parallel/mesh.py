@@ -71,6 +71,14 @@ class ShardingPolicy:
     def __init__(self, config: Config, mesh: Optional[Mesh]):
         self.mesh = mesh
         self.learner = config.tree_learner
+        try:
+            self.nproc = jax.process_count()
+        except Exception:  # pragma: no cover - uninitialized backend
+            self.nproc = 1
+        # multi-host: arrays must be assembled from process-local
+        # shards (device_put of a full array cannot address other
+        # hosts' devices)
+        self.multihost = mesh is not None and self.nproc > 1
         if mesh is None:
             self.row_spec = None
             self.hist_spec = None
@@ -94,16 +102,55 @@ class ShardingPolicy:
 
     # ------------------------------------------------------------------
     def place_rows(self, arr):
-        """Place a row-indexed array ((N,) or (N, G))."""
+        """Place a row-indexed array ((N,) or (N, G)).  Multi-host: the
+        array is the ASSEMBLED global view (host h's rows at
+        [h*N/nproc, (h+1)*N/nproc)); this host's slice is extracted and
+        the global array built from process-local shards."""
         if self.mesh is None or self.row_spec is None:
             return jax.device_put(arr)
         ndim = getattr(arr, "ndim", 1)
         spec = P(self.row_spec[0], *([None] * (ndim - 1)))
+        if self.multihost:
+            return self.place_local_rows(self._local_slice(arr, axis=0))
         return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def place_local_rows(self, local_arr):
+        """Multi-host: build the global row-sharded array from THIS
+        host's padded shard (jax.make_array_from_process_local_data —
+        the seam reference dataset_loader.cpp's pre-partitioned loading
+        feeds)."""
+        ndim = getattr(local_arr, "ndim", 1)
+        spec = P(self.row_spec[0], *([None] * (ndim - 1)))
+        sh = NamedSharding(self.mesh, spec)
+        if not self.multihost:
+            return jax.device_put(local_arr, sh)
+        return jax.make_array_from_process_local_data(sh, local_arr)
+
+    def place_score_rows(self, arr):
+        """Place a (K, N) class-major score matrix (rows on axis 1)."""
+        if self.mesh is None or self.row_spec is None:
+            return jax.device_put(arr)
+        sh = NamedSharding(self.mesh, P(None, self.row_spec[0]))
+        if self.multihost:
+            return jax.make_array_from_process_local_data(
+                sh, self._local_slice(arr, axis=1))
+        return jax.device_put(arr, sh)
+
+    def _local_slice(self, arr, axis: int):
+        import numpy as _np
+        n = arr.shape[axis]
+        per = n // self.nproc
+        pid = jax.process_index()
+        idx = [slice(None)] * arr.ndim
+        idx[axis] = slice(pid * per, (pid + 1) * per)
+        return _np.ascontiguousarray(_np.asarray(arr)[tuple(idx)])
 
     def replicate(self, arr):
         if self.mesh is None:
             return jax.device_put(arr)
+        if self.multihost:
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P()), np.asarray(arr))
         return jax.device_put(arr, NamedSharding(self.mesh, P()))
 
     def constrain_hist(self, hist):

@@ -1,0 +1,84 @@
+"""Profile the 1M-row training chunk on the real chip and print the
+per-op device-time breakdown (jax.profiler xplane parsed with
+jax.profiler.ProfileData — no TensorBoard needed).
+
+Usage: python scripts/profile_train.py [rows] [iters]
+"""
+import glob
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    os.environ.setdefault("BENCH_ROWS", str(rows))
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+
+    X, y, w = bench.make_data(rows, bench.BENCH_FEATURES)
+    params = {
+        "objective": "binary", "num_leaves": 255, "max_bin": 63,
+        "learning_rate": 0.1, "verbose": -1, "min_data_in_leaf": 1,
+        "min_sum_hessian_in_leaf": 100.0,
+        "hist_compute_dtype": "bfloat16", "quantized_grad": True,
+    }
+    extra = os.environ.get("BENCH_PARAMS")
+    if extra:
+        import json
+        params.update(json.loads(extra))
+    cfg = Config.from_params(params)
+    core = lgb.Dataset(X, label=y).construct(cfg)
+    g = GBDT(cfg, core)
+    g.train_chunk(iters)          # compile + warm
+    np.asarray(g.scores[:, :8])
+
+    tdir = "/tmp/lgbtpu_profile"
+    import shutil
+    shutil.rmtree(tdir, ignore_errors=True)
+    with jax.profiler.trace(tdir):
+        g.train_chunk(iters)
+        np.asarray(g.scores[:, :8])
+
+    # aggregate device-plane event durations by op name
+    pb = sorted(glob.glob(os.path.join(
+        tdir, "**", "*.xplane.pb"), recursive=True))[-1]
+    data = jax.profiler.ProfileData.from_serialized_xspace(
+        open(pb, "rb").read())
+    agg = defaultdict(float)
+    cnt = defaultdict(int)
+    total = 0.0
+    for plane in data.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name:
+            continue
+        for line in plane.lines:
+            if "XLA Ops" not in line.name and "Ops" not in line.name:
+                continue
+            for ev in line.events:
+                dur = ev.duration_ns / 1e6
+                agg[ev.name] += dur
+                cnt[ev.name] += 1
+                total += dur
+    print(f"\n== device op time over {iters} trees "
+          f"({rows//1000}k rows) ==")
+    print(f"{'ms/tree':>9} {'pct':>6} {'calls':>7}  op")
+    for name, ms in sorted(agg.items(), key=lambda kv: -kv[1])[:25]:
+        print(f"{ms/iters:9.3f} {100*ms/total:5.1f}% {cnt[name]:7d}  "
+              f"{name[:90]}")
+    print(f"{total/iters:9.3f} 100.0%          TOTAL device")
+
+
+if __name__ == "__main__":
+    main()

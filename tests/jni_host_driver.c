@@ -1,0 +1,201 @@
+/* C host that EXECUTES the JNI binding (jni/lightgbm_jni.c) without a
+ * JVM: fabricates a JNIEnv function table (string/array accessors,
+ * exception raise) and drives dataset -> train -> predict -> save ->
+ * reload -> parity through the Java_* entry points against the real
+ * liblgbm_tpu.so.  With a JDK present the same binding builds against
+ * the genuine <jni.h> and runs under a real JVM (see
+ * jni/LightGBMNative.java). */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../jni/jni_min.h"
+
+/* ---- fake object model ------------------------------------------- */
+typedef struct _jobject {
+  int kind; /* 0 = string, 1 = double array, 2 = class */
+  const char* str;
+  double* d;
+  jsize len;
+} FakeObj;
+
+static jobject mk_string(const char* s) {
+  FakeObj* o = calloc(1, sizeof(FakeObj));
+  o->kind = 0;
+  o->str = s;
+  return o;
+}
+
+static jobject mk_darray(const double* v, jsize n) {
+  FakeObj* o = calloc(1, sizeof(FakeObj));
+  o->kind = 1;
+  o->d = malloc(sizeof(double) * (size_t)n);
+  if (v) memcpy(o->d, v, sizeof(double) * (size_t)n);
+  o->len = n;
+  return o;
+}
+
+/* ---- JNIEnv implementation --------------------------------------- */
+static jclass env_FindClass(JNIEnv* env, const char* name) {
+  (void)env;
+  FakeObj* o = calloc(1, sizeof(FakeObj));
+  o->kind = 2;
+  o->str = name;
+  return o;
+}
+
+static jint env_ThrowNew(JNIEnv* env, jclass cls, const char* msg) {
+  (void)env;
+  fprintf(stderr, "java exception %s: %s\n",
+          cls ? ((FakeObj*)cls)->str : "?", msg ? msg : "");
+  exit(3); /* a real JVM unwinds; the host just fails the test */
+}
+
+static const char* env_GetStringUTFChars(JNIEnv* env, jstring s,
+                                         jboolean* copy) {
+  (void)env;
+  if (copy) *copy = 0;
+  return ((FakeObj*)s)->str;
+}
+
+static void env_ReleaseStringUTFChars(JNIEnv* env, jstring s,
+                                      const char* c) {
+  (void)env;
+  (void)s;
+  (void)c;
+}
+
+static jsize env_GetArrayLength(JNIEnv* env, jarray a) {
+  (void)env;
+  return ((FakeObj*)a)->len;
+}
+
+static jdoubleArray env_NewDoubleArray(JNIEnv* env, jsize n) {
+  (void)env;
+  return mk_darray(NULL, n);
+}
+
+static jdouble* env_GetDoubleArrayElements(JNIEnv* env, jdoubleArray a,
+                                           jboolean* copy) {
+  (void)env;
+  if (copy) *copy = 0;
+  return ((FakeObj*)a)->d;
+}
+
+static void env_ReleaseDoubleArrayElements(JNIEnv* env, jdoubleArray a,
+                                           jdouble* d, jint mode) {
+  (void)env;
+  (void)a;
+  (void)d;
+  (void)mode;
+}
+
+static void env_SetDoubleArrayRegion(JNIEnv* env, jdoubleArray a,
+                                     jsize start, jsize n,
+                                     const jdouble* src) {
+  (void)env;
+  memcpy(((FakeObj*)a)->d + start, src, sizeof(double) * (size_t)n);
+}
+
+/* ---- the Java_* entry points under test -------------------------- */
+extern jlong Java_com_lightgbm_tpu_LightGBMNative_datasetCreateFromMat(
+    JNIEnv*, jclass, jdoubleArray, jint, jint, jstring);
+extern void Java_com_lightgbm_tpu_LightGBMNative_datasetSetField(
+    JNIEnv*, jclass, jlong, jstring, jdoubleArray);
+extern void Java_com_lightgbm_tpu_LightGBMNative_datasetFree(
+    JNIEnv*, jclass, jlong);
+extern jlong Java_com_lightgbm_tpu_LightGBMNative_boosterCreate(
+    JNIEnv*, jclass, jlong, jstring);
+extern jlong
+Java_com_lightgbm_tpu_LightGBMNative_boosterCreateFromModelfile(
+    JNIEnv*, jclass, jstring);
+extern jint Java_com_lightgbm_tpu_LightGBMNative_boosterUpdateOneIter(
+    JNIEnv*, jclass, jlong);
+extern void Java_com_lightgbm_tpu_LightGBMNative_boosterSaveModel(
+    JNIEnv*, jclass, jlong, jint, jstring);
+extern jdoubleArray
+Java_com_lightgbm_tpu_LightGBMNative_boosterPredictForMat(
+    JNIEnv*, jclass, jlong, jdoubleArray, jint, jint, jint, jint);
+extern void Java_com_lightgbm_tpu_LightGBMNative_boosterFree(
+    JNIEnv*, jclass, jlong);
+
+static unsigned long rng_state = 777;
+static double frand(void) {
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return (double)(rng_state % 1000000ul) / 1000000.0 - 0.5;
+}
+
+int main(int argc, char** argv) {
+  const char* model_path = argc > 1 ? argv[1] : "/tmp/jni_model.txt";
+  struct JNINativeInterface_ table = {
+      env_FindClass,
+      env_ThrowNew,
+      env_GetStringUTFChars,
+      env_ReleaseStringUTFChars,
+      env_GetArrayLength,
+      env_NewDoubleArray,
+      env_GetDoubleArrayElements,
+      env_ReleaseDoubleArrayElements,
+      env_SetDoubleArrayRegion,
+  };
+  JNIEnv env_obj = &table;
+  JNIEnv* env = &env_obj;
+
+  const int n = 500, f = 4;
+  double* mat = malloc(sizeof(double) * n * f); /* row-major (Java) */
+  double* label = malloc(sizeof(double) * n);
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < f; ++j) mat[i * f + j] = frand();
+    label[i] = (mat[i * f] + 0.5 * mat[i * f + 1] > 0.0) ? 1.0 : 0.0;
+  }
+
+  jdoubleArray j_mat = mk_darray(mat, n * f);
+  jstring params = mk_string(
+      "objective=binary verbose=-1 num_leaves=15 min_data_in_leaf=5");
+  jlong ds = Java_com_lightgbm_tpu_LightGBMNative_datasetCreateFromMat(
+      env, NULL, j_mat, n, f, params);
+  Java_com_lightgbm_tpu_LightGBMNative_datasetSetField(
+      env, NULL, ds, mk_string("label"), mk_darray(label, n));
+  jlong bst = Java_com_lightgbm_tpu_LightGBMNative_boosterCreate(
+      env, NULL, ds, params);
+  for (int it = 0; it < 20; ++it)
+    Java_com_lightgbm_tpu_LightGBMNative_boosterUpdateOneIter(env, NULL,
+                                                              bst);
+  jdoubleArray pred =
+      Java_com_lightgbm_tpu_LightGBMNative_boosterPredictForMat(
+          env, NULL, bst, j_mat, n, f, 0, -1);
+  if (env_GetArrayLength(env, pred) != n) {
+    fprintf(stderr, "bad prediction length\n");
+    return 4;
+  }
+  double* p = env_GetDoubleArrayElements(env, pred, NULL);
+  int correct = 0;
+  for (int i = 0; i < n; ++i)
+    correct += ((p[i] > 0.5) == (label[i] > 0.5));
+  double acc = (double)correct / n;
+
+  Java_com_lightgbm_tpu_LightGBMNative_boosterSaveModel(
+      env, NULL, bst, -1, mk_string(model_path));
+  jlong bst2 =
+      Java_com_lightgbm_tpu_LightGBMNative_boosterCreateFromModelfile(
+          env, NULL, mk_string(model_path));
+  jdoubleArray pred2 =
+      Java_com_lightgbm_tpu_LightGBMNative_boosterPredictForMat(
+          env, NULL, bst2, j_mat, n, f, 0, -1);
+  double* p2 = env_GetDoubleArrayElements(env, pred2, NULL);
+  double maxdiff = 0.0;
+  for (int i = 0; i < n; ++i) {
+    double d = fabs(p[i] - p2[i]);
+    if (d > maxdiff) maxdiff = d;
+  }
+  Java_com_lightgbm_tpu_LightGBMNative_boosterFree(env, NULL, bst);
+  Java_com_lightgbm_tpu_LightGBMNative_boosterFree(env, NULL, bst2);
+  Java_com_lightgbm_tpu_LightGBMNative_datasetFree(env, NULL, ds);
+  printf("JNI-HOST OK acc=%.3f maxdiff=%g\n", acc, maxdiff);
+  if (acc < 0.85) return 5;
+  if (maxdiff > 1e-10) return 6;
+  return 0;
+}

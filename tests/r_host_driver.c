@@ -1,0 +1,101 @@
+/* C host that EXECUTES the R .Call shim (lightgbm_R.cpp) end-to-end
+ * against liblgbm_tpu.so, with R itself replaced by the rstub
+ * implementation (R-package/src/rstub) — every shim line runs for
+ * real: dataset from a column-major matrix, label field, booster
+ * training, prediction, model save + reload, reload-predict parity.
+ * Mirrors R-package/demo/binary.R (and the reference's R test flow
+ * over src/lightgbm_R.cpp). */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "Rinternals.h"
+
+/* the .Call surface exported by lightgbm_R.cpp (unmangled C names —
+ * this file may be compiled as C or C++) */
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern SEXP LGBM_R_DatasetCreateFromMat(SEXP, SEXP, SEXP, SEXP);
+extern SEXP LGBM_R_DatasetSetField(SEXP, SEXP, SEXP);
+extern SEXP LGBM_R_DatasetFree(SEXP);
+extern SEXP LGBM_R_BoosterCreate(SEXP, SEXP);
+extern SEXP LGBM_R_BoosterCreateFromModelfile(SEXP);
+extern SEXP LGBM_R_BoosterUpdateOneIter(SEXP);
+extern SEXP LGBM_R_BoosterSaveModel(SEXP, SEXP, SEXP);
+extern SEXP LGBM_R_BoosterPredictForMat(SEXP, SEXP, SEXP, SEXP, SEXP,
+                                        SEXP);
+extern SEXP LGBM_R_BoosterFree(SEXP);
+#ifdef __cplusplus
+}
+#endif
+
+static unsigned long rng_state = 12345;
+static double frand(void) { /* xorshift, deterministic across runs */
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return (double)(rng_state % 1000000ul) / 1000000.0 - 0.5;
+}
+
+int main(int argc, char** argv) {
+  const char* model_path = argc > 1 ? argv[1] : "/tmp/r_host_model.txt";
+  const int n = 600, f = 5;
+  /* column-major matrix, as R lays out numeric matrices */
+  double* mat = (double*)malloc(sizeof(double) * n * f);
+  double* label = (double*)malloc(sizeof(double) * n);
+  for (int i = 0; i < n; ++i) {
+    double x0 = 0, x1 = 0;
+    for (int j = 0; j < f; ++j) {
+      double v = frand();
+      mat[j * n + i] = v;
+      if (j == 0) x0 = v;
+      if (j == 1) x1 = v;
+    }
+    label[i] = (x0 - 0.7 * x1 > 0.0) ? 1.0 : 0.0;
+  }
+
+  SEXP s_mat = RStub_MakeReal(mat, (long)n * f);
+  SEXP ds = LGBM_R_DatasetCreateFromMat(
+      s_mat, RStub_MakeInt(n), RStub_MakeInt(f),
+      RStub_MakeString("objective=binary verbose=-1 num_leaves=15 "
+                       "min_data_in_leaf=5"));
+  LGBM_R_DatasetSetField(ds, RStub_MakeString("label"),
+                         RStub_MakeReal(label, n));
+  SEXP bst = LGBM_R_BoosterCreate(
+      ds, RStub_MakeString("objective=binary verbose=-1 num_leaves=15 "
+                           "min_data_in_leaf=5"));
+  for (int it = 0; it < 20; ++it) {
+    LGBM_R_BoosterUpdateOneIter(bst);
+  }
+  SEXP pred = LGBM_R_BoosterPredictForMat(
+      bst, s_mat, RStub_MakeInt(n), RStub_MakeInt(f), RStub_MakeInt(0),
+      RStub_MakeInt(-1));
+  if (Rf_length(pred) != n) {
+    fprintf(stderr, "bad prediction length %d\n", Rf_length(pred));
+    return 4;
+  }
+  int correct = 0;
+  for (int i = 0; i < n; ++i)
+    correct += ((REAL(pred)[i] > 0.5) == (label[i] > 0.5));
+  double acc = (double)correct / n;
+
+  LGBM_R_BoosterSaveModel(bst, RStub_MakeInt(-1),
+                          RStub_MakeString(model_path));
+  SEXP bst2 = LGBM_R_BoosterCreateFromModelfile(RStub_MakeString(model_path));
+  SEXP pred2 = LGBM_R_BoosterPredictForMat(
+      bst2, s_mat, RStub_MakeInt(n), RStub_MakeInt(f), RStub_MakeInt(0),
+      RStub_MakeInt(-1));
+  double maxdiff = 0.0;
+  for (int i = 0; i < n; ++i) {
+    double d = fabs(REAL(pred)[i] - REAL(pred2)[i]);
+    if (d > maxdiff) maxdiff = d;
+  }
+  LGBM_R_BoosterFree(bst);
+  LGBM_R_BoosterFree(bst2);
+  LGBM_R_DatasetFree(ds);
+  printf("R-HOST OK acc=%.3f maxdiff=%g\n", acc, maxdiff);
+  if (acc < 0.85) return 5;
+  if (maxdiff > 1e-10) return 6;
+  return 0;
+}

@@ -96,3 +96,74 @@ def test_cvbooster(rng):
     preds = cvb.predict(X[:16])
     assert len(preds) == 3 and all(p.shape == (16,) for p in preds)
     assert "binary_logloss-mean" in res
+
+
+def test_getter_tail(rng, tmp_path):
+    """Round-3 getter tail (reference c_api.h:316-739): GetSubset,
+    Merge, GetPredict, Get/SetLeafValue, PredictForFile, feature
+    names, NumberOfTotalModel, ResetParameter."""
+    X, y = _mk_data(rng)
+    dh = [None]
+    assert capi.LGBM_DatasetCreateFromMat(X, "verbose=-1", None, dh) == 0
+    assert capi.LGBM_DatasetSetField(dh[0], "label", y) == 0
+    names = [f"f{i}" for i in range(X.shape[1])]
+    assert capi.LGBM_DatasetSetFeatureNames(dh[0], names,
+                                            len(names)) == 0
+    got, nlen = [], [0]
+    assert capi.LGBM_DatasetGetFeatureNames(dh[0], got, nlen) == 0
+    assert got == names and nlen[0] == len(names)
+
+    sub = [None]
+    idx = np.arange(0, 200, dtype=np.int64)
+    assert capi.LGBM_DatasetGetSubset(dh[0], idx, len(idx),
+                                      "verbose=-1", sub) == 0, \
+        capi.LGBM_GetLastError()
+    assert capi.LGBM_DatasetGetNumData(sub[0], nlen) == 0
+    assert nlen[0] == 200
+
+    params = "objective=binary num_leaves=7 verbose=-1"
+    bh = [None]
+    assert capi.LGBM_BoosterCreate(dh[0], params, bh) == 0
+    for _ in range(4):
+        capi.LGBM_BoosterUpdateOneIter(bh[0], [None])
+
+    # GetPredict: converted training scores, length n * num_class
+    out_len = [0]
+    buf = np.zeros(X.shape[0], np.float64)
+    assert capi.LGBM_BoosterGetPredict(bh[0], 0, out_len, buf) == 0
+    assert out_len[0] == X.shape[0]
+    assert (buf >= 0).all() and (buf <= 1).all()
+
+    nm = [0]
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh[0], nm) == 0
+    assert nm[0] == 4
+
+    # leaf get/set round-trip invalidates device caches
+    v = [0.0]
+    assert capi.LGBM_BoosterGetLeafValue(bh[0], 0, 0, v) == 0
+    assert capi.LGBM_BoosterSetLeafValue(bh[0], 0, 0, v[0] + 0.25) == 0
+    v2 = [0.0]
+    assert capi.LGBM_BoosterGetLeafValue(bh[0], 0, 0, v2) == 0
+    assert abs(v2[0] - (v[0] + 0.25)) < 1e-12
+
+    # merge: another 2-tree booster's models append
+    bh2 = [None]
+    assert capi.LGBM_BoosterCreate(dh[0], params, bh2) == 0
+    for _ in range(2):
+        capi.LGBM_BoosterUpdateOneIter(bh2[0], [None])
+    assert capi.LGBM_BoosterMerge(bh[0], bh2[0]) == 0
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh[0], nm) == 0
+    assert nm[0] == 6
+
+    assert capi.LGBM_BoosterResetParameter(
+        bh[0], "learning_rate=0.05") == 0
+
+    # file predict round-trips through the text loader
+    fn = tmp_path / "pred_in.csv"
+    np.savetxt(fn, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+    outfn = tmp_path / "pred_out.tsv"
+    assert capi.LGBM_BoosterPredictForFile(
+        bh[0], str(fn), 0, 0, -1, "label_column=0", str(outfn)) == 0, \
+        capi.LGBM_GetLastError()
+    preds = np.loadtxt(outfn)
+    assert preds.shape[0] == X.shape[0]

@@ -103,7 +103,7 @@ lgb.importance <- function(booster) {
   lgb.save(booster, tmp)
   lines <- readLines(tmp)
   at <- which(lines == "feature importances:")
-  if (length(at) == 0L) {
+  if (length(at) == 0L || at[1] >= length(lines)) {
     return(data.frame(Feature = character(0), Frequency = numeric(0),
                       stringsAsFactors = FALSE))
   }

@@ -167,27 +167,31 @@ class GBDT:
                                      w.astype(np.float32))))
         self._bag_mask: Optional[jax.Array] = None
 
-        # multi-host: globally-sharded arrays may NOT be captured as
-        # jit closure constants (tracing fetches their value, which
-        # spans non-addressable devices) — they are threaded through
-        # the jit boundary as an explicit pytree argument and bound to
-        # their usual attributes for the dynamic extent of the trace
-        # (the grower's _ohb_arg pattern)
-        self._captives = None
-        if self._mh:
-            obj_caps = {}
-            if self.objective is not None:
-                obj_caps = {k: v for k, v in
-                            self.objective.__dict__.items()
-                            if k.endswith("_dev")
-                            and isinstance(v, jax.Array)}
-            self._captives = {
-                "bins": self.grower.bins,
-                "rv": self.grower._row_valid,
-                "fc": self._full_counts,
-                "w": self._weights_dev,
-                "obj": obj_caps,
-            }
+        # EVERY O(N) device array must cross the jit boundary as an
+        # ARGUMENT, never as a closure: closures are inlined as MLIR
+        # constants, which (a) makes XLA compile time linear in rows
+        # (~80 s per million measured — a HIGGS-scale compile took
+        # 25+ min) and (b) is impossible for multi-host sharded arrays
+        # (tracing fetches values spanning non-addressable devices).
+        # The captives pytree is built per call and bound to the usual
+        # attributes for the dynamic extent of the trace (the grower's
+        # _ohb_arg pattern).
+
+    def _build_captives(self):
+        obj_caps = {}
+        if self.objective is not None:
+            obj_caps = {k: v for k, v in self.objective.__dict__.items()
+                        if k.endswith("_dev")
+                        and isinstance(v, jax.Array)}
+        return {
+            "bins": self.grower.bins,
+            "binsT": self.grower.binsT,
+            "rv": self.grower._row_valid,
+            "fc": self._full_counts,
+            "w": self._weights_dev,
+            "obj": obj_caps,
+            "vbins": tuple(vs.bins for vs in self.valid_sets),
+        }
 
     @contextmanager
     def _bound_captives(self, cap):
@@ -195,18 +199,22 @@ class GBDT:
             yield
             return
         g, obj = self.grower, self.objective
-        saved = (g.bins, g._row_valid, self._full_counts,
+        saved = (g.bins, g.binsT, g._row_valid, self._full_counts,
                  self._weights_dev,
-                 {k: obj.__dict__[k] for k in cap["obj"]})
-        g.bins, g._row_valid = cap["bins"], cap["rv"]
+                 {k: obj.__dict__[k] for k in cap["obj"]}
+                 if obj is not None else {})
+        g.bins, g.binsT = cap["bins"], cap["binsT"]
+        g._row_valid = cap["rv"]
         self._full_counts, self._weights_dev = cap["fc"], cap["w"]
-        obj.__dict__.update(cap["obj"])
+        if obj is not None:
+            obj.__dict__.update(cap["obj"])
         try:
             yield
         finally:
-            (g.bins, g._row_valid, self._full_counts,
-             self._weights_dev) = saved[:4]
-            obj.__dict__.update(saved[4])
+            (g.bins, g.binsT, g._row_valid, self._full_counts,
+             self._weights_dev) = saved[:5]
+            if obj is not None:
+                obj.__dict__.update(saved[5])
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_set: Dataset, name: str) -> None:
@@ -347,10 +355,11 @@ class GBDT:
             # sample_active is a static cache key mirroring
             # self._sample_active(), which _boost_one reads at trace time
             del sample_active
+            vb = vbins if cap is None else cap["vbins"]
             with self._bound_captives(cap):
                 return self._boost_one(scores, vscores, bag_mask, key,
                                        fmask, shrinkage, fresh_bag,
-                                       vbins, ohb)
+                                       vb, ohb)
 
         self._fused_step = jax.jit(
             step, static_argnames=("fresh_bag", "sample_active"),
@@ -424,12 +433,14 @@ class GBDT:
 
         def chunk(scores, vscores, bag_mask, keys, fmasks, fresh_flags,
                   ohb=None, cap=None):
+            vb = vbins if cap is None else cap["vbins"]
+
             def one_iter(carry, xs):
                 scores, vscores, bag_mask = carry
                 key, fmask, fresh_bag = xs
                 scores, vscores, bag_mask, trees, nl = self._boost_one(
                     scores, vscores, bag_mask, key, fmask, shrinkage,
-                    fresh_bag, vbins, ohb)
+                    fresh_bag, vb, ohb)
                 return (scores, vscores, bag_mask), (trees, nl)
 
             with self._bound_captives(cap):
@@ -497,7 +508,7 @@ class GBDT:
             self.scores, tuple(vs.scores for vs in self.valid_sets),
             self._bag_state, keys, fmasks,
             fresh if isinstance(fresh, jax.Array) else jnp.asarray(fresh),
-            self.grower.ohb, self._captives)
+            self.grower.ohb, self._build_captives())
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
             vs.scores = s
@@ -548,7 +559,7 @@ class GBDT:
             self.scores, tuple(vs.scores for vs in self.valid_sets),
             self._bag_state, key, self._feature_masks(),
             jnp.asarray(self.shrinkage_rate, jnp.float32),
-            self.grower.ohb, self._captives,
+            self.grower.ohb, self._build_captives(),
             fresh_bag=fresh_bag, sample_active=self._sample_active())
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
@@ -574,6 +585,10 @@ class GBDT:
     def _train_one_iter_custom(self, grad, hess) -> bool:
         """Custom-gradient iteration (gradients cross the host boundary
         every call, like the reference's UpdateOneIterCustom)."""
+        if self._mh:
+            Log.fatal("multi-host training does not support custom "
+                      "gradient functions yet (host gradients cannot "
+                      "follow the sharded row layout)")
         self._before_boosting()
         self.timer.start("boosting")
         grad = np.asarray(grad, dtype=np.float32).reshape(

@@ -296,9 +296,11 @@ class Config:
     # (num_leaves, G, B, 3) f32 cache exceeds the bound, the grower
     # drops histogram subtraction and computes BOTH children of every
     # split directly from the data (2x histogram passes, no cache).
-    hist_onehot_budget_mb: int = 4096  # HBM budget for the streamed
-    # (N, G*B) int8 bin one-hot; datasets over budget rebuild the
-    # one-hot in-kernel per round instead
+    hist_onehot_budget_mb: int = 6144  # HBM budget for the resident
+    # streamed bin one-hot; datasets over budget (at every pack) rebuild
+    # the one-hot in-kernel per round instead.  6 GB leaves ~9 GB of a
+    # 16 GB v5e for bins/scores/gradients/temps — HIGGS scale (10.5M
+    # rows) needs 5.4 GB at pack=4
     hist_onehot_pack: int = 0       # one-hot columns per stored byte
     # (planar sub-byte packing, widened in-VMEM by the kernels): 1, 2
     # or 4; 0 = auto — the largest pack dividing G*B that fits the

@@ -373,6 +373,12 @@ class TreeGrower:
         self.use_pre_ohb = (self.use_pallas and not self.pallas_paired
                             and not self.use_quant_otf
                             and ohb_bytes <= budget)
+        if self.use_pallas and ohb_bytes > budget:
+            Log.warning(
+                f"resident one-hot ({ohb_bytes >> 20} MB at pack="
+                f"{self.ohb_pack}) exceeds hist_onehot_budget_mb="
+                f"{budget >> 20}; using the slower on-the-fly rebuild "
+                "(see docs/ROOFLINE.md regime table)")
         self.ohb = None
         self.binsT = (jnp.asarray(bins_np.T) if self.use_fused else None)
         self._route_cols = 15 + (self.max_feature_bin + 7) // 8
@@ -524,7 +530,8 @@ class TreeGrower:
         per-row post-route leaf value or None — see
         _train_tree_inner)."""
         return self._train_tree(grad, hess, counts, feature_mask,
-                                self.ohb)
+                                self.ohb, self.bins, self.binsT,
+                                self._row_valid)
 
     # ------------------------------------------------------------------
     def _hist_kernel(self, grad, hess, counts, leaf_id, slots=None,
@@ -768,15 +775,30 @@ class TreeGrower:
 
     # ------------------------------------------------------------------
     def _train_tree_impl(self, grad, hess, counts, feature_mask,
-                         ohb=None):
-        """``ohb`` is the streamed bin one-hot, threaded through the
-        caller's jit boundary as an argument (see _ohb_arg)."""
+                         ohb=None, bins=None, binsT=None,
+                         row_valid=None):
+        """``ohb``/``bins``/``binsT``/``row_valid`` are the O(N) device
+        arrays, threaded through the caller's jit boundary as ARGUMENTS
+        and bound to their attributes for the dynamic extent of the
+        trace.  Closing over them instead would inline each one as an
+        MLIR constant — the serialized program then carries the whole
+        matrix and XLA's compile time grows linearly with rows
+        (measured ~80 s per million rows; a HIGGS-scale compile took
+        25+ minutes before this)."""
         self._ohb_arg = ohb
+        saved = (self.bins, self.binsT, self._row_valid)
+        if bins is not None:
+            self.bins = bins
+        if binsT is not None:
+            self.binsT = binsT
+        if row_valid is not None:
+            self._row_valid = row_valid
         try:
             return self._train_tree_inner(grad, hess, counts,
                                           feature_mask)
         finally:
             self._ohb_arg = None
+            self.bins, self.binsT, self._row_valid = saved
 
     def _train_tree_inner(self, grad, hess, counts, feature_mask):
         state = self._init_state(grad, hess, counts)

@@ -431,7 +431,25 @@ def precompute_bin_onehot(bins: jax.Array, *,
     return oh.reshape(n, g * max_group_bin).astype(jnp.int8)
 
 
-@functools.partial(jax.jit, static_argnames=("max_group_bin", "pack"))
+@functools.partial(jax.jit,
+                   static_argnames=("max_group_bin", "pack", "gbp_pad"))
+def _packed_onehot_chunk(bc: jax.Array, gsel_d: jax.Array,
+                         bval_d: jax.Array, *, max_group_bin: int,
+                         pack: int, gbp_pad: int) -> jax.Array:
+    """One fixed-shape row chunk of the planar packing (jitted per
+    CHUNK shape, not per dataset size — XLA's compile time for the
+    whole-N single-program formulation grew ~linearly with N, hitting
+    minutes at HIGGS scale)."""
+    bits = 8 // pack
+    acc = None
+    for p in range(pack):
+        take = bc[:, gsel_d[p]].astype(jnp.int32)
+        plane = (take == bval_d[p][None, :]).astype(jnp.int8)
+        term = plane * jnp.int8(1 << (p * bits))
+        acc = term if acc is None else acc + term
+    return acc
+
+
 def precompute_bin_onehot_packed(bins: jax.Array, *, max_group_bin: int,
                                  pack: int) -> jax.Array:
     """(N, G) uint8 -> (N, G*B/pack) int8 PLANAR sub-byte one-hot.
@@ -459,26 +477,49 @@ def precompute_bin_onehot_packed(bins: jax.Array, *, max_group_bin: int,
     gbp = gb // pack
     gbp_pad = _round_up(gbp, 128)
     bits = 8 // pack
-    shifts = jnp.asarray([1 << (p * bits) for p in range(pack)],
-                         dtype=jnp.int8)
-    biota = jnp.arange(max_group_bin, dtype=jnp.int32)
-    # row-chunked so the transient full-width one-hot stays ~100 MB
+    # per-plane column maps: packed byte column j carries full one-hot
+    # column p*gbp + j = (group, bin); padding columns match nothing.
+    # (Plain gather/compare/add formulation — an earlier int8 einsum
+    # over (chunk, pack, gbp) sent XLA's LLVM backend into a ~4-minute
+    # compile at 10.5M rows.)
+    jcols = np.arange(gbp_pad)
+    gsel = np.zeros((pack, gbp_pad), np.int32)
+    bval = np.full((pack, gbp_pad), -1, np.int32)
+    for p in range(pack):
+        full = p * gbp + jcols[:gbp]
+        gsel[p, :gbp] = full // max_group_bin
+        bval[p, :gbp] = full % max_group_bin
+    del bits  # consumed inside the chunk kernel
+    gsel_d = jnp.asarray(gsel)
+    bval_d = jnp.asarray(bval)
+    # row-chunked so the transient per-plane intermediates stay ~100 MB;
+    # the loop runs HOST-side over device slices so the jitted program
+    # has a fixed, dataset-size-independent shape, and each chunk is
+    # written into ONE donated output buffer (materializing chunk parts
+    # + a concatenate would double the multi-GB resident footprint)
     chunk = max(1, (1 << 27) // max(gb, 1))
     chunk = min(n, max(256, (chunk // 256) * 256))
-    pad = (-n) % chunk
-    bins_p = jnp.pad(bins, ((0, pad), (0, 0)))
+    bins = jnp.asarray(bins)
+    out = jnp.zeros((n, gbp_pad), jnp.int8)
+    for i in range(0, n, chunk):
+        bc = bins[i:i + chunk]
+        take = bc.shape[0]
+        if take < chunk:
+            bc = jnp.pad(bc, ((0, chunk - take), (0, 0)))
+        part = _packed_onehot_chunk(
+            bc, gsel_d, bval_d, max_group_bin=max_group_bin, pack=pack,
+            gbp_pad=gbp_pad)
+        if take < chunk:
+            part = part[:take]
+        out = _write_packed_chunk(out, part, i)
+    return out
 
-    def one_chunk(bc):
-        oh = (bc.astype(jnp.int32)[:, :, None]
-              == biota[None, None, :]).astype(jnp.int8)
-        oh = oh.reshape(bc.shape[0], pack, gbp)
-        packed = jnp.einsum("cpj,p->cj", oh, shifts,
-                            preferred_element_type=jnp.int8)
-        return jnp.pad(packed, ((0, 0), (0, gbp_pad - gbp)))
 
-    out = jax.lax.map(one_chunk,
-                      bins_p.reshape(-1, chunk, g)).reshape(-1, gbp_pad)
-    return out[:n]
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_packed_chunk(out: jax.Array, part: jax.Array,
+                        start) -> jax.Array:
+    return jax.lax.dynamic_update_slice(
+        out, part, (jnp.asarray(start, jnp.int32), jnp.int32(0)))
 
 
 def _unpack_ohb_planes(pk: jax.Array, pack: int, out_dtype):

@@ -151,8 +151,13 @@ def finalize_global(ds):
             np.ascontiguousarray(ds.metadata.weight))).reshape(-1) \
             .astype(np.float32)
     if ds.metadata.init_score is not None:
-        md.init_score = np.asarray(multihost_utils.process_allgather(
-            np.ascontiguousarray(ds.metadata.init_score))).reshape(-1)
+        # init_score is class-major per host ((K, n_local) flattened);
+        # a naive concat would interleave hosts inside classes
+        init_l = np.ascontiguousarray(ds.metadata.init_score)
+        k = max(1, len(init_l) // n_local)
+        gathered = np.asarray(multihost_utils.process_allgather(
+            init_l)).reshape(nproc, k, n_local)
+        md.init_score = np.transpose(gathered, (1, 0, 2)).reshape(-1)
     ds.metadata = md
     ds._mh_local_rows = n_local
     ds._multihost = True

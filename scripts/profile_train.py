@@ -21,8 +21,6 @@ def main():
     os.environ.setdefault("BENCH_ROWS", str(rows))
     import jax
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     import bench
     import lightgbm_tpu as lgb
     from lightgbm_tpu.boosting.gbdt import GBDT

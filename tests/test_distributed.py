@@ -73,9 +73,8 @@ h = hashlib.sha256(model.encode()).hexdigest()
 pred = np.zeros(X[shard].shape[0])
 for t in g.models:
     pred += t.predict(X[shard])
-acc = float(((pred + g.init_score) > 0).astype(float).mean() * 0
-             + (((1/(1+np.exp(-(pred + g.init_score)))) > 0.5)
-                == y[shard]).mean())
+acc = float((((1/(1+np.exp(-(pred + g.init_score)))) > 0.5)
+             == y[shard]).mean())
 print(f"RANK {pid} model {h} trees {len(g.models)} acc {acc:.3f}",
       flush=True)
 assert acc > 0.85, acc

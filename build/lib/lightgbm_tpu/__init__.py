@@ -1,0 +1,27 @@
+"""lightgbm_tpu: a TPU-native gradient boosting framework.
+
+A from-scratch re-design of LightGBM (v2.1.1 feature surface) for
+JAX/XLA on TPU: HBM-resident packed bin matrix, MXU one-hot-matmul
+histograms, fully-jitted leaf-wise tree growth, XLA-collective
+distributed training.  User API mirrors the reference python package
+(lgb.train / Dataset / Booster / sklearn wrappers).
+"""
+from .basic import Dataset, Booster
+from .config import Config
+from .engine import train, cv, CVBooster
+from .utils.log import Log, LightGBMError
+from .callback import (early_stopping, print_evaluation, record_evaluation,
+                       reset_parameter)
+from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
+from . import plotting
+from .plotting import (plot_importance, plot_metric, plot_tree,
+                       create_tree_digraph)
+
+__version__ = "0.1.0"
+
+__all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster", "Log",
+           "LightGBMError", "early_stopping", "print_evaluation",
+           "record_evaluation", "reset_parameter", "LGBMModel",
+           "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+           "plot_importance", "plot_metric", "plot_tree",
+           "create_tree_digraph", "__version__"]

@@ -1,0 +1,297 @@
+"""User-facing Dataset (lazy) and Booster re-export.
+
+Mirrors the reference python package's basic.py: ``Dataset`` wraps raw
+data and constructs the binned core dataset lazily when training starts
+(reference: python-package/lightgbm/basic.py:572-1263 _lazy_init,
+reference alignment for validation data), so bin mappers are fitted with
+the final parameter set exactly once.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import numpy as np
+
+from .booster import Booster  # noqa: F401  (re-export)
+from .config import Config
+from .dataset import Dataset as CoreDataset
+from .utils.log import Log
+
+
+class Dataset:
+    """Lazy dataset handle (the lgb.Dataset analog)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, Sequence[str]] = "auto",
+                 categorical_feature: Union[str, Sequence] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = False):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._core: Optional[CoreDataset] = None
+
+    # ------------------------------------------------------------------
+    def construct(self, config: Optional[Config] = None) -> CoreDataset:
+        if self._core is not None:
+            return self._core
+        if config is None:
+            config = Config.from_params(self.params)
+        data = self.data
+        label = self.label
+        streaming_ok = (isinstance(data, str)
+                        and config.use_two_round_loading
+                        and self.reference is None
+                        and not isinstance(self.categorical_feature,
+                                           (list, tuple)))
+        if (isinstance(data, str) and config.use_two_round_loading
+                and not streaming_ok):
+            Log.warning("two_round loading does not support reference-"
+                        "aligned or explicitly-categorical datasets yet; "
+                        "falling back to in-RAM loading")
+        if streaming_ok:
+            # two-round streaming: the float matrix never exists
+            from .data_loader import load_file_streaming
+            self._core = load_file_streaming(data, config)
+            if isinstance(self.feature_name, (list, tuple)):
+                self._core.feature_names = list(self.feature_name)
+            if self.label is not None:
+                self._core.metadata.set_label(self.label)
+            if self.weight is not None:
+                self._core.metadata.set_weight(self.weight)
+            if self.group is not None:
+                self._core.metadata.set_group(self.group)
+            if self.init_score is not None:
+                self._core.metadata.set_init_score(self.init_score)
+            self._core.pandas_categorical = None
+            return self._core
+        if isinstance(data, str):
+            from .data_loader import load_file
+            data, label_from_file, extras = load_file(data, config)
+            if label is None:
+                label = label_from_file
+            if self.weight is None and extras.get("weight") is not None:
+                self.weight = extras["weight"]
+            if self.group is None and extras.get("group") is not None:
+                self.group = extras["group"]
+        ref_core = None
+        if self.reference is not None:
+            ref_core = self.reference.construct(config)
+        # validation frames must encode pandas categoricals against the
+        # TRAIN-time category lists (the reference aligns valid frames
+        # to the train categories and errors on mismatch)
+        train_cats = getattr(ref_core, "pandas_categorical", None)
+        pandas_cats = (train_cats if train_cats is not None
+                       else _pandas_categories(data))
+        data = _to_matrix(data, train_cats)
+        feature_names, cat_indices = self._resolve_columns(data)
+
+        self._core = CoreDataset.from_matrix(
+            data, label=label, weight=self.weight, group=self.group,
+            init_score=self.init_score, config=config,
+            categorical_features=cat_indices,
+            feature_names=feature_names, reference=ref_core)
+        self._core._raw_data = None if self.free_raw_data else data
+        self._core._categorical_features = cat_indices
+        self._core.pandas_categorical = pandas_cats
+        return self._core
+
+    # ------------------------------------------------------------------
+    def _resolve_columns(self, data: np.ndarray):
+        n_cols = data.shape[1]
+        feature_names = None
+        if isinstance(self.feature_name, (list, tuple)):
+            feature_names = list(self.feature_name)
+        elif _is_pandas(self.data):
+            feature_names = [str(c) for c in self.data.columns]
+        cat_indices = []
+        cf = self.categorical_feature
+        if isinstance(cf, (list, tuple)):
+            for c in cf:
+                if isinstance(c, str):
+                    if feature_names and c in feature_names:
+                        cat_indices.append(feature_names.index(c))
+                    else:
+                        Log.warning(f"Unknown categorical column {c}")
+                else:
+                    cat_indices.append(int(c))
+        elif cf == "auto" and _is_pandas(self.data):
+            for i, dtype in enumerate(self.data.dtypes):
+                if str(dtype) == "category":
+                    cat_indices.append(i)
+        return feature_names, cat_indices
+
+    # ------------------------------------------------------------------
+    def set_label(self, label):
+        self.label = label
+        if self._core is not None:
+            self._core.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight):
+        self.weight = weight
+        if self._core is not None:
+            self._core.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group):
+        self.group = group
+        if self._core is not None:
+            self._core.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score):
+        self.init_score = init_score
+        if self._core is not None:
+            self._core.metadata.set_init_score(init_score)
+        return self
+
+    def set_field(self, name, data):
+        if name == "label":
+            return self.set_label(data)
+        if name == "weight":
+            return self.set_weight(data)
+        if name in ("group", "query"):
+            return self.set_group(data)
+        if name == "init_score":
+            return self.set_init_score(data)
+        Log.fatal(f"Unknown field {name}")
+
+    def get_field(self, name):
+        if self._core is not None:
+            return self._core.metadata.get_field(name)
+        return {"label": self.label, "weight": self.weight,
+                "group": self.group, "init_score": self.init_score}.get(name)
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_group(self):
+        return self.get_field("group")
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
+    def num_data(self) -> int:
+        if self._core is not None:
+            return self._core.num_data
+        d = self.data
+        if isinstance(d, str):
+            Log.fatal("Cannot get num_data before construction of a "
+                      "file-backed Dataset")
+        if _is_sparse(d):
+            return d.shape[0]
+        return _to_matrix(d).shape[0]
+
+    def num_feature(self) -> int:
+        if self._core is not None:
+            return self._core.num_total_features
+        if _is_sparse(self.data):
+            return self.data.shape[1]
+        return _to_matrix(self.data).shape[1]
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        if _is_sparse(self.data):
+            data = self.data.tocsr()[used_indices]
+        else:
+            data = _to_matrix(self.data)[used_indices]
+        label = (None if self.label is None
+                 else np.asarray(self.label)[used_indices])
+        weight = (None if self.weight is None
+                  else np.asarray(self.weight)[used_indices])
+        return Dataset(data, label=label, weight=weight,
+                       feature_name=self.feature_name,
+                       categorical_feature=self.categorical_feature,
+                       params=params or self.params, reference=self)
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       feature_name=self.feature_name,
+                       categorical_feature=self.categorical_feature,
+                       params=params or self.params)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        from .dataset_io import save_binary
+        save_binary(self.construct(), filename)
+        return self
+
+
+def _is_pandas(obj) -> bool:
+    return type(obj).__module__.startswith("pandas") and \
+        hasattr(obj, "dtypes")
+
+
+def _to_matrix(data, pandas_categorical=None) -> np.ndarray:
+    """Raw input -> float64 matrix.  Pandas category-dtype columns
+    encode as their category codes; when ``pandas_categorical`` (the
+    train-time category lists, in categorical-column order) is given,
+    codes are computed AGAINST THOSE categories so a predict-time frame
+    with reordered or fewer observed categories maps identically
+    (reference basic.py pandas_categorical handling); unseen categories
+    become NaN."""
+    if isinstance(data, np.ndarray):
+        return np.ascontiguousarray(data.astype(np.float64, copy=False))
+    if _is_pandas(data) and not hasattr(data, "columns"):
+        # a Series: single row of raw features
+        return np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+    if _is_pandas(data):
+        import pandas as pd
+        n_cat = sum(1 for c in data.columns
+                    if str(data[c].dtype) == "category")
+        if pandas_categorical is not None \
+                and n_cat != len(pandas_categorical):
+            raise ValueError(
+                "train and valid/predict dataset categorical_feature do "
+                f"not match: trained with {len(pandas_categorical)} "
+                f"categorical columns, got {n_cat}")
+        cols = []
+        i_cat = 0
+        for c in data.columns:
+            col = data[c]
+            if str(col.dtype) == "category":
+                if pandas_categorical is not None:
+                    cats = pandas_categorical[i_cat]
+                    codes = pd.Categorical(
+                        col, categories=cats).codes.astype(np.float64)
+                    codes[codes < 0] = np.nan
+                else:
+                    codes = col.cat.codes.to_numpy().astype(np.float64)
+                cols.append(codes)
+                i_cat += 1
+            else:
+                cols.append(col.to_numpy().astype(np.float64))
+        return np.ascontiguousarray(np.stack(cols, axis=1))
+    if _is_sparse(data):
+        # sparse stays sparse: Dataset construction bins CSC columns
+        # directly and prediction densifies in bounded row chunks —
+        # the whole-matrix float64 densify of a 100k x 10k 99%-sparse
+        # input would be 8 GB for 80 MB of payload
+        return data.tocsc()
+    return np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+
+
+def _is_sparse(obj) -> bool:
+    return hasattr(obj, "tocsc") and hasattr(obj, "nnz")
+
+
+def _pandas_categories(data):
+    """Category lists of category-dtype columns, in column order (the
+    reference's pandas_categorical model attribute)."""
+    if not _is_pandas(data):
+        return None
+    cats = [list(data[c].cat.categories) for c in data.columns
+            if str(data[c].dtype) == "category"]
+    return cats or None

@@ -1,0 +1,460 @@
+"""Host-side binning pipeline: value -> bin discretization.
+
+TPU-native re-design of the reference's BinMapper
+(reference: include/LightGBM/bin.h:59-207, src/io/bin.cpp:73-390).
+Semantics are preserved — GreedyFindBin's count-balanced boundary
+placement, the zero-as-one-bin split, the MissingType {None, Zero, NaN}
+state machine, categorical most-frequent-first mapping with the 99%%
+coverage cut — but the runtime mapping path is vectorized
+(``np.searchsorted`` over all rows at once) instead of a per-value
+binary search, because the output feeds a packed ``(N, F)`` uint8
+device matrix rather than per-feature Bin objects.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .utils.log import Log
+
+K_ZERO_THRESHOLD = 1e-35  # reference: meta.h:40
+_INF = float("inf")
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+BIN_NUMERICAL = 0
+BIN_CATEGORICAL = 1
+
+MISSING_TYPE_NAMES = {MISSING_NONE: "none", MISSING_ZERO: "zero",
+                      MISSING_NAN: "nan"}
+
+
+def _next_after_up(a: float) -> float:
+    """Smallest double strictly greater than a (reference common.h:842)."""
+    return math.nextafter(a, _INF)
+
+
+def _double_equal_ordered(a: float, b: float) -> bool:
+    """b <= nextafter(a, inf) — reference common.h:837."""
+    return b <= _next_after_up(a)
+
+
+def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                    max_bin: int, total_cnt: int,
+                    min_data_in_bin: int) -> List[float]:
+    """Count-balanced bin boundary search (reference bin.cpp:73-150).
+
+    Returns ascending bin upper bounds ending with +inf.  Few distinct
+    values get one bin each (respecting min_data_in_bin); many distinct
+    values get boundaries targeting ~total/max_bin samples per bin, with
+    'big' values (count >= mean bin size) pinned to their own bins.
+    """
+    num_distinct = len(distinct_values)
+    assert max_bin > 0
+    bounds: List[float] = []
+    if num_distinct <= max_bin:
+        cur = 0
+        for i in range(num_distinct - 1):
+            cur += int(counts[i])
+            if cur >= min_data_in_bin:
+                val = _next_after_up(
+                    (float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0)
+                if not bounds or not _double_equal_ordered(bounds[-1], val):
+                    bounds.append(val)
+                    cur = 0
+        bounds.append(_INF)
+        return bounds
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_sample_cnt = total_cnt - int(counts[is_big].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+
+    # The reference walks every distinct value accumulating counts until
+    # a boundary triggers (bin.cpp:104-136).  Equivalent but O(bins):
+    # jump straight to each boundary with searchsorted — a boundary at j
+    # is the first index where (a) j is big, (b) accumulated >= mean, or
+    # (c) j+1 is big and accumulated >= mean/2.
+    cum = np.cumsum(counts)                                # (D,)
+    rest_cum = np.cumsum(np.where(is_big, 0, counts))      # (D,)
+    big_pos = np.flatnonzero(is_big)                       # ascending
+    uppers: List[float] = []
+    lowers: List[float] = [float(distinct_values[0])]
+    i = 0
+    last = num_distinct - 1                                # exclusive walk end
+    while i < last and len(uppers) < max_bin - 1:
+        base = cum[i - 1] if i > 0 else 0
+        # (a) next big value at/after i
+        bi = np.searchsorted(big_pos, i)
+        j1 = int(big_pos[bi]) if bi < len(big_pos) else num_distinct
+        # (b) first j with cum[j] - base >= mean_bin_size
+        j2 = int(np.searchsorted(cum, base + mean_bin_size))
+        # (c) first big-successor position p-1 >= the half-mean point
+        half_at = int(np.searchsorted(cum, base + max(1.0,
+                                                      mean_bin_size * 0.5)))
+        bj = np.searchsorted(big_pos, max(i, half_at) + 1)
+        j3 = int(big_pos[bj]) - 1 if bj < len(big_pos) else num_distinct
+        # clamp to the walk position: when mean_bin_size hits 0 (all
+        # non-big samples exhausted) the scalar loop makes every
+        # remaining value its own bin, i.e. the boundary is at i itself
+        j = max(i, min(j1, j2, j3))
+        if j >= last:
+            break
+        uppers.append(float(distinct_values[j]))
+        lowers.append(float(distinct_values[j + 1]))
+        if not is_big[j]:
+            rest_bin_cnt -= 1
+            mean_bin_size = (rest_sample_cnt - rest_cum[j]) \
+                / max(rest_bin_cnt, 1)
+        i = j + 1
+    for i in range(len(uppers)):
+        val = _next_after_up((uppers[i] + lowers[i + 1]) / 2.0)
+        if not bounds or not _double_equal_ordered(bounds[-1], val):
+            bounds.append(val)
+    bounds.append(_INF)
+    return bounds
+
+
+def find_bin_with_zero_as_one_bin(distinct_values: np.ndarray,
+                                  counts: np.ndarray, max_bin: int,
+                                  total_sample_cnt: int,
+                                  min_data_in_bin: int) -> List[float]:
+    """Split the value line into (-inf, -eps], (-eps, eps], (eps, inf) and
+    bin the negative/positive sides separately so that zero always owns
+    exactly one bin (reference bin.cpp:151-206)."""
+    dv = np.asarray(distinct_values, dtype=np.float64)
+    cnt = np.asarray(counts, dtype=np.int64)
+    left_mask = dv <= -K_ZERO_THRESHOLD
+    right_mask = dv > K_ZERO_THRESHOLD
+    zero_mask = ~left_mask & ~right_mask
+    left_cnt_data = int(cnt[left_mask].sum())
+    cnt_zero = int(cnt[zero_mask].sum())
+    right_cnt_data = int(cnt[right_mask].sum())
+
+    nonleft = np.nonzero(~left_mask)[0]
+    left_cnt = int(nonleft[0]) if len(nonleft) else len(dv)
+
+    bounds: List[float] = []
+    if left_cnt > 0:
+        denom = max(total_sample_cnt - cnt_zero, 1)
+        left_max_bin = max(1, int(left_cnt_data / denom * (max_bin - 1)))
+        bounds = greedy_find_bin(dv[:left_cnt], cnt[:left_cnt], left_max_bin,
+                                 left_cnt_data, min_data_in_bin)
+        bounds[-1] = -K_ZERO_THRESHOLD
+
+    right_idx = np.nonzero(right_mask)[0]
+    right_start = int(right_idx[0]) if len(right_idx) else -1
+    if right_start >= 0:
+        right_max_bin = max_bin - 1 - len(bounds)
+        assert right_max_bin > 0
+        right_bounds = greedy_find_bin(dv[right_start:], cnt[right_start:],
+                                       right_max_bin, right_cnt_data,
+                                       min_data_in_bin)
+        bounds.append(K_ZERO_THRESHOLD)
+        bounds.extend(right_bounds)
+    else:
+        bounds.append(_INF)
+    return bounds
+
+
+def _need_filter(cnt_in_bin: Sequence[int], total_cnt: int, filter_cnt: int,
+                 bin_type: int) -> bool:
+    """True if no split of this feature can put >= filter_cnt samples on
+    both sides (reference bin.cpp:49-71)."""
+    if bin_type == BIN_NUMERICAL:
+        left = 0
+        for c in cnt_in_bin[:-1]:
+            left += c
+            if left >= filter_cnt and total_cnt - left >= filter_cnt:
+                return False
+        return True
+    if len(cnt_in_bin) <= 2:
+        for c in cnt_in_bin[:-1]:
+            if c >= filter_cnt and total_cnt - c >= filter_cnt:
+                return False
+        return True
+    return False
+
+
+class BinMapper:
+    """Per-feature value->bin mapping (reference bin.h:59-207).
+
+    Attributes mirror the reference's serialized state: ``num_bin``,
+    ``missing_type``, ``is_trivial``, ``sparse_rate``, ``bin_type``,
+    ``bin_upper_bound`` (numerical) or ``bin_2_categorical`` /
+    ``categorical_2_bin`` (categorical), ``min_val``/``max_val``,
+    ``default_bin``.
+    """
+
+    __slots__ = ("num_bin", "missing_type", "is_trivial", "sparse_rate",
+                 "bin_type", "bin_upper_bound", "bin_2_categorical",
+                 "categorical_2_bin", "min_val", "max_val", "default_bin")
+
+    def __init__(self):
+        self.num_bin = 1
+        self.missing_type = MISSING_NONE
+        self.is_trivial = True
+        self.sparse_rate = 0.0
+        self.bin_type = BIN_NUMERICAL
+        self.bin_upper_bound: np.ndarray = np.array([_INF])
+        self.bin_2_categorical: List[int] = []
+        self.categorical_2_bin: dict = {}
+        self.min_val = 0.0
+        self.max_val = 0.0
+        self.default_bin = 0
+
+    # ------------------------------------------------------------------
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int,
+                 max_bin: int, min_data_in_bin: int, min_split_data: int,
+                 bin_type: int = BIN_NUMERICAL, use_missing: bool = True,
+                 zero_as_missing: bool = False) -> None:
+        """Fit the mapping from sampled non-zero values
+        (reference bin.cpp:207-390).  ``total_sample_cnt`` includes the
+        implicit zeros not present in ``values``."""
+        values = np.asarray(values, dtype=np.float64)
+        nan_mask = np.isnan(values)
+        na_cnt = int(nan_mask.sum())
+        values = values[~nan_mask]
+        if not use_missing:
+            self.missing_type = MISSING_NONE
+            na_cnt = 0
+        elif zero_as_missing:
+            self.missing_type = MISSING_ZERO
+        else:
+            self.missing_type = MISSING_NONE if na_cnt == 0 else MISSING_NAN
+        if self.missing_type != MISSING_NAN:
+            na_cnt = 0
+
+        self.bin_type = bin_type
+        self.default_bin = 0
+        zero_cnt = int(total_sample_cnt - len(values) - na_cnt)
+
+        distinct, counts = self._distinct_with_zero(values, zero_cnt)
+        self.min_val = float(distinct[0])
+        self.max_val = float(distinct[-1])
+        num_distinct = len(distinct)
+
+        if bin_type == BIN_NUMERICAL:
+            if self.missing_type == MISSING_NAN:
+                bounds = find_bin_with_zero_as_one_bin(
+                    distinct, counts, max_bin - 1, total_sample_cnt - na_cnt,
+                    min_data_in_bin)
+                bounds.append(float("nan"))
+            else:
+                bounds = find_bin_with_zero_as_one_bin(
+                    distinct, counts, max_bin, total_sample_cnt,
+                    min_data_in_bin)
+                if self.missing_type == MISSING_ZERO and len(bounds) == 2:
+                    self.missing_type = MISSING_NONE
+            self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+            self.num_bin = len(bounds)
+            assert self.num_bin <= max_bin
+            cnt_in_bin = np.zeros(self.num_bin, dtype=np.int64)
+            search_bounds = self.bin_upper_bound[:self.num_bin - 1] \
+                if self.missing_type == MISSING_NAN else self.bin_upper_bound
+            idx = np.searchsorted(search_bounds[:-1] if len(search_bounds) else [],
+                                  distinct, side="left")
+            # idx = first bin whose upper bound >= value
+            np.add.at(cnt_in_bin, idx, counts)
+            if self.missing_type == MISSING_NAN:
+                cnt_in_bin[self.num_bin - 1] = na_cnt
+        else:
+            cnt_in_bin = self._fit_categorical(distinct, counts, max_bin,
+                                               min_data_in_bin,
+                                               total_sample_cnt, na_cnt)
+
+        self.is_trivial = self.num_bin <= 1
+        if not self.is_trivial and _need_filter(
+                cnt_in_bin.tolist(), total_sample_cnt, min_split_data,
+                bin_type):
+            self.is_trivial = True
+        if not self.is_trivial:
+            self.default_bin = int(self.value_to_bin(np.zeros(1))[0])
+            if bin_type == BIN_CATEGORICAL:
+                assert self.default_bin > 0
+        self.sparse_rate = float(cnt_in_bin[self.default_bin]) / max(
+            total_sample_cnt, 1)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _distinct_with_zero(values: np.ndarray,
+                            zero_cnt: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Distinct sorted values with the implicit zero spliced in at its
+        ordered position carrying ``zero_cnt`` (reference bin.cpp:234-269).
+        Near-equal doubles (within one ulp) are merged keeping the larger.
+        Vectorized: runs of near-equal values become groups (a group's
+        value is its max = last element); the zero splice lands at the
+        adjacent negative->positive group boundary."""
+        values = np.sort(np.asarray(values, dtype=np.float64))
+        n = len(values)
+        if n == 0:
+            return (np.asarray([0.0]),
+                    np.asarray([zero_cnt], dtype=np.int64))
+        new_grp = np.empty(n, dtype=bool)
+        new_grp[0] = True
+        # chain rule matches the scalar loop: compare each value to its
+        # RAW predecessor (merged groups keep the larger value)
+        new_grp[1:] = values[1:] > np.nextafter(values[:-1], _INF)
+        starts = np.flatnonzero(new_grp)
+        ends = np.append(starts[1:], n) - 1
+        distinct = values[ends]
+        counts = np.diff(np.append(starts, n)).astype(np.int64)
+        if values[0] > 0.0 and zero_cnt > 0:
+            distinct = np.concatenate([[0.0], distinct])
+            counts = np.concatenate([[zero_cnt], counts])
+        elif values[-1] < 0.0 and zero_cnt > 0:
+            distinct = np.concatenate([distinct, [0.0]])
+            counts = np.concatenate([counts, [zero_cnt]])
+        else:
+            # splice between the last negative and first positive group
+            # (suppressed when an exact-zero group sits between them,
+            # matching the scalar loop's strict sign checks)
+            k = int(np.searchsorted(distinct, 0.0, side="left"))
+            if 0 < k < len(distinct) and distinct[k - 1] < 0.0 \
+                    and distinct[k] > 0.0:
+                distinct = np.insert(distinct, k, 0.0)
+                counts = np.insert(counts, k, zero_cnt)
+        return distinct, counts.astype(np.int64)
+
+    # ------------------------------------------------------------------
+    def _fit_categorical(self, distinct: np.ndarray, counts: np.ndarray,
+                         max_bin: int, min_data_in_bin: int,
+                         total_sample_cnt: int, na_cnt: int) -> np.ndarray:
+        """Most-frequent-first category->bin mapping with 99%% coverage cut
+        (reference bin.cpp:303-368)."""
+        int_vals: List[int] = []
+        int_cnts: List[int] = []
+        for v, c in zip(distinct, counts):
+            iv = int(v)
+            if iv < 0:
+                na_cnt += int(c)
+                Log.warning("Met negative value in categorical features, "
+                            "will convert it to NaN")
+            elif int_vals and iv == int_vals[-1]:
+                int_cnts[-1] += int(c)
+            else:
+                int_vals.append(iv)
+                int_cnts.append(int(c))
+        # sort by count descending (stable)
+        order = sorted(range(len(int_vals)), key=lambda i: -int_cnts[i])
+        int_vals = [int_vals[i] for i in order]
+        int_cnts = [int_cnts[i] for i in order]
+        # bin 0 must not map category 0 (bin 0 is the group's shared
+        # default slot downstream)
+        if int_vals and int_vals[0] == 0:
+            if len(int_vals) == 1:
+                int_vals.append(int_vals[0] + 1)
+                int_cnts.append(0)
+            int_vals[0], int_vals[1] = int_vals[1], int_vals[0]
+            int_cnts[0], int_cnts[1] = int_cnts[1], int_cnts[0]
+
+        cut_cnt = int((total_sample_cnt - na_cnt) * 0.99)
+        self.categorical_2_bin = {}
+        self.bin_2_categorical = []
+        self.num_bin = 0
+        used_cnt = 0
+        max_bin = min(len(int_vals), max_bin)
+        cnt_in_bin: List[int] = []
+        cur = 0
+        while cur < len(int_vals) and (used_cnt < cut_cnt
+                                       or self.num_bin < max_bin):
+            if int_cnts[cur] < min_data_in_bin and cur > 1:
+                break
+            self.bin_2_categorical.append(int_vals[cur])
+            self.categorical_2_bin[int_vals[cur]] = self.num_bin
+            used_cnt += int_cnts[cur]
+            cnt_in_bin.append(int_cnts[cur])
+            self.num_bin += 1
+            cur += 1
+        if cur == len(int_vals) and na_cnt > 0:
+            self.bin_2_categorical.append(-1)
+            self.categorical_2_bin[-1] = self.num_bin
+            cnt_in_bin.append(0)
+            self.num_bin += 1
+        if cur == len(int_vals) and na_cnt == 0:
+            self.missing_type = MISSING_NONE
+        elif na_cnt == 0:
+            self.missing_type = MISSING_ZERO
+        else:
+            self.missing_type = MISSING_NAN
+        cnt_in_bin[-1] += int(total_sample_cnt - used_cnt)
+        return np.asarray(cnt_in_bin, dtype=np.int64)
+
+    # ------------------------------------------------------------------
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value->bin (reference bin.h:450-486 ValueToBin)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BIN_NUMERICAL:
+            nan_mask = np.isnan(values)
+            v = np.where(nan_mask, 0.0, values)
+            n_search = self.num_bin - (1 if self.missing_type == MISSING_NAN
+                                       else 0)
+            # first bin whose upper bound >= value
+            bins = np.searchsorted(self.bin_upper_bound[:n_search - 1], v,
+                                   side="left").astype(np.int32)
+            if self.missing_type == MISSING_NAN:
+                bins = np.where(nan_mask, self.num_bin - 1, bins)
+            return bins
+        iv = values.astype(np.int64)
+        iv = np.where(np.isnan(values), -1, iv)
+        out = np.full(len(values), self.num_bin - 1, dtype=np.int32)
+        if self.categorical_2_bin:
+            keys = np.fromiter(self.categorical_2_bin.keys(), dtype=np.int64)
+            vals = np.fromiter(self.categorical_2_bin.values(), dtype=np.int32)
+            max_key = int(keys.max())
+            lut = np.full(max_key + 2, self.num_bin - 1, dtype=np.int32)
+            pos_keys = keys >= 0
+            lut[keys[pos_keys]] = vals[pos_keys]
+            in_range = (iv >= 0) & (iv <= max_key)
+            out[in_range] = lut[iv[in_range]]
+        return out
+
+    # ------------------------------------------------------------------
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Representative threshold value for a bin (used by model text
+        format: the split threshold written is the bin's upper bound)."""
+        if self.bin_type == BIN_NUMERICAL:
+            return float(self.bin_upper_bound[bin_idx])
+        return float(self.bin_2_categorical[bin_idx])
+
+    # ------------------------------------------------------------------
+    def feature_info_str(self) -> str:
+        """The model-file `feature_infos` entry (reference
+        dataset.h:556-568): `[min:max]` for numerical, `a:b:c` for
+        categorical, `none` for trivial."""
+        if self.is_trivial:
+            return "none"
+        if self.bin_type == BIN_NUMERICAL:
+            return f"[{self.min_val:g}:{self.max_val:g}]"
+        return ":".join(str(c) for c in self.bin_2_categorical)
+
+    def __repr__(self):
+        kind = "num" if self.bin_type == BIN_NUMERICAL else "cat"
+        return (f"BinMapper({kind}, num_bin={self.num_bin}, "
+                f"missing={MISSING_TYPE_NAMES[self.missing_type]}, "
+                f"trivial={self.is_trivial}, default_bin={self.default_bin})")
+
+
+def find_bin_mappers(sample_values: List[np.ndarray], total_sample_cnt: int,
+                     max_bin: int, min_data_in_bin: int, min_split_data: int,
+                     categorical_features: Optional[set] = None,
+                     use_missing: bool = True,
+                     zero_as_missing: bool = False) -> List[BinMapper]:
+    """Fit one BinMapper per feature from per-feature sampled non-zero
+    values (reference dataset_loader.cpp:523-605 serial path)."""
+    categorical_features = categorical_features or set()
+    mappers = []
+    for fidx, vals in enumerate(sample_values):
+        m = BinMapper()
+        bt = BIN_CATEGORICAL if fidx in categorical_features else BIN_NUMERICAL
+        m.find_bin(vals, total_sample_cnt, max_bin, min_data_in_bin,
+                   min_split_data, bt, use_missing, zero_as_missing)
+        mappers.append(m)
+    return mappers

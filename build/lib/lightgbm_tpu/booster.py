@@ -1,0 +1,675 @@
+"""Booster: the user-facing trained-model handle.
+
+The analog of the reference's C-API Booster + python Booster
+(reference: src/c_api.cpp:29-311, python-package/lightgbm/basic.py:1264+)
+— owns the boosting object during training and the host-side tree list
+for prediction/serialization; model text format is interchangeable with
+the reference's (gbdt_model_text.cpp:235-315).
+"""
+from __future__ import annotations
+
+import functools
+import re
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from .config import Config, canonical_objective
+from .dataset import Dataset
+from .tree import Tree
+from .utils.log import Log
+
+MODEL_VERSION = "v2"
+
+_ACC_FN = None
+
+
+def _acc_fn():
+    """Module-level jitted tree-stack accumulator for the device
+    predict path: one compilation per (shapes, max_steps), shared by
+    every Booster and every predict() call (a per-call closure would
+    re-trace each time)."""
+    global _ACC_FN
+    if _ACC_FN is None:
+        import jax
+        from .ops.predict import predict_binned
+
+        @functools.partial(jax.jit, static_argnames=("max_steps",))
+        def acc(total, stack, shrink_arr, vbins, f_group, g2f_lut,
+                f_missing, f_default_bin, f_num_bin, *, max_steps):
+            def body(carry, xs):
+                tr, sh = xs
+                pv = predict_binned(tr, vbins, f_group, g2f_lut,
+                                    f_missing, f_default_bin, f_num_bin,
+                                    max_steps=max_steps)
+                return carry + sh * pv, None
+            out, _ = jax.lax.scan(body, total, (stack, shrink_arr))
+            return out
+        _ACC_FN = acc
+    return _ACC_FN
+
+
+class Booster:
+    def __init__(self, config: Optional[Config] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 init_model=None, custom_objective: bool = False):
+        self.config = config or Config()
+        self.gbdt = None
+        # set when host-side tree arrays are mutated after training
+        # (refit): the device-resident stacks are then stale and the
+        # batched device predict must not serve from them
+        self._device_stale = False
+        self.best_iteration = -1
+        self.models: List[Tree] = []
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.max_feature_idx = 0
+        self.objective_str = "regression"
+        self.average_output = False
+
+        if model_file is not None:
+            with open(model_file) as f:
+                self._load_from_string(f.read())
+            return
+        if model_str is not None:
+            self._load_from_string(model_str)
+            return
+        if train_set is None:
+            return
+
+        from .boosting import create_boosting
+        self.gbdt = create_boosting(self.config, train_set,
+                                    custom_objective=custom_objective)
+        self.average_output = getattr(self.gbdt, "average_output", False)
+        self.models = self.gbdt.models      # shared list, grows in place
+        self.num_class = self.config.num_class
+        self.num_tree_per_iteration = self.config.num_tree_per_iteration
+        self.feature_names = train_set.feature_names
+        self.feature_infos = train_set.feature_infos()
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.pandas_categorical = getattr(train_set, "pandas_categorical",
+                                          None)
+        self.objective_str = self._objective_to_string()
+        if init_model is not None:
+            base = (Booster(model_file=init_model)
+                    if isinstance(init_model, str) else init_model)
+            self._continue_from(base, train_set)
+
+    # ------------------------------------------------------------------
+    def _objective_to_string(self) -> str:
+        o = self.config.objective
+        if o == "binary":
+            return f"binary sigmoid:{self.config.sigmoid:g}"
+        if o in ("multiclass", "multiclassova"):
+            s = f"{o} num_class:{self.config.num_class}"
+            if o == "multiclassova":
+                s += f" sigmoid:{self.config.sigmoid:g}"
+            return s
+        if o == "regression" and self.config.reg_sqrt:
+            return "regression sqrt"
+        if o == "lambdarank":
+            return "lambdarank"
+        return o
+
+    # ------------------------------------------------------------------
+    def _continue_from(self, base: "Booster", train_set: Dataset) -> None:
+        """Continued training: seed scores with the old model's
+        predictions (reference boosting.cpp:44-60 + gbdt.h MergeFrom)."""
+        import jax.numpy as jnp
+        raw = train_set._raw_data
+        if raw is None:
+            Log.fatal("Continued training requires raw data on the Dataset")
+        base._sync_models()
+        pred = base.predict(raw, raw_score=True)
+        pred = pred.reshape(self.num_class, train_set.num_data) \
+            if pred.ndim > 1 and self.num_class > 1 else \
+            pred.reshape(1, -1) if pred.ndim == 1 else pred.T
+        pad = self.gbdt.grower.n_padded - train_set.num_data
+        pred = np.pad(pred.astype(np.float32), ((0, 0), (0, pad)))
+        self.gbdt.scores = self.gbdt.scores + jnp.asarray(pred)
+        for t in base.models:
+            self.models.append(t)
+            # register foreign trees in the lazy-materialization
+            # bookkeeping so flush_models() indexes stay aligned
+            self.gbdt._tree_scale.append(1.0)
+            self.gbdt._applied_scale.append(1.0)
+            self.gbdt._scale_offset += 1
+        # note: models list order => merged model predicts old + new trees
+
+    # ------------------------------------------------------------------
+    def update(self, train_set=None, fobj=None) -> bool:
+        if fobj is not None:
+            score = self._current_train_scores()
+            grad, hess = fobj(score, self.gbdt.train_set)
+            return self.gbdt.train_one_iter(grad, hess)
+        return self.gbdt.train_one_iter()
+
+    def rollback_one_iter(self):
+        self.gbdt.rollback_one_iter()
+        # a later update() can restore the same tree COUNT with a
+        # different tree — a length-keyed stack cache would serve the
+        # rolled-back ensemble
+        self._raw_stack_cache = None
+
+    def _sync_models(self) -> None:
+        """Materialize any device-resident trees into self.models
+        (one batched transfer; no-op for file-loaded models)."""
+        if self.gbdt is not None:
+            self.gbdt.flush_models()
+
+    @property
+    def current_iteration(self) -> int:
+        return self.gbdt.iter_ if self.gbdt else \
+            len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def num_trees(self) -> int:
+        self._sync_models()
+        return len(self.models)
+
+    def _current_train_scores(self) -> np.ndarray:
+        s = np.asarray(self.gbdt.scores[:, :self.gbdt.num_data])
+        if self.num_tree_per_iteration == 1:
+            return s[0]
+        return s.T.reshape(-1, order="F")  # class-major like reference
+
+    # ------------------------------------------------------------------
+    def predict(self, data: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0,
+                device: Optional[bool] = None) -> np.ndarray:
+        """Prediction on raw features (reference
+        gbdt_prediction.cpp:9-100; SHAP via tree.PredictContrib;
+        margin-based early stop prediction_early_stop.cpp:13-80).
+
+        ``device``: None (auto) routes large batch predictions of
+        in-session models through the accelerator — the input is binned
+        with the training mappers and the device-resident trees are
+        evaluated in one scanned program (the TPU analog of the
+        reference's OMP batch predict, c_api.cpp:200).  The device path
+        accumulates in float32 (the host walk uses float64), so raw
+        scores may differ at ~1e-6 relative.  True forces it, False
+        forces the host path."""
+        from .basic import _is_sparse, _to_matrix
+        if _is_sparse(data):
+            # CSR prediction without whole-matrix densify (reference
+            # c_api.h:574 PredictForCSR): bounded row chunks keep the
+            # dense staging under ~128 MB regardless of width
+            csr = data.tocsr()
+            chunk = max(1, (128 << 20) // max(8 * csr.shape[1], 1))
+            parts = [self.predict(
+                np.asarray(csr[i:i + chunk].todense(), dtype=np.float64),
+                num_iteration=num_iteration, raw_score=raw_score,
+                pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                pred_early_stop=pred_early_stop,
+                pred_early_stop_freq=pred_early_stop_freq,
+                pred_early_stop_margin=pred_early_stop_margin,
+                device=device)
+                for i in range(0, csr.shape[0], chunk)]
+            return np.concatenate(parts, axis=0)
+        # pandas categoricals encode against the TRAIN-time category
+        # lists so reordered/unseen predict-time categories map right
+        data = _to_matrix(data, getattr(self, "pandas_categorical", None))
+        if data.ndim == 1:
+            data = data[None, :]
+        n = data.shape[0]
+        k = max(self.num_tree_per_iteration, 1)
+
+        if not pred_leaf and not pred_contrib and not pred_early_stop:
+            if self._can_device_predict(n, num_iteration, device):
+                # in-session single-class fast path: binned device scan
+                raw = self._device_predict_raw(data, num_iteration)[:, None]
+                if not raw_score and not self.average_output:
+                    raw = self._convert_output(raw)
+                return raw[:, 0]
+            if self._can_device_predict_loaded(n, num_iteration, device):
+                # every OTHER model kind (file-loaded, multiclass, DART
+                # -renormalized, init_model-merged, RF): raw-feature
+                # stacked walk (reference c_api.cpp:177-211 batch
+                # predict covers all models; so does this)
+                raw, used = self._device_predict_loaded(data,
+                                                        num_iteration)
+                raw = self._add_init_and_average(raw, used)
+                if not raw_score and not self.average_output:
+                    raw = self._convert_output(raw)
+                return raw[:, 0] if k == 1 else raw
+
+        models = self._used_models(num_iteration)
+
+        if pred_leaf:
+            out = np.zeros((n, len(models)), dtype=np.int32)
+            for i, t in enumerate(models):
+                out[:, i] = t.predict_leaf(data)
+            return out
+        if pred_contrib:
+            from .shap import predict_contrib
+            return predict_contrib(self, data, models)
+
+        raw = np.zeros((n, k), dtype=np.float64)
+        if pred_early_stop and not self.average_output:
+            # rows whose margin already exceeds the threshold skip the
+            # remaining trees, checked every pred_early_stop_freq trees
+            # (reference prediction_early_stop.cpp: binary |score|,
+            # multiclass top-2 gap)
+            active = np.ones(n, dtype=bool)
+            for i, t in enumerate(models):
+                if not active.any():
+                    break
+                raw[active, i % k] += t.predict(data[active])
+                if (i + 1) % (pred_early_stop_freq * k) == 0:
+                    if k == 1:
+                        margin = np.abs(raw[:, 0])
+                    else:
+                        part = np.partition(raw, k - 2, axis=1)
+                        margin = part[:, -1] - part[:, -2]
+                    active &= margin < pred_early_stop_margin
+        else:
+            for i, t in enumerate(models):
+                raw[:, i % k] += t.predict(data)
+        raw = self._add_init_and_average(raw, len(models))
+        if not raw_score and not self.average_output:
+            # RF leaf outputs are already in converted space
+            raw = self._convert_output(raw)
+        return raw[:, 0] if k == 1 else raw
+
+    def _resolve_tree_count(self, total: int, num_iteration: int) -> int:
+        """Shared num_iteration/best_iteration -> tree-count resolution
+        (used by both the host and device predict paths so they can
+        never slice different counts)."""
+        k = max(self.num_tree_per_iteration, 1)
+        if num_iteration is None or num_iteration <= 0:
+            if self.best_iteration > 0:
+                num_iteration = self.best_iteration
+            else:
+                return total
+        return min(total, num_iteration * k)
+
+    def _n_used_trees(self, num_iteration: int) -> int:
+        total = (len(self.gbdt.device_trees) if self.gbdt is not None
+                 else len(self.models))
+        return self._resolve_tree_count(total, num_iteration)
+
+    def _can_device_predict(self, n: int, num_iteration: int,
+                            device: Optional[bool]) -> bool:
+        """Batch device predict is valid for single-class in-session
+        models with uniform tree scaling (no DART renorm, no foreign
+        init_model trees, not RF averaging)."""
+        if device is False or self.gbdt is None or self._device_stale:
+            return False
+        g = self.gbdt
+        ok = (self.num_tree_per_iteration == 1
+              and not self.average_output
+              and g._scale_offset == 0
+              and len(g.device_trees) > 0
+              and all(s == 1.0 for s in g._tree_scale))
+        if not ok:
+            return False
+        if device is True:
+            return True
+        import jax
+        n_trees = self._n_used_trees(num_iteration)
+        return (jax.default_backend() in ("tpu", "axon")
+                and n * n_trees >= 2_000_000)
+
+    def _device_predict_raw(self, data: np.ndarray,
+                            num_iteration: int) -> np.ndarray:
+        """Raw scores via the accelerator: bin the input against the
+        training mappers, then accumulate a lax.scan of predict_binned
+        over the device-resident tree stacks."""
+        import jax
+        import jax.numpy as jnp
+
+        g = self.gbdt
+        gr = g.grower
+        cfg = g.config
+        vcore = Dataset.from_matrix(np.asarray(data, dtype=np.float64),
+                                    config=cfg, reference=g.train_set)
+        vbins = jnp.asarray(vcore.group_bins)
+        n_trees = self._n_used_trees(num_iteration)
+        shrinks = g._tree_shrink[:n_trees]
+
+        acc = _acc_fn()
+
+        def acc_jit(total, part, sh):
+            return acc(total, part, sh, vbins, gr.f_group, gr.g2f_lut,
+                       gr.f_missing, gr.f_default_bin, gr.f_num_bin,
+                       max_steps=cfg.num_leaves)
+        # iter-0 trained in session => the boost_from_average bias is
+        # NOT folded into the device trees (flush folds it host-side)
+        total = jnp.full(vbins.shape[0], np.float32(g.init_score))
+        i = 0
+        entries = g.device_trees[:n_trees]
+        while i < len(entries):
+            e = entries[i]
+            if isinstance(e, tuple) and e and e[0] == "stackref":
+                stack = e[1]
+                j0 = e[2]
+                j1 = j0
+                while (i + (j1 - j0) + 1 < len(entries)
+                       and isinstance(entries[i + (j1 - j0) + 1], tuple)
+                       and entries[i + (j1 - j0) + 1][0] == "stackref"
+                       and entries[i + (j1 - j0) + 1][1] is stack
+                       and entries[i + (j1 - j0) + 1][2] == j1 + 1):
+                    j1 += 1
+                count = j1 - j0 + 1
+                part = jax.tree_util.tree_map(
+                    lambda x: x[j0:j0 + count], stack)
+                sh = jnp.asarray(np.asarray(
+                    shrinks[i:i + count], np.float32))
+                total = acc_jit(total, part, sh)
+                i += count
+            else:
+                part = jax.tree_util.tree_map(lambda x: x[None], e)
+                sh = jnp.asarray(np.asarray(shrinks[i:i + 1], np.float32))
+                total = acc_jit(total, part, sh)
+                i += 1
+        return np.asarray(total)
+
+    def _can_device_predict_loaded(self, n: int, num_iteration: int,
+                                   device: Optional[bool]) -> bool:
+        """Raw-feature stacked device predict: valid for any model with
+        host trees (loaded, multiclass, DART, init_model, RF)."""
+        if device is False:
+            return False
+        total = len(self.models) or (
+            len(self.gbdt.device_trees) if self.gbdt is not None else 0)
+        if total == 0:
+            return False
+        if device is True:
+            return True
+        import jax
+        n_trees = self._resolve_tree_count(total, num_iteration)
+        return (jax.default_backend() in ("tpu", "axon")
+                and n * n_trees >= 2_000_000)
+
+    def _device_predict_loaded(self, data: np.ndarray,
+                               num_iteration: int):
+        """Raw scores via the stacked raw-feature walk.  Returns
+        ((n, k) float64 raw scores, used tree count).  Accumulation is
+        float32 (documented device-predict precision); decisions match
+        the host walk exactly via the two-float threshold compare."""
+        import jax
+        import jax.numpy as jnp
+
+        from .ops.predict import (predict_raw_ensemble, split_hi_lo,
+                                  stack_host_trees)
+
+        self._sync_models()
+        count = self._resolve_tree_count(len(self.models), num_iteration)
+        cache = getattr(self, "_raw_stack_cache", None)
+        if cache is None or cache[0] != len(self.models):
+            cache = (len(self.models), stack_host_trees(self.models))
+            self._raw_stack_cache = cache
+        stack = cache[1]
+        if count < len(self.models):
+            stack = jax.tree_util.tree_map(lambda x: x[:count], stack)
+        k = max(self.num_tree_per_iteration, 1)
+        cls = jnp.arange(count, dtype=jnp.int32) % k
+        Xhi, Xlo = split_hi_lo(data)
+        out = predict_raw_ensemble(
+            stack, jnp.asarray(Xhi), jnp.asarray(Xlo), cls,
+            jnp.zeros((k, data.shape[0]), jnp.float32))
+        return np.asarray(out).T.astype(np.float64), count
+
+    def _used_models(self, num_iteration: int) -> List[Tree]:
+        self._sync_models()
+        return self.models[:self._resolve_tree_count(len(self.models),
+                                                     num_iteration)]
+
+    def _add_init_and_average(self, raw, num_models):
+        if self.average_output and num_models:
+            raw = raw / (num_models // max(self.num_tree_per_iteration, 1))
+        return raw
+
+    def _convert_output(self, raw: np.ndarray) -> np.ndarray:
+        obj = self.objective_str.split()[0] if self.objective_str else ""
+        obj = canonical_objective(obj)
+        if obj == "binary":
+            m = re.search(r"sigmoid:([0-9.eE+-]+)", self.objective_str)
+            sig = float(m.group(1)) if m else 1.0
+            return 1.0 / (1.0 + np.exp(-sig * raw))
+        if obj == "multiclass":
+            e = np.exp(raw - raw.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        if obj == "multiclassova":
+            m = re.search(r"sigmoid:([0-9.eE+-]+)", self.objective_str)
+            sig = float(m.group(1)) if m else 1.0
+            return 1.0 / (1.0 + np.exp(-sig * raw))
+        if obj in ("poisson", "gamma", "tweedie"):
+            return np.exp(raw)
+        if obj == "regression" and "sqrt" in self.objective_str:
+            return np.sign(raw) * raw * raw
+        if obj == "cross_entropy":
+            return 1.0 / (1.0 + np.exp(-raw))
+        if obj == "cross_entropy_lambda":
+            return np.log1p(np.exp(raw))
+        return raw
+
+    # ------------------------------------------------------------------
+    def eval(self) -> List:
+        return self.gbdt.eval_metrics() if self.gbdt else []
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: int = -1) -> None:
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration))
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        """reference gbdt_model_text.cpp:235-315 SaveModelToString."""
+        models = self._used_models(num_iteration)
+        out = ["tree", f"version={MODEL_VERSION}",
+               f"num_class={self.num_class}",
+               f"num_tree_per_iteration={self.num_tree_per_iteration}",
+               "label_index=0",
+               f"max_feature_idx={self.max_feature_idx}",
+               f"objective={self.objective_str}"]
+        if self.average_output:
+            out.append("average_output")
+        out.append("feature_names=" + " ".join(self.feature_names))
+        out.append("feature_infos=" + " ".join(self.feature_infos))
+        tree_strs = []
+        for i, t in enumerate(models):
+            tree_strs.append(f"Tree={i}\n{t.to_string()}\n")
+        out.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+        out.append("")
+        text = "\n".join(out) + "\n" + "".join(tree_strs)
+        # feature importances footer
+        imp = self.feature_importance("split", num_iteration)
+        pairs = [(int(v), self.feature_names[i]) for i, v in enumerate(imp)
+                 if v > 0]
+        pairs.sort(key=lambda p: -p[0])
+        text += "\nfeature importances:\n"
+        for v, name in pairs:
+            text += f"{name}={v}\n"
+        if getattr(self, "pandas_categorical", None):
+            # trailing mapping line, like the reference python package
+            import json as _json
+            text += "\npandas_categorical:%s\n" % _json.dumps(
+                self.pandas_categorical, default=str)
+        return text
+
+    # ------------------------------------------------------------------
+    def _load_from_string(self, text: str) -> None:
+        """reference gbdt_model_text.cpp:317+ LoadModelFromString."""
+        self.pandas_categorical = None
+        for line in reversed(text.rstrip().splitlines()[-3:]):
+            if line.startswith("pandas_categorical:"):
+                import json as _json
+                try:
+                    self.pandas_categorical = _json.loads(
+                        line[len("pandas_categorical:"):])
+                except ValueError:
+                    pass
+                text = text[:text.rfind("pandas_categorical:")]
+                break
+        header, _, rest = text.partition("Tree=0")
+        kv = {}
+        for line in header.splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        self.num_class = int(kv.get("num_class", "1"))
+        self.num_tree_per_iteration = int(
+            kv.get("num_tree_per_iteration", "1"))
+        self.max_feature_idx = int(kv.get("max_feature_idx", "0"))
+        self.objective_str = kv.get("objective", "regression")
+        self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos = kv.get("feature_infos", "").split()
+        self.average_output = "average_output" in header.splitlines()
+        self.models = []
+        if not rest:
+            return
+        blocks = re.split(r"Tree=\d+\n", "Tree=0" + rest)
+        for block in blocks:
+            block = block.strip()
+            if not block or block.startswith("feature importances"):
+                continue
+            block = block.split("\nfeature importances")[0]
+            if "num_leaves" not in block:
+                continue
+            self.models.append(Tree.from_string(block))
+
+    # ------------------------------------------------------------------
+    def dump_model(self, num_iteration: int = -1) -> Dict[str, Any]:
+        """JSON model dump (reference gbdt_model_text.cpp:20-180
+        DumpModel / Tree::ToJSON)."""
+        models = self._used_models(num_iteration)
+
+        def node_json(tree: Tree, node: int):
+            if node < 0:
+                leaf = -node - 1
+                return {"leaf_index": leaf,
+                        "leaf_value": float(tree.leaf_value[leaf]),
+                        "leaf_count": int(tree.leaf_count[leaf])}
+            dt = int(tree.decision_type[node])
+            is_cat = bool(dt & 1)
+            mtype = {0: "None", 1: "Zero", 2: "NaN"}[(dt >> 2) & 3]
+            out = {
+                "split_index": int(node),
+                "split_feature": int(tree.split_feature[node]),
+                "split_gain": float(tree.split_gain[node]),
+                "threshold": float(tree.threshold[node]),
+                "decision_type": "==" if is_cat else "<=",
+                "default_left": bool(dt & 2),
+                "missing_type": mtype,
+                "internal_value": float(tree.internal_value[node]),
+                "internal_count": int(tree.internal_count[node]),
+                "left_child": node_json(tree, int(tree.left_child[node])),
+                "right_child": node_json(tree, int(tree.right_child[node])),
+            }
+            if is_cat:
+                ci = int(tree.threshold[node])
+                lo, hi = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+                out["cat_threshold"] = list(tree.cat_threshold[lo:hi])
+            return out
+
+        return {
+            "name": "tree",
+            "version": MODEL_VERSION,
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": 0,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": self.objective_str,
+            "average_output": self.average_output,
+            "feature_names": list(self.feature_names),
+            "tree_info": [
+                {"tree_index": i, "num_leaves": t.num_leaves,
+                 "num_cat": t.num_cat, "shrinkage": t.shrinkage,
+                 "tree_structure": node_json(
+                     t, 0 if t.num_leaves > 1 else -1)}
+                for i, t in enumerate(models)],
+        }
+
+    # ------------------------------------------------------------------
+    def refit(self, data: np.ndarray, label: np.ndarray,
+              params: Optional[Dict[str, Any]] = None) -> "Booster":
+        """Refit leaf values on new data keeping the tree structures
+        (reference gbdt.cpp:338-360 RefitTree + c_api refit task)."""
+        from .config import Config
+        from .dataset import Metadata
+        from .objectives import create_objective
+        from .ops.split import calculate_leaf_output
+
+        import jax.numpy as jnp  # noqa: F401  (objectives use jnp)
+
+        params = dict(params or {})
+        params.setdefault("objective", self.objective_str.split()[0])
+        if self.num_tree_per_iteration > 1:
+            params.setdefault("num_class", self.num_tree_per_iteration)
+        config = Config.from_params(params)
+        from .basic import _is_sparse
+        if not _is_sparse(data):
+            # sparse stays sparse — refit only reads the data through
+            # predict(pred_leaf=True), which densifies in bounded chunks
+            data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        n = data.shape[0]
+        objective = create_objective(config)
+        meta = Metadata(n)
+        meta.set_label(label)
+        objective.init(meta, n)
+
+        self._sync_models()
+        k = max(self.num_tree_per_iteration, 1)
+        leaf_preds = self.predict(data, pred_leaf=True)  # (n, ntrees)
+        scores = np.zeros((n, k), dtype=np.float64)
+        for i, tree in enumerate(self.models):
+            cls = i % k
+            s = scores if k > 1 else scores[:, 0]
+            g, h = objective.get_gradients(np.asarray(s, dtype=np.float32))
+            g = np.asarray(g)
+            h = np.asarray(h)
+            if k > 1:
+                g, h = g[:, cls], h[:, cls]
+            lp = leaf_preds[:, i]
+            shrink = tree.shrinkage if tree.shrinkage != 0 else 1.0
+            for leaf in range(tree.num_leaves):
+                mask = lp == leaf
+                if not mask.any():
+                    continue
+                sg, sh = float(g[mask].sum()), float(h[mask].sum())
+                out = float(calculate_leaf_output(
+                    np.float64(sg), np.float64(sh), config.lambda_l1,
+                    config.lambda_l2, config.max_delta_step))
+                tree.leaf_value[leaf] = out * shrink
+                tree.leaf_count[leaf] = int(mask.sum())
+            scores[:, cls] += tree.leaf_value[lp]
+        # host trees diverged from the in-session device stacks;
+        # invalidate both device paths' caches (the raw-stack path
+        # rebuilds from the refitted host trees on next use)
+        self._device_stale = True
+        self._raw_stack_cache = None
+        return self
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = -1) -> np.ndarray:
+        """reference gbdt.h FeatureImportance."""
+        models = self._used_models(num_iteration)
+        n = self.max_feature_idx + 1
+        imp = np.zeros(n, dtype=np.float64)
+        for t in models:
+            m = t.num_leaves - 1
+            for i in range(m):
+                f = t.split_feature[i]
+                if importance_type == "split":
+                    imp[f] += 1
+                else:
+                    imp[f] += max(t.split_gain[i], 0.0)
+        return imp
+
+    # ------------------------------------------------------------------
+    def __getstate__(self):
+        state = {"model_str": self.model_to_string(),
+                 "best_iteration": self.best_iteration}
+        return state
+
+    def __setstate__(self, state):
+        self.__init__(model_str=state["model_str"])
+        self.best_iteration = state.get("best_iteration", -1)

@@ -1,0 +1,111 @@
+"""DART: Dropouts meet Multiple Additive Regression Trees.
+
+Re-design of the reference DART (src/boosting/dart.hpp:26-201):
+weight-proportional (or uniform) tree dropping before each gradient
+computation, then the k/(k+1) (or xgboost-mode) renormalization of the
+dropped trees.  Where the reference mutates model trees with Shrinkage
+and replays AddScore, here tree contributions are recomputed on device
+by traversing the HBM-resident bin matrix (ops/predict.py) and score
+arrays are adjusted by weight deltas.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..utils.log import Log
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    def __init__(self, config: Config, train_set: Dataset, **kwargs):
+        super().__init__(config, train_set, **kwargs)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []   # current weight per iteration
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+
+    # ------------------------------------------------------------------
+    def _before_boosting(self) -> None:
+        self._dropping_trees()
+
+    def _dropping_trees(self) -> None:
+        """reference dart.hpp:86-136 DroppingTrees."""
+        cfg = self.config
+        self.drop_index = []
+        is_skip = self._drop_rng.rand() < cfg.skip_drop
+        if not is_skip and self.iter_ > 0:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                inv_avg = len(self.tree_weight) / max(self.sum_weight, 1e-30)
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate,
+                                    cfg.max_drop * inv_avg
+                                    / max(self.sum_weight, 1e-30))
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < \
+                            drop_rate * self.tree_weight[i] * inv_avg:
+                        self.drop_index.append(i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter_)
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+        # remove dropped trees' contribution from the training scores
+        for i in self.drop_index:
+            w = self.tree_weight[i]
+            for k in range(self.num_class):
+                t = self.device_trees[i * self.num_class + k]
+                pred = self._predict_valid_fn(t, self.grower.bins)
+                self.scores = self.scores.at[k].add(-w * pred)
+        k_drop = len(self.drop_index)
+        if not self.config.xgboost_dart_mode:
+            self.shrinkage_rate = self.config.learning_rate / (1.0 + k_drop)
+        else:
+            self.shrinkage_rate = (self.config.learning_rate if k_drop == 0
+                                   else self.config.learning_rate
+                                   / (self.config.learning_rate + k_drop))
+
+    # ------------------------------------------------------------------
+    def _after_iteration(self) -> None:
+        """Normalize dropped trees (reference dart.hpp:147-186) and
+        record the new tree's weight."""
+        cfg = self.config
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            w = self.tree_weight[i]
+            if not cfg.xgboost_dart_mode:
+                new_w = w * (k / (k + 1.0))
+            else:
+                new_w = w * (k / (k + cfg.learning_rate))
+            for ki in range(self.num_class):
+                idx = i * self.num_class + ki
+                t = self.device_trees[idx]
+                pred_train = self._predict_valid_fn(t, self.grower.bins)
+                self.scores = self.scores.at[ki].add(new_w * pred_train)
+                for vs in self.valid_sets:
+                    pv = self._predict_valid_fn(t, vs.bins)
+                    vs.scores = vs.scores.at[ki].add((new_w - w) * pv)
+                # record the weight change; flush_models() bakes the
+                # cumulative scale into the host tree lazily
+                # (_scale_offset skips trees merged from an init_model)
+                scale = new_w / w if w != 0 else 0.0
+                self._tree_scale[self._scale_offset + idx] *= scale
+            if not cfg.uniform_drop:
+                self.sum_weight -= w * (1.0 / (k + 1.0)
+                                        if not cfg.xgboost_dart_mode
+                                        else 1.0 / (k + cfg.learning_rate))
+                self.tree_weight[i] = new_w
+            else:
+                self.tree_weight[i] = new_w
+        # record this iteration's tree weight (dart.hpp:60-64)
+        self.tree_weight.append(self.shrinkage_rate)
+        self.sum_weight += self.shrinkage_rate

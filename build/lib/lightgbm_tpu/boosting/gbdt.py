@@ -1,0 +1,884 @@
+"""GBDT: the boosting orchestrator.
+
+TPU-native re-design of the reference GBDT
+(reference: src/boosting/gbdt.{h,cpp}; TrainOneIter hot path
+gbdt.cpp:386-481, bagging :234-316, boost_from_average :362-384,
+early stopping :582-639, score updating :528-580).  Scores, gradients
+and the binned matrix live on device for the whole run; one boosting
+iteration is ONE jitted call (gradients -> bagging mask -> tree growth
+-> score update -> validation-score update) with no host sync.  Host
+work per iteration is O(1) dispatch only; finished trees stay on device
+and are pulled to host models in a single batched transfer when the
+model is actually needed (flush_models) — on a remote-attached TPU
+every host pull costs a full RPC round trip, so the loop never blocks
+on one.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..learner.grower import TreeGrower, TreeArrays
+from ..metrics import Metric, create_metrics
+from ..objectives import Objective, create_objective
+from ..ops.histogram import leaf_value_broadcast
+from ..ops.predict import predict_binned
+from ..tree import Tree
+from ..utils.log import Log, PhaseTimer
+
+
+class _ValidSet:
+    """Per-validation-set device state (the ScoreUpdater analog,
+    reference score_updater.hpp:17-120)."""
+
+    def __init__(self, dataset: Dataset, num_class: int, init_score: float,
+                 metrics: List[Metric]):
+        self.dataset = dataset
+        self.num_data = dataset.num_data
+        self.bins = jax.device_put(dataset.group_bins)
+        self.scores = jnp.full((num_class, dataset.num_data), 0.0,
+                               dtype=jnp.float32)
+        if dataset.metadata.init_score is not None:
+            init = dataset.metadata.init_score.astype(np.float32)
+            self.scores = jnp.asarray(
+                init.reshape(num_class, dataset.num_data))
+        if init_score != 0.0:
+            self.scores = self.scores + init_score
+        self.metrics = metrics
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree trainer."""
+
+    def __init__(self, config: Config, train_set: Dataset,
+                 objective: Optional[Objective] = None,
+                 custom_objective: bool = False):
+        self.config = config
+        self.train_set = train_set
+        self.num_data = train_set.num_data
+        self.objective = (None if custom_objective else
+                          (objective if objective is not None
+                           else create_objective(config)))
+        self.num_class = config.num_tree_per_iteration
+        self.shrinkage_rate = config.learning_rate
+
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, self.num_data)
+
+        self.grower = TreeGrower(train_set, config)
+        # multi-host (finalize_global): device metadata arrays must
+        # follow the assembled per-host-padded row layout, sharded
+        self._mh = self.grower._mh_local is not None
+        if self._mh and self.objective is not None:
+            if self.objective.is_renew_tree_output:
+                Log.fatal(
+                    "multi-host training does not support "
+                    "RenewTreeOutput objectives (l1/huber/quantile/"
+                    f"mape) yet — got {self.objective.name}; the "
+                    "percentile refit needs a global sort across hosts")
+            self.objective.repad_device_arrays(
+                lambda a: self.grower.policy.place_rows(
+                    self.grower.pad_rows(a)))
+        self.models: List[Tree] = []
+        self.device_trees: List[TreeArrays] = []   # kept for DART drops
+        self.iter_ = 0
+        self.train_metrics: List[Metric] = []
+        self.valid_sets: List[_ValidSet] = []
+        self.valid_names: List[str] = []
+
+        # boost_from_average (reference gbdt.cpp:362-384)
+        self.init_score = 0.0
+        has_init = train_set.metadata.init_score is not None
+        if (self.objective is not None and config.boost_from_average
+                and not has_init and self.num_class == 1):
+            self.init_score = float(self.objective.boost_from_score())
+            if abs(self.init_score) > 1e-15:
+                Log.info(f"Start training from score {self.init_score:f}")
+
+        base = np.zeros((self.num_class, self.num_data), dtype=np.float32)
+        if has_init:
+            base += train_set.metadata.init_score.reshape(
+                self.num_class, self.num_data).astype(np.float32)
+        base += self.init_score
+        padded = np.stack([self.grower.pad_rows(base[c])
+                           for c in range(self.num_class)])
+        self.scores = self.grower.policy.place_score_rows(padded)
+
+        # per-phase wall-clock accounting (the TIMETAG analog,
+        # reference gbdt.cpp:21-29/52-61); reported at Log.debug level
+        # when training finishes
+        self.timer = PhaseTimer()
+        self._rng = np.random.RandomState(config.seed)
+        self._bag_rng = jax.random.PRNGKey(config.bagging_seed)
+        self._iter_key_rng = np.random.RandomState(config.bagging_seed)
+        self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
+        self._grad_fn = jax.jit(self._compute_gradients)
+        self._update_train_fn = jax.jit(self._update_train_scores)
+        self._predict_valid_fn = jax.jit(self._predict_valid)
+        self._eval_cache: Dict[Tuple[int, int], List[float]] = {}
+        # lazily-materialized host models: finished device trees queue in
+        # _pending as (TreeArrays, shrinkage, bias) and are pulled in one
+        # batched transfer by flush_models()
+        self._pending: List[Tuple[TreeArrays, float, float]] = []
+        self._scale_offset = 0   # foreign (init_model) trees precede ours
+        self._tree_scale: List[float] = []    # DART renorm per model idx
+        self._tree_shrink: List[float] = []   # shrinkage at train time
+        # (feeds the batched device predict; reset_parameter may vary it)
+        self._applied_scale: List[float] = []  # scale baked into models[i]
+        self._nl_window: List[jax.Array] = []  # deferred 1-leaf stop checks
+        # (entries are () or (n,) device arrays — kept stacked so a
+        # chunk never pays per-iteration slice dispatches)
+        self._nl_count = 0
+        # deferred no-split stop detection: each check is a device->host
+        # pull (a full RPC round trip on a remote-attached chip, ~60 ms
+        # measured) — amortize it far beyond the reference's every-
+        # iteration check; 1-leaf trees contribute exactly zero score,
+        # so the late rollback is exact (see _check_stop_window)
+        self._stop_check_every = 64
+        # threefry PRNGKey(seed) layout is [hi, lo] uint32 — verified
+        # once so chunk key batches can be built host-side in numpy
+        # (n PRNGKey dispatches per chunk each cost a remote RPC)
+        self._np_keys_ok = bool(np.array_equal(
+            np.asarray(jax.random.PRNGKey(7)),
+            np.array([0, 7], np.uint32)))
+        self._fused_step = None
+        self._fused_chunk = None
+        self._fused_chunk_n = 0
+        self._bag_state: Optional[jax.Array] = None
+        # early stopping state per (dataset, metric-output)
+        self._best_score: Dict[Tuple[int, int], float] = {}
+        self._best_iter: Dict[Tuple[int, int], int] = {}
+        self.best_iteration = -1
+
+        # row weights as count channel (bagging multiplies into this)
+        w = train_set.metadata.weight
+        self._full_counts = self.grower.policy.place_rows(
+            self.grower.pad_rows(np.ones(self.num_data,
+                                         dtype=np.float32)))
+        self._weights_dev = (None if w is None else
+                             self.grower.policy.place_rows(
+                                 self.grower.pad_rows(
+                                     w.astype(np.float32))))
+        self._bag_mask: Optional[jax.Array] = None
+
+        # EVERY O(N) device array must cross the jit boundary as an
+        # ARGUMENT, never as a closure: closures are inlined as MLIR
+        # constants, which (a) makes XLA compile time linear in rows
+        # (~80 s per million measured — a HIGGS-scale compile took
+        # 25+ min) and (b) is impossible for multi-host sharded arrays
+        # (tracing fetches values spanning non-addressable devices).
+        # The captives pytree is built per call and bound to the usual
+        # attributes for the dynamic extent of the trace (the grower's
+        # _ohb_arg pattern).
+
+    def _build_captives(self):
+        obj_caps = {}
+        if self.objective is not None:
+            obj_caps = {k: v for k, v in self.objective.__dict__.items()
+                        if k.endswith("_dev")
+                        and isinstance(v, jax.Array)}
+        return {
+            "bins": self.grower.bins,
+            "binsT": self.grower.binsT,
+            "rv": self.grower._row_valid,
+            "fc": self._full_counts,
+            "w": self._weights_dev,
+            "obj": obj_caps,
+            "vbins": tuple(vs.bins for vs in self.valid_sets),
+        }
+
+    @contextmanager
+    def _bound_captives(self, cap):
+        if cap is None:
+            yield
+            return
+        g, obj = self.grower, self.objective
+        saved = (g.bins, g.binsT, g._row_valid, self._full_counts,
+                 self._weights_dev,
+                 {k: obj.__dict__[k] for k in cap["obj"]}
+                 if obj is not None else {})
+        g.bins, g.binsT = cap["bins"], cap["binsT"]
+        g._row_valid = cap["rv"]
+        self._full_counts, self._weights_dev = cap["fc"], cap["w"]
+        if obj is not None:
+            obj.__dict__.update(cap["obj"])
+        try:
+            yield
+        finally:
+            (g.bins, g.binsT, g._row_valid, self._full_counts,
+             self._weights_dev) = saved[:5]
+            if obj is not None:
+                obj.__dict__.update(saved[5])
+
+    # ------------------------------------------------------------------
+    def add_valid(self, valid_set: Dataset, name: str) -> None:
+        if self._mh:
+            Log.fatal("multi-host training does not support validation "
+                      "sets yet (metric scores live sharded across "
+                      "hosts) — evaluate after training instead")
+        metrics = create_metrics(self.config)
+        for m in metrics:
+            m.init(valid_set.metadata, valid_set.num_data)
+        self.valid_sets.append(
+            _ValidSet(valid_set, self.num_class, self.init_score, metrics))
+        self.valid_names.append(name)
+
+    def add_train_metrics(self) -> None:
+        self.train_metrics = create_metrics(self.config)
+        for m in self.train_metrics:
+            m.init(self.train_set.metadata, self.num_data)
+
+    # ------------------------------------------------------------------
+    def _compute_gradients(self, scores):
+        """scores: (K, n_padded) -> (K, n_padded) grad/hess, zero-padded."""
+        if self._mh:
+            # multi-host layout: per-host padding blocks are interleaved
+            # — the objective's device arrays were re-padded to match,
+            # so gradients run full-width (padded rows produce values
+            # that never count: their leaf_id is -1)
+            s = scores
+        else:
+            s = scores[:, :self.num_data]
+        if self.num_class == 1:
+            g, h = self.objective.get_gradients(s[0])
+            g, h = g[None, :], h[None, :]
+        else:
+            g, h = self.objective.get_gradients(s.T)
+            g, h = g.T, h.T
+        pad = scores.shape[1] - s.shape[1]
+        if pad:
+            g = jnp.pad(g, ((0, 0), (0, pad)))
+            h = jnp.pad(h, ((0, 0), (0, pad)))
+        return g, h
+
+    # ------------------------------------------------------------------
+    def _bagging_counts(self, iteration: int):
+        """Per-iteration bagging mask (reference gbdt.cpp:234-316 with
+        mask-based rows instead of index subsets)."""
+        cfg = self.config
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            return self._full_counts, None
+        if iteration % cfg.bagging_freq == 0 or self._bag_mask is None:
+            self._bag_rng, sub = jax.random.split(self._bag_rng)
+            u = jax.random.uniform(sub, (self.grower.n_padded,))
+            self._bag_mask = (u < cfg.bagging_fraction) & \
+                (self._full_counts > 0)
+        counts = jnp.where(self._bag_mask, 1.0, 0.0)
+        return counts, self._bag_mask
+
+    # ------------------------------------------------------------------
+    def _feature_mask_np(self) -> np.ndarray:
+        """Per-tree feature sampling (reference
+        serial_tree_learner.cpp:252-345 BeforeTrain); host-side."""
+        f = self.config.feature_fraction
+        F = self.grower.num_features
+        if f >= 1.0:
+            return np.ones(F, dtype=bool)
+        used = max(1, int(round(F * f)))
+        idx = self._feat_rng.choice(F, size=used, replace=False)
+        mask = np.zeros(F, dtype=bool)
+        mask[idx] = True
+        return mask
+
+    def _feature_mask(self) -> jax.Array:
+        return jnp.asarray(self._feature_mask_np())
+
+    # ------------------------------------------------------------------
+    def _update_train_scores(self, scores, leaf_id, leaf_value, class_idx,
+                             shrinkage):
+        delta = leaf_value_broadcast(leaf_id, leaf_value) * shrinkage
+        return scores.at[class_idx].add(delta)
+
+    def _predict_valid(self, tree: TreeArrays, bins):
+        g = self.grower
+        return predict_binned(tree, bins, g.f_group, g.g2f_lut, g.f_missing,
+                              g.f_default_bin, g.f_num_bin,
+                              max_steps=self.config.num_leaves)
+
+    # ------------------------------------------------------------------
+    # hooks for DART/GOSS/RF subclasses --------------------------------
+    def _before_boosting(self) -> None:
+        """Called before gradient computation (DART drops trees here)."""
+
+    def _after_iteration(self) -> None:
+        """Called after the iteration's trees are in (DART normalizes)."""
+
+    def _sample_rows(self, g, h, counts):
+        """Row-sampling hook for the custom-gradient path; GOSS
+        reweights gradients here."""
+        return g, h, counts
+
+    def _sample_rows_fused(self, g, h, counts, key):
+        """Jit-traceable row-sampling hook (GOSS overrides)."""
+        return g, h, counts
+
+    def _sample_active(self) -> bool:
+        """Whether _sample_rows_fused does anything this iteration
+        (static per compile — GOSS flips it once)."""
+        return False
+
+    # ------------------------------------------------------------------
+    def _use_bagging_fused(self) -> bool:
+        """Whether the fused step draws a bagging mask (GOSS replaces
+        bagging entirely — reference goss.hpp Bagging override)."""
+        cfg = self.config
+        return cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+
+    # ------------------------------------------------------------------
+    def _feature_masks(self) -> jax.Array:
+        """(K, F) per-tree feature sampling masks for one iteration."""
+        if self.config.feature_fraction >= 1.0:
+            if not hasattr(self, "_full_feature_masks"):
+                self._full_feature_masks = jnp.ones(
+                    (self.num_class, self.grower.num_features), bool)
+            return self._full_feature_masks
+        return jnp.asarray(np.stack(
+            [self._feature_mask_np() for _ in range(self.num_class)]))
+
+    # ------------------------------------------------------------------
+    def _build_fused(self):
+        """One boosting iteration as a single jitted program: gradients,
+        bagging draw, K tree growths, train-score and valid-score
+        updates.  The only per-iteration host traffic left is the async
+        dispatch itself."""
+        vbins = tuple(vs.bins for vs in self.valid_sets)
+
+        def step(scores, vscores, bag_mask, key, fmask, shrinkage,
+                 ohb=None, cap=None, fresh_bag=False,
+                 sample_active=False):
+            # sample_active is a static cache key mirroring
+            # self._sample_active(), which _boost_one reads at trace time
+            del sample_active
+            vb = vbins if cap is None else cap["vbins"]
+            with self._bound_captives(cap):
+                return self._boost_one(scores, vscores, bag_mask, key,
+                                       fmask, shrinkage, fresh_bag,
+                                       vb, ohb)
+
+        self._fused_step = jax.jit(
+            step, static_argnames=("fresh_bag", "sample_active"),
+            donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    def can_chunk(self) -> bool:
+        """Whether multi-iteration fused chunks are valid: plain GBDT
+        gradients only.  DART/RF mutate state between iterations on the
+        host; GOSS flips its sampling activation mid-run, which a
+        compiled chunk would freeze at build time."""
+        return type(self).__name__ == "GBDT"
+
+    def _boost_one(self, scores, vscores, bag_mask, key, fmask,
+                   shrinkage, fresh_bag, vbins, ohb=None):
+        """One boosting iteration's device body — shared by the
+        per-iteration fused step and the multi-iteration chunk
+        (``fresh_bag`` may be a python bool or a traced scalar)."""
+        cfg = self.config
+        use_bag = self._use_bagging_fused()
+        n_pad = self.grower.n_padded
+        g, h = self._compute_gradients(scores)
+        kb, ks = jax.random.split(key)
+        if use_bag:
+            u = jax.random.uniform(kb, (n_pad,))
+            new_mask = (u < cfg.bagging_fraction) & (self._full_counts > 0)
+            bag_mask = jnp.where(fresh_bag, new_mask, bag_mask)
+            counts = jnp.where(bag_mask, 1.0, 0.0)
+        else:
+            counts = self._full_counts
+        if self._sample_active():
+            g, h, counts = self._sample_rows_fused(g, h, counts, ks)
+        g, h = self._mask_gradients(g, h, counts)
+        trees = []
+        nl = jnp.int32(1)
+        new_vscores = list(vscores)
+        for k in range(self.num_class):
+            tree, leaf_id, row_val = self.grower._train_tree_impl(
+                g[k], h[k], counts, fmask[k], ohb)
+            tree = self._finalize_tree(tree, leaf_id, k, scores, counts)
+            # a no-split tree must contribute nothing (the reference
+            # skips UpdateScore when num_leaves==1, gbdt.cpp:427-460)
+            ok = (tree.num_leaves > 1).astype(jnp.float32)
+            tree = tree._replace(leaf_value=tree.leaf_value * ok)
+            renew = (self.objective is not None
+                     and self.objective.is_renew_tree_output)
+            if row_val is not None and not renew:
+                # fused path: the exit-route already carried each row's
+                # leaf value — skip the separate (N, L) broadcast
+                delta = row_val * ok * shrinkage
+            else:
+                delta = leaf_value_broadcast(leaf_id,
+                                             tree.leaf_value) * shrinkage
+            scores = scores.at[k].add(delta)
+            for i, vb in enumerate(vbins):
+                pv = self._predict_valid(tree, vb)
+                new_vscores[i] = new_vscores[i].at[k].add(pv * shrinkage)
+            trees.append(tree)
+            nl = jnp.maximum(nl, tree.num_leaves)
+        return scores, tuple(new_vscores), bag_mask, tuple(trees), nl
+
+    def _build_fused_chunk(self, n_iters: int):
+        """n_iters boosting iterations as ONE jitted lax.scan — on a
+        remote-attached TPU every dispatch costs an RPC round trip
+        (measured ~40% of wall-clock at one call per iteration), so
+        headless stretches of training run chunked.  The reference has
+        no analog: its Train loop is host-driven per iteration
+        (gbdt.cpp:318-336)."""
+        vbins = tuple(vs.bins for vs in self.valid_sets)
+        shrinkage = self.shrinkage_rate
+
+        def chunk(scores, vscores, bag_mask, keys, fmasks, fresh_flags,
+                  ohb=None, cap=None):
+            vb = vbins if cap is None else cap["vbins"]
+
+            def one_iter(carry, xs):
+                scores, vscores, bag_mask = carry
+                key, fmask, fresh_bag = xs
+                scores, vscores, bag_mask, trees, nl = self._boost_one(
+                    scores, vscores, bag_mask, key, fmask, shrinkage,
+                    fresh_bag, vb, ohb)
+                return (scores, vscores, bag_mask), (trees, nl)
+
+            with self._bound_captives(cap):
+                (scores, vscores, bag_mask), (trees, nls) = jax.lax.scan(
+                    one_iter, (scores, vscores, bag_mask),
+                    (keys, fmasks, fresh_flags))
+            return scores, vscores, bag_mask, trees, nls
+
+        return jax.jit(chunk, donate_argnums=(0, 1))
+
+    def train_chunk(self, n_iters: int) -> bool:
+        """Run n_iters boosting iterations in one device program.
+        Returns True when the deferred no-split check stopped training."""
+        cfg = self.config
+        chunk_key = (n_iters, len(self.valid_sets), self.shrinkage_rate,
+                     self._sample_active())
+        if self._fused_chunk_n != chunk_key:
+            self._fused_chunk = self._build_fused_chunk(n_iters)
+            self._fused_chunk_n = chunk_key
+        use_bag = self._use_bagging_fused()
+        if self._bag_state is None:
+            self._bag_state = self._full_counts > 0
+        seeds = np.asarray([self._iter_key_rng.randint(0, 2**31 - 1)
+                            for _ in range(n_iters)], np.uint32)
+        if self._np_keys_ok and not use_bag and not self._sample_active():
+            # keys unused by the chunk body (no bagging draw, no GOSS
+            # sampling): reuse a cached device array and skip the
+            # per-chunk host->device transfer entirely
+            cache = getattr(self, "_chunk_keys", None)
+            if cache is None or cache.shape[0] != n_iters:
+                cache = jnp.zeros((n_iters, 2), jnp.uint32)
+                self._chunk_keys = cache
+            keys = cache
+        elif self._np_keys_ok:
+            keys = jnp.asarray(np.stack(
+                [np.zeros(n_iters, np.uint32), seeds], axis=1))
+        else:  # pragma: no cover - unexpected key layout
+            keys = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+        if self.config.feature_fraction >= 1.0:
+            cache = getattr(self, "_chunk_fmasks", None)
+            if cache is None or cache.shape[0] != n_iters:
+                cache = jnp.ones(
+                    (n_iters, self.num_class, self.grower.num_features),
+                    bool)
+                self._chunk_fmasks = cache
+            fmasks = cache
+        else:
+            fmasks = jnp.asarray(np.stack(
+                [np.stack([self._feature_mask_np()
+                           for _ in range(self.num_class)])
+                 for _ in range(n_iters)]))
+        if use_bag:
+            fresh = np.zeros(n_iters, bool)
+            for j in range(n_iters):
+                fresh[j] = (self.iter_ + j) % cfg.bagging_freq == 0
+        else:
+            # all-False flags never change: cache the device constant
+            cache = getattr(self, "_chunk_fresh", None)
+            if cache is None or cache.shape[0] != n_iters:
+                cache = jnp.zeros(n_iters, bool)
+                self._chunk_fresh = cache
+            fresh = cache
+        self.timer.start("tree")
+        scores, vscores, bag, trees, nls = self._fused_chunk(
+            self.scores, tuple(vs.scores for vs in self.valid_sets),
+            self._bag_state, keys, fmasks,
+            fresh if isinstance(fresh, jax.Array) else jnp.asarray(fresh),
+            self.grower.ohb, self._build_captives())
+        self.scores = scores
+        for vs, s in zip(self.valid_sets, vscores):
+            vs.scores = s
+        self._bag_state = bag
+        bias0 = self.init_score if (self.iter_ == 0 and
+                                    self.init_score != 0.0) else 0.0
+        # trees stay STACKED on device ((n_iters, ...) leaves) until
+        # flush_models — slicing per tree here would cost hundreds of
+        # tiny dispatches, defeating the point of chunking
+        stacks = list(trees)                      # one stack per class
+        self._pending.append(("stack", stacks, n_iters,
+                              self.shrinkage_rate, bias0))
+        for j in range(n_iters):
+            for stack in stacks:
+                self.device_trees.append(("stackref", stack, j))
+                self._tree_scale.append(1.0)
+                self._tree_shrink.append(self.shrinkage_rate)
+        self._nl_window.append(nls)          # stays stacked on device
+        self._nl_count += n_iters
+        self.iter_ += n_iters
+        self.timer.stop("tree")
+        if self._nl_count >= self._stop_check_every:
+            return self._check_stop_window()
+        return False
+
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference gbdt.cpp:386-481).
+        Custom grad/hess (shape (N,) or (N, K)) bypass the objective —
+        the LGBM_BoosterUpdateOneIterCustom path."""
+        if grad is not None and hess is not None:
+            return self._train_one_iter_custom(grad, hess)
+        if self.objective is None:
+            Log.fatal("No objective and no custom gradients")
+        self._before_boosting()
+        self.timer.start("tree")
+        if self._fused_step is None:
+            self._build_fused()
+        cfg = self.config
+        use_bag = self._use_bagging_fused()
+        fresh_bag = bool(use_bag and (self._bag_state is None or
+                                      self.iter_ % cfg.bagging_freq == 0))
+        if self._bag_state is None:
+            self._bag_state = self._full_counts > 0
+        key = jax.random.PRNGKey(
+            int(self._iter_key_rng.randint(0, 2**31 - 1)))
+        scores, vscores, bag, trees, nl = self._fused_step(
+            self.scores, tuple(vs.scores for vs in self.valid_sets),
+            self._bag_state, key, self._feature_masks(),
+            jnp.asarray(self.shrinkage_rate, jnp.float32),
+            self.grower.ohb, self._build_captives(),
+            fresh_bag=fresh_bag, sample_active=self._sample_active())
+        self.scores = scores
+        for vs, s in zip(self.valid_sets, vscores):
+            vs.scores = s
+        self._bag_state = bag
+        bias = self.init_score if (self.iter_ == 0 and
+                                   self.init_score != 0.0) else 0.0
+        for tree in trees:
+            self.device_trees.append(tree)
+            self._pending.append(("tree", tree, self.shrinkage_rate, bias))
+            self._tree_scale.append(1.0)
+            self._tree_shrink.append(self.shrinkage_rate)
+        self._nl_window.append(nl)
+        self._nl_count += 1
+        self._after_iteration()
+        self.iter_ += 1
+        self.timer.stop("tree")
+        if self._nl_count >= self._stop_check_every:
+            return self._check_stop_window()
+        return False
+
+    # ------------------------------------------------------------------
+    def _train_one_iter_custom(self, grad, hess) -> bool:
+        """Custom-gradient iteration (gradients cross the host boundary
+        every call, like the reference's UpdateOneIterCustom)."""
+        if self._mh:
+            Log.fatal("multi-host training does not support custom "
+                      "gradient functions yet (host gradients cannot "
+                      "follow the sharded row layout)")
+        self._before_boosting()
+        self.timer.start("boosting")
+        grad = np.asarray(grad, dtype=np.float32).reshape(
+            self.num_class, self.num_data)
+        hess = np.asarray(hess, dtype=np.float32).reshape(
+            self.num_class, self.num_data)
+        pad = self.grower.n_padded - self.num_data
+        g = jnp.asarray(np.pad(grad, ((0, 0), (0, pad))))
+        h = jnp.asarray(np.pad(hess, ((0, 0), (0, pad))))
+        self.timer.stop("boosting")
+        self.timer.start("bagging")
+        counts, bag_mask = self._bagging_counts(self.iter_)
+        g, h, counts = self._sample_rows(g, h, counts)
+        g, h = self._mask_gradients(g, h, counts)
+        self.timer.stop("bagging")
+
+        self.timer.start("tree")
+        bias = self.init_score if (self.iter_ == 0 and
+                                   self.init_score != 0.0) else 0.0
+        nl = jnp.int32(1)
+        for k in range(self.num_class):
+            feature_mask = self._feature_mask()
+            tree_arrays, leaf_id, _ = self.grower.train_tree(
+                g[k], h[k], counts, feature_mask)
+            tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k,
+                                              self.scores, counts)
+            ok = (tree_arrays.num_leaves > 1).astype(jnp.float32)
+            tree_arrays = tree_arrays._replace(
+                leaf_value=tree_arrays.leaf_value * ok)
+            self.device_trees.append(tree_arrays)
+            self.scores = self._update_train_fn(
+                self.scores, leaf_id, tree_arrays.leaf_value, k,
+                self.shrinkage_rate)
+            for vs in self.valid_sets:
+                delta = self._predict_valid_fn(tree_arrays, vs.bins)
+                vs.scores = vs.scores.at[k].add(
+                    delta * self.shrinkage_rate)
+            self._pending.append(("tree", tree_arrays,
+                                  self.shrinkage_rate, bias))
+            self._tree_scale.append(1.0)
+            self._tree_shrink.append(self.shrinkage_rate)
+            nl = jnp.maximum(nl, tree_arrays.num_leaves)
+        self.timer.stop("tree")
+        self._nl_window.append(nl)
+        self._after_iteration()
+        self.iter_ += 1
+        if len(self._nl_window) >= self._stop_check_every:
+            return self._check_stop_window()
+        return False
+
+    # ------------------------------------------------------------------
+    def _check_stop_window(self) -> bool:
+        """Deferred no-split detection: pull the queued per-iteration
+        max-num_leaves scalars in ONE transfer; if some iteration grew
+        no tree, roll back everything after it and stop (the reference
+        checks every iteration — here 1-leaf trees contribute exactly
+        zero score, so late rollback is exact)."""
+        if not self._nl_window:
+            return False
+        vals = np.asarray(jnp.concatenate(
+            [jnp.atleast_1d(x) for x in self._nl_window]))
+        self._nl_window = []
+        self._nl_count = 0
+        for j, v in enumerate(vals):
+            if int(v) <= 1:
+                overrun = len(vals) - j
+                for _ in range(overrun):
+                    self.rollback_one_iter()
+                Log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements.")
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    def flush_models(self, final: bool = False) -> None:
+        """Materialize queued device trees into host ``self.models`` in
+        one batched device->host transfer, and reconcile DART weight
+        rescales on already-materialized trees.  Only a ``final`` flush
+        consumes the deferred no-split window (popping degenerate tail
+        trees) — mid-training flushes must leave the window for
+        train_one_iter's own stop detection."""
+        if final and self._nl_window:
+            self._check_stop_window()
+        for i, t in enumerate(self.models):
+            if self._applied_scale[i] != self._tree_scale[i]:
+                r = self._tree_scale[i] / self._applied_scale[i]
+                t.leaf_value *= r
+                t.internal_value *= r
+                t.shrinkage *= r
+                self._applied_scale[i] = self._tree_scale[i]
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        # ONE device->host transfer for everything queued: per-tree
+        # entries are stacked, chunk entries already are stacks
+        plain = [p[1] for p in pending if p[0] == "tree"]
+        stacked_plain = (jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *plain) if plain else None)
+        chunk_stacks = [p[1] for p in pending if p[0] == "stack"]
+        host_plain, host_chunks = jax.device_get(
+            (stacked_plain, chunk_stacks))
+
+        def append_tree(arrs, shrinkage, bias):
+            t = Tree.from_grower_arrays(arrs, self.train_set)
+            t.apply_shrinkage(shrinkage)
+            if bias != 0.0:
+                # fold the init score into the first tree so saved models
+                # and raw predictions carry it (reference gbdt.cpp:452-454)
+                t.leaf_value += bias
+                t.internal_value += bias
+            idx = len(self.models)
+            scale = self._tree_scale[idx]
+            if scale != 1.0:
+                t.leaf_value *= scale
+                t.internal_value *= scale
+                t.shrinkage *= scale
+            self.models.append(t)
+            self._applied_scale.append(scale)
+
+        i_plain = 0
+        i_chunk = 0
+        for p in pending:
+            if p[0] == "tree":
+                _, _tree, shrinkage, bias = p
+                arrs = {f: np.asarray(getattr(host_plain, f)[i_plain])
+                        for f in host_plain._fields}
+                append_tree(arrs, shrinkage, bias)
+                i_plain += 1
+            else:
+                _, _stacks, n_iters, shrinkage, bias0 = p
+                stacks = host_chunks[i_chunk]
+                i_chunk += 1
+                for j in range(n_iters):
+                    for stack in stacks:
+                        arrs = {f: np.asarray(getattr(stack, f)[j])
+                                for f in stack._fields}
+                        append_tree(arrs, shrinkage,
+                                    bias0 if j == 0 else 0.0)
+
+    # ------------------------------------------------------------------
+    def _mask_gradients(self, g, h, counts):
+        """Apply bagging mask and row weights to gradient channels.
+        Row weights are already inside the objective's gradients
+        (reference semantics); only the bag mask zeroes rows here."""
+        mask = counts > 0
+        return g * mask[None, :], h * mask[None, :]
+
+    # ------------------------------------------------------------------
+    def _finalize_tree(self, tree_arrays: TreeArrays, leaf_id, class_idx,
+                       scores, counts) -> TreeArrays:
+        """Objective-specific leaf refitting hook (RenewTreeOutput,
+        reference serial_tree_learner.cpp:776-806).  Pure/jittable:
+        ``scores`` are the pre-update scores, ``counts`` the bag mask."""
+        if self.objective is not None and \
+                self.objective.is_renew_tree_output:
+            tree_arrays = self._renew_tree_output(tree_arrays, leaf_id,
+                                                  class_idx, scores, counts)
+        return tree_arrays
+
+    def _renew_tree_output(self, tree_arrays, leaf_id, class_idx,
+                           scores, counts):
+        """Re-fit leaf outputs to the objective's percentile (L1-family
+        objectives; reference regression_objective.hpp RenewTreeOutput).
+        Device: lexicographic sort by (leaf, residual) then per-leaf
+        percentile interpolation."""
+        from ..ops.percentile import leaf_percentiles
+        n = self.num_data
+        obj = self.objective
+        pred = scores[class_idx, :n]
+        label = obj._label_dev
+        residual = label - pred
+        alpha = obj.renew_alpha
+        if hasattr(obj, "_label_weight_dev"):
+            w = obj._label_weight_dev          # mape weighting
+        elif obj.weight is not None:
+            w = obj._weight_dev
+        else:
+            w = None
+        # restrict to in-bag rows (reference passes bag_data_indices,
+        # gbdt.cpp:446-447): out-of-bag rows get leaf -1 and are ignored
+        lid = jnp.where(counts[:n] > 0, leaf_id[:n], -1)
+        L = self.config.num_leaves
+        new_values = leaf_percentiles(residual, lid, L, alpha, w)
+        ok = tree_arrays.leaf_count > 0
+        return tree_arrays._replace(
+            leaf_value=jnp.where(ok, new_values,
+                                 tree_arrays.leaf_value))
+
+    # ------------------------------------------------------------------
+    def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
+        """Returns (dataset_name, metric_name, value, bigger_better)."""
+        self.timer.start("metric")
+        try:
+            return self._eval_metrics_impl()
+        finally:
+            self.timer.stop("metric")
+
+    def _eval_metrics_impl(self):
+        out = []
+        if self.train_metrics:
+            s = self._scores_for_eval(self.scores[:, :self.num_data])
+            for m in self.train_metrics:
+                for name, v in zip(m.names(), m.eval(s, self.objective)):
+                    out.append(("training", name, v, m.bigger_is_better))
+        for vs, vname in zip(self.valid_sets, self.valid_names):
+            s = self._scores_for_eval(vs.scores)
+            for m in vs.metrics:
+                for name, v in zip(m.names(), m.eval(s, self.objective)):
+                    out.append((vname, name, v, m.bigger_is_better))
+        return out
+
+    def _scores_for_eval(self, scores):
+        if self.num_class == 1:
+            return scores[0]
+        return scores.T       # (N, K)
+
+    # ------------------------------------------------------------------
+    def check_early_stopping(self, results, iteration: int) -> bool:
+        """Reference gbdt.cpp:582-639: stop as soon as ANY validation
+        metric has not improved for early_stopping_round iterations;
+        best_iteration comes from the triggering metric."""
+        rounds = self.config.early_stopping_round
+        if rounds <= 0:
+            return False
+        for i, (dname, mname, value, bigger) in enumerate(results):
+            if dname == "training":
+                continue
+            key = (i, 0)
+            score = value if bigger else -value
+            if key not in self._best_score or score > self._best_score[key]:
+                self._best_score[key] = score
+                self._best_iter[key] = iteration
+            elif iteration - self._best_iter[key] >= rounds:
+                self.best_iteration = self._best_iter[key] + 1
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _materialize_devtree(entry):
+        """device_trees entry -> TreeArrays (chunk entries are lazy
+        slices of a stacked chunk)."""
+        if isinstance(entry, tuple) and entry and entry[0] == "stackref":
+            _, stack, j = entry
+            return jax.tree_util.tree_map(lambda x: x[j], stack)
+        return entry
+
+    def rollback_one_iter(self) -> None:
+        """reference gbdt.cpp:483-499."""
+        if self.num_trees < self.num_class:
+            return
+        # pending bookkeeping: one iteration = num_class trees
+        shrinkage = self.shrinkage_rate
+        if self._pending:
+            last = self._pending[-1]
+            if last[0] == "stack":
+                _, stacks, n, shrinkage, bias0 = last
+                if n <= 1:
+                    self._pending.pop()
+                else:
+                    self._pending[-1] = ("stack", stacks, n - 1,
+                                         shrinkage, bias0)
+            else:
+                for _ in range(self.num_class):
+                    _, _t, shrinkage, _b = self._pending.pop()
+        else:
+            for _ in range(self.num_class):
+                self.models.pop()
+                self._applied_scale.pop()
+        for k in reversed(range(self.num_class)):
+            tree_arrays = self._materialize_devtree(self.device_trees.pop())
+            self._tree_scale.pop()
+            if self._tree_shrink:
+                self._tree_shrink.pop()
+            self.scores = self.scores.at[k].add(
+                -shrinkage * self._predict_valid_fn(
+                    tree_arrays, self.grower.bins))
+            for vs in self.valid_sets:
+                vs.scores = vs.scores.at[k].add(
+                    -shrinkage * self._predict_valid_fn(
+                        tree_arrays, vs.bins))
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    @property
+    def num_trees(self) -> int:
+        n = len(self.models)
+        for p in self._pending:
+            if p[0] == "stack":
+                n += p[2] * len(p[1])
+            else:
+                n += 1
+        return n

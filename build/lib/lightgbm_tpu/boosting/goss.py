@@ -1,0 +1,64 @@
+"""GOSS: Gradient-based One-Side Sampling.
+
+Re-design of the reference GOSS (src/boosting/goss.hpp:88-145): keep
+the top ``top_rate`` rows by |g*h|, sample ``other_rate`` of the rest
+and amplify their gradients by (1-a)/b.  The reference's per-thread
+adaptive sequential sampling becomes a device top_k threshold plus an
+i.i.d. Bernoulli draw — same marginal inclusion probabilities, fully
+parallel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..dataset import Dataset
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def __init__(self, config: Config, train_set: Dataset, **kwargs):
+        super().__init__(config, train_set, **kwargs)
+        self._goss_key = jax.random.PRNGKey(config.bagging_seed + 1)
+        self._goss_fn = jax.jit(self._goss_sample)
+
+    def _bagging_counts(self, iteration: int):
+        # GOSS replaces bagging entirely (reference goss.hpp Bagging)
+        return self._full_counts, None
+
+    def _use_bagging_fused(self) -> bool:
+        return False
+
+    def _sample_rows(self, g, h, counts):
+        # no subsampling for the first 1/learning_rate iterations
+        # (reference goss.hpp:138-140)
+        if not self._sample_active():
+            return g, h, counts
+        self._goss_key, sub = jax.random.split(self._goss_key)
+        return self._goss_fn(g, h, counts, sub)
+
+    def _sample_active(self) -> bool:
+        return self.iter_ >= int(1.0 / self.config.learning_rate)
+
+    def _sample_rows_fused(self, g, h, counts, key):
+        return self._goss_sample(g, h, counts, key)
+
+    def _goss_sample(self, g, h, counts, key):
+        n_real = self.num_data
+        score = jnp.sum(jnp.abs(g * h), axis=0)          # (n_padded,)
+        score = jnp.where(counts > 0, score, -jnp.inf)
+        top_k = max(1, int(n_real * self.config.top_rate))
+        other_k = max(1, int(n_real * self.config.other_rate))
+        kth = jax.lax.top_k(score, top_k)[0][-1]
+        is_top = score >= kth
+        rest = (counts > 0) & ~is_top
+        rest_cnt = jnp.maximum(jnp.sum(rest), 1)
+        prob = other_k / rest_cnt
+        u = jax.random.uniform(key, score.shape)
+        sampled = rest & (u < prob)
+        multiply = (n_real - top_k) / other_k
+        keep = is_top | sampled
+        scale = jnp.where(sampled, multiply, 1.0)[None, :]
+        new_counts = jnp.where(keep, counts, 0.0)
+        return g * scale, h * scale, new_counts

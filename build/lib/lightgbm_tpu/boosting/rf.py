@@ -1,0 +1,80 @@
+"""RF: random forest mode.
+
+Re-design of the reference RF (src/boosting/rf.hpp:18-180): gradients
+computed once from zero scores, mandatory bagging + feature_fraction,
+no shrinkage, leaf outputs converted through the objective's output
+transform, and the tracked score is the running AVERAGE of tree
+outputs (the MultiplyScore dance becomes an explicit running mean).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..utils.log import Log
+from .gbdt import GBDT
+from ..tree import Tree
+from ..learner.grower import TreeArrays
+
+
+class RF(GBDT):
+    def __init__(self, config: Config, train_set: Dataset, **kwargs):
+        super().__init__(config, train_set, **kwargs)
+        if config.num_class > 1:
+            Log.fatal("cannot use RF for multi-class")
+        if not (config.bagging_freq > 0 and 0 < config.bagging_fraction < 1):
+            Log.fatal("RF requires bagging "
+                      "(bagging_freq > 0, bagging_fraction in (0,1))")
+        if not (0 < config.feature_fraction < 1):
+            Log.fatal("RF requires feature_fraction in (0, 1)")
+        self.shrinkage_rate = 1.0
+        self.average_output = True
+        self.init_score = 0.0
+        # fixed gradients from zero score (reference rf.hpp:82-88)
+        zero = jnp.zeros_like(self.scores)
+        self._fixed_g, self._fixed_h = self._grad_fn(zero)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if grad is None or hess is None:
+            g, h = self._fixed_g, self._fixed_h
+        else:
+            return super().train_one_iter(grad, hess)
+
+        counts, _ = self._bagging_counts(self.iter_)
+        g, h = self._mask_gradients(g, h, counts)
+
+        for k in range(self.num_class):
+            feature_mask = self._feature_mask()
+            tree_arrays, leaf_id, _ = self.grower.train_tree(
+                g[k], h[k], counts, feature_mask)
+            tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k,
+                                              self.scores, counts)
+            # convert leaf outputs (reference rf.hpp ConvertTreeOutput)
+            conv = self.objective.convert_output(tree_arrays.leaf_value)
+            tree_arrays = tree_arrays._replace(leaf_value=conv)
+            self.device_trees.append(tree_arrays)
+            # running average: score = (score*t + tree_out) / (t+1)
+            t = float(self.iter_)
+            delta = self._update_train_fn(
+                self.scores * t, leaf_id, tree_arrays.leaf_value, k, 1.0)
+            self.scores = delta / (t + 1.0)
+            for vs in self.valid_sets:
+                pv = self._predict_valid_fn(tree_arrays, vs.bins)
+                vs.scores = (vs.scores * t).at[k].add(pv) / (t + 1.0)
+            self._pending.append(("tree", tree_arrays, 1.0, 0.0))
+            self._tree_scale.append(1.0)
+            self._tree_shrink.append(1.0)
+        self.iter_ += 1
+        return False
+
+    def eval_metrics(self):
+        """Scores are already in output space (averaged converted
+        outputs) — metrics must not re-apply the objective transform."""
+        saved = self.objective
+        self.objective = None
+        try:
+            return super().eval_metrics()
+        finally:
+            self.objective = saved

@@ -1,0 +1,514 @@
+"""Configuration layer: the key=value parameter namespace.
+
+TPU-native re-design of the reference's config system
+(reference: include/LightGBM/config.h:94-306 struct hierarchy,
+:364-529 alias table + known-parameter set, src/io/config.cpp
+CheckParamConflict).  One flat, typed ``Config`` dataclass replaces the
+OverallConfig/IOConfig/BoostingConfig/TreeConfig nesting — everything
+downstream (binning, grower, boosting, distributed) reads from it, and
+the jit-facing subset is hashable so a Config change triggers a
+recompile exactly when it must.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from .utils.log import Log
+
+# ---------------------------------------------------------------------------
+# Alias table (reference: include/LightGBM/config.h:364-457)
+# ---------------------------------------------------------------------------
+PARAM_ALIASES: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "num_thread": "num_threads",
+    "random_seed": "seed",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "pre_partition": "is_pre_partition",
+    "training_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "eval_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "n_estimators": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "contrib": "is_predict_contrib",
+    "predict_contrib": "is_predict_contrib",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "unbalanced_sets": "is_unbalance",
+    "bagging_fraction_seed": "bagging_seed",
+    "workers": "machines",
+    "nodes": "machines",
+    "subsample_for_bin": "bin_construct_sample_cnt",
+    "metric_freq": "output_freq",
+    "mc": "monotone_constraints",
+    "max_tree_output": "max_delta_step",
+    "max_leaf_output": "max_delta_step",
+}
+
+_OBJECTIVE_ALIASES = {
+    "regression_l2": "regression",
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "l2": "regression",
+    "l2_root": "regression",
+    "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "l1": "regression_l1",
+    "multiclassova": "multiclassova",
+    "multiclass_ova": "multiclassova",
+    "ova": "multiclassova",
+    "ovr": "multiclassova",
+    "softmax": "multiclass",
+    "mean_absolute_percentage_error": "mape",
+    "xentropy": "cross_entropy",
+    "xentlambda": "cross_entropy_lambda",
+}
+
+OBJECTIVES = (
+    "regression", "regression_l1", "huber", "fair", "poisson", "quantile",
+    "mape", "gamma", "tweedie", "binary", "multiclass", "multiclassova",
+    "lambdarank", "cross_entropy", "cross_entropy_lambda", "none",
+)
+
+BOOSTING_TYPES = ("gbdt", "dart", "goss", "rf")
+TREE_LEARNERS = ("serial", "feature", "data", "voting")
+DEVICE_TYPES = ("cpu", "tpu", "gpu")  # "gpu" accepted as alias for tpu
+TASK_TYPES = ("train", "predict", "convert_model", "refit")
+
+_TREE_LEARNER_ALIASES = {
+    "serial": "serial",
+    "feature": "feature", "feature_parallel": "feature",
+    "data": "data", "data_parallel": "data",
+    "voting": "voting", "voting_parallel": "voting",
+}
+
+
+def canonical_objective(name: str) -> str:
+    name = name.lower()
+    return _OBJECTIVE_ALIASES.get(name, name)
+
+
+# ---------------------------------------------------------------------------
+# Config dataclass
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class Config:
+    """Flat, typed parameter set (reference config.h:94-306)."""
+
+    # -- core task --
+    task: str = "train"
+    objective: str = "regression"
+    boosting_type: str = "gbdt"
+    device: str = "tpu"
+    tree_learner: str = "serial"
+    num_threads: int = 0
+    seed: int = 0
+    num_machines: int = 1
+    verbose: int = 1
+
+    # -- boosting --
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_class: int = 1
+    early_stopping_round: int = 0
+    output_freq: int = 1
+    is_training_metric: bool = False
+    snapshot_freq: int = -1
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    alpha: float = 0.9            # huber/quantile
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    reg_sqrt: bool = False
+    scale_pos_weight: float = 1.0
+    is_unbalance: bool = False
+    max_position: int = 20        # lambdarank truncation
+    label_gain: Tuple[float, ...] = ()
+    metric: Tuple[str, ...] = ()
+    ndcg_eval_at: Tuple[int, ...] = (1, 2, 3, 4, 5)
+
+    # -- tree --
+    num_leaves: int = 31
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    feature_fraction: float = 1.0
+    feature_fraction_seed: int = 2
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    max_bin: int = 255
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    monotone_constraints: Tuple[int, ...] = ()
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20               # voting parallel
+    forcedsplits_filename: str = ""
+
+    # -- dart --
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+
+    # -- goss --
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+
+    # -- io --
+    data: str = ""
+    valid_data: Tuple[str, ...] = ()
+    input_model: str = ""
+    output_model: str = "LightGBM_model.txt"
+    output_result: str = "LightGBM_predict_result.txt"
+    convert_model: str = "gbdt_prediction.cpp"
+    convert_model_language: str = ""
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_column: str = ""
+    is_pre_partition: bool = False
+    use_two_round_loading: bool = False
+    streaming_chunk_rows: int = 65536  # rows per two-round/PushRows
+    # text chunk (bounds peak float-row memory during streaming load)
+    is_save_binary_file: bool = False
+    is_enable_sparse: bool = True
+    enable_bundle: bool = True    # EFB
+    max_conflict_rate: float = 0.0
+    is_enable_bundle: bool = True
+    min_data_in_group: int = 100
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    num_iteration_predict: int = -1
+    is_predict_raw_score: bool = False
+    is_predict_leaf_index: bool = False
+    is_predict_contrib: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+
+    # -- network --
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_file: str = ""
+    machines: str = ""
+
+    # -- tpu-specific (new; no reference analog) --
+    hist_dtype: str = "float32"     # accumulation dtype for histogram matmuls
+    hist_compute_dtype: str = "float32"  # one-hot matmul input dtype
+    # (bfloat16 roughly doubles MXU throughput at ~0.4% grad rounding;
+    # opt in for benchmarks, keep float32 for reference parity)
+    row_chunk: int = 65536          # rows per histogram-scan chunk
+    growth_policy: str = "leafwise"  # leafwise (gain-budgeted frontier) | depthwise
+    frontier_width: int = 0         # max splits applied per frontier round
+    # (0 = auto: min(128, num_leaves-1) — one 128-lane MXU strip)
+    hist_kernel: str = "auto"       # auto | pallas | paired | xla
+    hist_packed_dispatch: bool = True  # lax.cond to the channel-packed
+    # kernel on narrow frontiers (off: always the full-width kernel)
+    pallas_hist_block: int = 2048   # rows per Pallas histogram block
+    quantized_grad: bool = False    # int8-MXU quantized histogram
+    # construction (one grad/hess scale per tree; the TPU analog of
+    # LightGBM v4 quantized training, arXiv 2207.09682) — TPU path only
+    histogram_pool_size: float = -1.0  # MB bound on the per-leaf
+    # histogram cache (reference config.h:216 + the LRU HistogramPool,
+    # feature_histogram.hpp:653-823).  -1 = unbounded.  When the
+    # (num_leaves, G, B, 3) f32 cache exceeds the bound, the grower
+    # drops histogram subtraction and computes BOTH children of every
+    # split directly from the data (2x histogram passes, no cache).
+    hist_onehot_budget_mb: int = 6144  # HBM budget for the resident
+    # streamed bin one-hot; datasets over budget (at every pack) rebuild
+    # the one-hot in-kernel per round instead.  6 GB leaves ~9 GB of a
+    # 16 GB v5e for bins/scores/gradients/temps — HIGGS scale (10.5M
+    # rows) needs 5.4 GB at pack=4
+    hist_onehot_pack: int = 0       # one-hot columns per stored byte
+    # (planar sub-byte packing, widened in-VMEM by the kernels): 1, 2
+    # or 4; 0 = auto — the largest pack dividing G*B that fits the
+    # budget, which both cuts the per-pass HBM stream and lets
+    # HIGGS-scale (10.5M-row) one-hots stay resident on a 16 GB chip
+    hist_quant_onthefly: bool = True  # quantized path: rebuild the bin
+    # one-hot in-kernel (packed int8 lanes) instead of streaming the
+    # (N, G*B) one-hot from HBM — B x less HBM traffic per round
+    hist_fused_route: bool = True   # apply pending split routing inside
+    # the next round's histogram kernel (single chip, streamed one-hot)
+    # instead of a separate XLA routing pass per round
+    force_pallas_interpret: bool = False  # test seam: run the Pallas
+    # kernel paths (incl. the fused-route grower wiring) in interpret
+    # mode on CPU — slow, for CI coverage of the TPU-only code paths
+    mesh_shape: Tuple[int, ...] = ()
+    mesh_axes: Tuple[str, ...] = ()
+    deterministic: bool = False
+
+    # free-form passthrough of unrecognized params (warned, kept for echo)
+    extra: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        self.objective = canonical_objective(self.objective)
+        self.tree_learner = _TREE_LEARNER_ALIASES.get(self.tree_learner,
+                                                      self.tree_learner)
+        if self.device == "gpu":
+            self.device = "tpu"
+        self.check()
+
+    # ------------------------------------------------------------------
+    def check(self):
+        """Parameter validation (reference: src/io/config.cpp CheckParamConflict)."""
+        if self.objective not in OBJECTIVES:
+            raise ValueError(f"Unknown objective: {self.objective}")
+        if self.boosting_type not in BOOSTING_TYPES:
+            raise ValueError(f"Unknown boosting_type: {self.boosting_type}")
+        if self.tree_learner not in TREE_LEARNERS:
+            raise ValueError(f"Unknown tree_learner: {self.tree_learner}")
+        if self.num_leaves < 2:
+            raise ValueError("num_leaves must be >= 2")
+        if not (0.0 < self.feature_fraction <= 1.0):
+            raise ValueError("feature_fraction must be in (0, 1]")
+        if not (0.0 < self.bagging_fraction <= 1.0):
+            raise ValueError("bagging_fraction must be in (0, 1]")
+        if self.max_bin < 2:
+            raise ValueError("max_bin must be >= 2")
+        if self.max_bin > 256:
+            raise ValueError("max_bin must be <= 256 (uint8 packed bin matrix)")
+        if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
+            raise ValueError(f"num_class must be >= 2 for {self.objective}")
+        if self.objective not in ("multiclass", "multiclassova") and self.num_class != 1:
+            raise ValueError("num_class must be 1 for non-multiclass objectives")
+        if self.boosting_type == "goss" and self.top_rate + self.other_rate > 1.0:
+            raise ValueError("GOSS: top_rate + other_rate must be <= 1.0")
+        if self.boosting_type == "rf" and (self.bagging_freq <= 0
+                                           or self.bagging_fraction >= 1.0):
+            raise ValueError("RF must use bagging "
+                             "(bagging_freq > 0, bagging_fraction < 1)")
+        # distributed learners force row pre-partition semantics
+        if self.tree_learner != "serial" and self.num_machines == 1 \
+                and not self.mesh_shape:
+            Log.debug("parallel tree_learner with a single device; "
+                      "running serial-equivalent path")
+
+    # ------------------------------------------------------------------
+    @property
+    def num_tree_per_iteration(self) -> int:
+        """Trees per boosting iteration (reference gbdt.cpp: K for softmax)."""
+        if self.objective == "multiclass" or self.objective == "multiclassova":
+            return self.num_class
+        return 1
+
+    @property
+    def max_num_levels(self) -> int:
+        """Static bound on frontier rounds for the jitted grower."""
+        if self.max_depth > 0:
+            return self.max_depth
+        # leaf-wise frontier: at most num_leaves-1 rounds; balanced trees use
+        # ~log2(num_leaves); pathological chains use more.  num_leaves-1 is
+        # the hard bound and the while_loop exits early.
+        return self.num_leaves - 1
+
+    # ------------------------------------------------------------------
+    def update(self, **kwargs) -> "Config":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]] = None, **kwargs) -> "Config":
+        """Build a Config from a user parameter dict, resolving aliases
+        with the reference's conflict rules (config.h:490-529): when an
+        alias and its canonical key are both given, the canonical key
+        wins; among aliases, the shortest (then lexicographically
+        smallest) name wins."""
+        params = dict(params or {})
+        params.update(kwargs)
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        canonical: Dict[str, Any] = {}
+        alias_src: Dict[str, str] = {}
+        # first pass: canonical keys
+        for key, value in params.items():
+            k = key.lower()
+            if k in field_names:
+                canonical[k] = value
+        # second pass: aliases
+        for key, value in params.items():
+            k = key.lower()
+            if k in field_names:
+                continue
+            target = PARAM_ALIASES.get(k)
+            if target is None or target not in field_names:
+                continue
+            if target in canonical:
+                if target not in alias_src:
+                    continue  # canonical key given explicitly: it wins
+                prev = alias_src[target]
+                if len(prev) < len(k) or (len(prev) == len(k) and prev < k):
+                    Log.warning(f"{target} is set by {prev}, ignoring {key}={value}")
+                    continue
+                Log.warning(f"{target} is set by {key}, overriding {prev}")
+            canonical[target] = value
+            alias_src[target] = k
+        # leftovers
+        extra = {}
+        for key, value in params.items():
+            k = key.lower()
+            if k in field_names or PARAM_ALIASES.get(k) in field_names:
+                continue
+            Log.warning(f"Unknown parameter: {key}")
+            extra[key] = str(value)
+
+        coerced = {name: _coerce(cls, name, v) for name, v in canonical.items()}
+        if extra:
+            coerced["extra"] = extra
+        return cls(**coerced)
+
+    @classmethod
+    def from_str(cls, text: str) -> "Config":
+        """Parse ``key=value`` pairs (CLI string or config-file contents,
+        ``#`` comments allowed — reference application.cpp:56-75)."""
+        params: Dict[str, str] = {}
+        for raw_line in text.replace("\r", "\n").split("\n"):
+            for tok in raw_line.split():
+                if tok.startswith("#"):
+                    break
+                if "=" in tok:
+                    k, v = tok.split("=", 1)
+                    params[k.strip()] = v.strip()
+        return cls.from_params(params)
+
+
+_TRUE = {"true", "1", "yes", "y", "t", "+"}
+_FALSE = {"false", "0", "no", "n", "f", "-"}
+
+
+def _coerce(cls, name: str, value: Any) -> Any:
+    """Coerce a raw (often string) param value to the dataclass field type."""
+    field = next(f for f in dataclasses.fields(cls) if f.name == name)
+    t = field.type
+    if isinstance(value, str):
+        s = value.strip()
+        if t in ("int", int):
+            return int(float(s))
+        if t in ("float", float):
+            return float(s)
+        if t in ("bool", bool):
+            ls = s.lower()
+            if ls in _TRUE:
+                return True
+            if ls in _FALSE:
+                return False
+            raise ValueError(f"Cannot parse bool param {name}={value}")
+        if "Tuple[int" in str(t):
+            return tuple(int(x) for x in s.split(",") if x != "")
+        if "Tuple[float" in str(t):
+            return tuple(float(x) for x in s.split(",") if x != "")
+        if "Tuple[str" in str(t):
+            return tuple(x for x in s.split(",") if x != "")
+        return s
+    if isinstance(value, bool):
+        return value
+    if t in ("int", int):
+        return int(value)
+    if t in ("float", float):
+        return float(value)
+    if t in ("bool", bool):
+        return bool(value)
+    if isinstance(value, (list, tuple)):
+        if "Tuple[int" in str(t):
+            return tuple(int(x) for x in value)
+        if "Tuple[float" in str(t):
+            return tuple(float(x) for x in value)
+        return tuple(value)
+    return value
+
+
+def params_to_str(params: Dict[str, Any]) -> str:
+    """Serialize a param dict to the key=value wire format
+    (reference python-package basic.py:125 param_dict_to_str)."""
+    parts = []
+    for k, v in params.items():
+        if isinstance(v, (list, tuple)):
+            v = ",".join(str(x) for x in v)
+        elif isinstance(v, bool):
+            v = "true" if v else "false"
+        parts.append(f"{k}={v}")
+    return " ".join(parts)

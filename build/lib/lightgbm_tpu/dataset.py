@@ -1,0 +1,658 @@
+"""Binned dataset: the TPU-resident training matrix.
+
+TPU-native re-design of the reference's Dataset/FeatureGroup/Metadata
+(reference: include/LightGBM/dataset.h:282-609, feature_group.h:18-230,
+src/io/dataset.cpp, src/io/metadata.cpp).  Key representation change:
+instead of per-group Bin objects (dense/sparse/4-bit) in row order plus
+leaf-ordered sparse copies, the whole training set is ONE packed
+``(num_data, num_groups)`` uint8 matrix that lives in HBM, sharded over
+the mesh row axis for data-parallel training.  Exclusive-feature-bundle
+groups keep the reference's bin-offset scheme (offset 0 = shared default
+slot, feature_group.h:34-51/128-136) so EFB plugs in without kernel
+changes; the per-feature view is recovered on device by a precomputed
+``(F, max_bin)`` gather map plus the FixHistogram default-bin
+reconstruction (dataset.cpp:776-795).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
+                      MISSING_NONE, MISSING_ZERO, BinMapper,
+                      find_bin_mappers)
+from .config import Config
+from .utils.log import Log
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores
+    (reference dataset.h:36-248, src/io/metadata.cpp)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label = np.zeros(num_data, dtype=np.float32)
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None  # (num_queries+1,)
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            Log.fatal(f"Length of label ({len(label)}) != num_data ({self.num_data})")
+        self.label = label
+
+    def set_weight(self, weight: Optional[Sequence[float]]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(weight) != self.num_data:
+            Log.fatal(f"Length of weight ({len(weight)}) != num_data ({self.num_data})")
+        self.weight = weight
+
+    def set_group(self, group: Optional[Sequence[int]]) -> None:
+        """``group`` is per-query sizes (python API convention); converted
+        to cumulative boundaries (reference metadata.cpp query_boundaries_)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        bounds = np.concatenate([[0], np.cumsum(group)])
+        if bounds[-1] != self.num_data:
+            Log.fatal(f"Sum of query counts ({bounds[-1]}) != num_data ({self.num_data})")
+        self.query_boundaries = bounds.astype(np.int32)
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        arr = np.asarray(init_score, dtype=np.float64).reshape(-1)
+        if len(arr) % self.num_data != 0:
+            Log.fatal("Initial score size doesn't match data size")
+        self.init_score = arr
+
+    @property
+    def num_queries(self) -> int:
+        if self.query_boundaries is None:
+            return 0
+        return len(self.query_boundaries) - 1
+
+    def get_field(self, name: str):
+        if name == "label":
+            return self.label
+        if name == "weight":
+            return self.weight
+        if name == "init_score":
+            return self.init_score
+        if name == "group":
+            if self.query_boundaries is None:
+                return None
+            return np.diff(self.query_boundaries)
+        Log.fatal(f"Unknown field {name}")
+
+    def set_field(self, name: str, data) -> None:
+        if name == "label":
+            self.set_label(data)
+        elif name == "weight":
+            self.set_weight(data)
+        elif name == "init_score":
+            self.set_init_score(data)
+        elif name in ("group", "query"):
+            self.set_group(data)
+        else:
+            Log.fatal(f"Unknown field {name}")
+
+
+class FeatureView:
+    """Per-feature device-facing metadata: where the feature's bins live
+    inside its group column and how missing values are encoded."""
+
+    __slots__ = ("feature_idx", "group", "sub", "offset", "num_bin",
+                 "default_bin", "missing_type", "is_categorical", "mapper",
+                 "collapsed_default")
+
+    def __init__(self, feature_idx: int, group: int, sub: int, offset: int,
+                 mapper: BinMapper, collapsed_default: bool):
+        self.feature_idx = feature_idx
+        self.group = group
+        self.sub = sub
+        self.offset = offset          # group-bin index of this feature's bin
+        self.num_bin = mapper.num_bin
+        self.default_bin = mapper.default_bin
+        self.missing_type = mapper.missing_type
+        self.is_categorical = mapper.bin_type == BIN_CATEGORICAL
+        self.mapper = mapper
+        # True when the feature shares the group's bin-0 default slot
+        # (multi-feature bundles, feature_group.h:128-136)
+        self.collapsed_default = collapsed_default
+
+
+class Dataset:
+    """The binned training matrix + metadata (host side).
+
+    ``group_bins`` is the packed (num_data, num_groups) uint8 matrix; the
+    device training path uploads it once per training run (the analog of
+    GPUTreeLearner::AllocateGPUMemory's one-time upload,
+    gpu_tree_learner.cpp:234-556).
+    """
+
+    def __init__(self):
+        self.num_data = 0
+        self.num_total_features = 0
+        self.mappers: List[BinMapper] = []
+        self.used_features: List[int] = []       # real idx of non-trivial features
+        self.features: List[FeatureView] = []    # one per used feature
+        self.group_bins: Optional[np.ndarray] = None  # (N, G) uint8
+        self.group_num_bin: List[int] = []
+        self.group_is_multi: List[bool] = []
+        self.metadata: Metadata = Metadata(0)
+        self.feature_names: List[str] = []
+        self.max_bin = 255
+        self.config: Optional[Config] = None
+        self.monotone_constraints: Optional[np.ndarray] = None
+        self._raw_data: Optional[np.ndarray] = None
+        self._categorical_features: List[int] = []
+        self._bundles: List[List[int]] = []
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        return len(self.features)
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.group_num_bin)
+
+    @property
+    def label(self) -> np.ndarray:
+        return self.metadata.label
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, label=None, weight=None,
+                    group=None, init_score=None,
+                    config: Optional[Config] = None,
+                    categorical_features: Optional[Sequence[int]] = None,
+                    feature_names: Optional[Sequence[str]] = None,
+                    reference: Optional["Dataset"] = None) -> "Dataset":
+        """Build from an in-memory float matrix or a scipy sparse
+        matrix — the analog of LGBM_DatasetCreateFromMat / FromCSR/CSC
+        -> CostructFromSampleData (reference c_api.cpp:424+,
+        dataset_loader.cpp:488-610; sparse classes
+        src/io/sparse_bin.hpp:68-456).
+
+        Sparse input is NEVER densified whole: sampling, EFB conflict
+        counting and bin-matrix construction all walk the CSC columns,
+        so host memory is bounded by nnz + the packed (N, G) uint8
+        output (the per-bundle-densify design — the uint8 matrix IS the
+        HBM-resident training representation)."""
+        config = config or Config()
+        sparse = hasattr(data, "tocsc") and hasattr(data, "nnz")
+        if sparse:
+            data = data.tocsc()
+            data.sort_indices()
+        else:
+            data = np.asarray(data, dtype=np.float64)
+            if data.ndim != 2:
+                raise ValueError("data must be 2-dimensional")
+        num_data, num_features = data.shape
+
+        self = cls()
+        self.config = config
+        self.num_data = num_data
+        self.num_total_features = num_features
+        self.max_bin = config.max_bin
+        self.feature_names = list(feature_names) if feature_names else [
+            f"Column_{i}" for i in range(num_features)]
+
+        if reference is not None:
+            # validation sets share the training set's bin mappers
+            # (reference basic.py reference-alignment / dataset.h CopyFeatureMapperFrom)
+            if reference.num_total_features != num_features:
+                Log.fatal("Validation data has different number of features "
+                          f"({num_features} vs {reference.num_total_features})")
+            self.mappers = reference.mappers
+            self.used_features = list(reference.used_features)
+            self.max_bin = reference.max_bin
+            self._build_groups(reference=reference)
+        else:
+            cat_set = set(categorical_features or [])
+            sampler = (_sample_feature_values_sparse if sparse
+                       else _sample_feature_values)
+            sample_vals, total_cnt, sample_rows = sampler(
+                data, config.bin_construct_sample_cnt, config.data_random_seed)
+            self.mappers = find_bin_mappers(
+                sample_vals, total_cnt, config.max_bin, config.min_data_in_bin,
+                config.min_data_in_leaf, cat_set, config.use_missing,
+                config.zero_as_missing)
+            self.used_features = [i for i, m in enumerate(self.mappers)
+                                  if not m.is_trivial]
+            if not self.used_features:
+                Log.warning("There are no meaningful features; "
+                            "all features are constant or filtered")
+            self._build_groups(reference=None, sample_nonzero=sample_rows,
+                               sample_cnt=total_cnt)
+
+        if sparse:
+            self._bin_data_sparse(data)
+        else:
+            self._bin_data(data)
+        self._raw_data = data
+        self._categorical_features = list(categorical_features or [])
+        self.metadata = Metadata(num_data)
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weight(weight)
+        self.metadata.set_group(group)
+        self.metadata.set_init_score(init_score)
+        self._resolve_monotone(config)
+        return self
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_sampled_columns(cls, sample_vals: List[np.ndarray],
+                             sample_rows: List[np.ndarray],
+                             total_sample: int, num_data: int,
+                             config: Optional[Config] = None,
+                             categorical_features=None,
+                             feature_names=None) -> "Dataset":
+        """Streaming construction, step 1: fit bin mappers from sampled
+        per-column values, allocate the packed (N, G) uint8 matrix, and
+        return a dataset awaiting ``push_rows`` chunks + ``finish_load``
+        — the two-round / LGBM_DatasetCreateFromSampledColumn +
+        PushRows protocol (reference c_api.h:68-145,
+        dataset_loader.cpp:180-265).  The float matrix never exists:
+        peak host memory is samples + one chunk + the uint8 matrix.
+
+        Args:
+          sample_vals: per-feature sampled non-zero (or NaN) values.
+          sample_rows: per-feature row indices of those values within
+            the sample (feeds EFB conflict counting).
+          total_sample: number of sampled rows (zeros implicit).
+          num_data: full row count being pushed.
+        """
+        from .binning import find_bin_mappers
+        config = config or Config()
+        self = cls()
+        self.config = config
+        self.num_data = num_data
+        self.num_total_features = len(sample_vals)
+        self.max_bin = config.max_bin
+        self.feature_names = list(feature_names) if feature_names else [
+            f"Column_{i}" for i in range(len(sample_vals))]
+        cat_set = set(categorical_features or [])
+        self.mappers = find_bin_mappers(
+            sample_vals, total_sample, config.max_bin,
+            config.min_data_in_bin, config.min_data_in_leaf, cat_set,
+            config.use_missing, config.zero_as_missing)
+        self.used_features = [i for i, m in enumerate(self.mappers)
+                              if not m.is_trivial]
+        self._build_groups(reference=None, sample_nonzero=sample_rows,
+                           sample_cnt=total_sample)
+        self.group_bins = np.zeros((num_data, self.num_groups),
+                                   dtype=np.uint8)
+        # prefill implicit-zero bins so sparse (CSR) pushes only write
+        # stored entries; dense pushes overwrite every cell anyway
+        for f in self.features:
+            if not f.collapsed_default:
+                zb = int(np.asarray(
+                    self.mappers[f.feature_idx].value_to_bin(
+                        np.zeros(1)))[0])
+                if zb != 0:
+                    self.group_bins[:, f.group] = zb
+        self.metadata = Metadata(num_data)
+        self._categorical_features = list(categorical_features or [])
+        self._resolve_monotone(config)
+        self._pushed_rows = 0
+        return self
+
+    def push_rows(self, chunk: np.ndarray, row_start: int) -> None:
+        """Streaming construction, step 2: bin one dense float chunk
+        (reference LGBM_DatasetPushRows, c_api.h:100-120)."""
+        chunk = np.asarray(chunk, dtype=np.float64)
+        if chunk.ndim == 1:
+            chunk = chunk[None, :]
+        self._bin_rows_dense(chunk, row_start)
+        # actual pushed-row COUNT (not a high-water mark): chunks may
+        # arrive in any order (reference allows thread-partitioned
+        # arbitrary start_row), so only the sum of chunk sizes can tell
+        # when every row has arrived
+        self._pushed_rows = getattr(self, "_pushed_rows", 0) \
+            + chunk.shape[0]
+
+    def push_rows_csr(self, indptr, indices, values,
+                      row_start: int) -> None:
+        """Streaming CSR chunk push (reference LGBM_DatasetPushRowsByCSR,
+        c_api.h:122-145): only stored entries are written; implicit
+        zeros were prefilled at creation."""
+        indptr = np.asarray(indptr, dtype=np.int64)
+        indices = np.asarray(indices, dtype=np.int32)
+        values = np.asarray(values, dtype=np.float64)
+        nrows = len(indptr) - 1
+        row_of = np.repeat(np.arange(nrows, dtype=np.int64),
+                           np.diff(indptr)) + row_start
+        order = np.argsort(indices, kind="stable")
+        cols_s, rows_s, vals_s = indices[order], row_of[order], values[order]
+        bounds = np.searchsorted(cols_s, np.arange(
+            self.num_total_features + 1))
+        for f in self.features:
+            j = f.feature_idx
+            lo, hi = bounds[j], bounds[j + 1]
+            if lo == hi:
+                continue
+            m = self.mappers[j]
+            col = m.value_to_bin(vals_s[lo:hi])
+            rr = rows_s[lo:hi]
+            if not f.collapsed_default:
+                self.group_bins[rr, f.group] = col.astype(np.uint8)
+            else:
+                gb = col + f.offset
+                if m.default_bin == 0:
+                    gb -= 1
+                keep = col != m.default_bin
+                self.group_bins[rr[keep], f.group] = gb[keep].astype(
+                    np.uint8)
+        self._pushed_rows = getattr(self, "_pushed_rows", 0) + nrows
+
+    def finish_load(self) -> "Dataset":
+        """End of streaming pushes (reference FinishLoad)."""
+        pushed = getattr(self, "_pushed_rows", self.num_data)
+        if pushed < self.num_data:
+            Log.warning(f"finish_load: only {pushed} of {self.num_data} "
+                        "rows were pushed")
+        return self
+
+    # ------------------------------------------------------------------
+    def _build_groups(self, reference: Optional["Dataset"],
+                      sample_nonzero: Optional[List[np.ndarray]] = None,
+                      sample_cnt: int = 0) -> None:
+        """Assign features to groups.  With EFB disabled (or until the
+        bundler finds conflicts-free bundles) every used feature is its
+        own single-feature group with identity bin mapping.
+        Multi-feature bundles follow the reference offset scheme
+        (feature_group.h:34-51): group bin 0 is the shared default slot,
+        each feature occupies [offset, offset+num_bin-1) with its
+        default bin collapsed into slot 0."""
+        if reference is not None:
+            self.features = reference.features
+            self.group_num_bin = reference.group_num_bin
+            self.group_is_multi = reference.group_is_multi
+            self._bundles = reference._bundles
+            return
+        bundles = _find_bundles(self, sample_nonzero, sample_cnt)
+        self._bundles = bundles
+        self.features = [None] * 0
+        feats: List[FeatureView] = []
+        self.group_num_bin = []
+        self.group_is_multi = []
+        for gidx, bundle in enumerate(bundles):
+            if len(bundle) == 1:
+                fidx = bundle[0]
+                m = self.mappers[fidx]
+                feats.append(FeatureView(fidx, gidx, 0, 0, m,
+                                         collapsed_default=False))
+                self.group_num_bin.append(m.num_bin)
+                self.group_is_multi.append(False)
+            else:
+                total = 1  # bin 0 = shared default slot
+                for sub, fidx in enumerate(bundle):
+                    m = self.mappers[fidx]
+                    offset = total
+                    nb = m.num_bin
+                    if m.default_bin == 0:
+                        nb -= 1
+                    feats.append(FeatureView(fidx, gidx, sub, offset, m,
+                                             collapsed_default=True))
+                    total += nb
+                self.group_num_bin.append(total)
+                self.group_is_multi.append(True)
+        # order features by real index for stable downstream numbering
+        feats.sort(key=lambda f: f.feature_idx)
+        self.features = feats
+
+    # ------------------------------------------------------------------
+    def _bin_data(self, data: np.ndarray) -> None:
+        self.group_bins = np.zeros((self.num_data, self.num_groups),
+                                   dtype=np.uint8)
+        self._bin_rows_dense(data, 0)
+
+    def _bin_rows_dense(self, data: np.ndarray, row_start: int) -> None:
+        """Bin a dense float chunk into group_bins[row_start:...] —
+        shared by whole-matrix construction and the PushRows streaming
+        path (reference Dataset::PushOneRow via FeatureGroup::PushData,
+        feature_group.h:128-136)."""
+        out = self.group_bins[row_start:row_start + data.shape[0]]
+        for f in self.features:
+            col = self.mappers[f.feature_idx].value_to_bin(
+                data[:, f.feature_idx])
+            if not f.collapsed_default:
+                out[:, f.group] = col.astype(np.uint8)
+            else:
+                # bundle write: non-default values land at offset (+ the
+                # default-at-0 slot removal), defaults stay at group bin 0.
+                # (reference feature_group.h:128-136)
+                gb = col + f.offset
+                if f.mapper.default_bin == 0:
+                    gb -= 1
+                is_default = col == f.mapper.default_bin
+                keep = ~is_default
+                out[keep, f.group] = gb[keep].astype(np.uint8)
+
+    # ------------------------------------------------------------------
+    def _bin_data_sparse(self, csc) -> None:
+        """Bin a CSC matrix column-by-column into the packed (N, G)
+        uint8 matrix: implicit zeros land in each feature's zero bin
+        (== its default bin, the GreedyFindBin contract) without ever
+        materializing a dense float column (reference sparse path:
+        src/io/sparse_bin.hpp Push / feature_group.h:128-136)."""
+        N = self.num_data
+        G = self.num_groups
+        out = np.zeros((N, G), dtype=np.uint8)
+        indptr, indices, values = csc.indptr, csc.indices, csc.data
+        for f in self.features:
+            m = self.mappers[f.feature_idx]
+            j = f.feature_idx
+            rows = indices[indptr[j]:indptr[j + 1]]
+            vals = values[indptr[j]:indptr[j + 1]]
+            col = m.value_to_bin(vals.astype(np.float64))
+            zero_bin = int(np.asarray(
+                m.value_to_bin(np.zeros(1)))[0])
+            if not f.collapsed_default:
+                if zero_bin != 0:
+                    out[:, f.group] = zero_bin
+                out[rows, f.group] = col.astype(np.uint8)
+            else:
+                gb = col + f.offset
+                if m.default_bin == 0:
+                    gb -= 1
+                keep = col != m.default_bin
+                out[rows[keep], f.group] = gb[keep].astype(np.uint8)
+        self.group_bins = out
+
+    # ------------------------------------------------------------------
+    def _resolve_monotone(self, config: Config) -> None:
+        mc = config.monotone_constraints
+        if mc:
+            arr = np.zeros(len(self.features), dtype=np.int8)
+            for j, f in enumerate(self.features):
+                if f.feature_idx < len(mc):
+                    arr[j] = mc[f.feature_idx]
+            self.monotone_constraints = arr
+        else:
+            self.monotone_constraints = None
+
+    # ------------------------------------------------------------------
+    def feature_bin_maps(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Device gather map from group histograms to per-feature
+        histograms.
+
+        Returns ``(bin_map, needs_fix)`` where ``bin_map[f, b]`` is the
+        flattened (group, group_bin) index holding feature ``f``'s bin
+        ``b`` (or -1 when the bin's count must be reconstructed from leaf
+        totals — the FixHistogram path, dataset.cpp:776-795), and
+        ``needs_fix[f]`` is that reconstructed bin's index (or -1)."""
+        F = self.num_features
+        B = self.max_feature_bin
+        bin_map = np.full((F, B), -1, dtype=np.int32)
+        fix_bin = np.full(F, -1, dtype=np.int32)
+        for j, f in enumerate(self.features):
+            for b in range(f.num_bin):
+                if not f.collapsed_default:
+                    bin_map[j, b] = f.group * self.max_group_bin + b
+                else:
+                    if b == f.mapper.default_bin:
+                        fix_bin[j] = b
+                        continue
+                    gb = b + f.offset - (1 if f.mapper.default_bin == 0 else 0)
+                    bin_map[j, b] = f.group * self.max_group_bin + gb
+        return bin_map, fix_bin
+
+    @property
+    def max_group_bin(self) -> int:
+        return max(self.group_num_bin) if self.group_num_bin else 1
+
+    @property
+    def max_feature_bin(self) -> int:
+        return max((f.num_bin for f in self.features), default=1)
+
+    # ------------------------------------------------------------------
+    def feature_meta_arrays(self) -> Dict[str, np.ndarray]:
+        """Per-used-feature metadata arrays shipped to the device split
+        finder."""
+        F = self.num_features
+        num_bin = np.array([f.num_bin for f in self.features], dtype=np.int32)
+        default_bin = np.array([f.default_bin for f in self.features],
+                               dtype=np.int32)
+        missing_type = np.array([f.missing_type for f in self.features],
+                                dtype=np.int32)
+        is_cat = np.array([f.is_categorical for f in self.features],
+                          dtype=bool)
+        mono = (self.monotone_constraints if self.monotone_constraints
+                is not None else np.zeros(F, dtype=np.int8))
+        return dict(num_bin=num_bin, default_bin=default_bin,
+                    missing_type=missing_type, is_categorical=is_cat,
+                    monotone=mono.astype(np.int32))
+
+    # ------------------------------------------------------------------
+    def real_feature_index(self, inner_idx: int) -> int:
+        return self.features[inner_idx].feature_idx
+
+    def inner_feature_index(self, real_idx: int) -> int:
+        for j, f in enumerate(self.features):
+            if f.feature_idx == real_idx:
+                return j
+        return -1
+
+    def feature_infos(self) -> List[str]:
+        return [m.feature_info_str() for m in self.mappers]
+
+
+# ---------------------------------------------------------------------------
+def _sample_feature_values(data: np.ndarray, sample_cnt: int, seed: int
+                           ) -> Tuple[List[np.ndarray], int,
+                                      List[np.ndarray]]:
+    """Row-sample then collect per-feature non-zero (and NaN) values for
+    bin finding (reference dataset_loader.cpp:649-754 sampling +
+    bin.cpp:207 contract: zeros are implicit).  Also returns per-feature
+    non-zero row indices within the sample, feeding the EFB bundler."""
+    num_data = data.shape[0]
+    if num_data > sample_cnt:
+        rng = np.random.RandomState(seed)
+        idx = rng.choice(num_data, size=sample_cnt, replace=False)
+        idx.sort()
+        sample = data[idx]
+    else:
+        sample = data
+    from .data_loader import split_sample_columns
+    out, rows = split_sample_columns(sample)
+    return out, sample.shape[0], rows
+
+
+def _sample_feature_values_sparse(csc, sample_cnt: int, seed: int
+                                  ) -> Tuple[List[np.ndarray], int,
+                                             List[np.ndarray]]:
+    """Sparse analog of :func:`_sample_feature_values`: row-sample the
+    CSC matrix (via a CSR slice) and collect each column's stored
+    values/rows — zeros stay implicit, exactly the reference sampling
+    contract (dataset_loader.cpp:649-754 + bin.cpp:207)."""
+    num_data = csc.shape[0]
+    if num_data > sample_cnt:
+        rng = np.random.RandomState(seed)
+        idx = rng.choice(num_data, size=sample_cnt, replace=False)
+        idx.sort()
+        sample = csc.tocsr()[idx].tocsc()
+        sample.sort_indices()
+    else:
+        sample = csc
+    total = sample.shape[0]
+    indptr, indices, values = sample.indptr, sample.indices, sample.data
+    out = []
+    rows = []
+    for j in range(sample.shape[1]):
+        v = values[indptr[j]:indptr[j + 1]].astype(np.float64)
+        r = indices[indptr[j]:indptr[j + 1]]
+        keep = np.isnan(v) | (np.abs(v) > 1e-35)
+        out.append(v[keep])
+        rows.append(r[keep].astype(np.int64))
+    return out, total, rows
+
+
+def _find_bundles(ds: Dataset, sample_nonzero: Optional[List[np.ndarray]]
+                  = None, sample_cnt: int = 0) -> List[List[int]]:
+    """Exclusive feature bundling (reference dataset.cpp:66-210
+    FindGroups/FastFeatureBundling): greedily pack mutually-exclusive
+    sparse features into shared bin columns, tolerating
+    ``max_conflict_rate`` collisions, with the 256-bins-per-group cap
+    the GPU learner imposes (dataset.cpp:76,90-91) — which is exactly
+    the uint8 packed-column constraint here.
+
+    ``sample_nonzero``: per-feature sorted row indices (within the
+    sample) where the feature is non-default.  When absent (e.g.
+    reloaded binary cache) falls back to single-feature groups.
+    """
+    cfg = ds.config
+    if (sample_nonzero is None or cfg is None or not cfg.enable_bundle
+            or not cfg.is_enable_bundle):
+        return [[fidx] for fidx in ds.used_features]
+
+    max_group_bins = 256
+    max_conflict = int(cfg.max_conflict_rate * max(sample_cnt, 1))
+    # order by non-zero count descending (densest placed first,
+    # mirroring the reference's sorted-by-count greedy pass)
+    order = sorted(ds.used_features,
+                   key=lambda f: -len(sample_nonzero[f]))
+    bundles: List[List[int]] = []
+    bundle_rows: List[np.ndarray] = []
+    bundle_bins: List[int] = []
+    bundle_conflicts: List[int] = []
+    for fidx in order:
+        m = ds.mappers[fidx]
+        nb = m.num_bin - (1 if m.default_bin == 0 else 0)
+        rows = sample_nonzero[fidx]
+        placed = False
+        # a feature covering most rows can't bundle with anything
+        if len(rows) * 2 < sample_cnt:
+            for bi in range(len(bundles)):
+                if bundle_bins[bi] + nb > max_group_bins:
+                    continue
+                conflicts = np.intersect1d(bundle_rows[bi], rows,
+                                           assume_unique=True).size
+                if bundle_conflicts[bi] + conflicts <= max_conflict:
+                    bundles[bi].append(fidx)
+                    bundle_rows[bi] = np.union1d(bundle_rows[bi], rows)
+                    bundle_bins[bi] += nb
+                    bundle_conflicts[bi] += conflicts
+                    placed = True
+                    break
+        if not placed:
+            bundles.append([fidx])
+            bundle_rows.append(rows)
+            bundle_bins.append(nb + 1)  # + shared default slot
+            bundle_conflicts.append(0)
+    # stable order: by first (lowest) feature index
+    for b in bundles:
+        b.sort()
+    bundles.sort(key=lambda b: b[0])
+    return bundles

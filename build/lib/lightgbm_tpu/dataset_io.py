@@ -1,0 +1,106 @@
+"""Binned-dataset binary cache.
+
+The analog of the reference's save_binary / LoadFromBinFile
+(reference: dataset.cpp:18 token + :528-607 writer,
+dataset_loader.cpp:171,266-486 auto-detected fast load): persists the
+fully-binned matrix, mappers and metadata so repeat training skips
+parsing + bin finding — the direct ancestor of a TPU HBM-resident
+packed-bin snapshot.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from .dataset import Dataset
+from .utils.log import Log
+
+BINARY_TOKEN = b"______LightGBM_TPU_Binary_File_Token______\n"
+FORMAT_VERSION = 1
+
+
+def save_binary(dataset: Dataset, filename: str) -> None:
+    payload = {
+        "version": FORMAT_VERSION,
+        "num_data": dataset.num_data,
+        "num_total_features": dataset.num_total_features,
+        "mappers": dataset.mappers,
+        "used_features": dataset.used_features,
+        "group_bins": dataset.group_bins,
+        "group_num_bin": dataset.group_num_bin,
+        "group_is_multi": dataset.group_is_multi,
+        "bundles": dataset._bundles,
+        "feature_names": dataset.feature_names,
+        "max_bin": dataset.max_bin,
+        "label": dataset.metadata.label,
+        "weight": dataset.metadata.weight,
+        "query_boundaries": dataset.metadata.query_boundaries,
+        "init_score": dataset.metadata.init_score,
+        "monotone": dataset.monotone_constraints,
+        "categorical_features": dataset._categorical_features,
+    }
+    with open(filename, "wb") as f:
+        f.write(BINARY_TOKEN)
+        pickle.dump(payload, f, protocol=4)
+    Log.info(f"Saved binned dataset to binary file {filename}")
+
+
+def is_binary_file(filename: str) -> bool:
+    try:
+        with open(filename, "rb") as f:
+            return f.read(len(BINARY_TOKEN)) == BINARY_TOKEN
+    except OSError:
+        return False
+
+
+def load_binary(filename: str) -> Dataset:
+    with open(filename, "rb") as f:
+        token = f.read(len(BINARY_TOKEN))
+        if token != BINARY_TOKEN:
+            Log.fatal(f"{filename} is not a lightgbm_tpu binary dataset")
+        payload = pickle.load(f)
+    if payload.get("version") != FORMAT_VERSION:
+        Log.fatal("Unsupported binary dataset version")
+    ds = Dataset.__new__(Dataset)
+    Dataset.__init__(ds)
+    ds.num_data = payload["num_data"]
+    ds.num_total_features = payload["num_total_features"]
+    ds.mappers = payload["mappers"]
+    ds.used_features = payload["used_features"]
+    ds.group_bins = payload["group_bins"]
+    ds.group_num_bin = payload["group_num_bin"]
+    ds.group_is_multi = payload["group_is_multi"]
+    ds._bundles = payload["bundles"]
+    ds.feature_names = payload["feature_names"]
+    ds.max_bin = payload["max_bin"]
+    ds._categorical_features = payload["categorical_features"]
+    ds.monotone_constraints = payload["monotone"]
+    # rebuild FeatureView list from bundles + mappers
+    from .dataset import FeatureView
+    feats = []
+    for gidx, bundle in enumerate(ds._bundles):
+        if len(bundle) == 1:
+            fidx = bundle[0]
+            feats.append(FeatureView(fidx, gidx, 0, 0, ds.mappers[fidx],
+                                     collapsed_default=False))
+        else:
+            total = 1
+            for sub, fidx in enumerate(bundle):
+                m = ds.mappers[fidx]
+                offset = total
+                nb = m.num_bin - (1 if m.default_bin == 0 else 0)
+                feats.append(FeatureView(fidx, gidx, sub, offset, m,
+                                         collapsed_default=True))
+                total += nb
+    feats.sort(key=lambda f: f.feature_idx)
+    ds.features = feats
+    from .dataset import Metadata
+    ds.metadata = Metadata(ds.num_data)
+    ds.metadata.label = payload["label"]
+    ds.metadata.weight = payload["weight"]
+    ds.metadata.query_boundaries = payload["query_boundaries"]
+    ds.metadata.init_score = payload["init_score"]
+    Log.info(f"Loaded binned dataset from binary file {filename}")
+    return ds

@@ -1,0 +1,290 @@
+"""Training entry points: train() and cv().
+
+The lgb.train / lgb.cv analogs (reference: python-package/lightgbm/
+engine.py:18-230 train, :312 cv) driving the device GBDT loop with the
+reference's callback/early-stopping protocol.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .booster import Booster
+from .config import Config
+from .dataset import Dataset
+from .utils.log import Log
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[Sequence[Dataset]] = None,
+          valid_names: Optional[Sequence[str]] = None,
+          fobj: Optional[Callable] = None,
+          feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, "Booster"]] = None,
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[dict] = None,
+          verbose_eval: Union[bool, int] = True,
+          callbacks: Optional[Sequence[Callable]] = None) -> Booster:
+    """Train a gradient-boosted model (reference engine.py:18-229)."""
+    params = dict(params or {})
+    if early_stopping_rounds is not None and not any(
+            k in params for k in ("early_stopping_round",
+                                  "early_stopping_rounds", "early_stopping")):
+        params["early_stopping_round"] = early_stopping_rounds
+    # params aliases override the argument (reference engine.py:85-91)
+    from .config import PARAM_ALIASES
+    has_num_iter = "num_iterations" in params or any(
+        PARAM_ALIASES.get(str(k).lower()) == "num_iterations" for k in params)
+    if not has_num_iter:
+        params["num_iterations"] = num_boost_round
+    config = Config.from_params(params)
+    num_boost_round = config.num_iterations
+
+    if hasattr(train_set, "construct"):
+        core_train = train_set.construct(config)
+    else:
+        core_train = train_set
+    valid_sets = [vs if not hasattr(vs, "construct")
+                  else (core_train if vs is train_set
+                        else vs.construct(config))
+                  for vs in (valid_sets or [])]
+    train_set = core_train
+
+    booster = Booster(config=config, train_set=train_set,
+                      init_model=init_model,
+                      custom_objective=fobj is not None)
+
+    valid_sets = list(valid_sets or [])
+    names = list(valid_names or [])
+    while len(names) < len(valid_sets):
+        names.append(f"valid_{len(names)}")
+    for vs, name in zip(valid_sets, names):
+        if vs is train_set:
+            booster.gbdt.add_train_metrics()
+        else:
+            booster.gbdt.add_valid(vs, name)
+
+    if config.is_training_metric and not booster.gbdt.train_metrics:
+        booster.gbdt.add_train_metrics()
+
+    eval_freq = (verbose_eval if isinstance(verbose_eval, int)
+                 and not isinstance(verbose_eval, bool)
+                 else config.output_freq)
+    show_eval = bool(verbose_eval)
+
+    # periodic model snapshots (reference gbdt.cpp:330-334 writes
+    # <output_model>.snapshot_iter_N every snapshot_freq iterations)
+    if config.snapshot_freq > 0 and config.output_model:
+        def _snapshot_cb(env):
+            it = env.iteration + 1
+            if it % config.snapshot_freq == 0:
+                env.model.save_model(
+                    f"{config.output_model}.snapshot_iter_{it}")
+        callbacks = list(callbacks or []) + [_snapshot_cb]
+
+    if evals_result is not None:
+        evals_result.clear()
+
+    # headless stretches (no per-iteration callbacks/eval/early-stop
+    # consumers) run as multi-iteration fused chunks: on a
+    # remote-attached TPU each dispatch is an RPC round trip, ~40% of
+    # wall-clock at one call per iteration
+    # (show_eval is irrelevant: with no valid sets and no train metrics
+    # there is nothing to print between iterations)
+    chunkable = (fobj is None and feval is None and not callbacks
+                 and evals_result is None
+                 and config.early_stopping_round <= 0
+                 and not booster.gbdt.valid_sets
+                 and not booster.gbdt.train_metrics
+                 and booster.gbdt.can_chunk())
+    chunk_size = 10
+
+    stopped_early = False
+    iteration = 0
+    while iteration < num_boost_round:
+        if chunkable and num_boost_round - iteration >= chunk_size:
+            stop = booster.gbdt.train_chunk(chunk_size)
+            iteration += chunk_size
+            if stop:
+                break
+            continue
+        if callbacks:
+            for cb in callbacks:
+                if getattr(cb, "before_iteration", False):
+                    cb(_CallbackEnv(booster, params, iteration,
+                                    num_boost_round, None))
+        if fobj is not None:
+            grad, hess = fobj(booster._current_train_scores(), train_set)
+            stop = booster.gbdt.train_one_iter(grad, hess)
+        else:
+            stop = booster.gbdt.train_one_iter()
+        if stop:
+            break
+
+        results = booster.gbdt.eval_metrics()
+        if feval is not None:
+            fr = feval(booster._current_train_scores(), train_set)
+            if fr is not None:
+                if not isinstance(fr, list):
+                    fr = [fr]
+                for name, val, bigger in fr:
+                    results.append(("feval", name, val, bigger))
+        if evals_result is not None:
+            for dname, mname, value, _ in results:
+                evals_result.setdefault(dname, collections.OrderedDict()) \
+                    .setdefault(mname, []).append(value)
+        if show_eval and results and eval_freq > 0 \
+                and (iteration + 1) % eval_freq == 0:
+            msg = "\t".join(f"{d}'s {m}: {v:g}"
+                            for d, m, v, _ in results)
+            Log.info(f"[{iteration + 1}]\t{msg}")
+        if callbacks:
+            env = _CallbackEnv(booster, params, iteration, num_boost_round,
+                               [(d, m, v, b) for d, m, v, b in results])
+            for cb in callbacks:
+                if not getattr(cb, "before_iteration", False):
+                    try:
+                        cb(env)
+                    except EarlyStopException as e:
+                        booster.best_iteration = e.best_iteration + 1
+                        stopped_early = True
+            if stopped_early:
+                break
+        if booster.gbdt.check_early_stopping(results, iteration):
+            booster.best_iteration = booster.gbdt.best_iteration
+            Log.info(f"Early stopping at iteration {iteration + 1}, best "
+                     f"iteration is {booster.best_iteration}")
+            stopped_early = True
+            break
+        iteration += 1
+    if not stopped_early:
+        booster.best_iteration = -1
+    if booster.gbdt is not None:
+        booster.gbdt.flush_models(final=True)
+    if booster.gbdt is not None and booster.gbdt.timer.acc:
+        Log.debug("training phase timings: "
+                  + booster.gbdt.timer.report())
+    return booster
+
+
+class EarlyStopException(Exception):
+    def __init__(self, best_iteration: int, best_score=None):
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+_CallbackEnv = collections.namedtuple(
+    "LightGBMCallbackEnv",
+    ["model", "params", "iteration", "end_iteration", "evaluation_result_list"])
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference engine.py:230-260).
+    Attribute access fans out to every fold's booster and returns the
+    list of results."""
+
+    def __init__(self, boosters=None):
+        self.boosters = list(boosters or [])
+        self.best_iteration = -1
+
+    def _append(self, booster):
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs)
+                    for b in self.boosters]
+        return handler
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       early_stopping_rounds=None, seed: int = 0,
+       callbacks=None, verbose_eval=None,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (reference engine.py:312-425)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    config = Config.from_params(params)
+    if hasattr(train_set, "construct"):
+        train_set = train_set.construct(config)
+    label = train_set.metadata.label
+    n = train_set.num_data
+    rng = np.random.RandomState(seed)
+
+    if folds is None:
+        idx = np.arange(n)
+        if stratified and config.objective in ("binary", "multiclass",
+                                               "multiclassova"):
+            folds = _stratified_folds(label, nfold, rng, shuffle)
+        else:
+            if shuffle:
+                rng.shuffle(idx)
+            folds = [(np.setdiff1d(idx, idx[i::nfold], assume_unique=False),
+                      idx[i::nfold]) for i in range(nfold)]
+
+    raw = train_set._raw_data
+    if raw is None:
+        Log.fatal("cv requires the Dataset to retain raw data "
+                  "(construct via Dataset(data, label))")
+
+    results: Dict[str, List[float]] = collections.defaultdict(list)
+    boosters = []
+    fold_evals = []
+    for train_idx, test_idx in folds:
+        dtrain = Dataset.from_matrix(
+            raw[train_idx], label=label[train_idx],
+            weight=None if train_set.metadata.weight is None
+            else train_set.metadata.weight[train_idx],
+            config=config,
+            categorical_features=train_set._categorical_features)
+        dtest = Dataset.from_matrix(
+            raw[test_idx], label=label[test_idx],
+            weight=None if train_set.metadata.weight is None
+            else train_set.metadata.weight[test_idx],
+            config=config, reference=dtrain)
+        er: dict = {}
+        bst = train(params, dtrain, num_boost_round, valid_sets=[dtest],
+                    valid_names=["valid"], fobj=fobj, feval=feval,
+                    early_stopping_rounds=early_stopping_rounds,
+                    evals_result=er, verbose_eval=False)
+        boosters.append(bst)
+        fold_evals.append(er.get("valid", {}))
+
+    if fold_evals and fold_evals[0]:
+        num_iters = min(len(next(iter(fe.values()))) for fe in fold_evals)
+        for mname in fold_evals[0]:
+            for i in range(num_iters):
+                vals = [fe[mname][i] for fe in fold_evals]
+                results[f"{mname}-mean"].append(float(np.mean(vals)))
+                results[f"{mname}-stdv"].append(float(np.std(vals)))
+    out = dict(results)
+    if return_cvbooster:
+        cvb = CVBooster(boosters)
+        cvb.best_iteration = max((b.best_iteration for b in boosters),
+                                 default=-1)
+        out["cvbooster"] = cvb
+    return out
+
+
+def _stratified_folds(label, nfold, rng, shuffle):
+    classes = np.unique(label)
+    fold_test = [[] for _ in range(nfold)]
+    for c in classes:
+        idx = np.nonzero(label == c)[0]
+        if shuffle:
+            rng.shuffle(idx)
+        for i in range(nfold):
+            fold_test[i].append(idx[i::nfold])
+    folds = []
+    all_idx = np.arange(len(label))
+    for i in range(nfold):
+        test = np.concatenate(fold_test[i])
+        train_idx = np.setdiff1d(all_idx, test)
+        folds.append((train_idx, test))
+    return folds

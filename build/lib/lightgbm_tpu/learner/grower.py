@@ -1,0 +1,1330 @@
+"""On-device tree growth: the TPU-native serial tree learner.
+
+Re-design of SerialTreeLearner's leaf-wise loop
+(reference: src/treelearner/serial_tree_learner.cpp:156-220 Train,
+:700-774 Split) for XLA's static-shape world.  One jitted function grows
+a whole tree: a ``lax.while_loop`` over frontier rounds where each round
+  1. refreshes the leaves created LAST round (queued in pend_*): builds
+     histograms ONLY for the new right children in one MXU pass
+     (ops/histogram.py, frontier-restricted), derives each left child
+     as parent-minus-right — the reference's histogram subtraction
+     trick (serial_tree_learner.cpp:505-507) with the histogram pool's
+     role played by a fixed (L, G, B, 3) HBM cache — and runs the split
+     finder on those 2*W leaves only, caching their best candidates
+     (the best_split_per_leaf_ analog),
+  2. splits every leaf whose cached candidate clears the gain bar
+     (gain-ordered within the remaining leaf budget, so slot/node
+     numbering matches the reference's sequential best-first allocation
+     whenever the budget doesn't bind),
+  3. re-labels rows (ops/partition.py) and queues the new children for
+     the next round — so the final round's children are never
+     histogrammed at all (the while_loop exits first).
+Zero host round-trips inside a tree; the boosting loop stays on device
+too and only syncs for metric printing/early stopping.
+
+Tree state is a fixed-size struct of arrays (the reference's Tree,
+include/LightGBM/tree.h:352-391, is already array-of-nodes — here the
+arrays live in HBM and are scattered into with `mode='drop'`).
+
+The voting-parallel learner keeps the full-frontier formulation (every
+active leaf re-histogrammed per round) because its per-round top-k
+feature election is a collective over freshly built local histograms.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
+                             compute_group_histograms_fused,
+                             compute_group_histograms_pallas,
+                             compute_group_histograms_pallas_paired,
+                             compute_group_histograms_pallas_q,
+                             compute_group_histograms_pre,
+                             compute_group_histograms_pre_packed,
+                             compute_group_histograms_q_packed,
+                             compute_leaf_totals, expand_feature_histograms,
+                             precompute_bin_onehot,
+                             precompute_bin_onehot_packed,
+                             quantize_gradients)
+from ..ops.partition import (apply_route_table, apply_splits,
+                             build_route_table)
+from ..ops.split import (SplitResult, build_cat_bitset,
+                         find_categorical_splits, find_numerical_splits,
+                         gather_split_at_threshold)
+
+NEG_INF = -jnp.inf
+
+
+class TreeArrays(NamedTuple):
+    """Device-side grown tree (fixed shapes; L leaf slots, M=L-1 nodes)."""
+    num_leaves: jax.Array        # scalar int32 — actual leaves used
+    leaf_value: jax.Array        # (L,) f32
+    leaf_weight: jax.Array       # (L,) f32 (sum_hessian)
+    leaf_count: jax.Array        # (L,) f32
+    leaf_parent: jax.Array       # (L,) int32 — parent internal node (-1 root)
+    leaf_depth: jax.Array        # (L,) int32
+    node_feature: jax.Array      # (M,) int32 inner feature idx
+    node_threshold: jax.Array    # (M,) int32 bin threshold / num-cats-1
+    node_default_left: jax.Array  # (M,) bool
+    node_is_cat: jax.Array       # (M,) bool
+    node_cat_mask: jax.Array     # (M, B) bool — feature-bin left set
+    node_gain: jax.Array         # (M,) f32
+    node_value: jax.Array        # (M,) f32 internal output
+    node_weight: jax.Array       # (M,) f32
+    node_count: jax.Array        # (M,) f32
+    node_left: jax.Array         # (M,) int32 (neg = ~leaf)
+    node_right: jax.Array        # (M,) int32
+
+
+class SplitCand(NamedTuple):
+    """Cached best split per leaf slot — the best_split_per_leaf_ analog
+    (reference serial_tree_learner.h best_split_per_leaf_ + SplitInfo,
+    split_info.hpp:18-288) as a struct of arrays, all (L,) / (L, B)."""
+    gain: jax.Array
+    feature: jax.Array       # int32 inner feature idx
+    threshold: jax.Array     # int32
+    default_left: jax.Array  # bool
+    lsg: jax.Array           # left sum_grad
+    lsh: jax.Array           # left sum_hess
+    lsc: jax.Array           # left count
+    lout: jax.Array          # constrained left output
+    rout: jax.Array          # constrained right output
+    cat_dir: jax.Array       # int32
+    cat_mask: jax.Array      # (L, B) bool
+
+
+class ForcedCand(NamedTuple):
+    """Cached forced-split evaluation per leaf (ForceSplits semantics,
+    reference serial_tree_learner.cpp:543-698), all (L,)."""
+    gain: jax.Array
+    threshold: jax.Array
+    default_left: jax.Array
+    lsg: jax.Array
+    lsh: jax.Array
+    lsc: jax.Array
+    lout: jax.Array
+    rout: jax.Array
+
+
+class GrowerState(NamedTuple):
+    leaf_id: jax.Array
+    num_leaves: jax.Array        # scalar int32
+    round_idx: jax.Array
+    done: jax.Array
+    leaf_sum_grad: jax.Array
+    leaf_sum_hess: jax.Array
+    leaf_count: jax.Array
+    leaf_min_c: jax.Array
+    leaf_max_c: jax.Array
+    leaf_is_left: jax.Array      # (L,) bool — side under its parent
+    leaf_forced: jax.Array       # (L,) int32 forced-split spec idx (-1 none)
+    tree: TreeArrays
+    hist_cache: jax.Array        # (L, G, Bg, 3) f32 — per-leaf group hists
+    cand: SplitCand
+    forced_cand: ForcedCand
+    pend_parents: jax.Array      # (W,) slots whose hist/cands are stale
+    pend_rights: jax.Array       # (W,) — refreshed at the NEXT round's
+    # start (so the final round's refresh is never computed at all)
+    route_tab: jax.Array         # (L, 15+nb) f32 PENDING route table
+    # (fused-kernel path: the splits selected this round re-label rows
+    # lazily inside the next round's histogram kernel; all-zero = no-op)
+
+
+def _encode_leaf(leaf_slot):
+    """LightGBM child encoding: ~leaf (negative) marks a leaf index."""
+    return -(leaf_slot + 1)
+
+
+class TreeGrower:
+    """Builds and caches the jitted per-tree training function for one
+    Dataset + Config combination.
+
+    Distributed modes (tree_learner=data/feature/voting) work through
+    the ShardingPolicy: the bin matrix is placed sharded over the mesh
+    and the histogram output constrained, after which XLA inserts the
+    reduce-scatter/all-gather the reference's Network layer hand-codes
+    (see parallel/mesh.py)."""
+
+    def __init__(self, dataset: Dataset, config: Config, policy=None):
+        from ..parallel.mesh import ShardingPolicy, build_mesh
+        if policy is None:
+            policy = ShardingPolicy(config, build_mesh(config))
+        self.policy = policy
+        self.config = config
+        self.num_leaves = config.num_leaves
+        self.max_group_bin = dataset.max_group_bin
+        self.max_feature_bin = dataset.max_feature_bin
+        self.num_groups = dataset.num_groups
+        self.num_features = dataset.num_features
+
+        meta = dataset.feature_meta_arrays()
+        self.f_num_bin = jnp.asarray(meta["num_bin"])
+        self.f_default_bin = jnp.asarray(meta["default_bin"])
+        self.f_missing = jnp.asarray(meta["missing_type"])
+        self.f_is_cat = jnp.asarray(meta["is_categorical"])
+        self.f_monotone = jnp.asarray(meta["monotone"])
+        self.f_group = jnp.asarray(
+            np.array([f.group for f in dataset.features], dtype=np.int32))
+        self.has_categorical = bool(meta["is_categorical"].any())
+
+        bin_map, fix_bin = dataset.feature_bin_maps()
+        self.bin_map = jnp.asarray(bin_map)
+        self.fix_bin = jnp.asarray(fix_bin)
+        lo, hi, shift, oor, dense_g2f = self._build_g2f_affine(dataset)
+        self.f_gb_lo = jnp.asarray(lo)
+        self.f_gb_hi = jnp.asarray(hi)
+        self.f_gb_shift = jnp.asarray(shift)
+        self.f_gb_oor = jnp.asarray(oor)
+        # dense (F, GB) form kept for the binned predict path
+        self.g2f_lut = jnp.asarray(dense_g2f)
+
+        self.cfg_scalars: Dict[str, float] = dict(
+            lambda_l1=config.lambda_l1, lambda_l2=config.lambda_l2,
+            max_delta_step=config.max_delta_step,
+            min_data_in_leaf=float(config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
+            min_gain_to_split=config.min_gain_to_split,
+            cat_smooth=config.cat_smooth, cat_l2=config.cat_l2,
+            max_cat_threshold=config.max_cat_threshold,
+            max_cat_to_onehot=config.max_cat_to_onehot,
+            min_data_in_group=float(config.min_data_in_group),
+        )
+        self.max_depth = config.max_depth
+        # hard bound on frontier rounds (the while_loop exits early when
+        # no leaf splits)
+        self.max_rounds = config.num_leaves - 1
+        # frontier width: max splits applied per round.  126 = 3 strips
+        # of the channel-packed histogram kernel (3 x PACKED_STRIP), so
+        # every round's refresh runs at the cheapest lane packing for
+        # its width; a wider cap would not reduce round count in
+        # practice but would force the 3x-wider unpacked kernel.
+        self.frontier = min(config.num_leaves - 1,
+                            config.frontier_width or 126)
+
+        # histogram memory governance (reference histogram_pool_size,
+        # config.h:216 + HistogramPool LRU): when the per-leaf cache
+        # exceeds the budget, drop histogram subtraction and compute
+        # BOTH children of every split directly (2x histogram passes,
+        # no (L, G, B, 3) cache)
+        cache_mb = (self.num_leaves * self.num_groups *
+                    self.max_group_bin * 3 * 4) / (1 << 20)
+        pool = float(getattr(config, "histogram_pool_size", -1.0))
+        self.use_hist_cache = pool < 0 or cache_mb <= pool
+        if not self.use_hist_cache:
+            from ..utils.log import Log as _Log
+            _Log.warning(
+                f"histogram cache ({cache_mb:.0f} MB) exceeds "
+                f"histogram_pool_size ({pool:.0f} MB); disabling "
+                "histogram subtraction (children computed directly — "
+                "~2x histogram passes)")
+
+        # forced splits (reference serial_tree_learner.cpp:543-698
+        # ForceSplits): JSON tree flattened to spec arrays; leaves carry
+        # a spec index through growth and split at the forced
+        # (feature, threshold) with top priority before gain ordering
+        self.forced_count = 0
+        self._load_forced_splits(dataset, config)
+
+        # pad rows to a histogram-chunk multiple once, host-side
+        n = dataset.num_data
+        from ..ops.histogram import _pick_chunk
+        cdt = jnp.dtype(config.hist_compute_dtype)
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        self.chunk = _pick_chunk(n, self.num_groups, self.max_group_bin,
+                                 cdt.itemsize,
+                                 min_chunk=4096 if on_tpu else 1024)
+        self.num_data = n
+        # multi-host: this process holds only ITS row shard of the bin
+        # matrix (parallel/distributed.py finalize_global); every host
+        # pads its shard to a whole chunk multiple and the global
+        # layout interleaves per-host padding blocks (host0 rows,
+        # host0 pad, host1 rows, ...).  pad_rows() reproduces that
+        # layout for global metadata arrays.
+        self._mh_local: Optional[int] = getattr(
+            dataset, "_mh_local_rows", None) if getattr(
+                dataset, "_multihost", False) else None
+        if self._mh_local is not None:
+            self._mh_nproc = max(1, self.policy.nproc)
+            per_host = ((self._mh_local + self.chunk - 1)
+                        // self.chunk) * self.chunk
+            self._mh_per_host = per_host
+            self.n_padded = per_host * self._mh_nproc
+            loc_pad = per_host - self._mh_local
+            bins_local = np.concatenate(
+                [dataset.group_bins,
+                 np.zeros((loc_pad, dataset.group_bins.shape[1]),
+                          dtype=np.uint8)])
+            self.bins = self.policy.place_local_rows(bins_local)
+            self._row_valid = self.policy.place_local_rows(
+                np.concatenate([np.ones(self._mh_local, bool),
+                                np.zeros(loc_pad, bool)]))
+        else:
+            self.n_padded = ((n + self.chunk - 1)
+                             // self.chunk) * self.chunk
+            pad = self.n_padded - n
+            bins_np = dataset.group_bins
+            if pad:
+                bins_np = np.concatenate(
+                    [bins_np,
+                     np.zeros((pad, bins_np.shape[1]), dtype=np.uint8)])
+            self.bins = self.policy.place_rows(bins_np)
+            self._row_valid = self.policy.place_rows(
+                np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
+        # the Pallas kernel path: single TPU device only (its sequential
+        # -grid accumulation is a Mosaic property); the XLA formulation
+        # stays for CPU simulation, GSPMD meshes (where the sharded
+        # contraction must lower to a reduce-scatter), and float32
+        # operand parity (the kernel runs bf16 operands, the analog of
+        # the reference GPU learner's single-precision default,
+        # gpu_tree_learner.cpp:73-77)
+        from ..utils.log import Log
+        hk = getattr(config, "hist_kernel", "auto")
+        if hk not in ("auto", "pallas", "paired", "xla"):
+            Log.warning(f"unknown hist_kernel={hk!r}; using 'auto'")
+            hk = "auto"
+        # test seam: interpret-mode Pallas on CPU exercises the SAME
+        # grower wiring (fused route carry, quant transpose, exit-time
+        # route application) the real chip runs
+        self._interp = bool(getattr(config, "force_pallas_interpret",
+                                    False))
+        pallas_ok = (
+            self.policy.mesh is None
+            and (jax.default_backend() in ("tpu", "axon")
+                 or self._interp)
+            and self.n_padded % 1024 == 0)
+        if hk in ("pallas", "paired") and not pallas_ok:
+            Log.warning(f"hist_kernel={hk} unavailable here (needs a "
+                        "single TPU device and 1024-row padding); "
+                        "falling back to the XLA histogram path")
+        self.use_pallas = pallas_ok and (
+            hk in ("pallas", "paired")
+            or (hk == "auto" and config.hist_compute_dtype == "bfloat16"))
+        # "paired" (per-group-pair dots, no expansion matmul) benched
+        # slower than the expansion kernel on v5e; kept as an option
+        self.pallas_paired = self.use_pallas and hk == "paired"
+        blk = int(getattr(config, "pallas_hist_block", 2048))
+        self.pallas_block = blk if self.n_padded % blk == 0 else 1024
+        # int8 quantized training (see _hist_kernel_body_q): histogram
+        # matmuls on the int8 MXU with one grad/hess scale per tree.
+        # The int32 accumulator bounds rows at N*127 < 2^31.
+        self.use_quant = self.use_pallas and not self.pallas_paired \
+            and getattr(config, "quantized_grad", False) \
+            and self.n_padded * 127 < 2**31
+        if getattr(config, "quantized_grad", False) and self.use_pallas \
+                and not self.use_quant and not self.pallas_paired:
+            Log.warning("quantized_grad disabled: dataset exceeds the "
+                        "int32 histogram accumulator bound (~16.9M rows)")
+        # quantized frontier kernels rebuild the bin one-hot in VMEM
+        # from the packed bins (~G bytes/row of HBM traffic instead of
+        # the G*B-byte streamed one-hot) — the cheapest formulation
+        # measured on v5e
+        self.use_quant_otf = self.use_quant and getattr(
+            config, "hist_quant_onthefly", True)
+        # streamed-one-hot histogram path: materialize the (N, G*B)
+        # int8 bin one-hot once (it is constant for the whole training
+        # run) and stream it through the kernel instead of rebuilding
+        # it from the packed bins every round.  Gated on an HBM budget.
+        # Sub-byte packing (hist_onehot_pack) stores `pack` one-hot
+        # columns per byte (planar layout, widened in-VMEM): pack-x
+        # less HBM footprint AND per-pass stream — at 10.5M x 28 x 63
+        # the full one-hot is 17.2 GB (over a 16 GB v5e) while pack=4
+        # is 4.3 GB and stays resident.
+        gbtot = self.num_groups * self.max_group_bin
+        budget = int(getattr(config, "hist_onehot_budget_mb", 4096)) << 20
+
+        from ..ops.histogram import _round_up
+
+        def _ohb_bytes(p):
+            width = gbtot if p == 1 else _round_up(gbtot // p, 128)
+            return self.n_padded * width
+
+        pk_cfg = int(getattr(config, "hist_onehot_pack", 0) or 0)
+        if pk_cfg in (1, 2, 4) and gbtot % pk_cfg == 0:
+            self.ohb_pack = pk_cfg
+        else:
+            if pk_cfg:
+                Log.warning(f"hist_onehot_pack={pk_cfg} invalid for "
+                            f"G*B={gbtot}; auto-selecting")
+            # auto: the pack with the smallest resident/streamed bytes;
+            # ties break toward the SMALLER pack (less 128-lane plane
+            # padding waste — for small G*B packing is a pessimization
+            # and this reduces to pack=1)
+            self.ohb_pack = min(
+                (p for p in (1, 2, 4) if gbtot % p == 0),
+                key=lambda p: (_ohb_bytes(p), p))
+        ohb_bytes = _ohb_bytes(self.ohb_pack)
+        # fused route+histogram kernel (single chip): the pending split
+        # routing is applied INSIDE the next round's histogram pass, so
+        # the separate per-round apply_splits pass disappears.  Needs
+        # the streamed one-hot (HBM budget) and a frontier that fits
+        # the packed strip ladder.
+        self.use_fused = (self.use_pallas and not self.pallas_paired
+                          and self.frontier <= 3 * PACKED_STRIP
+                          and ohb_bytes <= budget
+                          and getattr(config, "hist_fused_route", True))
+        self.use_quant_otf = (self.use_quant_otf and not self.use_fused)
+        self.use_pre_ohb = (self.use_pallas and not self.pallas_paired
+                            and not self.use_quant_otf
+                            and ohb_bytes <= budget)
+        if self.use_pallas and ohb_bytes > budget:
+            Log.warning(
+                f"resident one-hot ({ohb_bytes >> 20} MB at pack="
+                f"{self.ohb_pack}) exceeds hist_onehot_budget_mb="
+                f"{budget >> 20}; using the slower on-the-fly rebuild "
+                "(see docs/ROOFLINE.md regime table)")
+        self.ohb = None
+        self.binsT = (jnp.asarray(bins_np.T) if self.use_fused else None)
+        self._route_cols = 15 + (self.max_feature_bin + 7) // 8
+        # trace-scoped override: callers thread the one-hot through
+        # their jit boundary as an ARGUMENT (a multi-hundred-MB closure
+        # constant sends XLA's constant-folding passes into minutes of
+        # compile time); _train_tree_impl pins the traced value here for
+        # the dynamic extent of its trace
+        self._ohb_arg = None
+        if self.use_pre_ohb:
+            if self.ohb_pack == 1:
+                self.ohb = precompute_bin_onehot(
+                    self.bins, max_group_bin=self.max_group_bin)
+            else:
+                self.ohb = precompute_bin_onehot_packed(
+                    self.bins, max_group_bin=self.max_group_bin,
+                    pack=self.ohb_pack)
+        self._is_voting = (self.policy.mesh is not None
+                           and config.tree_learner == "voting")
+        self._train_tree = jax.jit(self._train_tree_impl)
+
+    # ------------------------------------------------------------------
+    def _load_forced_splits(self, dataset: Dataset, config: Config) -> None:
+        """Parse forcedsplits_filename into flat device spec arrays:
+        feature (inner idx), threshold (bin), left/right child spec
+        index.  Real-valued thresholds convert through the feature's
+        BinMapper (the reference's Dataset::BinThreshold)."""
+        fn = getattr(config, "forcedsplits_filename", "")
+        if not fn:
+            return
+        import json as _json
+        from ..utils.log import Log
+        with open(fn) as f:
+            spec = _json.load(f)
+        if not spec:
+            return
+        if config.tree_learner == "voting":
+            Log.warning("forced splits are not supported with "
+                        "tree_learner=voting; ignoring %s" % fn)
+            return
+        real2inner = {f.feature_idx: j
+                      for j, f in enumerate(dataset.features)}
+        nodes: list = []
+
+        def rec(node) -> int:
+            real_f = int(node["feature"])
+            j = real2inner.get(real_f)
+            if j is None:
+                Log.warning("forced split on unused feature %d ignored"
+                            % real_f)
+                return -1
+            mapper = dataset.features[j].mapper
+            thr_bin = int(np.asarray(mapper.value_to_bin(
+                np.array([float(node["threshold"])]))).ravel()[0])
+            idx = len(nodes)
+            nodes.append([j, thr_bin, -1, -1])
+            if isinstance(node.get("left"), dict):
+                nodes[idx][2] = rec(node["left"])
+            if isinstance(node.get("right"), dict):
+                nodes[idx][3] = rec(node["right"])
+            return idx
+
+        if rec(spec) < 0:
+            return
+        arr = np.asarray(nodes, dtype=np.int32)
+        self.forced_count = len(nodes)
+        self.forced_feature = jnp.asarray(arr[:, 0])
+        self.forced_thr = jnp.asarray(arr[:, 1])
+        self.forced_left = jnp.asarray(arr[:, 2])
+        self.forced_right = jnp.asarray(arr[:, 3])
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _build_g2f_affine(dataset: Dataset):
+        """Per-feature affine group-bin -> feature-bin map
+        ``fb = gb - shift if lo <= gb < hi else oor``.
+
+        This is the scalar form of the reference's min_bin/max_bin/bias
+        routing in DenseBin::Split (dense_bin.hpp:191-283): a feature's
+        bins occupy one contiguous group-bin range (identity for a
+        group it owns alone; offset for EFB bundle members whose
+        default collapsed into the shared slot 0), everything else
+        routes to the default bin.  Verified exhaustively against the
+        dense (F, GB) table at construction.
+        """
+        F = dataset.num_features
+        GB = dataset.max_group_bin
+        lo = np.zeros(F, dtype=np.int32)
+        hi = np.zeros(F, dtype=np.int32)
+        shift = np.zeros(F, dtype=np.int32)
+        oor = np.zeros(F, dtype=np.int32)
+        for j, f in enumerate(dataset.features):
+            if not f.collapsed_default:
+                lo[j], hi[j] = 0, f.num_bin
+                shift[j], oor[j] = 0, f.num_bin - 1
+            else:
+                adj = 1 if f.mapper.default_bin == 0 else 0
+                lo[j] = f.offset
+                hi[j] = f.offset + f.num_bin - adj
+                shift[j] = f.offset - adj
+                oor[j] = f.default_bin
+        # cross-check against the dense table the affine form replaces
+        gb_iota = np.arange(GB, dtype=np.int32)[None, :]
+        affine = np.where(
+            (gb_iota >= lo[:, None]) & (gb_iota < hi[:, None]),
+            gb_iota - shift[:, None], oor[:, None])
+        dense = np.zeros((F, GB), dtype=np.int32)
+        for j, f in enumerate(dataset.features):
+            if not f.collapsed_default:
+                dense[j] = np.minimum(np.arange(GB), f.num_bin - 1)
+            else:
+                dense[j, :] = f.default_bin
+                adj = 1 if f.mapper.default_bin == 0 else 0
+                for b in range(f.num_bin):
+                    if b == f.mapper.default_bin:
+                        continue
+                    gb = b + f.offset - adj
+                    if gb < GB:
+                        dense[j, gb] = b
+        if not np.array_equal(affine, dense):  # pragma: no cover
+            bad = np.argwhere(affine != dense)
+            raise AssertionError(
+                f"affine g2f map diverges from dense table at {bad[:5]}")
+        return lo, hi, shift, oor, dense
+
+    # ------------------------------------------------------------------
+    def pad_rows(self, arr: np.ndarray, fill=0.0) -> np.ndarray:
+        """Pad a global row array to n_padded.  Multi-host: padding is
+        interleaved per host to match the assembled shard layout."""
+        if self._mh_local is not None:
+            nl, ph = self._mh_local, self._mh_per_host
+            pad_shape = (ph - nl,) + tuple(arr.shape[1:])
+            parts = []
+            for h in range(self._mh_nproc):
+                parts.append(arr[h * nl:(h + 1) * nl])
+                parts.append(np.full(pad_shape, fill, dtype=arr.dtype))
+            return np.concatenate(parts)
+        pad = self.n_padded - self.num_data
+        if pad == 0:
+            return arr
+        return np.concatenate([arr, np.full(pad, fill, dtype=arr.dtype)])
+
+    # ------------------------------------------------------------------
+    def train_tree(self, grad: jax.Array, hess: jax.Array,
+                   counts: jax.Array, feature_mask: jax.Array
+                   ) -> Tuple[TreeArrays, jax.Array, Optional[jax.Array]]:
+        """Grow one tree.  grad/hess/counts are (n_padded,) with zeros
+        for out-of-bag and padded rows.  Returns (tree, final leaf_id,
+        per-row post-route leaf value or None — see
+        _train_tree_inner)."""
+        return self._train_tree(grad, hess, counts, feature_mask,
+                                self.ohb, self.bins, self.binsT,
+                                self._row_valid)
+
+    # ------------------------------------------------------------------
+    def _hist_kernel(self, grad, hess, counts, leaf_id, slots=None,
+                     num_leaves=None, quant=None):
+        """Frontier histogram dispatch: Pallas on a real single chip,
+        XLA one-hot contraction under meshes / CPU simulation."""
+        L = self.num_leaves if num_leaves is None else num_leaves
+        if quant is not None and self.use_quant_otf:
+            return self._hist_kernel_q_otf(leaf_id, slots, L, quant)
+        if self.use_pre_ohb:
+            return self._hist_kernel_pre(grad, hess, counts, leaf_id,
+                                         slots, L, quant)
+        if quant is not None:
+            wq, scales = quant
+            return compute_group_histograms_pallas_q(
+                self.bins, wq, scales, leaf_id,
+                num_leaves=L, max_group_bin=self.max_group_bin,
+                slots=slots)
+        if self.use_pallas:
+            if self.pallas_paired:
+                # lower VMEM footprint permits the larger row block
+                return compute_group_histograms_pallas_paired(
+                    self.bins, grad, hess, counts, leaf_id,
+                    num_leaves=L, max_group_bin=self.max_group_bin,
+                    slots=slots, block=self.pallas_block)
+            return compute_group_histograms_pallas(
+                self.bins, grad, hess, counts, leaf_id,
+                num_leaves=L, max_group_bin=self.max_group_bin,
+                slots=slots)
+        return compute_group_histograms(
+            self.bins, grad, hess, counts, leaf_id,
+            num_leaves=L, max_group_bin=self.max_group_bin,
+            compute_dtype=self.config.hist_compute_dtype,
+            chunk=self.chunk, slots=slots)
+
+    # ------------------------------------------------------------------
+    def _packed_dispatch(self, full, run_packed, slots, W):
+        """Shared narrow-frontier ladder: run at the narrowest lane
+        packing covering the valid slots.  ``full`` is a thunk for the
+        full-width kernel; ``run_packed(strips)`` runs the packed
+        kernel and returns its (strips*PACKED_STRIP, ...) output, which
+        is padded/truncated to W here.  The branch is a runtime
+        lax.cond on the valid-slot count — the early rounds of EVERY
+        tree have 1..PACKED_STRIP new leaves."""
+        def packed(strips):
+            def run(_):
+                h = run_packed(strips)
+                cap = strips * PACKED_STRIP
+                if cap >= W:
+                    return h[:W]
+                pad = jnp.zeros((W - cap,) + h.shape[1:], h.dtype)
+                return jnp.concatenate([h, pad])
+            return run
+
+        if not getattr(self.config, "hist_packed_dispatch", True):
+            return full(None)
+        if W <= PACKED_STRIP:
+            return packed(1)(None)
+
+        k = jnp.sum(slots >= 0)
+        if W <= 2 * PACKED_STRIP:
+            return jax.lax.cond(k <= PACKED_STRIP, packed(1), packed(2),
+                                None)
+        wide = packed(3) if W <= 3 * PACKED_STRIP else full
+        return jax.lax.cond(
+            k <= PACKED_STRIP, packed(1),
+            lambda _: jax.lax.cond(k <= 2 * PACKED_STRIP, packed(2),
+                                   wide, None), None)
+
+    # ------------------------------------------------------------------
+    def _hist_kernel_fused(self, st: "GrowerState", rights, grad, hess,
+                           counts, quant):
+        """Fused route+histogram ladder: one Pallas pass both re-labels
+        every row by the pending route table and accumulates the new
+        right children's histograms, at the narrowest strip packing
+        covering the frontier.  Returns (hist (W, G, B, 3), new
+        leaf_id)."""
+        B = self.max_group_bin
+        W = rights.shape[0]
+        ohb = self._ohb_arg if self._ohb_arg is not None else self.ohb
+        if quant is not None:
+            wT, scales, q = quant[0], quant[1], True    # (3, N) int32
+        else:
+            wT = jnp.stack([grad, hess, counts], axis=0)
+            scales, q = None, False
+
+        def run(strips):
+            def go(_):
+                # block=2048 measured fastest on v5e (4096 fits scoped
+                # VMEM for 1-strip but benched 16% slower — the DMA
+                # pipeline prefers the finer granularity)
+                h, leaf2 = compute_group_histograms_fused(
+                    ohb, self.binsT, wT, scales, st.leaf_id,
+                    st.route_tab, rights, max_group_bin=B,
+                    block=self.pallas_block, strips=strips, quant=q,
+                    interpret=self._interp, pack=self.ohb_pack,
+                    num_groups=self.num_groups)
+                cap = strips * PACKED_STRIP
+                if cap >= W:
+                    return h[:W], leaf2
+                pad = jnp.zeros((W - cap,) + h.shape[1:], h.dtype)
+                return jnp.concatenate([h, pad]), leaf2
+            return go
+
+        if W <= PACKED_STRIP:
+            return run(1)(None)
+        k = jnp.sum(rights >= 0)
+        if W <= 2 * PACKED_STRIP:
+            return jax.lax.cond(k <= PACKED_STRIP, run(1), run(2), None)
+        return jax.lax.cond(
+            k <= PACKED_STRIP, run(1),
+            lambda _: jax.lax.cond(k <= 2 * PACKED_STRIP, run(2), run(3),
+                                   None), None)
+
+    # ------------------------------------------------------------------
+    def _hist_kernel_q_otf(self, leaf_id, slots, L, quant):
+        """Quantized on-the-fly dispatch: the packed-lane int8 kernel
+        rebuilds the bin one-hot in VMEM (HBM stream = the (N, G) packed
+        bins), at the narrowest lane packing covering the frontier."""
+        wq, scales = quant
+        B = self.max_group_bin
+
+        def full(_):
+            return compute_group_histograms_pallas_q(
+                self.bins, wq, scales, leaf_id, num_leaves=L,
+                max_group_bin=B, block=self.pallas_block, slots=slots)
+
+        if slots is None:
+            return full(None)
+
+        def run_packed(strips):
+            return compute_group_histograms_q_packed(
+                self.bins, wq, scales, leaf_id, slots,
+                max_group_bin=B, block=self.pallas_block, strips=strips)
+
+        return self._packed_dispatch(full, run_packed, slots,
+                                     slots.shape[0])
+
+    # ------------------------------------------------------------------
+    def _hist_kernel_pre(self, grad, hess, counts, leaf_id, slots, L,
+                         quant):
+        """Streamed-one-hot dispatch: channel-packed kernel when the
+        frontier is narrow (3x fewer MXU rows), full kernel otherwise.
+        The branch is a runtime lax.cond on the valid-slot count — the
+        early rounds of EVERY tree have 1..PACKED_STRIP new leaves."""
+        B = self.max_group_bin
+        ohb = self._ohb_arg if self._ohb_arg is not None else self.ohb
+        if quant is not None:
+            w, scales, q = quant[0], quant[1], True
+        else:
+            w = jnp.stack([grad, hess, counts], axis=1)
+            scales, q = None, False
+
+        def full(_):
+            return compute_group_histograms_pre(
+                ohb, w, scales, leaf_id, num_leaves=L,
+                max_group_bin=B, block=self.pallas_block, quant=q,
+                slots=slots, pack=self.ohb_pack,
+                num_groups=self.num_groups)
+
+        if slots is None:
+            return full(None)
+
+        def run_packed(strips):
+            return compute_group_histograms_pre_packed(
+                ohb, w, scales, leaf_id, slots, max_group_bin=B,
+                block=self.pallas_block, strips=strips, quant=q,
+                pack=self.ohb_pack, num_groups=self.num_groups)
+
+        return self._packed_dispatch(full, run_packed, slots,
+                                     slots.shape[0])
+
+    # ------------------------------------------------------------------
+    def _init_state(self, grad, hess, counts) -> GrowerState:
+        L = self.num_leaves
+        M = L - 1
+        B = self.max_feature_bin
+        leaf_id = jnp.where(self._row_valid, 0, -1).astype(jnp.int32)
+        totals = compute_leaf_totals(grad, hess, counts, leaf_id, 1)
+        leaf_sum_grad = jnp.zeros(L, jnp.float32).at[0].set(totals[0, 0])
+        leaf_sum_hess = jnp.zeros(L, jnp.float32).at[0].set(totals[0, 1])
+        leaf_count = jnp.zeros(L, jnp.float32).at[0].set(totals[0, 2])
+        tree = TreeArrays(
+            num_leaves=jnp.int32(1),
+            leaf_value=jnp.zeros(L, jnp.float32),
+            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(totals[0, 1]),
+            leaf_count=jnp.zeros(L, jnp.float32).at[0].set(totals[0, 2]),
+            leaf_parent=jnp.full(L, -1, jnp.int32),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            node_feature=jnp.zeros(M, jnp.int32),
+            node_threshold=jnp.zeros(M, jnp.int32),
+            node_default_left=jnp.zeros(M, bool),
+            node_is_cat=jnp.zeros(M, bool),
+            node_cat_mask=jnp.zeros((M, B), bool),
+            node_gain=jnp.zeros(M, jnp.float32),
+            node_value=jnp.zeros(M, jnp.float32),
+            node_weight=jnp.zeros(M, jnp.float32),
+            node_count=jnp.zeros(M, jnp.float32),
+            node_left=jnp.zeros(M, jnp.int32),
+            node_right=jnp.zeros(M, jnp.int32),
+        )
+        leaf_forced = jnp.full(L, -1, jnp.int32)
+        if self.forced_count:
+            leaf_forced = leaf_forced.at[0].set(0)
+        cand = SplitCand(
+            gain=jnp.full(L, NEG_INF, jnp.float32),
+            feature=jnp.zeros(L, jnp.int32),
+            threshold=jnp.zeros(L, jnp.int32),
+            default_left=jnp.zeros(L, bool),
+            lsg=jnp.zeros(L, jnp.float32), lsh=jnp.zeros(L, jnp.float32),
+            lsc=jnp.zeros(L, jnp.float32), lout=jnp.zeros(L, jnp.float32),
+            rout=jnp.zeros(L, jnp.float32),
+            cat_dir=jnp.zeros(L, jnp.int32),
+            cat_mask=jnp.zeros((L, B), bool))
+        forced_cand = ForcedCand(
+            gain=jnp.full(L, NEG_INF, jnp.float32),
+            threshold=jnp.zeros(L, jnp.int32),
+            default_left=jnp.zeros(L, bool),
+            lsg=jnp.zeros(L, jnp.float32), lsh=jnp.zeros(L, jnp.float32),
+            lsc=jnp.zeros(L, jnp.float32), lout=jnp.zeros(L, jnp.float32),
+            rout=jnp.zeros(L, jnp.float32))
+        W = self.frontier
+        return GrowerState(
+            route_tab=jnp.zeros((L, self._route_cols), jnp.float32),
+            pend_parents=jnp.full((W,), -1, jnp.int32),
+            # the root is the first "new leaf" awaiting refresh
+            pend_rights=jnp.full((W,), -1, jnp.int32).at[0].set(0),
+            leaf_id=leaf_id, num_leaves=jnp.int32(1),
+            round_idx=jnp.int32(0), done=jnp.bool_(False),
+            leaf_sum_grad=leaf_sum_grad, leaf_sum_hess=leaf_sum_hess,
+            leaf_count=leaf_count,
+            leaf_min_c=jnp.full(L, -jnp.inf, jnp.float32),
+            leaf_max_c=jnp.full(L, jnp.inf, jnp.float32),
+            leaf_is_left=jnp.zeros(L, bool),
+            leaf_forced=leaf_forced,
+            tree=tree,
+            hist_cache=jnp.zeros(
+                (L if self.use_hist_cache else 1, self.num_groups,
+                 self.max_group_bin, 3), jnp.float32),
+            cand=cand, forced_cand=forced_cand)
+
+    # ------------------------------------------------------------------
+    def _train_tree_impl(self, grad, hess, counts, feature_mask,
+                         ohb=None, bins=None, binsT=None,
+                         row_valid=None):
+        """``ohb``/``bins``/``binsT``/``row_valid`` are the O(N) device
+        arrays, threaded through the caller's jit boundary as ARGUMENTS
+        and bound to their attributes for the dynamic extent of the
+        trace.  Closing over them instead would inline each one as an
+        MLIR constant — the serialized program then carries the whole
+        matrix and XLA's compile time grows linearly with rows
+        (measured ~80 s per million rows; a HIGGS-scale compile took
+        25+ minutes before this)."""
+        self._ohb_arg = ohb
+        saved = (self.bins, self.binsT, self._row_valid)
+        if bins is not None:
+            self.bins = bins
+        if binsT is not None:
+            self.binsT = binsT
+        if row_valid is not None:
+            self._row_valid = row_valid
+        try:
+            return self._train_tree_inner(grad, hess, counts,
+                                          feature_mask)
+        finally:
+            self._ohb_arg = None
+            self.bins, self.binsT, self._row_valid = saved
+
+    def _train_tree_inner(self, grad, hess, counts, feature_mask):
+        state = self._init_state(grad, hess, counts)
+        if self._is_voting:
+            def body_fn(st):
+                return self._round_voting(st, grad, hess, counts,
+                                          feature_mask)
+        else:
+            # gradients are fixed for the whole tree, so the int8
+            # quantization (one scale per channel) happens once here
+            quant = (quantize_gradients(grad, hess, counts)
+                     if self.use_quant else None)
+            if quant is not None and self.use_fused:
+                # the fused kernel streams weights lane-major
+                quant = (quant[0].T, quant[1])          # (3, N)
+
+            def body_fn(st):
+                return self._round(st, grad, hess, counts, feature_mask,
+                                   quant)
+
+        def cond(st: GrowerState):
+            return ~st.done
+
+        def body(st: GrowerState):
+            return body_fn(st)
+
+        final = jax.lax.while_loop(cond, body, state)
+        leaf_id = final.leaf_id
+        row_val = None
+        if self.use_fused:
+            # the last round's selected splits were never routed (the
+            # loop exited before the next refresh) — apply them once,
+            # and ride the per-row POST-route leaf value on the same
+            # (N, L) one-hot dot so the boosting score update needs no
+            # separate leaf_value_broadcast pass (callers ignore
+            # row_val when RenewTreeOutput will change leaf values)
+            leaf_id, row_val = apply_route_table(
+                self.bins, leaf_id, final.route_tab,
+                values=final.tree.leaf_value)
+        tree = final.tree._replace(num_leaves=final.num_leaves)
+        return tree, leaf_id, row_val
+
+    # ------------------------------------------------------------------
+    def _run_finders(self, hist, sum_grad, sum_hess, count, min_c, max_c,
+                     cfg, f_num_bin, f_missing, f_default_bin, f_monotone,
+                     f_is_cat, feature_mask):
+        """Best split per (leaf-row, feature) from per-feature hists.
+        All leaf-shaped args are (L',) aligned with hist's first axis."""
+        num_res = find_numerical_splits(
+            hist, sum_grad, sum_hess, count, f_num_bin, f_missing,
+            f_default_bin, f_monotone, min_c, max_c, cfg)
+        if self.has_categorical:
+            cat_res = find_categorical_splits(
+                hist, sum_grad, sum_hess, count, f_num_bin, f_missing,
+                min_c, max_c, cfg)
+            icat = f_is_cat[None, :]
+            res = SplitResult(*[jnp.where(icat, c, n) for c, n
+                                in zip(cat_res, num_res)])
+        else:
+            res = num_res
+        gains = jnp.where(feature_mask[None, :], res.gain, NEG_INF)
+        return res, gains
+
+    # ------------------------------------------------------------------
+    def _refresh(self, st: GrowerState, parents, rights, grad, hess,
+                 counts, feature_mask, quant=None) -> GrowerState:
+        """Histogram + split-finder pass over the new leaves of a round.
+
+        ``rights`` are histogrammed directly from the data (one
+        frontier-restricted MXU pass); each ``parents`` slot (which the
+        left child inherited) becomes parent-minus-right.  The finder
+        then runs on the 2W new leaves only and its results are
+        scattered into the per-leaf candidate cache.  Negative slot
+        entries are inert (their writes drop, their lanes match no row).
+        """
+        L = self.num_leaves
+        cfg = self.cfg_scalars
+        cache = st.hist_cache
+
+        if self.use_fused:
+            # the pending route (last round's splits) is applied INSIDE
+            # the histogram kernel just before each row contributes
+            right_hist, new_leaf = self._hist_kernel_fused(
+                st, rights, grad, hess, counts, quant)
+            st = st._replace(leaf_id=new_leaf)
+        else:
+            right_hist = self._hist_kernel(grad, hess, counts, st.leaf_id,
+                                           slots=rights, quant=quant)
+        right_hist = self.policy.constrain_hist(right_hist)
+        safe_p = jnp.clip(parents, 0, L - 1)
+        if self.use_hist_cache:
+            left_hist = cache[safe_p] - right_hist
+        elif self.use_fused:
+            # no-cache mode: the parent slot now hosts the LEFT child's
+            # rows (routing already applied; re-application is
+            # idempotent), so a direct pass replaces the subtraction
+            left_hist, _ = self._hist_kernel_fused(
+                st, parents, grad, hess, counts, quant)
+            left_hist = self.policy.constrain_hist(left_hist)
+        else:
+            left_hist = self._hist_kernel(grad, hess, counts, st.leaf_id,
+                                          slots=parents, quant=quant)
+            left_hist = self.policy.constrain_hist(left_hist)
+        new_slots = jnp.concatenate([parents, rights])          # (2W,)
+        h_new = jnp.concatenate([left_hist, right_hist])        # (2W,G,B,3)
+        if self.use_hist_cache:
+            # one combined scatter (parent and right slots are disjoint)
+            # so XLA emits a single in-place update of the cache buffer
+            cache = cache.at[jnp.where(new_slots >= 0, new_slots, L)].set(
+                h_new, mode="drop")
+        safe = jnp.clip(new_slots, 0, L - 1)
+        valid = new_slots >= 0
+        sg = st.leaf_sum_grad[safe]
+        sh = st.leaf_sum_hess[safe]
+        sc = st.leaf_count[safe]
+        mc = st.leaf_min_c[safe]
+        xc = st.leaf_max_c[safe]
+        totals = jnp.stack([sg, sh, sc], axis=1)
+        feat_hist = expand_feature_histograms(h_new, self.bin_map,
+                                              self.fix_bin, totals)
+        res, gains = self._run_finders(
+            feat_hist, sg, sh, sc, mc, xc, cfg, self.f_num_bin,
+            self.f_missing, self.f_default_bin, self.f_monotone,
+            self.f_is_cat, feature_mask)
+
+        best_fc = jnp.argmax(gains, axis=1).astype(jnp.int32)   # (2W,)
+        best_gain = jnp.take_along_axis(gains, best_fc[:, None],
+                                        axis=1)[:, 0]
+
+        def at_leaf(arr2d):
+            return jnp.take_along_axis(arr2d, best_fc[:, None],
+                                       axis=1)[:, 0]
+
+        thr = at_leaf(res.threshold)
+        cat_dir = at_leaf(res.cat_dir)
+        if self.has_categorical:
+            hist_chosen = jnp.take_along_axis(
+                feat_hist, best_fc[:, None, None, None], axis=1)[:, 0]
+            cat_mask = build_cat_bitset(
+                hist_chosen, thr, cat_dir, self.f_num_bin[best_fc],
+                self.f_missing[best_fc], cfg)
+        else:
+            cat_mask = jnp.zeros((new_slots.shape[0],
+                                  self.max_feature_bin), bool)
+
+        idx = jnp.where(valid, new_slots, L)
+        c = st.cand
+        cand = SplitCand(
+            gain=c.gain.at[idx].set(best_gain, mode="drop"),
+            feature=c.feature.at[idx].set(best_fc, mode="drop"),
+            threshold=c.threshold.at[idx].set(thr, mode="drop"),
+            default_left=c.default_left.at[idx].set(
+                at_leaf(res.default_left), mode="drop"),
+            lsg=c.lsg.at[idx].set(at_leaf(res.left_sum_grad), mode="drop"),
+            lsh=c.lsh.at[idx].set(at_leaf(res.left_sum_hess), mode="drop"),
+            lsc=c.lsc.at[idx].set(at_leaf(res.left_count), mode="drop"),
+            lout=c.lout.at[idx].set(at_leaf(res.left_output), mode="drop"),
+            rout=c.rout.at[idx].set(at_leaf(res.right_output), mode="drop"),
+            cat_dir=c.cat_dir.at[idx].set(cat_dir, mode="drop"),
+            cat_mask=c.cat_mask.at[idx].set(cat_mask, mode="drop"))
+
+        forced_cand = st.forced_cand
+        if self.forced_count:
+            spec = st.leaf_forced[safe]                          # (2W,)
+            s_node = jnp.clip(spec, 0, self.forced_count - 1)
+            ff = self.forced_feature[s_node]
+            ft = self.forced_thr[s_node]
+            hist_ff = jnp.take_along_axis(
+                feat_hist, ff[:, None, None, None], axis=1)[:, 0]
+            (fgain, flg, flh, flc, flo, fro, fdl) = \
+                gather_split_at_threshold(
+                    hist_ff, ft, sg, sh, sc, self.f_num_bin[ff],
+                    self.f_missing[ff], self.f_default_bin[ff],
+                    self.f_is_cat[ff], cfg)
+            fgain = jnp.where(spec >= 0, fgain, NEG_INF)
+            fc = forced_cand
+            forced_cand = ForcedCand(
+                gain=fc.gain.at[idx].set(fgain, mode="drop"),
+                threshold=fc.threshold.at[idx].set(ft, mode="drop"),
+                default_left=fc.default_left.at[idx].set(fdl, mode="drop"),
+                lsg=fc.lsg.at[idx].set(flg, mode="drop"),
+                lsh=fc.lsh.at[idx].set(flh, mode="drop"),
+                lsc=fc.lsc.at[idx].set(flc, mode="drop"),
+                lout=fc.lout.at[idx].set(flo, mode="drop"),
+                rout=fc.rout.at[idx].set(fro, mode="drop"))
+
+        return st._replace(hist_cache=cache, cand=cand,
+                           forced_cand=forced_cand)
+
+    # ------------------------------------------------------------------
+    def _apply_selection(self, st: GrowerState, do_split, rank, k,
+                         best_gain, best_f, thr, dleft, lsg, lsh, lsc,
+                         lout, rout, cat_mask, forced_valid=None
+                         ) -> GrowerState:
+        """Apply the selected splits: scatter new internal nodes, update
+        child leaf state, propagate monotone constraints, re-label rows
+        (shared by the cached and voting rounds; the reference's
+        SerialTreeLearner::Split, serial_tree_learner.cpp:700-774).
+        All per-leaf args are (L,) chosen-split values."""
+        L = self.num_leaves
+        M = L - 1
+        slot = jnp.arange(L, dtype=jnp.int32)
+        right_slot = st.num_leaves + rank            # valid where do_split
+        node_id = (st.num_leaves - 1) + rank
+
+        f_is_cat_leaf = self.f_is_cat[best_f]
+        f_missing_leaf = self.f_missing[best_f]
+        f_dbin_leaf = self.f_default_bin[best_f]
+        f_nb_leaf = self.f_num_bin[best_f]
+        f_group_leaf = self.f_group[best_f]
+        f_mono_leaf = self.f_monotone[best_f]
+
+        # scatter new internal nodes (drop out-of-budget writes)
+        nid = jnp.where(do_split, node_id, M)
+        t = st.tree
+        # internal_value = the leaf's output before it split (tree.cpp Split)
+        parent_out = t.leaf_value
+        tree = t._replace(
+            node_feature=t.node_feature.at[nid].set(best_f, mode="drop"),
+            node_threshold=t.node_threshold.at[nid].set(thr, mode="drop"),
+            node_default_left=t.node_default_left.at[nid].set(
+                dleft, mode="drop"),
+            node_is_cat=t.node_is_cat.at[nid].set(f_is_cat_leaf,
+                                                  mode="drop"),
+            node_cat_mask=t.node_cat_mask.at[nid].set(cat_mask,
+                                                      mode="drop"),
+            node_gain=t.node_gain.at[nid].set(best_gain, mode="drop"),
+            node_value=t.node_value.at[nid].set(parent_out, mode="drop"),
+            node_weight=t.node_weight.at[nid].set(st.leaf_sum_hess,
+                                                  mode="drop"),
+            node_count=t.node_count.at[nid].set(st.leaf_count, mode="drop"),
+            node_left=t.node_left.at[nid].set(_encode_leaf(slot),
+                                              mode="drop"),
+            node_right=t.node_right.at[nid].set(_encode_leaf(right_slot),
+                                                mode="drop"),
+        )
+        # parent child-pointer fixup: this leaf's slot in its parent now
+        # points at the new internal node
+        has_parent = do_split & (t.leaf_parent >= 0)
+        p = jnp.where(has_parent, t.leaf_parent, M)
+        pl = jnp.where(has_parent & st.leaf_is_left, p, M)
+        pr = jnp.where(has_parent & ~st.leaf_is_left, p, M)
+        tree = tree._replace(
+            node_left=tree.node_left.at[pl].set(node_id, mode="drop"),
+            node_right=tree.node_right.at[pr].set(node_id, mode="drop"),
+        )
+
+        # child leaf state (left keeps the slot, right takes right_slot)
+        rsg = st.leaf_sum_grad - lsg
+        rsh = st.leaf_sum_hess - lsh
+        rsc = st.leaf_count - lsc
+        new_depth = t.leaf_depth + 1
+        rs = jnp.where(do_split, right_slot, L)
+
+        def upd(arr, left_val, right_val):
+            arr = arr.at[rs].set(right_val, mode="drop")
+            return jnp.where(do_split, left_val, arr)
+
+        leaf_sum_grad = upd(st.leaf_sum_grad, lsg, rsg)
+        leaf_sum_hess = upd(st.leaf_sum_hess, lsh, rsh)
+        leaf_count = upd(st.leaf_count, lsc, rsc)
+
+        # monotone constraint propagation (serial_tree_learner.cpp:764-774)
+        mid = (lout + rout) / 2.0
+        is_num = ~f_is_cat_leaf
+        lmin = jnp.where(is_num & (f_mono_leaf < 0), mid, st.leaf_min_c)
+        lmax = jnp.where(is_num & (f_mono_leaf > 0), mid, st.leaf_max_c)
+        rmin = jnp.where(is_num & (f_mono_leaf > 0), mid, st.leaf_min_c)
+        rmax = jnp.where(is_num & (f_mono_leaf < 0), mid, st.leaf_max_c)
+        leaf_min_c = upd(st.leaf_min_c, lmin, rmin)
+        leaf_max_c = upd(st.leaf_max_c, lmax, rmax)
+
+        tree = tree._replace(
+            leaf_value=upd(t.leaf_value, lout, rout),
+            leaf_weight=upd(t.leaf_weight, lsh, rsh),
+            leaf_count=upd(t.leaf_count, lsc, rsc),
+            leaf_parent=upd(t.leaf_parent, node_id, node_id),
+            leaf_depth=upd(t.leaf_depth, new_depth, new_depth),
+        )
+        leaf_is_left = upd(st.leaf_is_left,
+                           jnp.ones(L, bool), jnp.zeros(L, bool))
+
+        # forced-split inheritance: children of a forced split receive
+        # the spec's left/right sub-nodes; any other split clears it
+        if forced_valid is not None:
+            s_node2 = jnp.clip(st.leaf_forced, 0, self.forced_count - 1)
+            fap = do_split & forced_valid
+            lf_left = jnp.where(fap, self.forced_left[s_node2], -1)
+            lf_right = jnp.where(fap, self.forced_right[s_node2], -1)
+            leaf_forced = upd(st.leaf_forced, lf_left, lf_right)
+        else:
+            leaf_forced = st.leaf_forced
+
+        # row re-labeling.  Fused path: only BUILD the route table —
+        # the next round's histogram kernel applies it in its own data
+        # stream (the loop exit applies the last pending table in
+        # _train_tree_inner).  Non-fused (CPU sim / GSPMD meshes): the
+        # XLA router runs now.  A Pallas VMEM-one-hot standalone router
+        # was benched on a v5e chip and lost to the XLA form (142 vs
+        # 96 ms/tree at 1M rows), which is what motivated fusing the
+        # routing into the histogram kernel instead.
+        route_args = (do_split, f_group_leaf,
+                      self.f_gb_lo[best_f], self.f_gb_hi[best_f],
+                      self.f_gb_shift[best_f], self.f_gb_oor[best_f],
+                      f_is_cat_leaf, thr, dleft, f_missing_leaf,
+                      f_dbin_leaf, f_nb_leaf, cat_mask, right_slot)
+        if self.use_fused:
+            leaf_id = st.leaf_id
+            route_tab = build_route_table(*route_args)
+        else:
+            leaf_id = apply_splits(self.bins, st.leaf_id, *route_args)
+            route_tab = st.route_tab
+
+        num_leaves = st.num_leaves + k
+        round_idx = st.round_idx + 1
+        done = (k == 0) | (num_leaves >= L) | (round_idx >= self.max_rounds)
+        return GrowerState(
+            leaf_id=leaf_id, num_leaves=num_leaves, round_idx=round_idx,
+            done=done, leaf_sum_grad=leaf_sum_grad,
+            leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
+            leaf_min_c=leaf_min_c, leaf_max_c=leaf_max_c,
+            leaf_is_left=leaf_is_left, leaf_forced=leaf_forced, tree=tree,
+            hist_cache=st.hist_cache, cand=st.cand,
+            forced_cand=st.forced_cand, route_tab=route_tab,
+            pend_parents=st.pend_parents, pend_rights=st.pend_rights)
+
+    # ------------------------------------------------------------------
+    def _round(self, st: GrowerState, grad, hess, counts, feature_mask,
+               quant=None) -> GrowerState:
+        """One cached-candidate frontier round: refresh histograms +
+        candidates for the leaves created LAST round (pend_*), then
+        select/apply splits from the cache.  Refreshing at round start
+        means the final round's new leaves are never histogrammed at
+        all — the while_loop exits first."""
+        L = self.num_leaves
+        W = self.frontier
+        st = self._refresh(st, st.pend_parents, st.pend_rights, grad,
+                           hess, counts, feature_mask, quant)
+
+        best_gain = st.cand.gain
+        best_f = st.cand.feature
+        thr = st.cand.threshold
+        dleft = st.cand.default_left
+        lsg, lsh, lsc = st.cand.lsg, st.cand.lsh, st.cand.lsc
+        lout, rout = st.cand.lout, st.cand.rout
+        cat_mask = st.cand.cat_mask
+
+        forced_valid = None
+        if self.forced_count:
+            fc = st.forced_cand
+            s_node = jnp.clip(st.leaf_forced, 0, self.forced_count - 1)
+            ff = self.forced_feature[s_node]
+            forced_valid = (st.leaf_forced >= 0) & (fc.gain > NEG_INF)
+            best_f = jnp.where(forced_valid, ff, best_f)
+            best_gain = jnp.where(forced_valid, fc.gain, best_gain)
+            thr = jnp.where(forced_valid, fc.threshold, thr)
+            dleft = jnp.where(forced_valid, fc.default_left, dleft)
+            lsg = jnp.where(forced_valid, fc.lsg, lsg)
+            lsh = jnp.where(forced_valid, fc.lsh, lsh)
+            lsc = jnp.where(forced_valid, fc.lsc, lsc)
+            lout = jnp.where(forced_valid, fc.lout, lout)
+            rout = jnp.where(forced_valid, fc.rout, rout)
+            fmask = (jnp.arange(self.max_feature_bin, dtype=jnp.int32)[None]
+                     == fc.threshold[:, None])
+            cat_mask = jnp.where(forced_valid[:, None], fmask, cat_mask)
+
+        slot = jnp.arange(L, dtype=jnp.int32)
+        active = slot < st.num_leaves
+        depth_ok = (self.max_depth <= 0) | \
+            (st.tree.leaf_depth < self.max_depth)
+        cand_m = active & depth_ok & (best_gain > 0.0)
+        if forced_valid is not None:
+            forced_valid = forced_valid & active
+            cand_m = cand_m | forced_valid
+
+        key = jnp.where(cand_m, best_gain, NEG_INF)
+        if forced_valid is not None:
+            key = jnp.where(forced_valid, jnp.inf, key)
+        order = jnp.argsort(-key)                   # best first, stable
+        rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
+        budget = L - st.num_leaves
+        do_split = cand_m & (rank < budget) & (rank < W)
+        k = do_split.sum().astype(jnp.int32)
+
+        st2 = self._apply_selection(st, do_split, rank, k, best_gain,
+                                    best_f, thr, dleft, lsg, lsh, lsc,
+                                    lout, rout, cat_mask, forced_valid)
+
+        # queue this round's new leaves for the NEXT round's refresh:
+        # order[w] is the leaf with split-rank w (its slot hosts the
+        # left child); the matching right child is num_leaves_old + w
+        w_iota = jnp.arange(W, dtype=jnp.int32)
+        split_ok = w_iota < k
+        parents = jnp.where(split_ok, order[:W].astype(jnp.int32), -1)
+        rights = jnp.where(split_ok, st.num_leaves + w_iota, -1)
+        return st2._replace(pend_parents=parents, pend_rights=rights)
+
+    # ==================================================================
+    # voting-parallel path (full-frontier formulation)
+    # ==================================================================
+    def _voting_find_splits(self, st: GrowerState, grad, hess, counts,
+                            feature_mask):
+        """Voting-parallel split search (PV-Tree — reference
+        voting_parallel_tree_learner.cpp): each shard builds LOCAL
+        histograms, votes its top_k features by local gain, the votes
+        are all-reduced, and only the globally top-2k voted features'
+        histograms are exchanged.  Deviation from the reference: the
+        per-leaf top-2k selection is a per-round UNION across the
+        frontier (one static feature subset), which generalizes the
+        reference's smaller/larger-leaf pair to frontier-parallel
+        growth while keeping the same communication scale."""
+        from functools import partial
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map as _sm
+            shard_map = functools.partial(_sm, check_vma=False)
+        except ImportError:          # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map as _sm
+            shard_map = functools.partial(_sm, check_rep=False)
+
+        cfg = self.cfg_scalars
+        L = self.num_leaves
+        mesh = self.policy.mesh
+        d = mesh.size
+        axis = mesh.axis_names[0]
+        k2 = min(2 * self.config.top_k, self.num_features)
+        # local constraints scaled down (voting_parallel:55-56)
+        cfg_local = dict(cfg)
+        cfg_local["min_data_in_leaf"] = cfg["min_data_in_leaf"] / d
+        cfg_local["min_sum_hessian_in_leaf"] = \
+            cfg["min_sum_hessian_in_leaf"] / d
+
+        spec_rows = P(axis)
+        rep = P()
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(spec_rows, spec_rows, spec_rows, spec_rows,
+                           spec_rows, rep, rep, rep),
+                 out_specs=(rep, rep))
+        def inner(bins, g, h, c, leaf_id, mask, min_c, max_c):
+            n_local = bins.shape[0]
+            local_hist = compute_group_histograms(
+                bins, g, h, c, leaf_id, num_leaves=L,
+                max_group_bin=self.max_group_bin,
+                compute_dtype=self.config.hist_compute_dtype,
+                chunk=n_local)
+            local_totals = compute_leaf_totals(g, h, c, leaf_id, L)
+            feat_hist = expand_feature_histograms(
+                local_hist, self.bin_map, self.fix_bin, local_totals)
+            _, local_gains = self._run_finders(
+                feat_hist, local_totals[:, 0], local_totals[:, 1],
+                local_totals[:, 2], min_c, max_c, cfg_local,
+                self.f_num_bin, self.f_missing, self.f_default_bin,
+                self.f_monotone, self.f_is_cat, mask)
+            # per-leaf local top_k vote (GlobalVoting, :166-195)
+            kth = jax.lax.top_k(local_gains,
+                                min(self.config.top_k,
+                                    self.num_features))[0][:, -1:]
+            votes = ((local_gains >= kth)
+                     & jnp.isfinite(local_gains)).astype(jnp.float32)
+            global_votes = jax.lax.psum(votes, axis)          # (L, F)
+            total_votes = global_votes.sum(axis=0)            # (F,)
+            sel = jax.lax.top_k(total_votes, k2)[1].astype(jnp.int32)
+            # exchange only the selected features' histograms
+            compact = feat_hist[:, sel]                       # (L,k2,B,3)
+            global_compact = jax.lax.psum(compact, axis)
+            return global_compact, sel
+
+        hist, sel = inner(self.bins, grad, hess, counts, st.leaf_id,
+                          feature_mask, st.leaf_min_c, st.leaf_max_c)
+        res, gains = self._run_finders(
+            hist, st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count,
+            st.leaf_min_c, st.leaf_max_c, cfg, self.f_num_bin[sel],
+            self.f_missing[sel], self.f_default_bin[sel],
+            self.f_monotone[sel], self.f_is_cat[sel], feature_mask[sel])
+        return res, gains, hist, sel
+
+    # ------------------------------------------------------------------
+    def _round_voting(self, st: GrowerState, grad, hess, counts,
+                      feature_mask) -> GrowerState:
+        """Full-frontier round for the voting learner: every active
+        leaf's histogram is rebuilt and searched each round."""
+        L = self.num_leaves
+        M = L - 1
+        B = self.max_feature_bin
+
+        res, gains, hist, sel = self._voting_find_splits(
+            st, grad, hess, counts, feature_mask)
+
+        # per-leaf best feature & candidate selection
+        best_fc = jnp.argmax(gains, axis=1).astype(jnp.int32)  # (L,)
+        best_gain = jnp.take_along_axis(gains, best_fc[:, None],
+                                        axis=1)[:, 0]
+        best_f = best_fc if sel is None else sel[best_fc]
+        slot = jnp.arange(L, dtype=jnp.int32)
+        active = slot < st.num_leaves
+        depth_ok = (self.max_depth <= 0) | \
+            (st.tree.leaf_depth < self.max_depth)
+        cand_m = active & depth_ok & (best_gain > 0.0)
+
+        key = jnp.where(cand_m, best_gain, NEG_INF)
+        order = jnp.argsort(-key)                   # best first, stable
+        rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
+        budget = L - st.num_leaves
+        do_split = cand_m & (rank < budget)
+        k = do_split.sum().astype(jnp.int32)
+
+        def at_leaf(arr2d):
+            # res arrays live in the (possibly compacted) finder space
+            return jnp.take_along_axis(arr2d, best_fc[:, None],
+                                       axis=1)[:, 0]
+
+        thr = at_leaf(res.threshold)
+        cat_dir = at_leaf(res.cat_dir)
+        if self.has_categorical:
+            hist_chosen = jnp.take_along_axis(
+                hist, best_fc[:, None, None, None], axis=1)[:, 0]  # (L,B,3)
+            cat_mask = build_cat_bitset(hist_chosen, thr, cat_dir,
+                                        self.f_num_bin[best_f],
+                                        self.f_missing[best_f],
+                                        self.cfg_scalars)
+        else:
+            cat_mask = jnp.zeros((L, B), bool)
+
+        return self._apply_selection(
+            st, do_split, rank, k, best_gain, best_f, thr,
+            at_leaf(res.default_left), at_leaf(res.left_sum_grad),
+            at_leaf(res.left_sum_hess), at_leaf(res.left_count),
+            at_leaf(res.left_output), at_leaf(res.right_output), cat_mask)

@@ -1,0 +1,431 @@
+"""Evaluation metrics, computed on device.
+
+TPU-native re-design of the reference metric layer
+(reference: src/metric/*.hpp behind the factory metric.cpp:11-53).
+Pointwise metrics are elementwise reductions; AUC's tie-aware
+sorted-group accumulation (binary_metric.hpp:157-260) and NDCG/MAP's
+per-query walks (rank_metric.hpp, dcg_calculator.cpp) become sort +
+segment-cumsum formulations.  ``factor_to_bigger_better`` drives early
+stopping exactly like the reference (gbdt.cpp:623).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config
+from .dataset import Metadata
+from .utils.log import Log
+
+
+class Metric:
+    name = "metric"
+    bigger_is_better = False   # factor_to_bigger_better = +1 if True
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = jnp.asarray(metadata.label[:num_data]
+                                 .astype(np.float32))
+        w = metadata.weight
+        self.weight = (None if w is None
+                       else jnp.asarray(w[:num_data].astype(np.float32)))
+        self.sum_weight = (float(num_data) if w is None
+                           else float(np.sum(w[:num_data])))
+
+    def eval(self, score: jax.Array, objective=None) -> List[float]:
+        raise NotImplementedError
+
+    def names(self) -> List[str]:
+        return [self.name]
+
+    def _avg(self, loss: jax.Array):
+        if self.weight is None:
+            return jnp.sum(loss) / self.sum_weight
+        return jnp.sum(loss * self.weight) / self.sum_weight
+
+
+class _PointwiseMetric(Metric):
+    """Analog of RegressionMetric<T> (regression_metric.hpp:16-106):
+    objective->ConvertOutput is applied when the objective defines one."""
+
+    def loss(self, label, pred):
+        raise NotImplementedError
+
+    def finalize(self, avg_loss):
+        return avg_loss
+
+    def eval(self, score, objective=None):
+        pred = score
+        if objective is not None:
+            pred = objective.convert_output(score)
+        return [float(self.finalize(self._avg(self.loss(self.label, pred))))]
+
+
+class L2Metric(_PointwiseMetric):
+    name = "l2"
+
+    def loss(self, label, pred):
+        return (pred - label) ** 2
+
+
+class RMSEMetric(_PointwiseMetric):
+    name = "rmse"
+
+    def loss(self, label, pred):
+        return (pred - label) ** 2
+
+    def finalize(self, avg_loss):
+        return jnp.sqrt(avg_loss)
+
+
+class L1Metric(_PointwiseMetric):
+    name = "l1"
+
+    def loss(self, label, pred):
+        return jnp.abs(pred - label)
+
+
+class QuantileMetric(_PointwiseMetric):
+    name = "quantile"
+
+    def loss(self, label, pred):
+        delta = label - pred
+        return jnp.where(delta < 0, (self.config.alpha - 1.0) * delta,
+                         self.config.alpha * delta)
+
+
+class HuberMetric(_PointwiseMetric):
+    name = "huber"
+
+    def loss(self, label, pred):
+        a = self.config.alpha
+        diff = pred - label
+        return jnp.where(jnp.abs(diff) <= a, 0.5 * diff * diff,
+                         a * (jnp.abs(diff) - 0.5 * a))
+
+
+class FairMetric(_PointwiseMetric):
+    name = "fair"
+
+    def loss(self, label, pred):
+        c = self.config.fair_c
+        x = jnp.abs(pred - label)
+        return c * x - c * c * jnp.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseMetric):
+    name = "poisson"
+
+    def loss(self, label, pred):
+        eps = 1e-10
+        pred = jnp.maximum(pred, eps)
+        return pred - label * jnp.log(pred)
+
+
+class MAPEMetric(_PointwiseMetric):
+    name = "mape"
+
+    def loss(self, label, pred):
+        return jnp.abs(label - pred) / jnp.maximum(1.0, jnp.abs(label))
+
+
+class GammaMetric(_PointwiseMetric):
+    """reference regression_metric.hpp:245-261: negative gamma
+    log-likelihood with unit shape."""
+    name = "gamma"
+
+    def loss(self, label, pred):
+        psi = 1.0
+        theta = -1.0 / jnp.maximum(pred, 1e-10)
+        a = psi
+        b = -jnp.log(-theta)
+        c = 1.0 / psi * jnp.log(label / psi) - jnp.log(label) \
+            - 0.0  # lgamma(1/psi) = 0 for psi=1
+        return -((label * theta - b) / a + c)
+
+
+class GammaDevianceMetric(_PointwiseMetric):
+    name = "gamma-deviance"
+
+    def loss(self, label, pred):
+        tmp = label / jnp.maximum(pred, 1e-10)
+        return tmp - jnp.log(tmp) - 1.0
+
+    def finalize(self, avg_loss):
+        # reference returns sum * 2 (no weight normalization)
+        return avg_loss * self.sum_weight * 2.0
+
+
+class TweedieMetric(_PointwiseMetric):
+    name = "tweedie"
+
+    def loss(self, label, pred):
+        rho = self.config.tweedie_variance_power
+        pred = jnp.maximum(pred, 1e-10)
+        a = label * jnp.exp((1 - rho) * jnp.log(pred)) / (1 - rho)
+        b = jnp.exp((2 - rho) * jnp.log(pred)) / (2 - rho)
+        return -a + b
+
+
+class BinaryLoglossMetric(_PointwiseMetric):
+    name = "binary_logloss"
+
+    def loss(self, label, prob):
+        is_pos = label > 0
+        p = jnp.clip(prob, 1e-15, 1 - 1e-15)
+        return jnp.where(is_pos, -jnp.log(p), -jnp.log(1 - p))
+
+
+class BinaryErrorMetric(_PointwiseMetric):
+    name = "binary_error"
+
+    def loss(self, label, prob):
+        pred_pos = prob > 0.5
+        return jnp.where((label > 0) == pred_pos, 0.0, 1.0)
+
+
+class AUCMetric(Metric):
+    """Tie-aware AUC (reference binary_metric.hpp:157-260): sum over
+    distinct-score groups of neg_w * (pos_w/2 + pos_before)."""
+    name = "auc"
+    bigger_is_better = True
+
+    def eval(self, score, objective=None):
+        label = self.label
+        w = (jnp.ones_like(label) if self.weight is None else self.weight)
+        order = jnp.argsort(-score, stable=True)
+        s = score[order]
+        lab = label[order]
+        ww = w[order]
+        pos = jnp.where(lab > 0, ww, 0.0)
+        neg = jnp.where(lab <= 0, ww, 0.0)
+        changed = jnp.concatenate([jnp.array([False]), s[1:] != s[:-1]])
+        gid = jnp.cumsum(changed.astype(jnp.int32))
+        n = s.shape[0]
+        seg_pos = jax.ops.segment_sum(pos, gid, num_segments=n)
+        seg_neg = jax.ops.segment_sum(neg, gid, num_segments=n)
+        pos_before = jnp.concatenate(
+            [jnp.zeros(1), jnp.cumsum(seg_pos)[:-1]])
+        accum = jnp.sum(seg_neg * (seg_pos * 0.5 + pos_before))
+        sum_pos = jnp.sum(pos)
+        denom = sum_pos * (self.sum_weight - sum_pos)
+        auc = jnp.where(denom > 0, accum / denom, 1.0)
+        return [float(auc)]
+
+
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective=None):
+        # score: (N, K) raw; convert via softmax (or objective transform)
+        if objective is not None:
+            p = objective.convert_output(score)
+        else:
+            p = jax.nn.softmax(score, axis=1)
+        li = self.label.astype(jnp.int32)
+        pt = jnp.take_along_axis(p, li[:, None], axis=1)[:, 0]
+        loss = -jnp.log(jnp.clip(pt, 1e-15, None))
+        return [float(self._avg(loss))]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, score, objective=None):
+        li = self.label.astype(jnp.int32)
+        pred = jnp.argmax(score, axis=1).astype(jnp.int32)
+        return [float(self._avg(jnp.where(pred == li, 0.0, 1.0)))]
+
+
+class CrossEntropyMetric(_PointwiseMetric):
+    name = "cross_entropy"
+
+    def loss(self, label, prob):
+        p = jnp.clip(prob, 1e-15, 1 - 1e-15)
+        return -(label * jnp.log(p) + (1 - label) * jnp.log(1 - p))
+
+
+class CrossEntropyLambdaMetric(Metric):
+    """reference xentropy_metric.hpp xentlambda: loss on hhat scale."""
+    name = "cross_entropy_lambda"
+
+    def eval(self, score, objective=None):
+        hhat = jnp.log1p(jnp.exp(score))
+        w = jnp.ones_like(score) if self.weight is None else self.weight
+        z = 1.0 - jnp.exp(-w * hhat)
+        z = jnp.clip(z, 1e-15, 1 - 1e-15)
+        loss = -(self.label * jnp.log(z) + (1 - self.label) * jnp.log(1 - z))
+        return [float(jnp.sum(loss) / self.sum_weight)]
+
+
+class KLDivMetric(Metric):
+    """reference xentropy_metric.hpp kldiv: cross-entropy minus label
+    entropy."""
+    name = "kldiv"
+
+    def eval(self, score, objective=None):
+        p = jnp.clip(jax.nn.sigmoid(score), 1e-15, 1 - 1e-15)
+        y = jnp.clip(self.label, 0.0, 1.0)
+        ye = jnp.where((y > 0) & (y < 1),
+                       y * jnp.log(y) + (1 - y) * jnp.log(1 - y), 0.0)
+        ce = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        return [float(self._avg(ce + ye))]
+
+
+class _RankMetric(Metric):
+    """Shared padded-query layout for NDCG/MAP (reference
+    rank_metric.hpp + dcg_calculator.cpp)."""
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            Log.fatal(f"The {self.name} metric requires query information")
+        qb = metadata.query_boundaries
+        self.num_queries = len(qb) - 1
+        sizes = np.diff(qb)
+        M = int(sizes.max())
+        Q = self.num_queries
+        idx = np.full((Q, M), -1, dtype=np.int32)
+        for q in range(Q):
+            idx[q, :sizes[q]] = np.arange(qb[q], qb[q + 1])
+        self._qidx = jnp.asarray(idx)
+        self._qmask = jnp.asarray(idx >= 0)
+        lab = metadata.label[np.maximum(idx, 0)] * (idx >= 0)
+        self._qlabel = jnp.asarray(lab.astype(np.float32))
+        # query weights: mean of row weights (reference uses query_weights
+        # from metadata; approximated as uniform when absent)
+        self._qweight = jnp.ones(Q, dtype=jnp.float32)
+        self.eval_at = tuple(int(k) for k in self.config.ndcg_eval_at)
+
+    def names(self):
+        return [f"{self.name}@{k}" for k in self.eval_at]
+
+
+class NDCGMetric(_RankMetric):
+    name = "ndcg"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label_gain = self.config.label_gain
+        if not label_gain:
+            label_gain = tuple(float(2 ** i - 1) for i in range(31))
+        self._gain = jnp.asarray(np.asarray(label_gain, dtype=np.float32))
+
+    def eval(self, score, objective=None):
+        qidx = self._qidx
+        qmask = self._qmask
+        safe = jnp.maximum(qidx, 0)
+        s = jnp.where(qmask, score[safe], -jnp.inf)
+        lab = self._qlabel.astype(jnp.int32)
+        gains = self._gain[jnp.clip(lab, 0, None)] * qmask
+
+        order = jnp.argsort(-s, axis=1, stable=True)
+        sorted_gain = jnp.take_along_axis(gains, order, axis=1)
+        ideal_gain = -jnp.sort(-gains, axis=1)
+        M = s.shape[1]
+        discount = 1.0 / jnp.log2(2.0 + jnp.arange(M, dtype=jnp.float32))
+        results = []
+        for k in self.eval_at:
+            kk = min(k, M)
+            dcg = jnp.sum(sorted_gain[:, :kk] * discount[None, :kk], axis=1)
+            maxdcg = jnp.sum(ideal_gain[:, :kk] * discount[None, :kk], axis=1)
+            ndcg = jnp.where(maxdcg > 0, dcg / maxdcg, 1.0)
+            results.append(float(jnp.sum(ndcg * self._qweight)
+                                 / jnp.sum(self._qweight)))
+        return results
+
+
+class MAPMetric(_RankMetric):
+    name = "map"
+    bigger_is_better = True
+
+    def eval(self, score, objective=None):
+        qidx = self._qidx
+        qmask = self._qmask
+        safe = jnp.maximum(qidx, 0)
+        s = jnp.where(qmask, score[safe], -jnp.inf)
+        rel = (self._qlabel > 0) & qmask
+        order = jnp.argsort(-s, axis=1, stable=True)
+        rel_sorted = jnp.take_along_axis(rel, order, axis=1)
+        M = s.shape[1]
+        cum_rel = jnp.cumsum(rel_sorted.astype(jnp.float32), axis=1)
+        prec = cum_rel / jnp.arange(1, M + 1, dtype=jnp.float32)[None, :]
+        results = []
+        for k in self.eval_at:
+            kk = min(k, M)
+            ap_num = jnp.sum(jnp.where(rel_sorted[:, :kk], prec[:, :kk], 0.0),
+                             axis=1)
+            denom = jnp.minimum(jnp.sum(rel, axis=1).astype(jnp.float32),
+                                float(kk))
+            ap = jnp.where(denom > 0, ap_num / denom, 0.0)
+            results.append(float(jnp.sum(ap * self._qweight)
+                                 / jnp.sum(self._qweight)))
+        return results
+
+
+_METRIC_REGISTRY = {
+    "l2": L2Metric, "mse": L2Metric, "mean_squared_error": L2Metric,
+    "regression": L2Metric,
+    "rmse": RMSEMetric, "root_mean_squared_error": RMSEMetric,
+    "l2_root": RMSEMetric,
+    "l1": L1Metric, "mae": L1Metric, "mean_absolute_error": L1Metric,
+    "regression_l1": L1Metric,
+    "quantile": QuantileMetric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "mape": MAPEMetric, "mean_absolute_percentage_error": MAPEMetric,
+    "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric, "gamma-deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
+    "softmax": MultiLoglossMetric, "multiclassova": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "cross_entropy": CrossEntropyMetric, "xentropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "xentlambda": CrossEntropyLambdaMetric,
+    "kldiv": KLDivMetric, "kullback_leibler": KLDivMetric,
+    "ndcg": NDCGMetric, "lambdarank": NDCGMetric,
+    "map": MAPMetric, "mean_average_precision": MAPMetric,
+}
+
+_DEFAULT_METRIC_FOR_OBJECTIVE = {
+    "regression": "l2", "regression_l1": "l1", "huber": "huber",
+    "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+    "mape": "mape", "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary_logloss", "multiclass": "multi_logloss",
+    "multiclassova": "multi_logloss", "lambdarank": "ndcg",
+    "cross_entropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+}
+
+
+def create_metrics(config: Config,
+                   names: Optional[Sequence[str]] = None) -> List[Metric]:
+    """Factory (reference metric.cpp:11-53); falls back to the
+    objective's default metric when none requested."""
+    names = list(names if names is not None else config.metric)
+    if not names:
+        default = _DEFAULT_METRIC_FOR_OBJECTIVE.get(config.objective)
+        names = [default] if default else []
+    out = []
+    for nm in names:
+        nm = nm.strip().lower()
+        if nm in ("", "none", "null", "na"):
+            continue
+        cls = _METRIC_REGISTRY.get(nm)
+        if cls is None:
+            Log.warning(f"Unknown metric {nm}, ignored")
+            continue
+        out.append(cls(config))
+    return out

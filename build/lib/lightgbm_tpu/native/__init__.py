@@ -1,0 +1,119 @@
+"""Native (C++) runtime bindings via ctypes.
+
+Builds lightgbm_tpu/native/src/*.cpp into libltpu.so on first use
+(cached beside the sources) — the framework's native IO layer, standing
+in for the reference's C++ parser/text-reader stack without a
+pybind11 dependency.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libltpu.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    srcs = [os.path.join(_SRC_DIR, f) for f in sorted(os.listdir(_SRC_DIR))
+            if f.endswith(".cpp")]
+    if not srcs:
+        return None
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(_LIB_PATH) and \
+            os.path.getmtime(_LIB_PATH) >= newest_src:
+        return _LIB_PATH
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _LIB_PATH] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        Log.warning(f"native build failed ({e}); "
+                    "falling back to Python IO")
+        return None
+    return _LIB_PATH
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ltpu_load_csv.restype = ctypes.POINTER(ctypes.c_double)
+        lib.ltpu_load_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.ltpu_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+        lib.ltpu_count_lines.restype = ctypes.c_long
+        lib.ltpu_count_lines.argtypes = [ctypes.c_char_p]
+        lib.ltpu_bin_values.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8)]
+        _lib = lib
+        return _lib
+
+
+class text_loader:
+    """Namespace used by data_loader.py."""
+
+    @staticmethod
+    def load_csv(path: str, sep: str, skip_rows: int) -> np.ndarray:
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        ptr = lib.ltpu_load_csv(path.encode(), sep.encode(), skip_rows,
+                                ctypes.byref(rows), ctypes.byref(cols))
+        if not ptr:
+            raise RuntimeError(f"native parse failed for {path}")
+        try:
+            n = rows.value * cols.value
+            arr = np.ctypeslib.as_array(ptr, shape=(n,)).copy()
+        finally:
+            lib.ltpu_free(ptr)
+        return arr.reshape(rows.value, cols.value)
+
+    @staticmethod
+    def count_lines(path: str) -> int:
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        return int(lib.ltpu_count_lines(path.encode()))
+
+
+def bin_values_native(values: np.ndarray, bounds: np.ndarray,
+                      num_bin: int, missing_type: int
+                      ) -> Optional[np.ndarray]:
+    """Threaded value->bin mapping; None when the native lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    bounds = np.ascontiguousarray(bounds, dtype=np.float64)
+    out = np.empty(len(values), dtype=np.uint8)
+    lib.ltpu_bin_values(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(values),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        num_bin, missing_type,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out

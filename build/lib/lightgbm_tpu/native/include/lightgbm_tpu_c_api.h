@@ -1,0 +1,127 @@
+/*
+ * lightgbm_tpu C API — native embedding surface for non-Python hosts.
+ *
+ * Plays the role of the reference's flat C API
+ * (reference: include/LightGBM/c_api.h, src/c_api.cpp) with the same
+ * function names, handle discipline and 0/-1 + LGBM_GetLastError error
+ * convention (reference c_api.h:765-788).  The stack is inverted
+ * relative to the reference: the core is a Python/JAX program, so this
+ * library embeds CPython (statically linked against libpython) and
+ * forwards each call to lightgbm_tpu.capi.  R's .Call shim or a Java
+ * JNI wrapper links against this exactly the way the reference's
+ * R-package/src/lightgbm_R.cpp links against lib_lightgbm.
+ *
+ * Threading: every entry point acquires the GIL; concurrent calls from
+ * multiple host threads serialize (the reference serializes Booster
+ * mutations with a std::mutex, c_api.cpp:67,311 — same effective
+ * discipline).
+ *
+ * Environment: the embedded interpreter must be able to import
+ * `lightgbm_tpu` (set PYTHONPATH, or call LTPU_AddSysPath first).
+ */
+#ifndef LIGHTGBM_TPU_C_API_H_
+#define LIGHTGBM_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+/* dtype codes (reference c_api.h:33-41) */
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32   (2)
+#define C_API_DTYPE_INT64   (3)
+
+/* predict task codes (reference c_api.h:43-47) */
+#define C_API_PREDICT_NORMAL     (0)
+#define C_API_PREDICT_RAW_SCORE  (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+#define C_API_PREDICT_CONTRIB    (3)
+
+/* ---- embedding helpers (no reference analog; interpreter control) */
+/* Append a directory to the embedded interpreter's sys.path BEFORE the
+ * first API call (so `import lightgbm_tpu` resolves). */
+int LTPU_AddSysPath(const char* path);
+/* Force interpreter + module initialization now (otherwise lazy). */
+int LTPU_EnsureInitialized(void);
+
+/* ---- error handling */
+const char* LGBM_GetLastError(void);
+
+/* ---- Dataset */
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type);
+/* out_ptr stays valid until the next GetField on the same handle or
+ * DatasetFree (the reference returns a pointer into the Dataset too). */
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr, int* out_type);
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
+int LGBM_DatasetFree(DatasetHandle handle);
+
+/* ---- Booster */
+int LGBM_BoosterCreate(const DatasetHandle train_data,
+                       const char* parameters, BoosterHandle* out);
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int64_t num_elements,
+                                    int* is_finished);
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                    int* out_iteration);
+/* Number of metric values per dataset — size the GetEval buffer with
+ * this first (reference c_api.h:430-437). */
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
+                          const char* filename);
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str);
+int LGBM_BoosterDumpModel(BoosterHandle handle, int num_iteration,
+                          int64_t buffer_len, int64_t* out_len,
+                          char* out_str);
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results);
+
+/* ---- Network (reference c_api.h:749-762; see capi.py for the TPU
+ * semantics — rendezvous goes through jax.distributed, these warn) */
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines);
+int LGBM_NetworkFree(void);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* LIGHTGBM_TPU_C_API_H_ */

@@ -1,0 +1,84 @@
+"""Per-leaf (weighted) percentiles on device.
+
+Implements the RenewTreeOutput leaf refit for L1-family objectives
+(reference: regression_objective.hpp RenewTreeOutput + the
+PercentileFun / WeightedPercentileFun templates in utils/common.h) as a
+single lexicographic sort by (leaf, residual) followed by vectorized
+segment interpolation — replacing the reference's per-leaf gather +
+nth_element host loops.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def leaf_percentiles(residual: jax.Array, leaf_id: jax.Array,
+                     num_leaves: int, alpha: float,
+                     weights: Optional[jax.Array] = None) -> jax.Array:
+    """alpha-percentile of ``residual`` within each leaf.
+
+    Args:
+      residual: (N,) values (label - prediction).
+      leaf_id: (N,) int32; negative ids are ignored.
+      num_leaves: static L.
+      weights: optional (N,) weights (weighted-percentile semantics).
+
+    Returns: (L,) f32 percentile per leaf (0 for empty leaves).
+    """
+    n = residual.shape[0]
+    lid = jnp.where(leaf_id >= 0, leaf_id, num_leaves).astype(jnp.int32)
+    if weights is None:
+        s_leaf, s_r = jax.lax.sort((lid, residual), num_keys=2)
+        starts = jnp.searchsorted(s_leaf, jnp.arange(num_leaves,
+                                                     dtype=jnp.int32),
+                                  side="left")
+        ends = jnp.searchsorted(s_leaf, jnp.arange(num_leaves,
+                                                   dtype=jnp.int32),
+                                side="right")
+        counts = ends - starts
+        # PercentileFun: position interpolation at alpha*(n-1)
+        pos = alpha * (counts - 1).astype(jnp.float32)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        frac = pos - lo.astype(jnp.float32)
+        i_lo = jnp.clip(starts + lo, 0, n - 1)
+        i_hi = jnp.clip(starts + jnp.minimum(lo + 1, counts - 1), 0, n - 1)
+        vals = s_r[i_lo] * (1.0 - frac) + s_r[i_hi] * frac
+        return jnp.where(counts > 0, vals, 0.0)
+
+    s_leaf, s_r, s_w = jax.lax.sort((lid, residual, weights), num_keys=2)
+    arangeL = jnp.arange(num_leaves, dtype=jnp.int32)
+    starts = jnp.searchsorted(s_leaf, arangeL, side="left")
+    ends = jnp.searchsorted(s_leaf, arangeL, side="right")
+    counts = ends - starts
+    cumw = jnp.cumsum(s_w)
+    cumw_before_start = jnp.where(starts > 0, cumw[jnp.maximum(starts - 1, 0)],
+                                  0.0)
+    total_w = jnp.where(counts > 0,
+                        cumw[jnp.clip(ends - 1, 0, n - 1)]
+                        - cumw_before_start, 0.0)
+    # WeightedPercentileFun: c_i = cum_within - w_i/2, find first
+    # c_i >= alpha * total, interpolate between neighbors
+    safe_lid = jnp.clip(s_leaf, 0, num_leaves - 1)
+    within = cumw - cumw_before_start[safe_lid]
+    c = within - s_w / 2.0
+    thr = alpha * total_w
+    flag = (c >= thr[safe_lid]) & (s_leaf < num_leaves)
+    idx_cand = jnp.where(flag, jnp.arange(n, dtype=jnp.int32), n)
+    first = jax.ops.segment_min(idx_cand, safe_lid,
+                                num_segments=num_leaves)
+    first = jnp.where(counts > 0, first, 0)
+    at_start = first <= starts
+    at_end = first >= ends
+    i = jnp.clip(first, 0, n - 1)
+    prev = jnp.clip(first - 1, 0, n - 1)
+    c_i = c[i]
+    c_prev = c[prev]
+    t = (thr - c_prev) / jnp.maximum(c_i - c_prev, 1e-30)
+    interp = s_r[prev] * (1.0 - t) + s_r[i] * t
+    vals = jnp.where(at_start, s_r[jnp.clip(starts, 0, n - 1)],
+                     jnp.where(at_end,
+                               s_r[jnp.clip(ends - 1, 0, n - 1)], interp))
+    return jnp.where(counts > 0, vals, 0.0)

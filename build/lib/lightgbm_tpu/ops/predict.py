@@ -1,0 +1,235 @@
+"""Device prediction over the binned matrix and over raw features.
+
+Replaces the reference's per-row pointer-chasing tree walk
+(reference: tree.h:212-295 DecisionInner, gbdt_prediction.cpp) with a
+vectorized level-synchronous traversal: every row advances one level per
+step, all rows in lockstep, over the fixed-size TreeArrays produced by
+the grower.  Used for validation-score updates during training and for
+DART's dropped-tree score subtraction — the binned matrix stays resident
+in HBM, so a traversal is a handful of gathers per level.
+
+The RAW-feature path (stack_host_trees / predict_raw_ensemble) serves
+models with no live training session — file-loaded, multiclass,
+init_model-merged, DART-renormalized — the device analog of the
+reference's OMP batch predict over every model kind (c_api.cpp:177-211).
+Thresholds are f64 midpoints; the device compares in TWO-FLOAT (hi+lo
+f32) arithmetic so the `value <= threshold` decision matches the host's
+float64 semantics for any f32-representable data (the f32-rounded
+threshold alone would misroute rows equal to the upper neighbour of a
+midpoint).
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+K_ZERO_THRESHOLD = 1e-35
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+
+
+def predict_binned(tree, bins: jax.Array, f_group: jax.Array,
+                   g2f_lut: jax.Array, f_missing: jax.Array,
+                   f_default_bin: jax.Array, f_num_bin: jax.Array,
+                   max_steps: int) -> jax.Array:
+    """Evaluate one grown tree on a binned matrix.
+
+    Args:
+      tree: TreeArrays (bin-space thresholds/cat masks).
+      bins: (N, G) uint8.
+      f_group/(F,): group column per inner feature.
+      g2f_lut: (F, GB) group-bin -> feature-bin map.
+      f_missing/f_default_bin/f_num_bin: (F,) metadata.
+      max_steps: static bound on tree depth (num_leaves - 1).
+
+    Returns: (N,) f32 leaf values (unshrunk).
+    """
+    n = bins.shape[0]
+    gb_dim = g2f_lut.shape[1]
+    b_dim = tree.node_cat_mask.shape[1]
+
+    def body(node):
+        # node >= 0: internal node index; negative: settled leaf
+        is_internal = node >= 0
+        nid = jnp.maximum(node, 0)
+        feat = tree.node_feature[nid]
+        grp = f_group[feat]
+        gb = jnp.take_along_axis(bins, grp[:, None].astype(jnp.int32),
+                                 axis=1)[:, 0].astype(jnp.int32)
+        fb = g2f_lut[feat, gb]
+        thr = tree.node_threshold[nid]
+        dleft = tree.node_default_left[nid]
+        mtype = f_missing[feat]
+        dbin = f_default_bin[feat]
+        nb = f_num_bin[feat]
+        is_cat = tree.node_is_cat[nid]
+
+        is_nan_bin = fb == (nb - 1)
+        is_def_bin = fb == dbin
+        cmp_left = fb <= thr
+        num_left = jnp.where(
+            (mtype == MISSING_NAN) & is_nan_bin, dleft,
+            jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
+        cat_left = tree.node_cat_mask.reshape(-1)[
+            nid * b_dim + jnp.clip(fb, 0, b_dim - 1)]
+        go_left = jnp.where(is_cat, cat_left, num_left)
+        nxt = jnp.where(go_left, tree.node_left[nid], tree.node_right[nid])
+        return jnp.where(is_internal, nxt, node)
+
+    node0 = jnp.where(tree.num_leaves > 1,
+                      jnp.zeros(n, jnp.int32),
+                      jnp.full(n, -1, jnp.int32))
+    del max_steps  # depth-synchronous walk exits when every row settles
+    node = jax.lax.while_loop(lambda nd: jnp.any(nd >= 0), body, node0)
+    leaf = -node - 1
+    return tree.leaf_value[jnp.clip(leaf, 0, tree.leaf_value.shape[0] - 1)]
+
+
+class RawTreeStack(NamedTuple):
+    """T host trees stacked into fixed-shape device arrays for the
+    raw-feature batch predict (padded to the batch max node/leaf/cat
+    counts; empty node slots route to leaf 0 of an all-zero pad)."""
+    num_leaves: jax.Array   # (T,) int32
+    feature: jax.Array      # (T, M) int32 real feature idx
+    thr_hi: jax.Array       # (T, M) f32 threshold high part
+    thr_lo: jax.Array       # (T, M) f32 threshold residual
+    dtype_: jax.Array       # (T, M) int32 decision_type bitfield
+    left: jax.Array         # (T, M) int32 (negative = ~leaf)
+    right: jax.Array        # (T, M) int32
+    leaf_value: jax.Array   # (T, L) f32
+    cat_words: jax.Array    # (T, M, W) int32 per-node category bitset
+
+
+def stack_host_trees(models: List) -> RawTreeStack:
+    """Upload a host Tree list as one RawTreeStack (leaf values carry
+    shrinkage/DART renormalization already — host semantics)."""
+    T = len(models)
+    M = max(max(t.num_leaves - 1 for t in models), 1)
+    L = M + 1
+    W = 1
+    for t in models:
+        for i in range(t.num_leaves - 1):
+            if t.decision_type[i] & K_CATEGORICAL_MASK:
+                ci = int(t.threshold[i])
+                W = max(W, t.cat_boundaries[ci + 1] - t.cat_boundaries[ci])
+    nl = np.zeros(T, np.int32)
+    feat = np.zeros((T, M), np.int32)
+    thr = np.zeros((T, M), np.float64)
+    dt = np.zeros((T, M), np.int32)
+    left = np.zeros((T, M), np.int32)
+    right = np.zeros((T, M), np.int32)
+    lv = np.zeros((T, L), np.float64)
+    cw = np.zeros((T, M, W), np.uint32)
+    for k, t in enumerate(models):
+        m = t.num_leaves - 1
+        nl[k] = t.num_leaves
+        if m <= 0:
+            lv[k, 0] = t.leaf_value[0] if len(t.leaf_value) else 0.0
+            continue
+        feat[k, :m] = t.split_feature[:m]
+        thr[k, :m] = t.threshold[:m]
+        dt[k, :m] = t.decision_type[:m]
+        left[k, :m] = t.left_child[:m]
+        right[k, :m] = t.right_child[:m]
+        lv[k, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        for i in range(m):
+            if dt[k, i] & K_CATEGORICAL_MASK:
+                ci = int(t.threshold[i])
+                lo, hi = t.cat_boundaries[ci], t.cat_boundaries[ci + 1]
+                words = np.asarray(t.cat_threshold[lo:hi], dtype=np.uint32)
+                cw[k, i, :len(words)] = words
+    hi = thr.astype(np.float32)
+    lo = (thr - hi.astype(np.float64)).astype(np.float32)
+    return RawTreeStack(
+        num_leaves=jnp.asarray(nl), feature=jnp.asarray(feat),
+        thr_hi=jnp.asarray(hi), thr_lo=jnp.asarray(lo),
+        dtype_=jnp.asarray(dt), left=jnp.asarray(left),
+        right=jnp.asarray(right),
+        leaf_value=jnp.asarray(lv.astype(np.float32)),
+        cat_words=jnp.asarray(cw.view(np.int32)))
+
+
+def split_hi_lo(X: np.ndarray):
+    """float64 matrix -> (hi, lo) f32 pair with hi + lo == X to ~48
+    mantissa bits (enough to reproduce f64 threshold decisions on any
+    f32-representable data)."""
+    X = np.asarray(X, dtype=np.float64)
+    hi = X.astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        lo = (X - hi.astype(np.float64)).astype(np.float32)
+    return hi, np.where(np.isnan(lo), np.float32(0), lo)
+
+
+def _walk_raw(tree: RawTreeStack, Xhi: jax.Array, Xlo: jax.Array
+              ) -> jax.Array:
+    """One stacked tree (unbatched slices) over raw features: the
+    device form of Tree.predict_leaf (tree.py:136-179; reference
+    tree.h:212-295 Numerical/CategoricalDecision)."""
+    n = Xhi.shape[0]
+    W = tree.cat_words.shape[-1]
+
+    def body(node):
+        is_internal = node >= 0
+        nid = jnp.maximum(node, 0)
+        feat = tree.feature[nid]
+        vhi = jnp.take_along_axis(Xhi, feat[:, None], axis=1)[:, 0]
+        vlo = jnp.take_along_axis(Xlo, feat[:, None], axis=1)[:, 0]
+        dt = tree.dtype_[nid]
+        is_cat = (dt & K_CATEGORICAL_MASK) > 0
+        dleft = (dt & K_DEFAULT_LEFT_MASK) > 0
+        mtype = (dt >> 2) & 3
+        nan_mask = jnp.isnan(vhi)
+        conv = nan_mask & (mtype != MISSING_NAN)
+        fhi = jnp.where(conv, 0.0, vhi)
+        flo = jnp.where(conv, 0.0, vlo)
+        is_zero = (fhi > -K_ZERO_THRESHOLD) & (fhi <= K_ZERO_THRESHOLD)
+        use_default = ((mtype == MISSING_ZERO) & is_zero) | \
+                      ((mtype == MISSING_NAN) & jnp.isnan(fhi))
+        # two-float comparison: exact f64 `fv <= thr` for
+        # f32-representable data (see module docstring)
+        d = (fhi - tree.thr_hi[nid]) + (flo - tree.thr_lo[nid])
+        num_left = jnp.where(use_default, dleft, d <= 0.0)
+        # categorical: int truncation of the raw value, then bitset
+        v_int = jnp.where(nan_mask, -1, fhi.astype(jnp.int32))
+        in_range = (v_int >= 0) & (v_int < W * 32)
+        word = tree.cat_words.reshape(-1)[
+            nid * W + jnp.clip(v_int // 32, 0, W - 1)]
+        bit = jnp.bitwise_and(
+            jax.lax.shift_right_logical(word, v_int % 32), 1)
+        cat_left = in_range & (bit > 0)
+        go_left = jnp.where(is_cat, cat_left, num_left)
+        nxt = jnp.where(go_left, tree.left[nid], tree.right[nid])
+        return jnp.where(is_internal, nxt, node)
+
+    node0 = jnp.where(tree.num_leaves > 1,
+                      jnp.zeros(n, jnp.int32),
+                      jnp.full(n, -1, jnp.int32))
+    node = jax.lax.while_loop(lambda nd: jnp.any(nd >= 0), body, node0)
+    leaf = -node - 1
+    return tree.leaf_value[jnp.clip(leaf, 0, tree.leaf_value.shape[0] - 1)]
+
+
+@jax.jit
+def predict_raw_ensemble(stack: RawTreeStack, Xhi: jax.Array,
+                         Xlo: jax.Array, cls: jax.Array,
+                         k_total: jax.Array) -> jax.Array:
+    """Scan every stacked tree over raw features, accumulating each
+    tree's output into its class row.  ``cls`` is the (T,) class index
+    per tree (tree t -> t % num_class, reference gbdt_prediction.cpp),
+    ``k_total`` a (K, 1) broadcastable zero init (K = num_class).
+    Returns (K, N) raw scores (f32 accumulation — the documented
+    device-predict precision)."""
+    def body(carry, xs):
+        tree, c = xs
+        pv = _walk_raw(tree, Xhi, Xlo)
+        return carry.at[c].add(pv), None
+
+    out, _ = jax.lax.scan(body, k_total, (stack, cls))
+    return out

@@ -1,0 +1,158 @@
+"""Collective-communication seam.
+
+The functional equivalent of the reference's static Network class
+(reference: include/LightGBM/network.h:86-296 — Allreduce,
+ReduceScatter, Allgather, GlobalSyncUpByMin/Max/Mean, GlobalSum — and
+the external-function injection point Network::Init(num_machines, rank,
+reduce_scatter_fn, allgather_fn) at network.h:96 / c_api.h:760).
+
+Inside jitted programs the collectives are implicit in shardings (see
+parallel/mesh.py); this module exists for code that needs EXPLICIT
+collective calls — the voting learner's vote exchange, distributed
+objective syncs (RenewTreeOutput's GlobalSum, gbdt.cpp:795-804), and
+tests that inject a fake backend the way LGBM_NetworkInitWithFunctions
+allowed.  The default backend maps straight onto jax.lax collectives
+over a named mesh axis; a host backend (numpy, single process) makes
+the distributed code paths unit-testable without any devices.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Collectives:
+    """Collective ops over a named mesh axis, usable inside shard_map."""
+
+    def __init__(self, axis_name: Optional[str]):
+        self.axis_name = axis_name
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.axis_name is not None
+
+    # -- core three (the only ones the learners need; SURVEY §2.4) ----
+    def allreduce_sum(self, x):
+        if self.axis_name is None:
+            return x
+        return jax.lax.psum(x, self.axis_name)
+
+    def reduce_scatter(self, x, tiled_axis: int = 0):
+        if self.axis_name is None:
+            return x
+        return jax.lax.psum_scatter(x, self.axis_name,
+                                    scatter_dimension=tiled_axis,
+                                    tiled=True)
+
+    def all_gather(self, x, axis: int = 0):
+        if self.axis_name is None:
+            return x
+        return jax.lax.all_gather(x, self.axis_name, axis=axis,
+                                  tiled=True)
+
+    # -- scalar sync helpers (network.h:165-257) ----------------------
+    def global_sum(self, x):
+        return self.allreduce_sum(x)
+
+    def global_min(self, x):
+        if self.axis_name is None:
+            return x
+        return jax.lax.pmin(x, self.axis_name)
+
+    def global_max(self, x):
+        if self.axis_name is None:
+            return x
+        return jax.lax.pmax(x, self.axis_name)
+
+    def global_mean(self, x):
+        if self.axis_name is None:
+            return x
+        return jax.lax.pmean(x, self.axis_name)
+
+    def argmax_sync(self, value, payload):
+        """Global argmax with payload broadcast — the
+        SyncUpGlobalBestSplit pattern (parallel_tree_learner.h:184-207):
+        every shard contributes (gain, split-struct); all shards end up
+        with the payload of the globally best gain."""
+        if self.axis_name is None:
+            return payload
+        gains = jax.lax.all_gather(value, self.axis_name)
+        best = jnp.argmax(gains)
+        gathered = jax.tree_util.tree_map(
+            lambda p: jax.lax.all_gather(p, self.axis_name), payload)
+        return jax.tree_util.tree_map(lambda g: g[best], gathered)
+
+    def rank(self):
+        if self.axis_name is None:
+            return 0
+        return jax.lax.axis_index(self.axis_name)
+
+    def num_machines(self):
+        if self.axis_name is None:
+            return 1
+        return jax.lax.axis_size(self.axis_name)
+
+
+class HostCollectives(Collectives):
+    """Single-process fake backend — the LGBM_NetworkInitWithFunctions
+    analog for unit tests: simulates a k-way reduction by applying the
+    reduction to caller-provided per-shard arrays."""
+
+    def __init__(self, shards: int = 1):
+        super().__init__(None)
+        self.shards = shards
+
+    def simulate_allreduce(self, per_shard_arrays):
+        return np.sum(np.stack(per_shard_arrays), axis=0)
+
+    def simulate_reduce_scatter(self, per_shard_arrays, axis: int = 0):
+        total = self.simulate_allreduce(per_shard_arrays)
+        return np.array_split(total, self.shards, axis=axis)
+
+    def simulate_allgather(self, per_shard_arrays, axis: int = 0):
+        return np.concatenate(per_shard_arrays, axis=axis)
+
+
+class ExternalCollectives(HostCollectives):
+    """User-injected reduce-scatter/allgather callables — the direct
+    analog of LGBM_NetworkInitWithFunctions (reference c_api.h:760-762,
+    network.h:96).  Callables receive and return numpy arrays; used by
+    embedders that bring their own transport."""
+
+    def __init__(self, num_machines: int, rank: int,
+                 reduce_scatter_fn: Optional[Callable] = None,
+                 allgather_fn: Optional[Callable] = None):
+        super().__init__(shards=num_machines)
+        self.external_rank = rank
+        self.reduce_scatter_fn = reduce_scatter_fn
+        self.allgather_fn = allgather_fn
+
+    def simulate_reduce_scatter(self, per_shard_arrays, axis: int = 0):
+        if self.reduce_scatter_fn is None:
+            return super().simulate_reduce_scatter(per_shard_arrays, axis)
+        return self.reduce_scatter_fn(per_shard_arrays)
+
+    def simulate_allgather(self, per_shard_arrays, axis: int = 0):
+        if self.allgather_fn is None:
+            return super().simulate_allgather(per_shard_arrays, axis)
+        return self.allgather_fn(per_shard_arrays)
+
+
+_external: Optional[ExternalCollectives] = None
+
+
+def install_external(num_machines: int, rank: int,
+                     reduce_scatter_fn: Optional[Callable] = None,
+                     allgather_fn: Optional[Callable] = None) -> None:
+    """Install a process-global external backend (the
+    LGBM_NetworkInitWithFunctions seam, exposed via capi.py)."""
+    global _external
+    _external = ExternalCollectives(num_machines, rank,
+                                    reduce_scatter_fn, allgather_fn)
+
+
+def external() -> Optional[ExternalCollectives]:
+    return _external

@@ -1,0 +1,173 @@
+"""Multi-host ingestion + rendezvous.
+
+TPU-native redesign of the reference's distributed loading protocol
+(reference: src/io/dataset_loader.cpp:424-456 row partitioning,
+:523-605 + :828-886 distributed bin finding with mapper allgather):
+
+  * Rendezvous: ``jax.distributed.initialize`` (the Linkers TCP-mesh
+    construction, linkers_socket.cpp:20-78, collapses to one call; the
+    coordinator address plays mlist.txt's role).
+  * Distributed bin finding: every host samples ITS OWN row shard,
+    the per-host samples are allgathered (multihost_utils), and every
+    host fits bin mappers + EFB bundles from the identical combined
+    sample — deterministic construction replaces the reference's
+    serialized-mapper allgather (same result, no custom wire format).
+  * Per-host binning: each host bins ONLY its row shard into its local
+    (N_local, G) uint8 matrix; the training mesh then assembles the
+    global row-sharded array with
+    ``jax.make_array_from_process_local_data``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import Log
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host rendezvous (reference Network::Init +
+    Linkers ctor).  With no arguments, jax auto-detects the cluster
+    environment (TPU pod metadata / SLURM / env vars)."""
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def sample_local_rows(local_data: np.ndarray, sample_cnt: int,
+                      seed: int) -> np.ndarray:
+    """FIXED-SIZE (sample_cnt, F+1) row sample of this host's shard:
+    the collective requires identical shapes on every process, so
+    shards smaller than the quota pad with rows whose trailing
+    validity column is 0 (dropped after the gather).  Each host uses a
+    DIFFERENT derived seed so the combined sample isn't biased toward
+    identical row positions."""
+    import jax
+    n, f = local_data.shape
+    rng = np.random.RandomState(seed + 7919 * jax.process_index())
+    out = np.zeros((sample_cnt, f + 1), dtype=np.float64)
+    take = min(n, sample_cnt)
+    if n <= sample_cnt:
+        out[:take, :f] = np.asarray(local_data, dtype=np.float64)
+    else:
+        idx = rng.choice(n, size=sample_cnt, replace=False)
+        idx.sort()
+        out[:, :f] = np.asarray(local_data[idx], dtype=np.float64)
+    out[:take, f] = 1.0
+    return out
+
+
+def allgather_samples(local_sample: np.ndarray) -> np.ndarray:
+    """(S, F+1) per-host padded sample -> (sum valid, F) combined
+    sample, identical on every host (the redesign of the reference's
+    per-feature serialized-mapper allgather)."""
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(
+        multihost_utils.process_allgather(local_sample))
+    flat = gathered.reshape(-1, local_sample.shape[1])
+    valid = flat[:, -1] > 0.5
+    return flat[valid, :-1]
+
+
+def construct_sharded(local_data: np.ndarray, label=None, weight=None,
+                      group=None, config: Optional[Config] = None,
+                      categorical_features: Optional[Sequence[int]] = None,
+                      feature_names: Optional[Sequence[str]] = None):
+    """Build THIS HOST's shard of the distributed dataset: mappers and
+    EFB bundles are fitted from the globally-gathered sample (bit-equal
+    on every host), then only the local rows are binned.
+
+    Returns a CoreDataset whose ``group_bins`` holds N_local rows; the
+    caller assembles the global array over the mesh with
+    ``jax.make_array_from_process_local_data``.
+    """
+    from ..data_loader import split_sample_columns
+    from ..dataset import Dataset as CoreDataset
+    config = config or Config()
+    local_data = np.asarray(local_data, dtype=np.float64)
+    local_sample = sample_local_rows(
+        local_data, max(1, config.bin_construct_sample_cnt //
+                        max(1, _num_processes())),
+        config.data_random_seed)
+    combined = allgather_samples(local_sample)
+
+    # the COMBINED sample drives mapper + EFB fitting (bit-equal on
+    # every host); construction then reuses the single-host streaming
+    # machinery with one local "push" of this host's rows
+    sample_vals, sample_rows = split_sample_columns(combined)
+    ds = CoreDataset.from_sampled_columns(
+        sample_vals, sample_rows, combined.shape[0],
+        local_data.shape[0], config=config,
+        categorical_features=categorical_features,
+        feature_names=feature_names)
+    ds.push_rows(local_data, 0)
+    ds.finish_load()
+    if label is not None:
+        ds.metadata.set_label(np.asarray(label))
+    ds.metadata.set_weight(weight)
+    ds.metadata.set_group(group)
+    return ds
+
+
+def finalize_global(ds):
+    """Promote a per-host shard dataset (construct_sharded) into the
+    GLOBAL training view: metadata (labels/weights — bytes-per-row
+    small) is allgathered into assembled global row order (host 0's
+    rows, then host 1's, ...), ``num_data`` becomes the global count,
+    while ``group_bins`` stays THIS host's rows — the grower assembles
+    the global HBM array over the mesh with
+    ``jax.make_array_from_process_local_data`` (the redesign of
+    reference data_parallel_tree_learner.cpp:117-246, where each
+    machine trains on its shard and histograms are reduce-scattered).
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    from ..dataset import Metadata
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return ds
+    n_local = ds.num_data
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.array([n_local], dtype=np.int64))).ravel()
+    if not (counts == counts[0]).all():
+        Log.fatal("multi-host training requires equal row shards per "
+                  f"host, got {counts.tolist()} — pad the tail shard")
+    if ds.metadata.query_boundaries is not None:
+        Log.fatal("multi-host ranking (query groups) is not supported "
+                  "yet — queries must not span hosts")
+    n_global = int(counts.sum())
+    md = Metadata(n_global)
+    md.label = np.asarray(multihost_utils.process_allgather(
+        np.ascontiguousarray(ds.metadata.label))).reshape(-1) \
+        .astype(np.float32)
+    if ds.metadata.weight is not None:
+        md.weight = np.asarray(multihost_utils.process_allgather(
+            np.ascontiguousarray(ds.metadata.weight))).reshape(-1) \
+            .astype(np.float32)
+    if ds.metadata.init_score is not None:
+        # init_score is class-major per host ((K, n_local) flattened);
+        # a naive concat would interleave hosts inside classes
+        init_l = np.ascontiguousarray(ds.metadata.init_score)
+        k = max(1, len(init_l) // n_local)
+        gathered = np.asarray(multihost_utils.process_allgather(
+            init_l)).reshape(nproc, k, n_local)
+        md.init_score = np.transpose(gathered, (1, 0, 2)).reshape(-1)
+    ds.metadata = md
+    ds._mh_local_rows = n_local
+    ds._multihost = True
+    ds.num_data = n_global
+    return ds
+
+
+def _num_processes() -> int:
+    import jax
+    try:
+        return jax.process_count()
+    except Exception:  # pragma: no cover - uninitialized
+        return 1

@@ -1,0 +1,165 @@
+"""Device mesh construction and sharding policies for distributed
+tree learning.
+
+TPU-native replacement for the reference's entire network stack
+(reference: src/network/ Linkers + Bruck/recursive-halving/ring
+collectives, network.cpp:64-314, and the tree_learner x device dispatch
+tree_learner.cpp:9-33).  The hand-written socket/MPI collectives
+disappear: parallelism is expressed as shardings over a
+``jax.sharding.Mesh`` and XLA inserts the psum / reduce-scatter /
+all-gather over ICI/DCN:
+
+  * ``data`` learner  — rows sharded (DataParallelTreeLearner,
+    data_parallel_tree_learner.cpp): the histogram matmul contracts the
+    sharded row dimension, XLA emits exactly the ReduceScatter(+gather)
+    of per-(leaf,group,bin) partial histograms the reference codes by
+    hand (:147-162); constraining the histogram output to be
+    feature-sharded reproduces the per-machine feature ownership.
+  * ``feature`` learner — bins replicated, histogram columns sharded
+    (FeatureParallelTreeLearner): split search is divided by feature,
+    the global best split is a tiny argmax all-reduce
+    (SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207) that XLA
+    derives from the replicated argmax.
+  * ``voting`` learner — top-k gain preselection then a reduced
+    histogram exchange (voting_parallel_tree_learner.cpp); expressed
+    with the same constraints plus a top_k mask.
+
+Multi-host: call ``jax.distributed.initialize()`` before building the
+mesh; the same jitted program then spans hosts with collectives routed
+over ICI within a pod and DCN across pods.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..utils.log import Log
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def build_mesh(config: Config) -> Optional[Mesh]:
+    """Build the training mesh from config (mesh_shape/mesh_axes or all
+    local devices on one axis matching the tree_learner)."""
+    if config.tree_learner == "serial" and not config.mesh_shape:
+        return None
+    devices = jax.devices()
+    if config.mesh_shape:
+        shape = tuple(config.mesh_shape)
+        axes = tuple(config.mesh_axes) or (DATA_AXIS,)
+        n = int(np.prod(shape))
+        if n > len(devices):
+            Log.warning(f"mesh_shape {shape} needs {n} devices, have "
+                        f"{len(devices)}; falling back to serial")
+            return None
+        return Mesh(np.asarray(devices[:n]).reshape(shape), axes)
+    n = len(devices)
+    if n == 1:
+        return None
+    axis = FEATURE_AXIS if config.tree_learner == "feature" else DATA_AXIS
+    return Mesh(np.asarray(devices), (axis,))
+
+
+class ShardingPolicy:
+    """Per-learner sharding decisions consumed by the grower."""
+
+    def __init__(self, config: Config, mesh: Optional[Mesh]):
+        self.mesh = mesh
+        self.learner = config.tree_learner
+        try:
+            self.nproc = jax.process_count()
+        except Exception:  # pragma: no cover - uninitialized backend
+            self.nproc = 1
+        # multi-host: arrays must be assembled from process-local
+        # shards (device_put of a full array cannot address other
+        # hosts' devices)
+        self.multihost = mesh is not None and self.nproc > 1
+        if mesh is None:
+            self.row_spec = None
+            self.hist_spec = None
+            return
+        axes = mesh.axis_names
+        if self.learner in ("data", "voting") or DATA_AXIS in axes:
+            data_axis = DATA_AXIS if DATA_AXIS in axes else axes[0]
+            self.row_spec = P(data_axis)            # rows sharded
+            # per-machine feature ownership after the reduce
+            # (data_parallel_tree_learner.cpp:53-115): shard the reduced
+            # histogram over groups so the row-contraction lowers to a
+            # reduce-scatter instead of a full all-reduce
+            self.hist_spec = P(None, data_axis, None, None)
+        elif self.learner == "feature":
+            f_axis = FEATURE_AXIS if FEATURE_AXIS in axes else axes[0]
+            self.row_spec = None                    # rows replicated
+            self.hist_spec = P(None, f_axis, None, None)
+        else:
+            self.row_spec = None
+            self.hist_spec = None
+
+    # ------------------------------------------------------------------
+    def place_rows(self, arr):
+        """Place a row-indexed array ((N,) or (N, G)).  Multi-host: the
+        array is the ASSEMBLED global view (host h's rows at
+        [h*N/nproc, (h+1)*N/nproc)); this host's slice is extracted and
+        the global array built from process-local shards."""
+        if self.mesh is None or self.row_spec is None:
+            return jax.device_put(arr)
+        ndim = getattr(arr, "ndim", 1)
+        spec = P(self.row_spec[0], *([None] * (ndim - 1)))
+        if self.multihost:
+            return self.place_local_rows(self._local_slice(arr, axis=0))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def place_local_rows(self, local_arr):
+        """Multi-host: build the global row-sharded array from THIS
+        host's padded shard (jax.make_array_from_process_local_data —
+        the seam reference dataset_loader.cpp's pre-partitioned loading
+        feeds)."""
+        ndim = getattr(local_arr, "ndim", 1)
+        spec = P(self.row_spec[0], *([None] * (ndim - 1)))
+        sh = NamedSharding(self.mesh, spec)
+        if not self.multihost:
+            return jax.device_put(local_arr, sh)
+        return jax.make_array_from_process_local_data(sh, local_arr)
+
+    def place_score_rows(self, arr):
+        """Place a (K, N) class-major score matrix (rows on axis 1)."""
+        if self.mesh is None or self.row_spec is None:
+            return jax.device_put(arr)
+        sh = NamedSharding(self.mesh, P(None, self.row_spec[0]))
+        if self.multihost:
+            return jax.make_array_from_process_local_data(
+                sh, self._local_slice(arr, axis=1))
+        return jax.device_put(arr, sh)
+
+    def _local_slice(self, arr, axis: int):
+        import numpy as _np
+        n = arr.shape[axis]
+        per = n // self.nproc
+        pid = jax.process_index()
+        idx = [slice(None)] * arr.ndim
+        idx[axis] = slice(pid * per, (pid + 1) * per)
+        return _np.ascontiguousarray(_np.asarray(arr)[tuple(idx)])
+
+    def replicate(self, arr):
+        if self.mesh is None:
+            return jax.device_put(arr)
+        if self.multihost:
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P()), np.asarray(arr))
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    def constrain_hist(self, hist):
+        """Apply the post-reduce histogram sharding constraint."""
+        if self.mesh is None or self.hist_spec is None:
+            return hist
+        return jax.lax.with_sharding_constraint(
+            hist, NamedSharding(self.mesh, self.hist_spec))
+
+    @property
+    def num_shards(self) -> int:
+        return 1 if self.mesh is None else self.mesh.size

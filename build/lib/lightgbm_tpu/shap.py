@@ -1,0 +1,177 @@
+"""TreeSHAP feature contributions.
+
+Implements the polynomial-time TreeSHAP algorithm backing the
+reference's PredictContrib (reference: include/LightGBM/tree.h:322-349
+TreeSHAP/ExtendPath/UnwindPath, gbdt.cpp:670-689 PredictContrib):
+per-node coverage fractions from internal_count, EXTEND/UNWIND over the
+active decision path, output = per-feature contributions plus the
+expected value in the last slot.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .tree import Tree, K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, \
+    _find_in_bitset
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction",
+                 "pweight")
+
+    def __init__(self, f=-1, z=1.0, o=1.0, w=1.0):
+        self.feature_index = f
+        self.zero_fraction = z
+        self.one_fraction = o
+        self.pweight = w
+
+    def copy(self):
+        return _PathElement(self.feature_index, self.zero_fraction,
+                            self.one_fraction, self.pweight)
+
+
+def _extend(path: List[_PathElement], zero_fraction, one_fraction,
+            feature_index):
+    path.append(_PathElement(feature_index, zero_fraction, one_fraction,
+                             0.0 if len(path) > 0 else 1.0))
+    depth = len(path) - 1
+    for i in range(depth - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) \
+            / (depth + 1)
+        path[i].pweight = zero_fraction * path[i].pweight * (depth - i) \
+            / (depth + 1)
+
+
+def _unwind(path: List[_PathElement], path_index):
+    depth = len(path) - 1
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[depth].pweight
+    for i in range(depth - 1, -1, -1):
+        if one_fraction != 0.0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (depth + 1) \
+                / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction \
+                * (depth - i) / (depth + 1)
+        else:
+            path[i].pweight = path[i].pweight * (depth + 1) \
+                / (zero_fraction * (depth - i))
+    for i in range(path_index, depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+    path.pop()
+
+
+def _unwound_sum(path: List[_PathElement], path_index):
+    depth = len(path) - 1
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[depth].pweight
+    total = 0.0
+    for i in range(depth - 1, -1, -1):
+        if one_fraction != 0.0:
+            tmp = next_one_portion * (depth + 1) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction \
+                * (depth - i) / (depth + 1)
+        else:
+            total += path[i].pweight / (zero_fraction * (depth - i)
+                                        / (depth + 1))
+    return total
+
+
+def _decision(tree: Tree, node: int, x: np.ndarray) -> int:
+    """Hot child of `node` for row x (mirrors tree.h Decision)."""
+    dt = tree.decision_type[node]
+    fval = x[tree.split_feature[node]]
+    if dt & K_CATEGORICAL_MASK:
+        if np.isnan(fval) or int(fval) < 0:
+            return tree.right_child[node]
+        ci = int(tree.threshold[node])
+        lo, hi = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+        words = np.asarray(tree.cat_threshold[lo:hi], dtype=np.uint32)
+        if len(words) and _find_in_bitset(words,
+                                          np.asarray([int(fval)]))[0]:
+            return tree.left_child[node]
+        return tree.right_child[node]
+    mtype = (dt >> 2) & 3
+    if np.isnan(fval) and mtype != 2:
+        fval = 0.0
+    is_zero = -1e-35 < fval <= 1e-35
+    if (mtype == 1 and is_zero) or (mtype == 2 and np.isnan(fval)):
+        return tree.left_child[node] if dt & K_DEFAULT_LEFT_MASK \
+            else tree.right_child[node]
+    return tree.left_child[node] if fval <= tree.threshold[node] \
+        else tree.right_child[node]
+
+
+def _node_count(tree: Tree, node: int) -> float:
+    if node < 0:
+        return max(float(tree.leaf_count[-node - 1]), 1.0)
+    return max(float(tree.internal_count[node]), 1.0)
+
+
+def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray, node: int,
+               path: List[_PathElement], parent_zero: float,
+               parent_one: float, parent_feature: int):
+    path = [p.copy() for p in path]
+    _extend(path, parent_zero, parent_one, parent_feature)
+    if node < 0:   # leaf
+        leaf = -node - 1
+        for i in range(1, len(path)):
+            w = _unwound_sum(path, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction
+                                          - el.zero_fraction) \
+                * tree.leaf_value[leaf]
+        return
+    hot = _decision(tree, node, x)
+    cold = tree.right_child[node] if hot == tree.left_child[node] \
+        else tree.left_child[node]
+    node_cnt = _node_count(tree, node)
+    hot_frac = _node_count(tree, hot) / node_cnt
+    cold_frac = _node_count(tree, cold) / node_cnt
+    incoming_zero, incoming_one = 1.0, 1.0
+    feat = int(tree.split_feature[node])
+    path_index = next((i for i, el in enumerate(path)
+                       if el.feature_index == feat), -1)
+    if path_index >= 0:
+        incoming_zero = path[path_index].zero_fraction
+        incoming_one = path[path_index].one_fraction
+        _unwind(path, path_index)
+    _tree_shap(tree, x, phi, hot, path, hot_frac * incoming_zero,
+               incoming_one, feat)
+    _tree_shap(tree, x, phi, cold, path, cold_frac * incoming_zero, 0.0,
+               feat)
+
+
+def tree_expected_value(tree: Tree) -> float:
+    counts = np.maximum(tree.leaf_count.astype(np.float64), 1.0)
+    return float(np.average(tree.leaf_value, weights=counts))
+
+
+def predict_contrib(booster, data: np.ndarray,
+                    models: List[Tree]) -> np.ndarray:
+    """SHAP contributions: (n, (F+1)) or (n, K*(F+1)) — last slot(s) are
+    expected values (reference c_api predict_type=contrib layout)."""
+    n = data.shape[0]
+    F = booster.max_feature_idx + 1
+    k = max(booster.num_tree_per_iteration, 1)
+    out = np.zeros((n, k * (F + 1)), dtype=np.float64)
+    for ti, tree in enumerate(models):
+        cls = ti % k
+        base = cls * (F + 1)
+        if tree.num_leaves <= 1:
+            out[:, base + F] += tree.leaf_value[0]
+            continue
+        ev = tree_expected_value(tree)
+        out[:, base + F] += ev
+        for r in range(n):
+            phi = np.zeros(F + 1)
+            _tree_shap(tree, data[r], phi, 0, [], 1.0, 1.0, -1)
+            out[r, base:base + F] += phi[:F]
+    return out[:, :F + 1] if k == 1 else out

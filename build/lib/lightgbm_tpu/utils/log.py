@@ -1,0 +1,68 @@
+"""Leveled logging (reference: include/LightGBM/utils/log.h).
+
+``Log.fatal`` raises instead of aborting, matching the reference's
+``Log::Fatal`` -> std::runtime_error contract (log.h:83-95).
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+
+class LightGBMError(RuntimeError):
+    """Raised by Log.fatal (reference: Log::Fatal throws std::runtime_error)."""
+
+
+class Log:
+    # verbosity: <0 fatal only, =0 warning+, =1 info+, >1 debug+
+    level: int = 1
+
+    @classmethod
+    def set_level(cls, level: int) -> None:
+        cls.level = level
+
+    @classmethod
+    def _emit(cls, tag: str, msg: str) -> None:
+        sys.stderr.write(f"[LightGBM-TPU] [{tag}] {msg}\n")
+        sys.stderr.flush()
+
+    @classmethod
+    def debug(cls, msg: str) -> None:
+        if cls.level > 1:
+            cls._emit("Debug", msg)
+
+    @classmethod
+    def info(cls, msg: str) -> None:
+        if cls.level >= 1:
+            cls._emit("Info", msg)
+
+    @classmethod
+    def warning(cls, msg: str) -> None:
+        if cls.level >= 0:
+            cls._emit("Warning", msg)
+
+    @classmethod
+    def fatal(cls, msg: str) -> None:
+        cls._emit("Fatal", msg)
+        raise LightGBMError(msg)
+
+
+class PhaseTimer:
+    """Per-phase accumulated wall-clock timing, the analog of the
+    reference's TIMETAG chrono counters (gbdt.cpp:21-29,
+    serial_tree_learner.cpp:13-20)."""
+
+    def __init__(self):
+        self.acc: dict[str, float] = {}
+        self._start: dict[str, float] = {}
+
+    def start(self, phase: str) -> None:
+        self._start[phase] = time.perf_counter()
+
+    def stop(self, phase: str) -> None:
+        t0 = self._start.pop(phase, None)
+        if t0 is not None:
+            self.acc[phase] = self.acc.get(phase, 0.0) + time.perf_counter() - t0
+
+    def report(self) -> str:
+        return ", ".join(f"{k}={v:.3f}s" for k, v in sorted(self.acc.items()))

@@ -33,8 +33,49 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE = os.path.join(_REPO, "lightgbm_tpu", "native")
+_CAPI_SRC = os.path.join(_NATIVE, "src", "capi", "c_api_embed.cpp")
+_CAPI_LIB = os.path.join(_NATIVE, "liblgbm_tpu.so")
+
+
+def _python_config(*flags):
+    exe = f"python{sys.version_info.major}.{sys.version_info.minor}-config"
+    for cand in (exe, "python3-config"):
+        try:
+            out = subprocess.run([cand, *flags], capture_output=True,
+                                 text=True, check=True)
+            return out.stdout.split()
+        except (OSError, subprocess.CalledProcessError):
+            continue
+    return None
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    """Session-shared liblgbm_tpu.so: built once per suite (three
+    binding test files used to rebuild it independently, ~40 s of g++
+    each) and skipped entirely when the source hasn't changed."""
+    inc = _python_config("--includes")
+    ld = _python_config("--ldflags", "--embed")
+    if inc is None or ld is None:
+        pytest.skip("python-config not available")
+    if (os.path.exists(_CAPI_LIB)
+            and os.path.getmtime(_CAPI_LIB) > os.path.getmtime(_CAPI_SRC)):
+        return _CAPI_LIB
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", *inc,
+         _CAPI_SRC, "-o", _CAPI_LIB, *ld],
+        capture_output=True, text=True)
+    assert build.returncode == 0, \
+        f"native capi build failed: {build.stderr[-2000:]}"
+    return _CAPI_LIB
 
 
 @pytest.fixture

@@ -21,33 +21,6 @@ NATIVE = os.path.join(REPO, "lightgbm_tpu", "native")
 JNI = os.path.join(REPO, "jni")
 
 
-def _python_config(*flags):
-    exe = f"python{sys.version_info.major}.{sys.version_info.minor}-config"
-    for cand in (exe, "python3-config"):
-        try:
-            out = subprocess.run([cand, *flags], capture_output=True,
-                                 text=True, check=True)
-            return out.stdout.split()
-        except (OSError, subprocess.CalledProcessError):
-            continue
-    return None
-
-
-@pytest.fixture(scope="module")
-def native_lib():
-    inc = _python_config("--includes")
-    ld = _python_config("--ldflags", "--embed")
-    if inc is None or ld is None:
-        pytest.skip("python-config not available")
-    lib = os.path.join(NATIVE, "liblgbm_tpu.so")
-    src = os.path.join(NATIVE, "src", "capi", "c_api_embed.cpp")
-    build = subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", *inc, src,
-         "-o", lib, *ld], capture_output=True, text=True)
-    assert build.returncode == 0, \
-        f"native capi build failed: {build.stderr[-2000:]}"
-    return lib
-
 
 def test_jni_binding_executes_via_fake_env(native_lib, tmp_path):
     exe = str(tmp_path / "jni_host")

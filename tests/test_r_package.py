@@ -23,32 +23,6 @@ RSRC = os.path.join(REPO, "R-package", "src")
 RSTUB = os.path.join(RSRC, "rstub")
 
 
-def _python_config(*flags):
-    exe = f"python{sys.version_info.major}.{sys.version_info.minor}-config"
-    for cand in (exe, "python3-config"):
-        try:
-            out = subprocess.run([cand, *flags], capture_output=True,
-                                 text=True, check=True)
-            return out.stdout.split()
-        except (OSError, subprocess.CalledProcessError):
-            continue
-    return None
-
-
-@pytest.fixture(scope="module")
-def native_lib():
-    inc = _python_config("--includes")
-    ld = _python_config("--ldflags", "--embed")
-    if inc is None or ld is None:
-        pytest.skip("python-config not available")
-    src = os.path.join(NATIVE, "src", "capi", "c_api_embed.cpp")
-    build = subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", *inc, src,
-         "-o", LIB, *ld], capture_output=True, text=True)
-    assert build.returncode == 0, \
-        f"native capi build failed: {build.stderr[-2000:]}"
-    return LIB
-
 
 def test_r_shim_executes_via_stub_host(native_lib, tmp_path):
     """Every line of the .Call shim runs for real: stub-libR host

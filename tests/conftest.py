@@ -66,8 +66,13 @@ def native_lib():
     ld = _python_config("--ldflags", "--embed")
     if inc is None or ld is None:
         pytest.skip("python-config not available")
+    src_mtime = os.path.getmtime(_CAPI_SRC)
+    inc_dir = os.path.join(_NATIVE, "include")
+    for f in os.listdir(inc_dir):
+        src_mtime = max(src_mtime,
+                        os.path.getmtime(os.path.join(inc_dir, f)))
     if (os.path.exists(_CAPI_LIB)
-            and os.path.getmtime(_CAPI_LIB) > os.path.getmtime(_CAPI_SRC)):
+            and os.path.getmtime(_CAPI_LIB) > src_mtime):
         return _CAPI_LIB
     build = subprocess.run(
         ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", *inc,

@@ -31,6 +31,7 @@ def _ensure_example_data():
                                      "regression",
                                      "multiclass_classification",
                                      "lambdarank"])
+@pytest.mark.slow
 def test_cli_python_consistency(example, tmp_path, monkeypatch):
     _ensure_example_data()
     ex_dir = os.path.join(EXAMPLES, example)

@@ -89,6 +89,7 @@ def _free_port():
     return p
 
 
+@pytest.mark.slow
 def test_two_process_distributed_binning(tmp_path):
     port = _free_port()
     coord = f"localhost:{port}"
@@ -122,6 +123,7 @@ def test_two_process_distributed_binning(tmp_path):
     assert lines["0"][7] == lines["1"][7] == "1000"
 
 
+@pytest.mark.slow
 def test_two_process_distributed_training(tmp_path):
     """The multi-host TRAINING path (VERDICT r2 weak#9): 2 real
     processes assemble the global batch with

@@ -12,7 +12,6 @@ binding builds against the genuine <jni.h> and a real Java smoke runs
 import os
 import shutil
 import subprocess
-import sys
 
 import pytest
 
@@ -22,6 +21,7 @@ JNI = os.path.join(REPO, "jni")
 
 
 
+@pytest.mark.slow
 def test_jni_binding_executes_via_fake_env(native_lib, tmp_path):
     exe = str(tmp_path / "jni_host")
     build = subprocess.run(

@@ -5,7 +5,6 @@ stack — the seam R/Java hosts use (reference: R-package/src/
 lightgbm_R.cpp links lib_lightgbm the same way)."""
 import os
 import subprocess
-import sys
 
 import pytest
 
@@ -16,6 +15,7 @@ DRIVER_SRC = os.path.join(REPO, "tests", "native_capi_driver.c")
 
 
 
+@pytest.mark.slow
 def test_c_host_end_to_end(native_lib, tmp_path):
     exe = str(tmp_path / "capi_driver")
     inc_dir = os.path.join(NATIVE, "include")

@@ -11,6 +11,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_wheel_builds(tmp_path):
     pytest.importorskip("setuptools")
     r = subprocess.run(

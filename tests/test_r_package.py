@@ -12,7 +12,6 @@ skipless there).  Reference: R-package/src/lightgbm_R.cpp + R tests.
 import os
 import shutil
 import subprocess
-import sys
 
 import pytest
 
@@ -24,6 +23,7 @@ RSTUB = os.path.join(RSRC, "rstub")
 
 
 
+@pytest.mark.slow
 def test_r_shim_executes_via_stub_host(native_lib, tmp_path):
     """Every line of the .Call shim runs for real: stub-libR host
     drives train -> predict -> save -> reload -> parity over the

@@ -25,9 +25,9 @@ REF_BIN = os.path.join(os.path.dirname(__file__), "..", ".refbuild",
                        "lightgbm")
 REF_EXAMPLES = "/root/reference/examples"
 
-pytestmark = pytest.mark.skipif(
+pytestmark = [pytest.mark.slow, pytest.mark.skipif(
     not os.path.exists(REF_BIN),
-    reason="reference binary not built — run: sh tests/build_reference.sh")
+    reason="reference binary not built — run: sh tests/build_reference.sh")]
 
 
 def _run_ref(cwd, *args):

@@ -102,6 +102,7 @@ def test_capi_csr_roundtrip():
     np.testing.assert_allclose(pred[0], dense_pred[0], atol=0)
 
 
+@pytest.mark.slow
 def test_large_sparse_construct_bounded_rss():
     """100k x 10k, 99.9%-sparse construct stays under 2 GB peak RSS —
     run in a subprocess so the parent's allocations don't pollute
@@ -168,6 +169,7 @@ def test_libsvm_parses_to_csr(tmp_path):
             [0, 0.5, 0, 7.0]])
 
 
+@pytest.mark.slow
 def test_wide_libsvm_bounded_rss(tmp_path):
     """A 5k x 300k libsvm file (dense equivalent: 12 GB float64) must
     parse + construct within 1.5 GB peak RSS — the round-2 verdict

@@ -26,7 +26,12 @@ class Dataset:
                  feature_name: Union[str, Sequence[str]] = "auto",
                  categorical_feature: Union[str, Sequence] = "auto",
                  params: Optional[Dict[str, Any]] = None,
-                 free_raw_data: bool = False):
+                 free_raw_data: bool = True):
+        # free_raw_data defaults True like the reference python package
+        # (at bench scale the float64 matrix is 224 MB of dead weight
+        # next to the binned copy; 2.4 GB at HIGGS scale).  Continued
+        # training (init_model) needs the raw matrix to seed scores —
+        # pass free_raw_data=False there, as in the reference.
         self.data = data
         self.label = label
         self.reference = reference
@@ -102,6 +107,11 @@ class Dataset:
         self._core._raw_data = None if self.free_raw_data else data
         self._core._categorical_features = cat_indices
         self._core.pandas_categorical = pandas_cats
+        if self.free_raw_data:
+            # drop the lazy handle's copy too (reference sets
+            # Dataset.data = None after construction) — the binned
+            # matrix is the training representation from here on
+            self.data = None
         return self._core
 
     # ------------------------------------------------------------------
@@ -202,6 +212,9 @@ class Dataset:
         return _to_matrix(self.data).shape[1]
 
     def subset(self, used_indices, params=None) -> "Dataset":
+        if self.data is None:
+            Log.fatal("Cannot subset: raw data was freed — construct "
+                      "the Dataset with free_raw_data=False")
         if _is_sparse(self.data):
             data = self.data.tocsr()[used_indices]
         else:

@@ -122,7 +122,11 @@ class Booster:
         import jax.numpy as jnp
         raw = train_set._raw_data
         if raw is None:
-            Log.fatal("Continued training requires raw data on the Dataset")
+            Log.fatal("Continued training (init_model) requires raw "
+                      "data on the Dataset — construct it with "
+                      "free_raw_data=False (reference semantics; "
+                      "two_round streaming datasets never materialize "
+                      "the matrix and cannot continue training)")
         base._sync_models()
         pred = base.predict(raw, raw_score=True)
         pred = pred.reshape(self.num_class, train_set.num_data) \

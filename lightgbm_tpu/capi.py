@@ -86,6 +86,7 @@ def LGBM_DatasetCreateFromMat(data, parameters: str, reference=None,
     params = _parse_params(parameters)
     ref = _get(reference) if reference else None
     ds = Dataset(np.asarray(data, dtype=np.float64), reference=ref,
+                 free_raw_data=False,
                  params=params)
     out[0] = _register(ds)
     return 0
@@ -106,7 +107,8 @@ def LGBM_DatasetCreateFromCSR(indptr, indices, data, num_col: int,
          np.asarray(indices, dtype=np.int32),
          np.asarray(indptr, dtype=np.int64)),
         shape=(len(indptr) - 1, int(num_col)))
-    ds = Dataset(mat, reference=ref, params=params)
+    ds = Dataset(mat, reference=ref, params=params,
+                 free_raw_data=False)
     out[0] = _register(ds)
     return 0
 
@@ -124,7 +126,8 @@ def LGBM_DatasetCreateFromCSC(col_ptr, indices, data, num_row: int,
          np.asarray(indices, dtype=np.int32),
          np.asarray(col_ptr, dtype=np.int64)),
         shape=(int(num_row), len(col_ptr) - 1))
-    ds = Dataset(mat, reference=ref, params=params)
+    ds = Dataset(mat, reference=ref, params=params,
+                 free_raw_data=False)
     out[0] = _register(ds)
     return 0
 
@@ -205,7 +208,8 @@ def LGBM_DatasetCreateFromFile(filename: str, parameters: str,
     """reference c_api.h:53-66."""
     params = _parse_params(parameters)
     ref = _get(reference) if reference else None
-    ds = Dataset(str(filename), reference=ref, params=params)
+    ds = Dataset(str(filename), reference=ref, params=params,
+                 free_raw_data=False)
     out[0] = _register(ds)
     return 0
 

@@ -62,7 +62,10 @@ def run(argv: List[str]) -> int:
 def _task_train(params, config: Config) -> None:
     if not config.data:
         Log.fatal("No training data: set data=<file>")
-    train_set = Dataset(config.data, params=params)
+    # input_model (continued training) seeds scores from raw data —
+    # retain it in that case (reference CLI keeps data in memory too)
+    train_set = Dataset(config.data, params=params,
+                        free_raw_data=not config.input_model)
     valid_sets = []
     valid_names = []
     for i, vf in enumerate(config.valid_data):

@@ -211,6 +211,13 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if metrics is not None:
         params["metric"] = metrics
     config = Config.from_params(params)
+    # keep the pre-construct raw data in hand: with the reference's
+    # free_raw_data=True default the constructed core drops it, but cv
+    # re-bins each fold from raw (the reference's cv instead subsets
+    # the constructed dataset; per-fold re-binning is this framework's
+    # equivalent, and fold mappers are refit per fold like `lgb.cv`
+    # semantics require)
+    lazy_data = getattr(train_set, "data", None)
     if hasattr(train_set, "construct"):
         train_set = train_set.construct(config)
     label = train_set.metadata.label
@@ -229,9 +236,20 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                       idx[i::nfold]) for i in range(nfold)]
 
     raw = train_set._raw_data
+    if raw is None and lazy_data is not None \
+            and not isinstance(lazy_data, str):
+        # free_raw_data=True (the default) dropped the converted matrix
+        # at construct; re-convert the caller's in-memory data once for
+        # the per-fold re-binning (costs one extra materialization —
+        # pass free_raw_data=False to avoid it)
+        from .basic import _is_sparse, _to_matrix
+        raw = (lazy_data.tocsr() if _is_sparse(lazy_data)
+               else _to_matrix(lazy_data, None))
     if raw is None:
-        Log.fatal("cv requires the Dataset to retain raw data "
-                  "(construct via Dataset(data, label))")
+        Log.fatal("cv requires the Dataset's raw data: pass an "
+                  "in-memory matrix, or a non-streaming file dataset "
+                  "with free_raw_data=False (two_round streaming never "
+                  "materializes the matrix)")
 
     results: Dict[str, List[float]] = collections.defaultdict(list)
     boosters = []

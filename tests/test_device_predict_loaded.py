@@ -81,8 +81,8 @@ def test_init_model_merged_device_predict():
     X, y = _task(with_nan=False)
     p = {"objective": "binary", "verbose": -1, "num_leaves": 15}
     base = lgb.train(p, lgb.Dataset(X, label=y), 5, verbose_eval=False)
-    cont = lgb.train(p, lgb.Dataset(X, label=y), 5, verbose_eval=False,
-                     init_model=base)
+    cont = lgb.train(p, lgb.Dataset(X, label=y, free_raw_data=False), 5,
+                     verbose_eval=False, init_model=base)
     _assert_device_matches_host(cont, X)
 
 

@@ -169,7 +169,9 @@ def test_continued_training():
     ds = lgb.Dataset(X_train, label=y_train)
     bst1 = lgb.train(params, ds, 20, verbose_eval=False)
     ll1 = log_loss(y_test, bst1.predict(X_test))
-    ds2 = lgb.Dataset(X_train, label=y_train)
+    # continued training needs raw data (reference semantics: pass
+    # free_raw_data=False explicitly)
+    ds2 = lgb.Dataset(X_train, label=y_train, free_raw_data=False)
     bst2 = lgb.train(params, ds2, 20, init_model=bst1, verbose_eval=False)
     ll2 = log_loss(y_test, bst2.predict(X_test))
     assert bst2.num_trees() == 40

@@ -360,27 +360,37 @@ class TreeGrower:
                 (p for p in (1, 2, 4) if gbtot % p == 0),
                 key=lambda p: (_ohb_bytes(p), p))
         ohb_bytes = _ohb_bytes(self.ohb_pack)
+        # tiled-iota kernel (quantized single chip, round 4): the bin
+        # one-hot is rebuilt in VMEM per 128-lane tile — measured at
+        # the MXU floor on v5e, so the resident streamed one-hot (and
+        # its precompute + HBM budget gating) is obsolete on this path
+        self.use_tiled = (self.use_quant and self.frontier
+                          <= 3 * PACKED_STRIP
+                          and getattr(config, "hist_kernel_tiled", True))
         # fused route+histogram kernel (single chip): the pending split
         # routing is applied INSIDE the next round's histogram pass, so
-        # the separate per-round apply_splits pass disappears.  Needs
-        # the streamed one-hot (HBM budget) and a frontier that fits
-        # the packed strip ladder.
+        # the separate per-round apply_splits pass disappears.  Needs a
+        # frontier that fits the packed strip ladder, and (non-tiled)
+        # the streamed one-hot (HBM budget).
         self.use_fused = (self.use_pallas and not self.pallas_paired
                           and self.frontier <= 3 * PACKED_STRIP
-                          and ohb_bytes <= budget
+                          and (self.use_tiled or ohb_bytes <= budget)
                           and getattr(config, "hist_fused_route", True))
-        self.use_quant_otf = (self.use_quant_otf and not self.use_fused)
+        self.use_quant_otf = (self.use_quant_otf and not self.use_fused
+                              and not self.use_tiled)
         self.use_pre_ohb = (self.use_pallas and not self.pallas_paired
                             and not self.use_quant_otf
+                            and not self.use_tiled
                             and ohb_bytes <= budget)
-        if self.use_pallas and ohb_bytes > budget:
+        if self.use_pallas and not self.use_tiled and ohb_bytes > budget:
             Log.warning(
                 f"resident one-hot ({ohb_bytes >> 20} MB at pack="
                 f"{self.ohb_pack}) exceeds hist_onehot_budget_mb="
                 f"{budget >> 20}; using the slower on-the-fly rebuild "
                 "(see docs/ROOFLINE.md regime table)")
         self.ohb = None
-        self.binsT = (jnp.asarray(bins_np.T) if self.use_fused else None)
+        self.binsT = (jnp.asarray(bins_np.T)
+                      if self.use_fused or self.use_tiled else None)
         self._route_cols = 15 + (self.max_feature_bin + 7) // 8
         # trace-scoped override: callers thread the one-hot through
         # their jit boundary as an ARGUMENT (a multi-hundred-MB closure
@@ -539,6 +549,8 @@ class TreeGrower:
         """Frontier histogram dispatch: Pallas on a real single chip,
         XLA one-hot contraction under meshes / CPU simulation."""
         L = self.num_leaves if num_leaves is None else num_leaves
+        if quant is not None and self.use_tiled:
+            return self._hist_kernel_q_tiled(leaf_id, slots, quant)
         if quant is not None and self.use_quant_otf:
             return self._hist_kernel_q_otf(leaf_id, slots, L, quant)
         if self.use_pre_ohb:
@@ -623,12 +635,21 @@ class TreeGrower:
                 # block=2048 measured fastest on v5e (4096 fits scoped
                 # VMEM for 1-strip but benched 16% slower — the DMA
                 # pipeline prefers the finer granularity)
-                h, leaf2 = compute_group_histograms_fused(
-                    ohb, self.binsT, wT, scales, st.leaf_id,
-                    st.route_tab, rights, max_group_bin=B,
-                    block=self.pallas_block, strips=strips, quant=q,
-                    interpret=self._interp, pack=self.ohb_pack,
-                    num_groups=self.num_groups)
+                if self.use_tiled:
+                    from ..ops.histogram import \
+                        compute_group_histograms_fused_tiled
+                    h, leaf2 = compute_group_histograms_fused_tiled(
+                        self.binsT, wT, scales, st.leaf_id,
+                        st.route_tab, rights, max_group_bin=B,
+                        block=self.pallas_block, strips=strips,
+                        interpret=self._interp)
+                else:
+                    h, leaf2 = compute_group_histograms_fused(
+                        ohb, self.binsT, wT, scales, st.leaf_id,
+                        st.route_tab, rights, max_group_bin=B,
+                        block=self.pallas_block, strips=strips, quant=q,
+                        interpret=self._interp, pack=self.ohb_pack,
+                        num_groups=self.num_groups)
                 cap = strips * PACKED_STRIP
                 if cap >= W:
                     return h[:W], leaf2
@@ -645,6 +666,30 @@ class TreeGrower:
             k <= PACKED_STRIP, run(1),
             lambda _: jax.lax.cond(k <= 2 * PACKED_STRIP, run(2), run(3),
                                    None), None)
+
+    # ------------------------------------------------------------------
+    def _hist_kernel_q_tiled(self, leaf_id, slots, quant):
+        """Tiled-iota dispatch (quant weights arrive TRANSPOSED (3, N)):
+        the one-hot is rebuilt in VMEM from the transposed packed bins
+        at the narrowest lane packing covering the frontier."""
+        from ..ops.histogram import compute_group_histograms_q_tiled
+        wT, scales = quant
+        B = self.max_group_bin
+
+        def full(_):  # pragma: no cover — frontier is capped at 126
+            return compute_group_histograms_pallas_q(
+                self.bins, wT.T, scales, leaf_id,
+                num_leaves=self.num_leaves, max_group_bin=B,
+                block=self.pallas_block, slots=slots)
+
+        def run_packed(strips):
+            return compute_group_histograms_q_tiled(
+                self.binsT, wT, scales, leaf_id, slots,
+                max_group_bin=B, block=self.pallas_block, strips=strips,
+                interpret=self._interp)
+
+        return self._packed_dispatch(full, run_packed, slots,
+                                     slots.shape[0])
 
     # ------------------------------------------------------------------
     def _hist_kernel_q_otf(self, leaf_id, slots, L, quant):
@@ -811,8 +856,8 @@ class TreeGrower:
             # quantization (one scale per channel) happens once here
             quant = (quantize_gradients(grad, hess, counts)
                      if self.use_quant else None)
-            if quant is not None and self.use_fused:
-                # the fused kernel streams weights lane-major
+            if quant is not None and (self.use_fused or self.use_tiled):
+                # the fused/tiled kernels stream weights lane-major
                 quant = (quant[0].T, quant[1])          # (3, N)
 
             def body_fn(st):

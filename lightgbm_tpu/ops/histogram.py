@@ -841,6 +841,94 @@ def _unpack_strip_channels(out: jax.Array, strips: int, num_groups: int,
     return jnp.transpose(hist, (1, 2, 3, 0))
 
 
+def _hist_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, slots_ref,
+                              out_ref, *, strip, strips, max_group_bin,
+                              num_groups):
+    """Fast on-the-fly int8 kernel: the bin one-hot is rebuilt in VMEM
+    per 128-lane TILE by a single iota compare — no expansion matmul.
+
+    The old q_packed rebuild route (bins @ E with a (G, G*B) constant)
+    is MXU-hostile: K = G = 28 pads to 128 (4.6x wasted systolic rows)
+    and runs bf16, making the rebuild several times the cost of the
+    histogram dot itself.  Here everything is TRANSPOSED (the fused
+    kernel's Mosaic-friendly orientation: per-row scalars are (1, C)
+    lane vectors, one-hots are built (rows, C) by broadcasting an iota
+    COLUMN against (1, C) rows — sublane broadcasts, no cross-lane
+    shuffles).  Each one-hot tile packs ``per_tile = 128 // B`` groups
+    as SUBLANE ranges; the tile is ``target == sublane_iota`` with
+    ``target`` selecting the owning group's bins row offset by k*B —
+    ~3 VPU ops/element.  Output rows follow the tile layout; the
+    wrapper reshuffles to (slot, G, B, 3)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    c = binsT_ref.shape[1]
+    b = max_group_bin
+    per_tile = max(1, 128 // b)
+    m_pad = 128 * strips
+
+    leaf = leafT_ref[:]                                  # (1, C) int32
+    w = wT_ref[:]                                        # (3, C) int32
+    slot_col = slots_ref[:]                              # (m_pad, 1)
+    ohl = slot_col == leaf                               # (m_pad, C)
+    riota = jax.lax.broadcasted_iota(jnp.int32, (m_pad, 1), 0) % 128
+    wl = jnp.where(riota < strip, w[0:1, :],
+                   jnp.where(riota < 2 * strip, w[1:2, :], w[2:3, :]))
+    lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
+
+    binb = binsT_ref[:].astype(jnp.int32)                # (G, C)
+    _tiled_onehot_dots(lhs, binb, out_ref, max_group_bin=b,
+                       num_groups=num_groups)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_group_bin", "block", "strips",
+                              "interpret"))
+def compute_group_histograms_q_tiled(
+        binsT: jax.Array, wT: jax.Array, scales: jax.Array,
+        leaf_id: jax.Array, slots: jax.Array, *, max_group_bin: int,
+        block: int = 2048, strips: int = 1,
+        interpret: bool = False) -> jax.Array:
+    """Tiled-iota on-the-fly int8 histogram: same contract as
+    :func:`compute_group_histograms_q_packed` but takes TRANSPOSED
+    inputs (binsT (G, N) uint8, wT (3, N) int32 quantized).  ``slots``
+    holds at most strips*PACKED_STRIP valid entries; returns
+    (strips*PACKED_STRIP, G, B, 3) following (padded) ``slots`` order."""
+    num_groups = binsT.shape[0]
+    b = max_group_bin
+    per_tile = max(1, 128 // b)
+    tile_w = 128 if b <= 128 else _round_up(b, 128)
+    num_tiles = (num_groups + per_tile - 1) // per_tile
+    m_pad = 128 * strips
+    slot_col = _pack_slot_tiles(slots, strips)[:, None]  # (m_pad, 1)
+    kern = functools.partial(_hist_kernel_body_q_tiled, strip=PACKED_STRIP,
+                             strips=strips, max_group_bin=b,
+                             num_groups=num_groups)
+    n = binsT.shape[1]
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((3, block), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec(slot_col.shape, lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((m_pad, num_tiles * tile_w),
+                               lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, num_tiles * tile_w),
+                                       jnp.int32),
+        interpret=interpret,
+    )(binsT, wT, leaf_id[None, :], slot_col)
+    hist = _tiled_out_to_hist(out, strips, num_groups, b)
+    return hist.astype(jnp.float32) * scales[None, None, None, :]
+
+
 @functools.partial(
     jax.jit, static_argnames=("max_group_bin", "block", "strips", "quant",
                               "interpret", "pack", "num_groups"))
@@ -878,6 +966,92 @@ def compute_group_histograms_pre_packed(
     return hist
 
 
+def _route_prologue_T(binb, leaf, routeT, *, num_groups, nb):
+    """Shared transposed routing prologue of the fused kernels: apply
+    the pending per-leaf route table to a block's rows.  ``binb`` is
+    the (G, C) int32 bins block, ``leaf`` the (1, C) int32 leaf ids,
+    ``routeT`` the (K, Lpad) transposed route table in VMEM.  Returns
+    the (1, C) post-route leaf ids.
+
+    This is the in-kernel transposed form of ops/partition.py
+    route_rows — see the NOTE there: any semantic change MUST land in
+    both places (tests/test_histogram_kernel.py pins them together)."""
+    c = leaf.shape[1]
+    l_pad = routeT.shape[1]
+    liota = jax.lax.broadcasted_iota(jnp.int32, (l_pad, c), 0)
+    ohl_route = (liota == leaf).astype(jnp.bfloat16)     # (Lpad, C)
+    scal = jax.lax.dot_general(                          # (K, C) f32
+        routeT.astype(jnp.bfloat16), ohl_route,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    def irow(k):
+        return scal[k:k + 1, :].astype(jnp.int32)        # (1, C)
+
+    grp = irow(0) * 256 + irow(1)
+    thr = irow(2)
+    dleft = irow(3)
+    mtype = irow(4)
+    dbin = irow(5)
+    nbin = irow(6)
+    iscat = scal[7:8, :] > 0.5
+    rs = irow(8) * 256 + irow(9)
+    active = (scal[10:11, :] > 0.5) & (leaf >= 0)
+    lo, hi = irow(11), irow(12)
+    shift, oor = irow(13), irow(14)
+
+    giota = jax.lax.broadcasted_iota(jnp.int32, (num_groups, c), 0)
+    gsel = giota == grp                                  # (G, C)
+    gb = jnp.sum(jnp.where(gsel, binb, 0), axis=0,
+                 keepdims=True)                          # (1, C)
+    fbin = jnp.where((gb >= lo) & (gb < hi), gb - shift, oor)
+
+    is_nan_bin = fbin == nbin - 1
+    is_def_bin = fbin == dbin
+    cmp_left = (fbin <= thr).astype(jnp.int32)
+    num_left = jnp.where(
+        (mtype == MISSING_NAN) & is_nan_bin, dleft,
+        jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
+
+    byte_idx = fbin // 8
+    niota = jax.lax.broadcasted_iota(jnp.int32, (nb, c), 0)
+    bsel = niota == byte_idx
+    byte_val = jnp.sum(
+        jnp.where(bsel, scal[15:15 + nb, :], 0.0), axis=0,
+        keepdims=True).astype(jnp.int32)
+    cat_left = (byte_val >> (fbin % 8)) & 1
+
+    go_left = jnp.where(iscat, cat_left, num_left)
+    return jnp.where(active, jnp.where(go_left > 0, leaf, rs), leaf)
+
+
+def _tiled_onehot_dots(lhs, binb, out_ref, *, max_group_bin, num_groups):
+    """Shared tiled-iota histogram accumulate: rebuild the bin one-hot
+    per 128-lane tile from the (G, C) int32 bins block and dot ``lhs``
+    ((m_pad, C) int8) into the tile's output slice.  See
+    _hist_kernel_body_q_tiled for the layout contract."""
+    b = max_group_bin
+    c = binb.shape[1]
+    per_tile = max(1, 128 // b)
+    tile_w = 128 if b <= 128 else _round_up(b, 128)
+    siota = jax.lax.broadcasted_iota(jnp.int32, (tile_w, c), 0)
+    num_tiles = (num_groups + per_tile - 1) // per_tile
+    for t in range(num_tiles):
+        g0 = t * per_tile
+        gs = min(per_tile, num_groups - g0)
+        # target[s, r] = bins[r, g0 + s // B] + (s // B) * B, so a
+        # single (target == siota) compare builds the whole tile
+        target = binb[g0:g0 + 1, :]
+        for k in range(1, gs):
+            target = jnp.where(siota < k * b, target,
+                               binb[g0 + k:g0 + k + 1, :] + k * b)
+        if gs * b < tile_w:
+            target = jnp.where(siota < gs * b, target, -1)
+        oh = (target == siota).astype(jnp.int8)          # (tile_w, C)
+        out_ref[:, t * tile_w:(t + 1) * tile_w] += jax.lax.dot_general(
+            lhs, oh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+
 def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
                        slots_ref, hist_ref, leaf_out_ref, *, strip,
                        strips, quant, num_groups, nb, pack=1):
@@ -904,56 +1078,12 @@ def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
     def _init():
         hist_ref[:] = jnp.zeros_like(hist_ref)
 
-    c = ohb_ref.shape[0]
-    l_pad = routeT_ref.shape[1]
     m_pad = 128 * strips
 
-    # --- routing prologue -------------------------------------------
     leaf = leafT_ref[:]                                  # (1, C) int32
-    liota = jax.lax.broadcasted_iota(jnp.int32, (l_pad, c), 0)
-    ohl_route = (liota == leaf).astype(jnp.bfloat16)     # (Lpad, C)
-    scal = jax.lax.dot_general(                          # (K, C) f32
-        routeT_ref[:].astype(jnp.bfloat16), ohl_route,
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-
-    def irow(k):
-        return scal[k:k + 1, :].astype(jnp.int32)        # (1, C)
-
-    grp = irow(0) * 256 + irow(1)
-    thr = irow(2)
-    dleft = irow(3)
-    mtype = irow(4)
-    dbin = irow(5)
-    nbin = irow(6)
-    iscat = scal[7:8, :] > 0.5
-    rs = irow(8) * 256 + irow(9)
-    active = (scal[10:11, :] > 0.5) & (leaf >= 0)
-    lo, hi = irow(11), irow(12)
-    shift, oor = irow(13), irow(14)
-
-    giota = jax.lax.broadcasted_iota(jnp.int32, (num_groups, c), 0)
-    gsel = giota == grp                                  # (G, C)
-    gb = jnp.sum(jnp.where(gsel, binsT_ref[:].astype(jnp.int32), 0),
-                 axis=0, keepdims=True)                  # (1, C)
-    fbin = jnp.where((gb >= lo) & (gb < hi), gb - shift, oor)
-
-    is_nan_bin = fbin == nbin - 1
-    is_def_bin = fbin == dbin
-    cmp_left = (fbin <= thr).astype(jnp.int32)
-    num_left = jnp.where(
-        (mtype == MISSING_NAN) & is_nan_bin, dleft,
-        jnp.where((mtype == MISSING_ZERO) & is_def_bin, dleft, cmp_left))
-
-    byte_idx = fbin // 8
-    niota = jax.lax.broadcasted_iota(jnp.int32, (nb, c), 0)
-    bsel = niota == byte_idx
-    byte_val = jnp.sum(
-        jnp.where(bsel, scal[15:15 + nb, :], 0.0), axis=0,
-        keepdims=True).astype(jnp.int32)
-    cat_left = (byte_val >> (fbin % 8)) & 1
-
-    go_left = jnp.where(iscat, cat_left, num_left)
-    new_leaf = jnp.where(active, jnp.where(go_left > 0, leaf, rs), leaf)
+    new_leaf = _route_prologue_T(binsT_ref[:].astype(jnp.int32), leaf,
+                                 routeT_ref[:], num_groups=num_groups,
+                                 nb=nb)
     leaf_out_ref[:] = new_leaf
 
     # --- histogram (channel-packed lanes along ROWS) ----------------
@@ -1057,6 +1187,116 @@ def compute_group_histograms_fused(
     if quant:
         out = out * scales[None, None, None, :]
     return out, leaf_out[0]
+
+
+def _fused_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, routeT_ref,
+                               slots_ref, hist_ref, leaf_out_ref, *,
+                               strip, strips, num_groups, nb,
+                               max_group_bin):
+    """Fused route + tiled-iota histogram: the pending route table is
+    applied to the block's rows, then the histogram accumulates from a
+    one-hot rebuilt per 128-lane tile in VMEM — HBM traffic is just the
+    TRANSPOSED packed bins (~G bytes/row) + weights.  Replaces the
+    streamed-one-hot fused kernel wherever quantized training runs:
+    same per-pass speed (the dot floors both) with no multi-GB resident
+    one-hot, no precompute, and no HBM budget gating.
+
+    Routing prologue is the _fused_kernel_body one (see
+    ops/partition.py route_rows for the semantics contract)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    b = max_group_bin
+    m_pad = 128 * strips
+
+    leaf = leafT_ref[:]                                  # (1, C) int32
+    binb = binsT_ref[:].astype(jnp.int32)                # (G, C)
+    new_leaf = _route_prologue_T(binb, leaf, routeT_ref[:],
+                                 num_groups=num_groups, nb=nb)
+    leaf_out_ref[:] = new_leaf
+
+    slot_col = slots_ref[:]                              # (m_pad, 1)
+    ohl = slot_col == new_leaf                           # (m_pad, C)
+    riota = jax.lax.broadcasted_iota(jnp.int32, (m_pad, 1), 0) % 128
+    w = wT_ref[:]                                        # (3, C) int32
+    wl = jnp.where(riota < strip, w[0:1, :],
+                   jnp.where(riota < 2 * strip, w[1:2, :], w[2:3, :]))
+    lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
+    _tiled_onehot_dots(lhs, binb, hist_ref, max_group_bin=b,
+                       num_groups=num_groups)
+
+
+def _tiled_out_to_hist(out: jax.Array, strips: int, num_groups: int,
+                       max_group_bin: int) -> jax.Array:
+    """(m_pad, num_tiles*tile_w) tiled kernel accumulator ->
+    (strips*PACKED_STRIP, G, B, 3) float32 (pre-scale)."""
+    b = max_group_bin
+    per_tile = max(1, 128 // b)
+    tile_w = 128 if b <= 128 else _round_up(b, 128)
+    num_tiles = (num_groups + per_tile - 1) // per_tile
+    m_pad = out.shape[0]
+    tiles = out.reshape(m_pad, num_tiles, tile_w)[:, :, :per_tile * b]
+    full = tiles.reshape(m_pad, num_tiles * per_tile, b)[:, :num_groups]
+    return _unpack_strip_channels(
+        full.reshape(m_pad, num_groups * b), strips, num_groups, b)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_group_bin", "block", "strips",
+                              "interpret"))
+def compute_group_histograms_fused_tiled(
+        binsT: jax.Array, wT: jax.Array, scales: jax.Array,
+        leaf_id: jax.Array, route_tab: jax.Array, slots: jax.Array, *,
+        max_group_bin: int, block: int = 2048, strips: int = 1,
+        interpret: bool = False):
+    """Fused route + tiled-iota int8 histogram: same contract as
+    :func:`compute_group_histograms_fused` minus the ``ohb`` operand —
+    the one-hot is rebuilt in VMEM from ``binsT``.  Quantized path only
+    (wT is the (3, N) int32 quantized weights)."""
+    num_groups = binsT.shape[0]
+    b = max_group_bin
+    per_tile = max(1, 128 // b)
+    tile_w = 128 if b <= 128 else _round_up(b, 128)
+    num_tiles = (num_groups + per_tile - 1) // per_tile
+    n = binsT.shape[1]
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    slot_col = _pack_slot_tiles(slots, strips)[:, None]  # (m_pad, 1)
+
+    L, K = route_tab.shape
+    l_pad = max(128, ((L + 127) // 128) * 128)
+    routeT = jnp.zeros((K, l_pad), jnp.float32).at[:, :L].set(route_tab.T)
+    m_pad = 128 * strips
+
+    kern = functools.partial(_fused_kernel_body_q_tiled, strip=PACKED_STRIP,
+                             strips=strips, num_groups=num_groups,
+                             nb=K - 15, max_group_bin=b)
+    out, leaf_out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((3, block), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec(routeT.shape, lambda i: (0, 0)),
+            pl.BlockSpec(slot_col.shape, lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m_pad, num_tiles * tile_w), lambda i: (0, 0)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, num_tiles * tile_w), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+        ],
+        interpret=interpret,
+    )(binsT, wT, leaf_id[None, :], routeT, slot_col)
+    hist = _tiled_out_to_hist(out, strips, num_groups, b).astype(
+        jnp.float32) * scales[None, None, None, :]
+    return hist, leaf_out[0]
 
 
 def expand_feature_histograms(group_hist: jax.Array, bin_map: jax.Array,

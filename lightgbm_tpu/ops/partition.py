@@ -99,11 +99,12 @@ def route_rows(rows, leaf_id, gb, with_decision=False):
     bin of the chosen group.  Returns the updated leaf id (plus the
     went-right mask when ``with_decision``).
 
-    NOTE: ops/histogram.py _fused_kernel_body carries a TRANSPOSED
-    duplicate of this logic (scalars live as (K, C) rows there; Mosaic
-    can't share this row-orientation code) — any semantic change here
-    MUST be mirrored there; tests/test_histogram_kernel.py's fused
-    parity test pins the two together."""
+    NOTE: ops/histogram.py _route_prologue_T is the TRANSPOSED in-kernel
+    duplicate of this logic, shared by every fused Pallas kernel
+    (scalars live as (K, C) rows there; Mosaic can't share this
+    row-orientation code) — any semantic change here MUST be mirrored
+    there; tests/test_histogram_kernel.py's fused parity test pins the
+    two together."""
     nb = rows.shape[-1] - ROUTE_FIXED_COLS
 
     def icol(i):

@@ -1,0 +1,117 @@
+"""Microbench: row-gather bandwidth on TPU for the leaf-partition design.
+
+Question: can we stream ONLY the frontier rows of the packed one-hot by
+gathering them into a staging buffer?  The answer decides the round-4
+leaf-partitioned histogram architecture.
+
+Methodology (tpu-bench-methodology memory note): jax.block_until_ready
+does NOT sync on the axon backend — sync via a tiny D2H slice; cancel
+the ~65 ms dispatch overhead by differencing two loop counts.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NPAD = 1_048_576
+W = 512          # packed one-hot bytes/row at bench shape (pack=4)
+L1, L2 = 20, 60
+
+
+def loop_time(call, *args):
+    """Per-iteration seconds via two-loop-count differencing."""
+    times = {}
+    for loops in (L1, L2):
+        @jax.jit
+        def many(*a):
+            def body(i, carry):
+                return call(carry, i, *a)
+            return jax.lax.fori_loop(0, loops, body, jnp.int32(0))
+        out = many(*args)
+        _ = np.asarray(out)           # D2H sync (block_until_ready lies)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _ = np.asarray(many(*args))
+            best = min(best, time.perf_counter() - t0)
+        times[loops] = best
+    return (times[L2] - times[L1]) / (L2 - L1)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    ohb = jnp.asarray(rng.randint(0, 127, size=(NPAD, W), dtype=np.int8))
+    leaf = jnp.asarray(rng.randint(0, 256, size=NPAD, dtype=np.int32))
+
+    # full-stream yardstick
+    def g_sum(carry, i, ohb):
+        return (jnp.sum(ohb, dtype=jnp.int32) + carry) & 1
+
+    t = loop_time(g_sum, ohb)
+    print(f"full stream sum {NPAD} rows: {t*1e3:.3f} ms  "
+          f"read_bw={NPAD*W/t/1e9:.0f} GB/s")
+
+    for frac in (0.5, 0.25, 0.05):
+        R = int(NPAD * frac)
+        idx_np = np.sort(rng.choice(NPAD, size=R, replace=False))
+        idx = jnp.asarray(idx_np.astype(np.int32))
+
+        def g_take(carry, i, ohb, idx):
+            g = jnp.take(ohb, idx + (carry & 1), axis=0, mode="clip")
+            return jnp.sum(g, dtype=jnp.int32) & 1
+
+        t = loop_time(g_take, ohb, idx)
+        bw = (R * W) / t / 1e9
+        print(f"take+sum frac={frac} ({R} rows): {t*1e3:.3f} ms  "
+              f"read_bw={bw:.0f} GB/s")
+
+    # contiguous best case via dynamic_slice
+    R = NPAD // 2
+
+    def g_dslice(carry, i, ohb):
+        g = jax.lax.dynamic_slice(ohb, (carry & 1, 0), (R, W))
+        return jnp.sum(g, dtype=jnp.int32) & 1
+
+    t = loop_time(g_dslice, ohb)
+    print(f"dynamic_slice+sum {R} rows: {t*1e3:.3f} ms  "
+          f"read_bw={R*W/t/1e9:.0f} GB/s")
+
+    # compaction index build
+    def g_idx(carry, i, leaf):
+        m = (leaf >= carry & 1) & (leaf < 128)
+        pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+        out = jnp.full(NPAD, NPAD - 1, jnp.int32)
+        out = out.at[jnp.where(m, pos, NPAD - 1)].set(
+            jnp.arange(NPAD, dtype=jnp.int32), mode="drop")
+        return (out[0] + out[NPAD // 2]) & 1
+
+    t = loop_time(g_idx, leaf)
+    print(f"compaction index (cumsum+scatter): {t*1e3:.3f} ms")
+
+    # staged: gather -> materialized buffer -> reread (sum)
+    R = NPAD // 2
+    idx = jnp.asarray(np.sort(rng.choice(NPAD, size=R, replace=False))
+                      .astype(np.int32))
+
+    def g_staged(carry, i, ohb, idx):
+        g = jnp.take(ohb, idx + (carry & 1), axis=0, mode="clip")
+        g = jax.lax.optimization_barrier(g)
+        return jnp.sum(g, dtype=jnp.int32) & 1
+
+    t = loop_time(g_staged, ohb, idx)
+    print(f"staged gather {R} rows: {t*1e3:.3f} ms  "
+          f"eff_bw={R*W*2/t/1e9:.0f} GB/s")
+
+    # leaf_id row scatter (the routing writeback): update leaf at idx
+    def g_scatter(carry, i, leaf, idx):
+        nl = leaf.at[idx].add(carry & 1, mode="drop")
+        return nl[0] & 1
+
+    t = loop_time(g_scatter, leaf, idx)
+    print(f"leaf scatter {R} rows: {t*1e3:.3f} ms")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

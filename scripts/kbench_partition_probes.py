@@ -1,0 +1,211 @@
+"""Feasibility probes for the leaf-partitioned layout (round 4).
+
+Validates, on the real chip, the Mosaic capabilities the partition
+design rests on:
+  P1  dynamic-sublane accumulate:  out_ref[pl.ds(off, 8), :] += x
+  P2  masked grid with repeated index_map entries — per-step cost of
+      skipped steps (same block index => no DMA refetch)
+  P3  manual async_copy VMEM->HBM at a DYNAMIC 128-aligned column
+      offset of a transposed (R, Ncap) int8 ref
+  P4  in-kernel lane cumsum + one-hot permutation matmul (compaction)
+Each probe prints OK/FAIL + a rough time so the design can pick block
+sizes.  D2H-sync timing (block_until_ready lies on axon).
+"""
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def sync(x):
+    return np.asarray(x)
+
+
+# ----------------------------------------------------------------- P1
+def probe_dyn_sublane():
+    C = 256
+
+    def body(off_ref, x_ref, out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        off = off_ref[0]
+        out_ref[pl.ds(off, 8), :] += x_ref[:]
+
+    x = jnp.ones((8, 128), jnp.int32)
+    off = jnp.asarray([24], jnp.int32)
+    try:
+        out = pl.pallas_call(
+            body,
+            grid=(4,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec((8, 128), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((128, 128), jnp.int32),
+        )(off, x)
+        got = sync(out)
+        ok = (got[24:32] == 4).all() and got[:24].sum() == 0 \
+            and got[32:].sum() == 0
+        print(f"P1 dynamic-sublane accumulate: {'OK' if ok else 'WRONG'}")
+        return ok
+    except Exception as e:
+        print(f"P1 dynamic-sublane accumulate: FAIL ({type(e).__name__}: "
+              f"{str(e)[:200]})")
+        return False
+
+
+# ----------------------------------------------------------------- P2
+def probe_masked_grid():
+    C = 1024
+    N = 1_048_576
+    nblocks = N // C
+
+    def body(nreal_ref, idx_ref, x_ref, out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        @pl.when(i < nreal_ref[0])
+        def _():
+            out_ref[:] += jnp.sum(x_ref[:].astype(jnp.int32))
+
+    x = jnp.ones((N // 128, 128), jnp.int8)
+
+    def run(nreal, idx_np):
+        idx = jnp.asarray(idx_np, jnp.int32)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(nblocks,),
+            in_specs=[pl.BlockSpec((C // 128, 128),
+                                   lambda i, nreal, idx: (idx[i], 0))],
+            out_specs=pl.BlockSpec((1, 1), lambda i, nreal, idx: (0, 0)),
+        )
+        f = pl.pallas_call(
+            body, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32))
+
+        @jax.jit
+        def many(x, nreal, idx):
+            def step(k, acc):
+                return acc + f(nreal + k * 0, idx, x)[0, 0]
+            return jax.lax.fori_loop(0, 30, step, jnp.int32(0))
+
+        nreal_a = jnp.asarray([nreal], jnp.int32)
+        r = sync(many(x, nreal_a, idx))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sync(many(x, nreal_a, idx))
+            best = min(best, time.perf_counter() - t0)
+        return best / 30, r
+
+    # all real
+    idx_full = np.arange(nblocks)
+    t_full, r = run(nblocks, idx_full)
+    ok = r == 30 * N
+    # 1/16 real, tail repeats last real block
+    nreal = nblocks // 16
+    idx_sparse = np.concatenate(
+        [np.arange(nreal), np.full(nblocks - nreal, nreal - 1)])
+    t_sparse, r2 = run(nreal, idx_sparse)
+    ok = ok and r2 == 30 * nreal * C
+    per_skip = (t_sparse - t_full * nreal / nblocks) / (nblocks - nreal)
+    print(f"P2 masked grid: {'OK' if ok else 'WRONG'} full={t_full*1e3:.3f} "
+          f"ms, 1/16={t_sparse*1e3:.3f} ms, ~{per_skip*1e9:.0f} ns/skipped "
+          f"step ({nblocks} blocks of {C})")
+    return ok
+
+
+# ----------------------------------------------------------------- P3
+def probe_dyn_copy():
+    R, NCAP, C = 32, 8192, 512
+
+    def body(off_ref, x_ref, out_ref, sem):
+        i = pl.program_id(0)
+        off = off_ref[0]
+        cp = pltpu.make_async_copy(
+            x_ref, out_ref.at[:, pl.ds(off, C)], sem)
+        cp.start()
+        cp.wait()
+
+    x = jnp.arange(R * C, dtype=jnp.int32).reshape(R, C).astype(jnp.int8)
+    off = jnp.asarray([1280], jnp.int32)
+    try:
+        out = pl.pallas_call(
+            body,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            out_shape=jax.ShapeDtypeStruct((R, NCAP), jnp.int8),
+            scratch_shapes=[pltpu.SemaphoreType.DMA],
+        )(off, x)
+        got = sync(out)
+        want = np.asarray(x)
+        ok = (got[:, 1280:1280 + C] == want).all()
+        print(f"P3 dyn-offset async copy (HBM->HBM cols): "
+              f"{'OK' if ok else 'WRONG'}")
+        return ok
+    except Exception as e:
+        print(f"P3 dyn-offset async copy: FAIL ({type(e).__name__}: "
+              f"{str(e)[:200]})")
+        return False
+
+
+# ----------------------------------------------------------------- P4
+def probe_compact_matmul():
+    C = 1024
+    R = 64
+
+    def body(x_ref, mask_ref, out_ref, cnt_ref):
+        m = mask_ref[:]                                   # (1, C) int32
+        pos = jnp.cumsum(m, axis=1) - m                   # exclusive
+        liota = jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+        # P[s, d] = 1 iff dest(s) == d and mask[s]
+        P = ((pos[0, :, None] == liota[:, :]) &
+             (m[0, :, None] > 0)).astype(jnp.int8)
+        out_ref[:] = jax.lax.dot_general(
+            x_ref[:], P, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.int8)
+        cnt_ref[0, 0] = jnp.sum(m)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(-100, 100, (R, C)).astype(np.int8)
+    mask = (rng.rand(C) < 0.4).astype(np.int32)
+    try:
+        out, cnt = pl.pallas_call(
+            body,
+            in_specs=[pl.BlockSpec((R, C), lambda: (0, 0)),
+                      pl.BlockSpec((1, C), lambda: (0, 0))],
+            out_specs=[pl.BlockSpec((R, C), lambda: (0, 0)),
+                       pl.BlockSpec((1, 1), lambda: (0, 0),
+                                    memory_space=pltpu.SMEM)],
+            out_shape=[jax.ShapeDtypeStruct((R, C), jnp.int8),
+                       jax.ShapeDtypeStruct((1, 1), jnp.int32)],
+        )(jnp.asarray(x), jnp.asarray(mask)[None, :])
+        got = sync(out)
+        k = int(sync(cnt)[0, 0])
+        want = x[:, mask.astype(bool)]
+        ok = k == mask.sum() and (got[:, :k] == want).all()
+        print(f"P4 cumsum+permute-matmul compaction: "
+              f"{'OK' if ok else 'WRONG'} (k={k})")
+        return ok
+    except Exception as e:
+        print(f"P4 compaction: FAIL ({type(e).__name__}: {str(e)[:200]})")
+        return False
+
+
+if __name__ == "__main__":
+    r = [probe_dyn_sublane(), probe_masked_grid(), probe_dyn_copy(),
+         probe_compact_matmul()]
+    sys.exit(0 if all(r) else 1)

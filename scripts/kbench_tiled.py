@@ -1,0 +1,118 @@
+"""Microbench: tiled-iota on-the-fly rebuild vs existing hist kernels.
+
+Gate for the round-4 leaf-partitioned design: the partitioned layout
+moves only narrow per-row data (bins, weights) and rebuilds the one-hot
+in VMEM — viable only if the rebuild approaches the MXU floor
+(~1.34 ms/pass at 1M x 28 x 63) instead of q_packed's rebuild cost.
+
+D2H-sync timing (block_until_ready lies on axon), two loop counts to
+cancel dispatch overhead.  All device arrays are threaded as jit
+ARGUMENTS (closures inline as MLIR constants and blow the remote
+compile request limit).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import (
+    PACKED_STRIP, compute_group_histograms_pre_packed,
+    compute_group_histograms_q_packed, compute_group_histograms_q_tiled,
+    precompute_bin_onehot_packed)
+
+L1, L2 = 20, 100
+
+
+def loop_time(call, *args):
+    times = {}
+    for loops in (L1, L2):
+        @jax.jit
+        def many(*a):
+            def body(i, carry):
+                acc, s = carry
+                h = call(s, *a)
+                v = h[0, 0, 0, 0]
+                bump = jnp.where(jnp.isfinite(v), 0, 1).astype(jnp.int32)
+                return acc + v, jnp.roll(s + bump, i)
+            out, _ = jax.lax.fori_loop(
+                0, loops, body,
+                (jnp.float32(0.0),
+                 jnp.arange(PACKED_STRIP, dtype=jnp.int32)))
+            return out
+        _ = np.asarray(many(*args))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _ = np.asarray(many(*args))
+            best = min(best, time.perf_counter() - t0)
+        times[loops] = best
+    return (times[L2] - times[L1]) / (L2 - L1)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_003_520
+    g, b = 28, 63
+    block = int(os.environ.get("BLOCK", 2048))
+    rng = np.random.RandomState(0)
+    bins_np = rng.randint(0, b, (n, g), dtype=np.uint8)
+    bins = jnp.asarray(bins_np)
+    binsT = jnp.asarray(bins_np.T)
+    leaf = jnp.asarray(rng.randint(0, PACKED_STRIP, n, dtype=np.int32))
+    wq_np = np.stack([rng.randint(-127, 128, n), rng.randint(0, 128, n),
+                      np.ones(n)], axis=1).astype(np.int32)
+    wq = jnp.asarray(wq_np)
+    wT = jnp.asarray(wq_np.T)
+    scales = jnp.ones(3, jnp.float32)
+    slots = jnp.arange(PACKED_STRIP, dtype=jnp.int32)
+
+    # correctness first
+    h_ref = np.asarray(compute_group_histograms_q_packed(
+        bins, wq, scales, leaf, slots, max_group_bin=b, block=block,
+        strips=1))
+    h_new = np.asarray(compute_group_histograms_q_tiled(
+        binsT, wT, scales, leaf, slots, max_group_bin=b, block=block,
+        strips=1))
+    err = np.abs(h_new - h_ref).max()
+    assert err == 0.0, f"tiled mismatch {err}"
+    print("correctness OK")
+
+    t = loop_time(
+        lambda s, bT, w, lf: compute_group_histograms_q_tiled(
+            bT, w, scales, lf, s, max_group_bin=b, block=block, strips=1),
+        binsT, wT, leaf)
+    print(f"q_tiled  (otf, new): {t*1e3:.2f} ms/pass")
+
+    t = loop_time(
+        lambda s, bn, w, lf: compute_group_histograms_q_packed(
+            bn, w, scales, lf, s, max_group_bin=b, block=block, strips=1),
+        bins, wq, leaf)
+    print(f"q_packed (otf, old): {t*1e3:.2f} ms/pass")
+
+    ohb = precompute_bin_onehot_packed(bins, max_group_bin=b, pack=4)
+    t = loop_time(
+        lambda s, o, w, lf: compute_group_histograms_pre_packed(
+            o, w, scales, lf, s, max_group_bin=b, block=block, strips=1,
+            quant=True, pack=4, num_groups=g),
+        ohb, wq, leaf)
+    print(f"pre_packed pack=4 (streamed): {t*1e3:.2f} ms/pass")
+
+    for strips in (2, 3):
+        s0 = jnp.arange(PACKED_STRIP * strips, dtype=jnp.int32)
+
+        def call(s, bT, w, lf, st=strips, s0=s0):
+            return compute_group_histograms_q_tiled(
+                bT, w, scales, lf, s0 + s[0] * 0, max_group_bin=b,
+                block=block, strips=st)
+
+        t = loop_time(call, binsT, wT, leaf)
+        print(f"q_tiled strips={strips}: {t*1e3:.2f} ms/pass")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

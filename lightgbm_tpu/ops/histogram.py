@@ -865,22 +865,10 @@ def _hist_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, slots_ref,
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    c = binsT_ref.shape[1]
-    b = max_group_bin
-    per_tile = max(1, 128 // b)
-    m_pad = 128 * strips
-
-    leaf = leafT_ref[:]                                  # (1, C) int32
-    w = wT_ref[:]                                        # (3, C) int32
-    slot_col = slots_ref[:]                              # (m_pad, 1)
-    ohl = slot_col == leaf                               # (m_pad, C)
-    riota = jax.lax.broadcasted_iota(jnp.int32, (m_pad, 1), 0) % 128
-    wl = jnp.where(riota < strip, w[0:1, :],
-                   jnp.where(riota < 2 * strip, w[1:2, :], w[2:3, :]))
-    lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
-
+    lhs = _tiled_lhs(leafT_ref[:], wT_ref[:], slots_ref[:], strip=strip,
+                     strips=strips)
     binb = binsT_ref[:].astype(jnp.int32)                # (G, C)
-    _tiled_onehot_dots(lhs, binb, out_ref, max_group_bin=b,
+    _tiled_onehot_dots(lhs, binb, out_ref, max_group_bin=max_group_bin,
                        num_groups=num_groups)
 
 
@@ -1022,6 +1010,20 @@ def _route_prologue_T(binb, leaf, routeT, *, num_groups, nb):
 
     go_left = jnp.where(iscat, cat_left, num_left)
     return jnp.where(active, jnp.where(go_left > 0, leaf, rs), leaf)
+
+
+def _tiled_lhs(leaf, w, slot_col, *, strip, strips):
+    """Shared channel-packed lhs of the tiled kernels: slot one-hot ×
+    strip-selected weight channel, int8 (m_pad, C).  ``leaf`` (1, C)
+    int32, ``w`` (3, C) int32 quantized weights, ``slot_col``
+    (m_pad, 1) from _pack_slot_tiles.  Layout contract pinned by
+    _pack_slot_tiles / _unpack_strip_channels."""
+    m_pad = 128 * strips
+    ohl = slot_col == leaf                               # (m_pad, C)
+    riota = jax.lax.broadcasted_iota(jnp.int32, (m_pad, 1), 0) % 128
+    wl = jnp.where(riota < strip, w[0:1, :],
+                   jnp.where(riota < 2 * strip, w[1:2, :], w[2:3, :]))
+    return jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
 
 
 def _tiled_onehot_dots(lhs, binb, out_ref, *, max_group_bin, num_groups):
@@ -1209,23 +1211,15 @@ def _fused_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, routeT_ref,
     def _init():
         hist_ref[:] = jnp.zeros_like(hist_ref)
 
-    b = max_group_bin
-    m_pad = 128 * strips
-
     leaf = leafT_ref[:]                                  # (1, C) int32
     binb = binsT_ref[:].astype(jnp.int32)                # (G, C)
     new_leaf = _route_prologue_T(binb, leaf, routeT_ref[:],
                                  num_groups=num_groups, nb=nb)
     leaf_out_ref[:] = new_leaf
 
-    slot_col = slots_ref[:]                              # (m_pad, 1)
-    ohl = slot_col == new_leaf                           # (m_pad, C)
-    riota = jax.lax.broadcasted_iota(jnp.int32, (m_pad, 1), 0) % 128
-    w = wT_ref[:]                                        # (3, C) int32
-    wl = jnp.where(riota < strip, w[0:1, :],
-                   jnp.where(riota < 2 * strip, w[1:2, :], w[2:3, :]))
-    lhs = jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
-    _tiled_onehot_dots(lhs, binb, hist_ref, max_group_bin=b,
+    lhs = _tiled_lhs(new_leaf, wT_ref[:], slots_ref[:], strip=strip,
+                     strips=strips)
+    _tiled_onehot_dots(lhs, binb, hist_ref, max_group_bin=max_group_bin,
                        num_groups=num_groups)
 
 

@@ -1,0 +1,433 @@
+"""The leaf-partition Pallas kernel: split-leaf streams, routed and
+compacted into tile-aligned child spans.
+
+TPU redesign of DataPartition::Split
+(reference src/treelearner/data_partition.hpp:109-161).  One sequential
+grid walks the blocks of every leaf splitting this round (a prefetched
+step table maps grid steps to (parent, block)); each 128-column tile is
+routed (numeric split rules in feature-bin space), then left-going
+columns are compacted FORWARD from the left child's alloc start and
+right-going columns BACKWARD from the right child's alloc end — the
+backward fill makes write cursors independent of the (unknown until
+done) left count.  Compaction is a one-hot permutation matmul per tile
+(Mosaic has no dynamic lane gather/scatter; at 128-lane granularity
+with a 64-row carrier the matmul costs ~2*64*128*256 int8 ops per
+tile, ~25% of a histogram pass on the same columns).  Dead columns
+(alloc slack, tile padding) carry leaf = -1 and match nothing
+downstream — spans only need to COVER the live columns, so children
+need no intra-tile contiguity and no cross-parent coordination.
+
+Flushes accumulate full (R, 128) tiles into a double-buffered staging
+scratch DMA'd at dynamic tile offsets of the (T, R, 128) destination
+carrier (the paged-attention pattern; dynamic offsets on the MINOR dim
+crash Mosaic — scripts/kbench_probes2.py).
+
+Step table columns (all int32):
+  0 block      src block index (units of BT tiles); tail steps repeat
+               the previous block so the pipeline skips the refetch
+  1 first      1 = first step of its parent (reset stream state)
+  2 last       1 = last step of its parent (final flushes)
+  3 p_slot     parent leaf id ( == left child id)
+  4 p_rslot    right child leaf id
+  5 grp        split feature's group row
+  6 thr        bin threshold
+  7 dleft      default_left
+  8 mtype      missing type (ops/partition.py constants)
+  9 dbin       default bin
+  10 nbin      feature num_bin
+  11 fb_lo 12 fb_hi 13 fb_shift 14 fb_oor   group->feature bin affine
+  15 dstL_t0   left child alloc start tile
+  16 dstR_te   right child alloc end tile (exclusive)
+  17 active    0 = tail padding step
+  18 span_t0 19 span_te   parent's src span (tiles): block tiles
+               outside it are SKIPPED — stale bytes beyond a span can
+               alias any live slot id (unwritten alloc gaps, previous
+               trees' leftovers); span tiles themselves are always
+               fresh (fully written when the parent was created)
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .carrier import CARRIER_ROWS, TILE, carrier_row_map
+from .partition import MISSING_NAN, MISSING_ZERO
+
+BT = 16            # tiles per block (block = 2048 columns)
+STAGE = 8          # tiles per staging buffer flush
+
+# SMEM state slots
+_FILL_L, _FILL_R, _SN_L, _SN_R, _CUR_L, _CUR_R, _SEL_L, _SEL_R, \
+    _OUT_L, _OUT_R = range(10)
+
+NCOLS_TAB = 20
+
+
+def _partition_body(tab_ref, src_ref, dst_in_ref, dst_ref, pendL, pendR,
+                    stageL, stageR, smem, semL, semR, semres, *,
+                    num_groups, rm, debug=0):
+    del dst_in_ref  # aliased with dst_ref (same buffer)
+    i = pl.program_id(0)
+    active = tab_ref[i, 17] == 1
+    first = tab_ref[i, 1] == 1
+    last = tab_ref[i, 2] == 1
+    p_slot = tab_ref[i, 3]
+    p_rslot = tab_ref[i, 4]
+    grp = tab_ref[i, 5]
+    thr = tab_ref[i, 6]
+    dleft = tab_ref[i, 7]
+    mtype = tab_ref[i, 8]
+    dbin = tab_ref[i, 9]
+    nbin = tab_ref[i, 10]
+    fb_lo = tab_ref[i, 11]
+    fb_hi = tab_ref[i, 12]
+    fb_shift = tab_ref[i, 13]
+    fb_oor = tab_ref[i, 14]
+    dstL_t0 = tab_ref[i, 15]
+    dstR_te = tab_ref[i, 16]
+    span_t0 = tab_ref[i, 18]
+    span_te = tab_ref[i, 19]
+    blk = tab_ref[i, 0]
+
+    # dead-column pattern for final partial tiles: leaf rows -1, rest 0
+    # (built from iota — pallas kernels cannot capture array constants)
+    riota = jax.lax.broadcasted_iota(jnp.int32, (CARRIER_ROWS, TILE), 0)
+    # computed in int32 then cast: an i1-from-int32-compare select with
+    # int8 operands needs a replicated->tiled relayout Mosaic rejects
+    dead_tile = jnp.where(
+        riota == rm["leaf_lo"], -1,
+        jnp.where(riota == rm["leaf_hi"], -1, 0)).astype(jnp.int8)
+    liota = jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)
+    d_iota = jax.lax.broadcasted_iota(jnp.int32, (2 * TILE, TILE), 0)
+
+    @pl.when(active & first)
+    def _reset():
+        pendL[:] = jnp.zeros_like(pendL)
+        pendR[:] = jnp.zeros_like(pendR)
+        smem[_FILL_L] = 0
+        smem[_FILL_R] = 0
+        smem[_SN_L] = 0
+        smem[_SN_R] = 0
+        smem[_CUR_L] = dstL_t0
+        smem[_CUR_R] = dstR_te
+        smem[_SEL_L] = 0
+        smem[_SEL_R] = 0
+        # outstanding-DMA flags for the two stage buffers, packed as
+        # bit0/bit1 per side
+        smem[_OUT_L] = 0
+        smem[_OUT_R] = 0
+
+    def emit(side_is_l, tile_val):
+        """Side-dispatched staging append + flush (traced twice,
+        statically, once per side)."""
+        if side_is_l:
+            stage, sem = stageL, semL
+            k_sn, k_sel, k_cur, k_out = _SN_L, _SEL_L, _CUR_L, _OUT_L
+        else:
+            stage, sem = stageR, semR
+            k_sn, k_sel, k_cur, k_out = _SN_R, _SEL_R, _CUR_R, _OUT_R
+        sn = smem[k_sn]
+        sel = smem[k_sel]
+        slot = sn if side_is_l else STAGE - 1 - sn
+        stage[sel, pl.ds(slot, 1)] = tile_val[None]
+        smem[k_sn] = sn + 1
+
+        @pl.when(sn + 1 == STAGE)
+        def _flush():
+            cur = smem[k_cur]
+            t0 = cur if side_is_l else cur - STAGE
+            # reusing this buffer after the flip requires its previous
+            # DMA to have completed
+            out = smem[k_out]
+            nxt = 1 - sel
+
+            @pl.when((out & (1 << nxt)) != 0)
+            def _wait_prev():
+                pltpu.make_async_copy(
+                    stage.at[nxt], dst_ref.at[pl.ds(smem[k_cur], STAGE)],
+                    sem.at[nxt]).wait()
+            # (the wait target slice is irrelevant for wait(); the
+            # semaphore identifies the transfer)
+            cp = pltpu.make_async_copy(
+                stage.at[sel], dst_ref.at[pl.ds(t0, STAGE)], sem.at[sel])
+            cp.start()
+            # clear the waited buffer's bit, set ours (a stale bit
+            # would make the parent-end drain wait a second time on a
+            # semaphore with no pending signal -> deadlock/crash)
+            smem[k_out] = (out & ~(1 << nxt)) | (1 << sel)
+            smem[k_cur] = cur + STAGE if side_is_l else cur - STAGE
+            smem[k_sel] = nxt
+            smem[k_sn] = 0
+
+    def compact(tile_val, keep, pend, k_fill, side_is_l):
+        """Route one side's columns of a tile into its pending buffer.
+
+        Lane-oriented throughout (Mosaic rejects 1-lane dot outputs):
+        exclusive prefix sum by log-shift adds, then a (2C, 128) 0/1
+        destination matrix Q (Q[d, s] = dest[s]==d & keep[s]) built
+        from sublane-iota compares, contracted with the tile on the
+        int8 MXU.  Unfilled pending lanes stay 0 (the one-hot matmul
+        contributes nothing there); only the FINAL partial flush must
+        overwrite them with the dead pattern."""
+        x = keep.astype(jnp.int32)                       # (1, 128)
+        if debug == 2:       # compaction floor: dot into a fixed window
+            contrib0 = jax.lax.dot_general(
+                tile_val, jnp.broadcast_to(x, (2 * TILE, TILE))
+                .astype(jnp.int8), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            pend[:] = pend[:] + contrib0
+            return
+        incl = x
+        for k in (1, 2, 4, 8, 16, 32, 64):
+            shifted = jnp.roll(incl, k, axis=1)
+            incl = incl + jnp.where(liota >= k, shifted, 0)
+        pos = incl - x                                   # exclusive
+        fill = smem[k_fill]
+        dest = pos + fill                                # (1, 128)
+        q = ((jnp.broadcast_to(dest, (2 * TILE, TILE)) == d_iota)
+             & jnp.broadcast_to(keep, (2 * TILE, TILE))).astype(jnp.int8)
+        contrib = jax.lax.dot_general(                   # (R, 2C) i32
+            tile_val, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        pend[:] = pend[:] + contrib
+        k = jnp.sum(x)
+        newfill = fill + k
+
+        @pl.when(newfill >= TILE)
+        def _spill():
+            emit(side_is_l, pend[:, :TILE].astype(jnp.int8))
+            pend[:, :TILE] = pend[:, TILE:]
+            pend[:, TILE:] = jnp.zeros_like(pend[:, TILE:])
+            smem[k_fill] = newfill - TILE
+
+        @pl.when(newfill < TILE)
+        def _nosp():
+            smem[k_fill] = newfill
+
+    def _do_tile(j):
+            tile = src_ref[j]                            # (R, 128) i8
+            lo = tile[rm["leaf_lo"], :].astype(jnp.int32) & 255
+            hi = tile[rm["leaf_hi"], :].astype(jnp.int32)
+            leaf = (lo | (hi << 8))[None, :]             # (1, 128)
+            mask = leaf == p_slot
+            # chosen group's bin per column: masked sum over the bins
+            # rows (dynamic sublane reads need 8-alignment in Mosaic)
+            binb = tile[:num_groups, :].astype(jnp.int32) & 255
+            giota = jax.lax.broadcasted_iota(
+                jnp.int32, (num_groups, TILE), 0)
+            gb = jnp.sum(jnp.where(giota == grp, binb, 0), axis=0,
+                         keepdims=True)                  # (1, 128)
+            fbin = jnp.where((gb >= fb_lo) & (gb < fb_hi), gb - fb_shift,
+                             fb_oor)
+            is_nan_bin = fbin == nbin - 1
+            is_def_bin = fbin == dbin
+            cmp_left = (fbin <= thr).astype(jnp.int32)
+            dl = dleft
+            num_left = jnp.where(
+                (mtype == MISSING_NAN) & is_nan_bin, dl,
+                jnp.where((mtype == MISSING_ZERO) & is_def_bin, dl,
+                          cmp_left))
+            go_left = num_left > 0
+            keepL = mask & go_left
+            keepR = mask & ~go_left
+            # right-bound columns take the right child's leaf id
+            rlo = (p_rslot & 255).astype(jnp.int8)
+            rhi = (p_rslot >> 8).astype(jnp.int8)
+            tile_r = jnp.where(riota == rm["leaf_lo"], rlo,
+                               jnp.where(riota == rm["leaf_hi"], rhi,
+                                         tile))
+            if debug == 1:       # route-only floor: consume the masks
+                pendL[:1, :TILE] = pendL[:1, :TILE] + keepL.astype(
+                    jnp.int32)
+                pendR[:1, :TILE] = pendR[:1, :TILE] + keepR.astype(
+                    jnp.int32)
+            else:
+                compact(tile, keepL, pendL, _FILL_L, True)
+                compact(tile_r, keepR, pendR, _FILL_R, False)
+
+    @pl.when(active)
+    def _work():
+        for j in range(BT):
+            gt = blk * BT + j                # global tile index
+
+            @pl.when((gt >= span_t0) & (gt < span_te))
+            def _tile(j=j):
+                _do_tile(j)
+    @pl.when(active & last)
+    def _finalize():
+        # final partial pending tiles: lanes beyond fill carry zeros
+        # (which would read as live leaf 0) — overwrite with the dead
+        # pattern before emitting
+        lanes = jnp.broadcast_to(liota, (CARRIER_ROWS, TILE))
+
+        @pl.when(smem[_FILL_L] > 0)
+        def _():
+            tile = jnp.where(lanes >= smem[_FILL_L], dead_tile,
+                             pendL[:, :TILE].astype(jnp.int8))
+            emit(True, tile)
+
+        @pl.when(smem[_FILL_R] > 0)
+        def _():
+            tile = jnp.where(lanes >= smem[_FILL_R], dead_tile,
+                             pendR[:, :TILE].astype(jnp.int8))
+            emit(False, tile)
+
+        # residual staging (sn < STAGE tiles): single-tile sync DMAs
+        for side_is_l in (True, False):
+            if side_is_l:
+                stage, sem = stageL, semres
+                k_sn, k_sel, k_cur = _SN_L, _SEL_L, _CUR_L
+            else:
+                stage, sem = stageR, semres
+                k_sn, k_sel, k_cur = _SN_R, _SEL_R, _CUR_R
+            sn = smem[k_sn]
+            sel = smem[k_sel]
+            cur = smem[k_cur]
+            for s in range(STAGE):
+                @pl.when(s < sn)
+                def _(s=s, stage=stage, sel=sel, cur=cur, sem=sem,
+                      side_is_l=side_is_l):
+                    slot = s if side_is_l else STAGE - 1 - s
+                    dstt = cur + s if side_is_l else cur - 1 - s
+                    cp = pltpu.make_async_copy(
+                        stage.at[sel, pl.ds(slot, 1)],
+                        dst_ref.at[pl.ds(dstt, 1)], sem)
+                    cp.start()
+                    cp.wait()
+        # drain outstanding big flushes before the next parent reuses
+        # the buffers (and before kernel exit)
+        for k_out, stage, sem, k_cur in ((_OUT_L, stageL, semL, _CUR_L),
+                                         (_OUT_R, stageR, semR, _CUR_R)):
+            out = smem[k_out]
+            for b in (0, 1):
+                @pl.when((out & (1 << b)) != 0)
+                def _(b=b, stage=stage, sem=sem, k_cur=k_cur):
+                    pltpu.make_async_copy(
+                        stage.at[b], dst_ref.at[pl.ds(smem[k_cur],
+                                                      STAGE)],
+                        sem.at[b]).wait()
+            smem[k_out] = 0
+
+
+def allocate_children(alloc_t0, alloc_te, kl, kr, arena_ptr):
+    """Gap-splitting child allocator (vectorized over the W parents).
+
+    Children split the parent's 128-aligned alloc span: left child
+    left-aligned, right child right-aligned, slack in the middle split
+    proportionally to child sizes.  When ceil-rounding overflows the
+    parent span (gap < 0), the split relocates to the arena tail with
+    two tiles of fresh slack.  All quantities in TILES except kl/kr
+    (columns).
+
+    Returns (dstL_t0, dstR_te, X, new_arena_ptr) — X is the aligned
+    boundary between the children's allocs.
+    """
+    valid = kl + kr > 0
+    tl = (kl + TILE - 1) // TILE
+    tr = (kr + TILE - 1) // TILE
+    gap = (alloc_te - alloc_t0) - tl - tr
+    fits = (gap >= 0) | ~valid
+    fb_size = jnp.where(~fits & valid, tl + tr + 2, 0)
+    fb_off = arena_ptr + jnp.cumsum(fb_size) - fb_size
+    a_use = jnp.where(fits, alloc_t0, fb_off)
+    e_use = jnp.where(fits, alloc_te, fb_off + fb_size)
+    gap_use = (e_use - a_use) - tl - tr
+    tot = jnp.maximum(kl + kr, 1)
+    gap_l = (gap_use * kl) // tot
+    x = a_use + tl + gap_l
+    return a_use, e_use, x, arena_ptr + jnp.sum(fb_size)
+
+
+def build_step_table(span_t0, span_te, route_cols, dstl_t0, dstr_te,
+                     valid, cap):
+    """Build the (cap, NCOLS_TAB) int32 step table for one launch.
+
+    Args: per-parent (W,) arrays — src span tiles [span_t0, span_te),
+    the 12 route scalar columns stacked as route_cols (W, 12) in table
+    order (p_slot, p_rslot, grp, thr, dleft, mtype, dbin, nbin, fb_lo,
+    fb_hi, fb_shift, fb_oor), child alloc anchors, and a validity
+    mask.  ``cap`` is the static grid size; tail steps repeat the last
+    real block with active=0.
+    """
+    b0 = span_t0 // BT
+    nb = jnp.where(valid, (span_te + BT - 1) // BT - b0, 0)
+    nb = jnp.maximum(nb, jnp.where(valid, 1, 0))
+    cum = jnp.cumsum(nb)
+    total = cum[-1]
+    offs = cum - nb
+    i = jnp.arange(cap, dtype=jnp.int32)
+    pidx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    pidx = jnp.clip(pidx, 0, span_t0.shape[0] - 1)
+    j = i - offs[pidx]
+    active = (i < total).astype(jnp.int32)
+    block = b0[pidx] + j
+    # tail: repeat the last real block so the input pipeline skips the
+    # fetch entirely
+    last_real = jnp.maximum(total - 1, 0)
+    last_block = block[last_real]
+    block = jnp.where(active == 1, block, last_block)
+    first = ((j == 0) & (active == 1)).astype(jnp.int32)
+    last = ((j == nb[pidx] - 1) & (active == 1)).astype(jnp.int32)
+    cols = [block, first, last]
+    for k in range(12):
+        cols.append(route_cols[pidx, k])
+    cols.append(dstl_t0[pidx])
+    cols.append(dstr_te[pidx])
+    cols.append(active)
+    cols.append(span_t0[pidx])
+    cols.append(span_te[pidx])
+    return jnp.stack(cols, axis=1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_groups", "grid_cap", "interpret", "debug"),
+    donate_argnums=(1,))
+def partition_round(src: jax.Array, dst: jax.Array, tab: jax.Array, *,
+                    num_groups: int, grid_cap: int,
+                    interpret: bool = False, debug: int = 0) -> jax.Array:
+    """Run one round of leaf partitioning.
+
+    Args:
+      src: (T, R, 128) int8 carrier holding the splitting parents.
+      dst: (T, R, 128) int8 carrier to write children into (donated;
+        only the children's alloc spans are overwritten).
+      tab: (grid_cap, NCOLS_TAB) int32 step table (see module doc).
+    Returns the updated dst carrier.
+    """
+    t, r, _ = src.shape
+    rm = carrier_row_map(num_groups)
+    kern = functools.partial(_partition_body, num_groups=num_groups,
+                             rm=rm, debug=debug)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid_cap,),
+        in_specs=[
+            pl.BlockSpec((BT, r, TILE), lambda i, tab: (tab[i, 0], 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((CARRIER_ROWS, 2 * TILE), jnp.int32),  # pendL
+            pltpu.VMEM((CARRIER_ROWS, 2 * TILE), jnp.int32),  # pendR
+            pltpu.VMEM((2, STAGE, CARRIER_ROWS, TILE), jnp.int8),
+            pltpu.VMEM((2, STAGE, CARRIER_ROWS, TILE), jnp.int8),
+            pltpu.SMEM((16,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(dst.shape, dst.dtype),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(tab, src, dst)
+    return out

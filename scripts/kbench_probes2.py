@@ -1,0 +1,127 @@
+"""Round-4 probes, part 2: the two Mosaic capabilities the cheap
+partition kernel needs.
+
+P5  dynamic LANE gather in VMEM: out[:, d] = x[:, idx[d]] — compaction
+    by index gather (15x less MXU than a permutation matmul).  Tried
+    as jnp.take / take_along_axis / x[:, idx] spellings.
+P6  async_copy VMEM -> HBM at a DYNAMIC (128-aligned) column offset
+    (the pending-buffer flush; the part-1 P3 probe crashed as an
+    HBM->HBM copy).
+P7  SMEM scalar carry across sequential grid steps (running cursors).
+"""
+import sys
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def sync(x):
+    return np.asarray(x)
+
+
+def probe_lane_gather():
+    R, C = 48, 512
+    rng = np.random.RandomState(0)
+    x = rng.randint(-100, 100, (R, C)).astype(np.int8)
+    idx = rng.randint(0, C, C).astype(np.int32)
+
+    spellings = {
+        "jnp.take axis=1": lambda xv, iv: jnp.take(xv, iv, axis=1),
+        "take_along_axis": lambda xv, iv: jnp.take_along_axis(
+            xv, jnp.broadcast_to(iv[None, :], xv.shape), axis=1),
+    }
+    ok_any = False
+    for name, fn in spellings.items():
+        def body(x_ref, i_ref, o_ref, fn=fn):
+            o_ref[:] = fn(x_ref[:], i_ref[0, :])
+        try:
+            out = pl.pallas_call(
+                body,
+                in_specs=[pl.BlockSpec((R, C), lambda: (0, 0)),
+                          pl.BlockSpec((1, C), lambda: (0, 0))],
+                out_specs=pl.BlockSpec((R, C), lambda: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((R, C), jnp.int8),
+            )(jnp.asarray(x), jnp.asarray(idx)[None, :])
+            got = sync(out)
+            ok = (got == x[:, idx]).all()
+            print(f"P5 lane gather [{name}]: {'OK' if ok else 'WRONG'}")
+            ok_any = ok_any or ok
+        except Exception as e:
+            print(f"P5 lane gather [{name}]: FAIL ({type(e).__name__}: "
+                  f"{str(e)[:160]})")
+    return ok_any
+
+
+def probe_vmem_to_hbm_dyn():
+    R, NCAP, C = 48, 8192, 512
+
+    def body(off_ref, x_ref, out_ref, scratch, sem):
+        scratch[:] = x_ref[:] + 1
+        off = off_ref[0]
+        cp = pltpu.make_async_copy(scratch, out_ref.at[:, pl.ds(off, C)],
+                                  sem)
+        cp.start()
+        cp.wait()
+
+    x = jnp.ones((R, C), jnp.int8)
+    off = jnp.asarray([1280], jnp.int32)  # 128-aligned, not C-aligned
+    try:
+        out = pl.pallas_call(
+            body,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec((R, C), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct((R, NCAP), jnp.int8),
+            scratch_shapes=[pltpu.VMEM((R, C), jnp.int8),
+                            pltpu.SemaphoreType.DMA],
+        )(off, x)
+        got = sync(out)
+        ok = (got[:, 1280:1280 + C] == 2).all()
+        print(f"P6 VMEM->HBM dyn-offset copy: {'OK' if ok else 'WRONG'}")
+        return bool(ok)
+    except Exception as e:
+        print(f"P6 VMEM->HBM dyn-offset copy: FAIL ({type(e).__name__}: "
+              f"{str(e)[:200]})")
+        return False
+
+
+def probe_smem_carry():
+    def body(x_ref, out_ref, cnt):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            cnt[0] = 0
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        k = jnp.sum(x_ref[:].astype(jnp.int32))
+        out_ref[0, i] = cnt[0]
+        cnt[0] = cnt[0] + k
+
+    x = jnp.ones((8, 8, 128), jnp.int8)
+    try:
+        out = pl.pallas_call(
+            body,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, 8), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, 8), jnp.int32),
+            scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        )(x)
+        got = sync(out)[0]
+        want = np.arange(8) * 1024
+        ok = (got == want).all()
+        print(f"P7 SMEM carry across steps: {'OK' if ok else 'WRONG'} "
+              f"({got.tolist()})")
+        return bool(ok)
+    except Exception as e:
+        print(f"P7 SMEM carry: FAIL ({type(e).__name__}: {str(e)[:160]})")
+        return False
+
+
+if __name__ == "__main__":
+    r = [probe_lane_gather(), probe_vmem_to_hbm_dyn(), probe_smem_carry()]
+    sys.exit(0 if all(r) else 1)

@@ -1,0 +1,236 @@
+"""Standalone validation + timing of the leaf-partition kernel.
+
+Drives ops/partition_kernel.py on synthetic data through two rounds
+(root split, then both children) and checks every carried byte against
+a numpy simulation; then times a full-N round at 1M columns.
+
+Usage: python scripts/proto_partition.py [ncols]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.carrier import (CARRIER_ROWS, TILE,
+                                      assemble_carrier, carrier_row_map,
+                                      rows_to_f32, rows_to_i32,
+                                      rows_to_leaf)
+from lightgbm_tpu.ops.partition_kernel import (BT, NCOLS_TAB,
+                                               allocate_children,
+                                               build_step_table,
+                                               partition_round)
+
+G, B = 28, 63
+
+
+def np_carrier_view(carr, rm):
+    """Device carrier -> dict of per-col numpy arrays."""
+    c = np.asarray(carr)                       # (T, R, 128)
+    t = c.shape[0]
+    rows = c.transpose(1, 0, 2).reshape(CARRIER_ROWS, t * TILE)
+    leaf = (rows[rm["leaf_lo"]].astype(np.int32) & 255) | \
+        (rows[rm["leaf_hi"]].astype(np.int32) << 8)
+    perm = np.zeros(t * TILE, np.int64)
+    for i in range(4):
+        perm |= (rows[rm["perm"] + i].astype(np.int64) & 255) << (8 * i)
+    perm = perm.astype(np.int32)
+    score = rows[rm["score"]:rm["score"] + 4].astype(np.uint8)
+    score = (score[0].astype(np.uint32) | (score[1].astype(np.uint32) << 8)
+             | (score[2].astype(np.uint32) << 16)
+             | (score[3].astype(np.uint32) << 24)).view(np.float32)
+    bins = rows[:G].astype(np.uint8)
+    wq = rows[rm["wq"]:rm["wq"] + 3].astype(np.int8)
+    return dict(leaf=leaf, perm=perm, score=score, bins=bins, wq=wq)
+
+
+def run_round(src, dst, parents, rng_tab, arena_ptr, cap, rm):
+    """One partition round via the real builder + kernel.
+
+    parents: list of dicts with slot, rslot, grp, thr, kl, kr.
+    rng_tab: dict slot -> (alloc_t0, alloc_te, span_t0, span_te).
+    Returns (new_dst, updated rng_tab, arena_ptr)."""
+    W = len(parents)
+    span_t0 = jnp.asarray([rng_tab[p["slot"]][2] for p in parents],
+                          jnp.int32)
+    span_te = jnp.asarray([rng_tab[p["slot"]][3] for p in parents],
+                          jnp.int32)
+    al_t0 = jnp.asarray([rng_tab[p["slot"]][0] for p in parents],
+                        jnp.int32)
+    al_te = jnp.asarray([rng_tab[p["slot"]][1] for p in parents],
+                        jnp.int32)
+    kl = jnp.asarray([p["kl"] for p in parents], jnp.int32)
+    kr = jnp.asarray([p["kr"] for p in parents], jnp.int32)
+    a_use, e_use, x, arena_ptr = allocate_children(
+        al_t0, al_te, kl, kr, jnp.int32(arena_ptr))
+    route_cols = jnp.asarray(
+        [[p["slot"], p["rslot"], p["grp"], p["thr"], 0, 0, 0, B,
+          0, B, 0, B - 1] for p in parents], jnp.int32)
+    tab = build_step_table(span_t0, span_te, route_cols, a_use, e_use,
+                           jnp.ones(W, bool), cap)
+    out = partition_round(src, dst, tab, num_groups=G, grid_cap=cap)
+    a_use, e_use, x = map(np.asarray, (a_use, e_use, x))
+    kl_n, kr_n = np.asarray(kl), np.asarray(kr)
+    for i, p in enumerate(parents):
+        tl = -(-int(kl_n[i]) // TILE)
+        tr = -(-int(kr_n[i]) // TILE)
+        rng_tab[p["slot"]] = (int(a_use[i]), int(x[i]), int(a_use[i]),
+                              int(a_use[i]) + tl)
+        rng_tab[p["rslot"]] = (int(x[i]), int(e_use[i]),
+                               int(e_use[i]) - tr, int(e_use[i]))
+    return out, rng_tab, int(np.asarray(arena_ptr))
+
+
+def check_children(view, rng_tab, parent, expect_l, expect_r, rm):
+    """expect_l/r: dicts perm -> (bins col, wq col, score)."""
+    for slot, expect in ((parent["slot"], expect_l),
+                         (parent["rslot"], expect_r)):
+        t0, te = rng_tab[slot][2], rng_tab[slot][3]
+        cols = np.arange(t0 * TILE, te * TILE)
+        live = cols[view["leaf"][cols] == slot]
+        perms = view["perm"][live]
+        assert len(perms) == len(expect), \
+            f"slot {slot}: {len(perms)} live vs {len(expect)} expected"
+        assert len(set(perms.tolist())) == len(perms), "dup perms"
+        for c, pm in zip(live, perms):
+            eb, ew, es = expect[int(pm)]
+            assert (view["bins"][:, c] == eb).all(), f"bins mismatch @{c}"
+            assert (view["wq"][:, c] == ew).all(), f"wq mismatch @{c}"
+            assert view["score"][c] == es, f"score mismatch @{c}"
+    # within each child's span, only that child's live columns appear
+    # (the alloc gap between spans is never written NOR read — readers
+    # only stream spans — so stale donated-buffer bytes there are fine)
+    pl_, pr = parent["slot"], parent["rslot"]
+    for slot, other in ((pl_, pr), (pr, pl_)):
+        t0, te = rng_tab[slot][2], rng_tab[slot][3]
+        span_leafs = view["leaf"][t0 * TILE:te * TILE]
+        assert not (span_leafs == other).any(), \
+            f"sibling {other} cols inside slot {slot}'s span"
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    rng = np.random.RandomState(0)
+    tiles = -(-n // TILE)
+    root_alloc = tiles + 8          # ceil-rounding slack for the root
+    # arena tail: a quarter again, BT-aligned total
+    t_cap = -(-int(root_alloc * 1.25 + 2 * BT) // BT) * BT
+    rm = carrier_row_map(G)
+
+    bins = rng.randint(0, B, (n, G)).astype(np.uint8)
+    score = rng.randn(n).astype(np.float32)
+    label = rng.randint(0, 2, n).astype(np.float32)
+    carr = assemble_carrier(jnp.asarray(bins), jnp.asarray(score),
+                            jnp.asarray(label), jnp.ones(n, jnp.float32),
+                            num_tiles=t_cap, num_groups=G)
+    # wq rows: random int8
+    wq = rng.randint(-100, 100, (3, n)).astype(np.int8)
+    carr_np = np.asarray(carr)
+    rowsv = carr_np.transpose(1, 0, 2).reshape(CARRIER_ROWS, t_cap * TILE)
+    rowsv[rm["wq"]:rm["wq"] + 3, :n] = wq
+    carr = jnp.asarray(rowsv.reshape(CARRIER_ROWS, t_cap, TILE)
+                       .transpose(1, 0, 2))
+    other = jnp.zeros_like(carr)
+
+    cap = t_cap // BT + 8
+    arena_ptr = root_alloc  # arena tail right after the root alloc
+    rng_tab = {0: (0, root_alloc, 0, tiles)}
+
+    def expected_split(live_dict, grp, thr):
+        el, er = {}, {}
+        for pm, (eb, ew, es) in live_dict.items():
+            (el if eb[grp] <= thr else er)[pm] = (eb, ew, es)
+        return el, er
+
+    live0 = {int(i): (bins[i], wq[:, i], score[i]) for i in range(n)}
+    p1 = dict(slot=0, rslot=1, grp=3, thr=25)
+    el, er = expected_split(live0, p1["grp"], p1["thr"])
+    p1["kl"], p1["kr"] = len(el), len(er)
+
+    out, rng_tab, arena_ptr = run_round(carr, other, [p1], rng_tab,
+                                        arena_ptr, cap, rm)
+    view = np_carrier_view(out, rm)
+    check_children(view, rng_tab, p1, el, er, rm)
+    print(f"round 1 OK: kl={p1['kl']} kr={p1['kr']} "
+          f"spans L={rng_tab[0]} R={rng_tab[1]}")
+
+    # round 2: split both children (ping-pong back into the original)
+    p2a = dict(slot=0, rslot=2, grp=7, thr=40)
+    e2l, e2r = expected_split(el, p2a["grp"], p2a["thr"])
+    p2a["kl"], p2a["kr"] = len(e2l), len(e2r)
+    p2b = dict(slot=1, rslot=3, grp=11, thr=10)
+    e3l, e3r = expected_split(er, p2b["grp"], p2b["thr"])
+    p2b["kl"], p2b["kr"] = len(e3l), len(e3r)
+    out2, rng_tab, arena_ptr = run_round(out, carr, [p2a, p2b], rng_tab,
+                                         arena_ptr, cap, rm)
+    view2 = np_carrier_view(out2, rm)
+    check_children(view2, rng_tab, p2a, e2l, e2r, rm)
+    check_children(view2, rng_tab, p2b, e3l, e3r, rm)
+    print(f"round 2 OK: ({p2a['kl']},{p2a['kr']}) / "
+          f"({p2b['kl']},{p2b['kr']})")
+    print("CORRECTNESS OK")
+
+
+if __name__ == "__main__":
+    main()
+
+
+def timing(n=1_000_000):
+    """Full-N round timing: split the root repeatedly (ping-pong inside
+    one jit via fori_loop), two loop counts to cancel dispatch."""
+    import functools
+    rng = np.random.RandomState(0)
+    tiles = -(-n // TILE)
+    root_alloc = tiles + 8
+    t_cap = -(-int(root_alloc * 1.25 + 2 * BT) // BT) * BT
+    rm = carrier_row_map(G)
+    bins = rng.randint(0, B, (n, G)).astype(np.uint8)
+    carr = assemble_carrier(jnp.asarray(bins), jnp.zeros(n, jnp.float32),
+                            jnp.zeros(n, jnp.float32),
+                            jnp.ones(n, jnp.float32),
+                            num_tiles=t_cap, num_groups=G)
+    other = jnp.zeros_like(carr)
+    cap = t_cap // BT + 8
+    kl = int((bins[:, 3] <= 25).sum())
+    route_cols = jnp.asarray([[0, 1, 3, 25, 0, 0, 0, B, 0, B, 0, B - 1]],
+                             jnp.int32)
+    a_use, e_use, x, _ = allocate_children(
+        jnp.asarray([0]), jnp.asarray([root_alloc]), jnp.asarray([kl]),
+        jnp.asarray([n - kl]), jnp.int32(root_alloc))
+    tab = build_step_table(jnp.asarray([0]), jnp.asarray([tiles]),
+                           route_cols, a_use, e_use,
+                           jnp.ones(1, bool), cap)
+    from lightgbm_tpu.ops.partition_kernel import partition_round as pr
+    pr_nojit = pr.__wrapped__   # un-jitted: called inside our own jit
+
+    import time as _t
+    for loops in (4, 16):
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def many(a, b, tab, loops=loops):
+            def body(i, ab):
+                a, b = ab
+                out = pr_nojit(a, b, tab, num_groups=G, grid_cap=cap)
+                return (out, a)
+            return jax.lax.fori_loop(0, loops, body, (a, b))
+        o = many(carr, other, tab)
+        _ = np.asarray(o[0][0, 0])
+        carr, other = o   # keep buffers alive/valid
+        best = float("inf")
+        for _i in range(3):
+            t0 = _t.perf_counter()
+            o = many(carr, other, tab)
+            _ = np.asarray(o[0][0, 0])
+            carr, other = o
+            best = min(best, _t.perf_counter() - t0)
+        if loops == 4:
+            t4 = best
+        else:
+            t16 = best
+    per_round = (t16 - t4) / 12
+    print(f"partition full-N round @ {n}: {per_round*1e3:.3f} ms "
+          f"(cap={cap} steps)")

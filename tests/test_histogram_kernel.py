@@ -204,6 +204,23 @@ def test_subbyte_streamed_kernels_match_pack1_interpret():
     np.testing.assert_allclose(ref_pp, ref_pre, rtol=1e-6)
     np.testing.assert_allclose(ref_fu, ref_pre, rtol=1e-6)
 
+    # round-4 tiled-iota kernels (no resident one-hot at all) join the
+    # family parity: both must reproduce the pack=1 streamed results
+    from lightgbm_tpu.ops.histogram import (
+        compute_group_histograms_fused_tiled,
+        compute_group_histograms_q_tiled)
+    binsT = jnp.asarray(bins.T)
+    h_qt = np.asarray(compute_group_histograms_q_tiled(
+        binsT, wq.T, scales, jnp.asarray(leaf), slots, max_group_bin=B,
+        block=256, strips=1, interpret=True))[:slots.shape[0]]
+    np.testing.assert_array_equal(h_qt, ref_pp)
+    h_ft, lf_t = compute_group_histograms_fused_tiled(
+        binsT, wq.T, scales, jnp.asarray(leaf), tab, slots,
+        max_group_bin=B, block=256, strips=1, interpret=True)
+    np.testing.assert_array_equal(np.asarray(lf_t), leaf)
+    np.testing.assert_array_equal(
+        np.asarray(h_ft)[:slots.shape[0]], ref_fu)
+
 
 def test_fused_grower_wiring_interpret_matches_xla_path():
     """The TPU-only fused-route grower wiring (route_tab round-carry,

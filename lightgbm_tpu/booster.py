@@ -145,6 +145,50 @@ class Booster:
         # note: models list order => merged model predicts old + new trees
 
     # ------------------------------------------------------------------
+    def num_feature(self) -> int:
+        """reference c_api LGBM_BoosterGetNumFeature."""
+        return self.max_feature_idx + 1
+
+    def feature_name(self) -> List[str]:
+        """reference c_api LGBM_BoosterGetFeatureNames."""
+        return list(self.feature_names)
+
+    # ------------------------------------------------------------------
+    def reset_training_data(self, train_set: Dataset) -> None:
+        """Swap the training dataset, keeping the trained model
+        (reference c_api.cpp ResetTrainingData): the new data must have
+        the same feature count; existing trees' predictions seed the
+        new training scores exactly like continued training."""
+        from .boosting import create_boosting
+        self._sync_models()
+        old = None
+        if self.models:
+            old = Booster()
+            old.config = self.config
+            for k in ("num_class", "num_tree_per_iteration",
+                      "objective_str", "average_output", "feature_names",
+                      "feature_infos", "max_feature_idx"):
+                setattr(old, k, getattr(self, k))
+            old.models = list(self.models)
+        nf = train_set.num_total_features if hasattr(
+            train_set, "num_total_features") else train_set.num_feature()
+        if self.models and nf != self.max_feature_idx + 1:
+            Log.fatal("reset_training_data: feature count mismatch "
+                      f"({nf} vs model's {self.max_feature_idx + 1})")
+        old_iter = self.current_iteration
+        self.gbdt = create_boosting(self.config, train_set)
+        self.models = self.gbdt.models
+        self.feature_names = train_set.feature_names
+        self.feature_infos = train_set.feature_infos()
+        self.max_feature_idx = nf - 1
+        if old is not None and old.models:
+            self._continue_from(old, train_set)
+            # the reference keeps GetCurrentIteration across
+            # ResetTrainingData (the model is retained)
+            self.gbdt.iter_ = old_iter
+        self._device_stale = False
+
+    # ------------------------------------------------------------------
     def update(self, train_set=None, fobj=None) -> bool:
         if fobj is not None:
             score = self._current_train_scores()

@@ -686,3 +686,104 @@ def LGBM_BoosterPredictForFile(handle, data_filename: str,
             f.write("\t".join(f"{v:g}" for v in np.atleast_1d(row))
                     + "\n")
     return 0
+
+
+# ---------------------------------------------------------------------------
+# round-4 tail: the 7 symbols the r3 audit found missing
+# ---------------------------------------------------------------------------
+def LGBM_SetLastError(msg: str) -> int:
+    """reference c_api.h:768 — let embedders (custom objectives calling
+    back into the host) set the error slot themselves."""
+    _last_error[0] = str(msg)
+    return 0
+
+
+@_api
+def LGBM_DatasetCreateByReference(reference, num_total_row: int,
+                                  out=None) -> int:
+    """reference c_api.h: create an empty dataset aligned to an
+    existing one's bin mappers, awaiting PushRows chunks — the
+    streaming path used when workers bin against a coordinator's
+    mappers."""
+    from .dataset import Dataset as CoreDataset
+    ref_obj = _get(reference)
+    ref_core = ref_obj.construct() if hasattr(ref_obj, "construct") \
+        else ref_obj
+    core = CoreDataset.from_reference_for_push(ref_core,
+                                               int(num_total_row))
+    out[0] = _register(_PushableDataset(core))
+    return 0
+
+
+@_api
+def LGBM_BoosterResetTrainingData(handle, train_data) -> int:
+    """reference c_api.h:352-360: swap the training dataset of an
+    existing booster (continued training on refreshed data)."""
+    bst = _get(handle)
+    ds = _get(train_data)
+    core = ds.construct(bst.config) if hasattr(ds, "construct") else ds
+    bst.reset_training_data(core)
+    return 0
+
+
+@_api
+def LGBM_BoosterGetNumFeature(handle, out=None) -> int:
+    """reference c_api.h:443-450 (LGBM_BoosterGetNumFeature)."""
+    out[0] = _get(handle).num_feature()
+    return 0
+
+
+@_api
+def LGBM_BoosterGetFeatureNames(handle, out_strs=None,
+                                out_len=None) -> int:
+    """reference c_api.h:430-441: feature names of the booster's
+    model (post-training they come from the model, not the dataset)."""
+    names = list(_get(handle).feature_name())
+    if out_len is not None:
+        out_len[0] = len(names)
+    if out_strs is not None:
+        out_strs[0] = names
+    return 0
+
+
+@_api
+def LGBM_BoosterCalcNumPredict(handle, num_row: int, predict_type: int,
+                               num_iteration: int = -1,
+                               out_len=None) -> int:
+    """reference c_api.h:520-535: result-buffer size for a prediction
+    call — rows x per-row outputs (classes, leaves, or contribs)."""
+    bst = _get(handle)
+    ncls = bst.num_tree_per_iteration
+    cur = bst.current_iteration
+    # reference semantics: num_iteration <= 0 means all iterations
+    n_iter = cur if num_iteration <= 0 else min(int(num_iteration), cur)
+    if predict_type == 2:                      # leaf indices
+        per_row = ncls * n_iter
+    elif predict_type == 3:                    # SHAP contribs
+        per_row = ncls * (bst.num_feature() + 1)
+    else:                                      # raw / normal
+        per_row = ncls
+    out_len[0] = int(num_row) * per_row
+    return 0
+
+
+@_api
+def LGBM_BoosterPredictForCSC(handle, col_ptr, indices, data,
+                              num_row: int, predict_type: int = 0,
+                              num_iteration: int = -1, out=None) -> int:
+    """reference c_api.h:626-659: CSC prediction — the transposed
+    sibling of the CSR path (converted column-major -> row-major
+    sparse, then the same chunked sparse predict)."""
+    from scipy import sparse as sp
+    bst = _get(handle)
+    ncol = len(col_ptr) - 1
+    mat = sp.csc_matrix(
+        (np.asarray(data, dtype=np.float64),
+         np.asarray(indices, dtype=np.int32),
+         np.asarray(col_ptr, dtype=np.int64)),
+        shape=(int(num_row), ncol)).tocsr()
+    out[0] = bst.predict(mat, num_iteration=num_iteration,
+                         raw_score=(predict_type == 1),
+                         pred_leaf=(predict_type == 2),
+                         pred_contrib=(predict_type == 3))
+    return 0

@@ -291,10 +291,37 @@ class Dataset:
                               if not m.is_trivial]
         self._build_groups(reference=None, sample_nonzero=sample_rows,
                            sample_cnt=total_sample)
-        self.group_bins = np.zeros((num_data, self.num_groups),
+        self._init_push_storage(list(categorical_features or []))
+        return self
+
+    @classmethod
+    def from_reference_for_push(cls, ref: "Dataset",
+                                num_data: int) -> "Dataset":
+        """Streaming construction aligned to an existing dataset's bin
+        mappers (reference LGBM_DatasetCreateByReference, c_api.h —
+        the distributed/streaming analog of validation-set alignment):
+        allocates the packed matrix for ``num_data`` rows and awaits
+        ``push_rows`` chunks + ``finish_load``."""
+        self = cls()
+        self.config = ref.config
+        self.num_data = int(num_data)
+        self.num_total_features = ref.num_total_features
+        self.max_bin = ref.max_bin
+        self.feature_names = list(ref.feature_names)
+        self.mappers = ref.mappers
+        self.used_features = list(ref.used_features)
+        self._build_groups(reference=ref)
+        self._init_push_storage(list(
+            getattr(ref, "_categorical_features", [])))
+        return self
+
+    def _init_push_storage(self, categorical_features) -> None:
+        """Shared streaming-construction tail (from_sampled_columns /
+        from_reference_for_push): allocate the packed matrix, prefill
+        implicit-zero bins so sparse (CSR) pushes only write stored
+        entries, and arm the pushed-row counter."""
+        self.group_bins = np.zeros((self.num_data, self.num_groups),
                                    dtype=np.uint8)
-        # prefill implicit-zero bins so sparse (CSR) pushes only write
-        # stored entries; dense pushes overwrite every cell anyway
         for f in self.features:
             if not f.collapsed_default:
                 zb = int(np.asarray(
@@ -302,11 +329,10 @@ class Dataset:
                         np.zeros(1)))[0])
                 if zb != 0:
                     self.group_bins[:, f.group] = zb
-        self.metadata = Metadata(num_data)
-        self._categorical_features = list(categorical_features or [])
-        self._resolve_monotone(config)
+        self.metadata = Metadata(self.num_data)
+        self._categorical_features = categorical_features
+        self._resolve_monotone(self.config)
         self._pushed_rows = 0
-        return self
 
     def push_rows(self, chunk: np.ndarray, row_start: int) -> None:
         """Streaming construction, step 2: bin one dense float chunk

@@ -74,6 +74,12 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
         order.  This is the frontier path: only newly created leaves
         are histogrammed, their siblings come from parent subtraction.
 
+    Distributed note: under a row-sharded mesh, call this INSIDE
+    shard_map on the local shard (learner/grower.py
+    _hist_xla_rowsharded) — GSPMD propagation through the chunk-scan
+    reshape produces involuntary full rematerializations (row-scale
+    all-gathers) otherwise.
+
     Returns:
       (L|W, G, B, 3) float32: sum_grad, sum_hess, count per
       (leaf, group, bin).
@@ -107,8 +113,9 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
         # traffic, measured ~10x slower on v5e)
         ohb = (bins_c.astype(jnp.int32)[:, :, None]
                == bin_iota[None, None, :]).astype(cdt)
+        rhs = ohb.reshape(chunk, num_groups * max_group_bin)
         contrib = jnp.einsum(
-            "cm,cx->mx", lhs, ohb.reshape(chunk, num_groups * max_group_bin),
+            "cm,cx->mx", lhs, rhs,
             preferred_element_type=jnp.float32)
         return acc + contrib.reshape(num_leaves * 3, num_groups,
                                      max_group_bin), None

@@ -167,3 +167,94 @@ def test_getter_tail(rng, tmp_path):
         capi.LGBM_GetLastError()
     preds = np.loadtxt(outfn)
     assert preds.shape[0] == X.shape[0]
+
+
+def test_round4_symbol_tail(rng, tmp_path):
+    """The 7 symbols the round-3 audit found missing: SetLastError,
+    DatasetCreateByReference, BoosterResetTrainingData,
+    BoosterGetFeatureNames, BoosterGetNumFeature,
+    BoosterCalcNumPredict, BoosterPredictForCSC."""
+    from scipy import sparse as sp
+    X, y = _mk_data(rng, 600, 5)
+
+    # SetLastError round-trips through GetLastError
+    assert capi.LGBM_SetLastError("embedder message") == 0
+    assert capi.LGBM_GetLastError() == "embedder message"
+
+    dh = [None]
+    assert capi.LGBM_DatasetCreateFromMat(X, "max_bin=31", None, dh) == 0
+    assert capi.LGBM_DatasetSetField(dh[0], "label", y) == 0
+
+    # DatasetCreateByReference + PushRows: mapper-aligned streaming
+    X2, y2 = _mk_data(rng, 300, 5)
+    dh2 = [None]
+    assert capi.LGBM_DatasetCreateByReference(dh[0], 300, dh2) == 0, \
+        capi.LGBM_GetLastError()
+    assert capi.LGBM_DatasetPushRows(dh2[0], X2[:150], 150, 5, 0) == 0
+    assert capi.LGBM_DatasetPushRows(dh2[0], X2[150:], 150, 5, 150) == 0
+    assert capi.LGBM_DatasetSetField(dh2[0], "label", y2) == 0
+    # aligned mappers: identical bin boundaries (feature_infos) to the
+    # in-memory construction of the same reference
+    ref_core = capi._get(dh[0]).construct()
+    pushed_core = capi._get(dh2[0]).construct()
+    assert pushed_core.feature_infos() == ref_core.feature_infos()
+
+    bh = [None]
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=binary num_leaves=15 verbose=-1 "
+        "metric=binary_logloss", bh) == 0
+    for _ in range(8):
+        assert capi.LGBM_BoosterUpdateOneIter(bh[0], [0]) == 0
+
+    # GetNumFeature / GetFeatureNames
+    nf, names, nlen = [None], [None], [None]
+    assert capi.LGBM_BoosterGetNumFeature(bh[0], nf) == 0
+    assert nf[0] == 5
+    assert capi.LGBM_BoosterGetFeatureNames(bh[0], names, nlen) == 0
+    assert nlen[0] == 5 and names[0][0] == "Column_0"
+
+    # CalcNumPredict for the three predict types
+    out_len = [None]
+    assert capi.LGBM_BoosterCalcNumPredict(bh[0], 32, 0, -1, out_len) == 0
+    assert out_len[0] == 32
+    assert capi.LGBM_BoosterCalcNumPredict(bh[0], 32, 2, -1, out_len) == 0
+    assert out_len[0] == 32 * 8
+    assert capi.LGBM_BoosterCalcNumPredict(bh[0], 32, 3, -1, out_len) == 0
+    assert out_len[0] == 32 * 6
+
+    # PredictForCSC == PredictForMat == PredictForCSR
+    Xs = sp.csc_matrix(X[:64])
+    pc, pm = [None], [None]
+    assert capi.LGBM_BoosterPredictForCSC(
+        bh[0], Xs.indptr, Xs.indices, Xs.data, 64, 0, -1, pc) == 0, \
+        capi.LGBM_GetLastError()
+    assert capi.LGBM_BoosterPredictForMat(bh[0], X[:64], 0, -1, pm) == 0
+    np.testing.assert_allclose(pc[0], pm[0], rtol=1e-6)
+
+    # ResetTrainingData: model kept, training continues on new data
+    it = [None]
+    assert capi.LGBM_BoosterGetCurrentIteration(bh[0], it) == 0
+    assert it[0] == 8
+    p_before = [None]
+    assert capi.LGBM_BoosterPredictForMat(bh[0], X[:16], 0, -1,
+                                          p_before) == 0
+    Xn, yn = _mk_data(rng, 400, 5)
+    dh3 = [None]
+    assert capi.LGBM_DatasetCreateFromMat(Xn, "max_bin=31", None,
+                                          dh3) == 0
+    assert capi.LGBM_DatasetSetField(dh3[0], "label", yn) == 0
+    assert capi.LGBM_BoosterResetTrainingData(bh[0], dh3[0]) == 0, \
+        capi.LGBM_GetLastError()
+    p_after = [None]
+    assert capi.LGBM_BoosterPredictForMat(bh[0], X[:16], 0, -1,
+                                          p_after) == 0
+    np.testing.assert_allclose(p_after[0], p_before[0], rtol=1e-5)
+    # iteration count survives the reset (reference semantics)
+    assert capi.LGBM_BoosterGetCurrentIteration(bh[0], it) == 0
+    assert it[0] == 8
+    # num_iteration=0 means ALL iterations (reference <=0 convention)
+    assert capi.LGBM_BoosterCalcNumPredict(bh[0], 4, 2, 0, out_len) == 0
+    assert out_len[0] == 4 * 8
+    assert capi.LGBM_BoosterUpdateOneIter(bh[0], [0]) == 0
+    assert capi.LGBM_BoosterGetCurrentIteration(bh[0], it) == 0
+    assert it[0] == 9

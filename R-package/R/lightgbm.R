@@ -13,14 +13,18 @@
         collapse = " ")
 }
 
-lgb.Dataset <- function(data, label = NULL, params = list()) {
+lgb.Dataset <- function(data, label = NULL, params = list(),
+                        reference = NULL) {
+  # `reference` aligns this dataset's bin mappers to a training set's
+  # (required for valids — reference R-package/R/lgb.Dataset.R)
   pstr <- .params_str(params)
+  ref_h <- if (is.null(reference)) NULL else reference$handle
   if (is.character(data)) {
-    h <- .Call("LGBM_R_DatasetCreateFromFile", data, pstr)
+    h <- .Call("LGBM_R_DatasetCreateFromFile", data, pstr, ref_h)
   } else {
     storage.mode(data) <- "double"
     h <- .Call("LGBM_R_DatasetCreateFromMat", data, nrow(data),
-               ncol(data), pstr)
+               ncol(data), pstr, ref_h)
   }
   if (!is.null(label)) {
     .Call("LGBM_R_DatasetSetField", h, "label", as.double(label))
@@ -28,14 +32,66 @@ lgb.Dataset <- function(data, label = NULL, params = list()) {
   structure(list(handle = h), class = "lgb.Dataset")
 }
 
-lgb.train <- function(params, data, nrounds = 100L) {
+lgb.train <- function(params, data, nrounds = 100L, valids = list(),
+                      record = TRUE, eval_freq = 1L,
+                      early_stopping_rounds = NULL, verbose = 1L) {
+  # Training loop with validation tracking + early stopping (reference
+  # R-package/R/lgb.train.R): `valids` is a named list of lgb.Dataset;
+  # per-eval metric values are recorded into $record_evals and the
+  # iteration minimizing the FIRST metric of the FIRST valid set (all
+  # framework metrics here are smaller-is-better except auc/ndcg,
+  # handled by sign) selects $best_iter under early stopping.
   stopifnot(inherits(data, "lgb.Dataset"))
   h <- .Call("LGBM_R_BoosterCreate", data$handle, .params_str(params))
+  for (v in valids) {
+    stopifnot(inherits(v, "lgb.Dataset"))
+    .Call("LGBM_R_BoosterAddValidData", h, v$handle)
+  }
+  metric_name <- if (!is.null(params$metric)) params$metric[[1L]] else ""
+  bigger_better <- metric_name %in% c("auc", "ndcg", "map")
+  record_evals <- list()
+  best_score <- if (bigger_better) -Inf else Inf
+  best_iter <- -1L
+  since_best <- 0L
   for (i in seq_len(nrounds)) {
     finished <- .Call("LGBM_R_BoosterUpdateOneIter", h)
+    if (length(valids) > 0L && (i %% eval_freq == 0L)) {
+      for (vi in seq_along(valids)) {
+        ev <- .Call("LGBM_R_BoosterGetEval", h, as.integer(vi))
+        vname <- names(valids)[vi]
+        if (is.null(vname) || !nzchar(vname)) vname <- sprintf("valid_%d", vi)
+        if (record) {
+          record_evals[[vname]] <- c(record_evals[[vname]], ev[1L])
+        }
+        if (verbose > 0L) {
+          cat(sprintf("[%d] %s %s: %g\n", i, vname, metric_name, ev[1L]))
+        }
+        if (vi == 1L && length(ev) > 0L) {
+          improved <- if (bigger_better) ev[1L] > best_score else
+            ev[1L] < best_score
+          if (improved) {
+            best_score <- ev[1L]
+            best_iter <- i
+            since_best <- 0L
+          } else {
+            since_best <- since_best + eval_freq
+          }
+        }
+      }
+      if (!is.null(early_stopping_rounds) &&
+          since_best >= early_stopping_rounds) {
+        if (verbose > 0L) {
+          cat(sprintf("Early stopping at iteration %d (best %d)\n",
+                      i, best_iter))
+        }
+        break
+      }
+    }
     if (finished != 0L) break
   }
-  structure(list(handle = h), class = "lgb.Booster")
+  structure(list(handle = h, best_iter = best_iter,
+                 best_score = best_score, record_evals = record_evals),
+            class = "lgb.Booster")
 }
 
 predict.lgb.Booster <- function(object, data, rawscore = FALSE,
@@ -114,6 +170,126 @@ lgb.importance <- function(booster) {
     Feature = vapply(parts, `[`, character(1L), 1L),
     Frequency = as.numeric(vapply(parts, `[`, character(1L), 2L)),
     stringsAsFactors = FALSE)
+}
+
+lgb.model.dt.tree <- function(booster) {
+  # Flat per-node/leaf table of the model (reference
+  # R-package/R/lgb.model.dt.tree.R, built here from the reference-
+  # format model TEXT so no jsonlite/data.table dependency is needed):
+  # one row per split node and per leaf, with tree_index, depth-free
+  # split info, gains and counts.
+  stopifnot(inherits(booster, "lgb.Booster"))
+  txt <- .Call("LGBM_R_BoosterSaveModelToString", booster$handle, -1L)
+  lines <- strsplit(txt, "\n", fixed = TRUE)[[1L]]
+  tree_starts <- which(grepl("^Tree=", lines))
+  out <- NULL
+  for (ti in seq_along(tree_starts)) {
+    lo <- tree_starts[ti]
+    hi <- if (ti < length(tree_starts)) tree_starts[ti + 1L] - 1L else
+      length(lines)
+    block <- lines[lo:hi]
+    get <- function(key) {
+      ln <- block[startsWith(block, paste0(key, "="))]
+      if (length(ln) == 0L) return(numeric(0))
+      as.numeric(strsplit(sub(paste0(key, "="), "", ln[1L],
+                              fixed = TRUE), " ")[[1L]])
+    }
+    sf <- get("split_feature")
+    if (length(sf) > 0L) {
+      out <- rbind(out, data.frame(
+        tree_index = ti - 1L, node_type = "split",
+        node_index = seq_along(sf) - 1L, split_feature = sf,
+        threshold = get("threshold"), split_gain = get("split_gain"),
+        internal_value = get("internal_value"),
+        internal_count = get("internal_count"),
+        left_child = get("left_child"), right_child = get("right_child"),
+        value = NA_real_, count = NA_real_,
+        stringsAsFactors = FALSE))
+    }
+    lv <- get("leaf_value")
+    out <- rbind(out, data.frame(
+      tree_index = ti - 1L, node_type = "leaf",
+      node_index = seq_along(lv) - 1L, split_feature = NA_real_,
+      threshold = NA_real_, split_gain = NA_real_,
+      internal_value = NA_real_, internal_count = NA_real_,
+      left_child = NA_real_, right_child = NA_real_,
+      value = lv, count = get("leaf_count"),
+      stringsAsFactors = FALSE))
+  }
+  out
+}
+
+lgb.interprete <- function(booster, data, idxset = 1L) {
+  # Per-prediction feature contributions (reference
+  # R-package/R/lgb.interprete.R) from the SHAP predict path
+  # (predict_type 3): one data.frame per requested row, features
+  # ordered by |contribution|, bias last.
+  stopifnot(inherits(booster, "lgb.Booster"))
+  storage.mode(data) <- "double"
+  f <- ncol(data)
+  res <- vector("list", length(idxset))
+  for (k in seq_along(idxset)) {
+    row <- data[idxset[k], , drop = FALSE]
+    contrib <- .Call("LGBM_R_BoosterPredictForMat", booster$handle, row,
+                     1L, as.integer(f), 3L, -1L)
+    num_class <- length(contrib) %/% (f + 1L)
+    cm <- matrix(contrib, nrow = f + 1L)   # (f+1) x num_class
+    ord <- order(-abs(cm[seq_len(f), 1L]))
+    df <- data.frame(Feature = c(sprintf("Column_%d", ord - 1L),
+                                 "(bias)"), stringsAsFactors = FALSE)
+    for (cl in seq_len(num_class)) {
+      col <- if (num_class == 1L) "Contribution" else
+        sprintf("Contribution_%d", cl - 1L)
+      df[[col]] <- c(cm[ord, cl], cm[f + 1L, cl])
+    }
+    res[[k]] <- df
+  }
+  res
+}
+
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Frequency", ...) {
+  # base-graphics importance bar chart (reference
+  # R-package/R/lgb.plot.importance.R, ggplot-free)
+  tree_imp <- tree_imp[order(-tree_imp[[measure]]), , drop = FALSE]
+  tree_imp <- utils::head(tree_imp, top_n)
+  graphics::barplot(rev(tree_imp[[measure]]),
+                    names.arg = rev(tree_imp$Feature), horiz = TRUE,
+                    las = 1, main = "Feature importance",
+                    xlab = measure, ...)
+  invisible(tree_imp)
+}
+
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    ...) {
+  # per-prediction contribution chart (reference
+  # R-package/R/lgb.plot.interpretation.R)
+  ti <- utils::head(tree_interpretation, top_n)
+  graphics::barplot(rev(ti$Contribution), names.arg = rev(ti$Feature),
+                    horiz = TRUE, las = 1,
+                    main = "Feature contribution", ...)
+  invisible(ti)
+}
+
+saveRDS.lgb.Booster <- function(object, file, ...) {
+  # Serialize via the model STRING (an lgb.Booster's handle is a
+  # process-local external pointer — reference
+  # R-package/R/saveRDS.lgb.Booster.R raws the model the same way)
+  stopifnot(inherits(object, "lgb.Booster"))
+  txt <- .Call("LGBM_R_BoosterSaveModelToString", object$handle, -1L)
+  payload <- list(model_str = txt, best_iter = object$best_iter,
+                  best_score = object$best_score,
+                  record_evals = object$record_evals)
+  saveRDS(payload, file = file, ...)
+}
+
+readRDS.lgb.Booster <- function(file, ...) {
+  payload <- readRDS(file, ...)
+  h <- .Call("LGBM_R_BoosterLoadModelFromString", payload$model_str)
+  structure(list(handle = h, best_iter = payload$best_iter,
+                 best_score = payload$best_score,
+                 record_evals = payload$record_evals),
+            class = "lgb.Booster")
 }
 
 lgb.Dataset.free <- function(dataset) {

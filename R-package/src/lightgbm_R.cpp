@@ -36,6 +36,12 @@ int LGBM_BoosterPredictForMat(BoosterHandle, const void*, int, int32_t,
                               int32_t, int, int, int, const char*,
                               int64_t*, double*);
 int LGBM_BoosterFree(BoosterHandle);
+int LGBM_BoosterAddValidData(BoosterHandle, DatasetHandle);
+int LGBM_BoosterGetEvalCounts(BoosterHandle, int*);
+int LGBM_BoosterGetEval(BoosterHandle, int, int*, double*);
+int LGBM_BoosterSaveModelToString(BoosterHandle, int, int64_t, int64_t*,
+                                  char*);
+int LGBM_BoosterLoadModelFromString(const char*, int*, BoosterHandle*);
 }
 
 #define C_API_DTYPE_FLOAT64 1
@@ -51,22 +57,29 @@ static void* get_handle(SEXP h) {
 extern "C" {
 
 SEXP LGBM_R_DatasetCreateFromMat(SEXP mat, SEXP nrow, SEXP ncol,
-                                 SEXP parameters) {
+                                 SEXP parameters, SEXP reference) {
   DatasetHandle h = nullptr;
+  DatasetHandle ref = nullptr;
+  if (reference != R_NilValue && R_ExternalPtrAddr(reference) != nullptr)
+    ref = R_ExternalPtrAddr(reference);
   CHECK_CALL(LGBM_DatasetCreateFromMat(
       REAL(mat), C_API_DTYPE_FLOAT64, (int32_t)Rf_asInteger(nrow),
       (int32_t)Rf_asInteger(ncol), 0 /* column-major (R layout) */,
-      CHAR(Rf_asChar(parameters)), nullptr, &h));
+      CHAR(Rf_asChar(parameters)), ref, &h));
   SEXP out = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
   UNPROTECT(1);
   return out;
 }
 
-SEXP LGBM_R_DatasetCreateFromFile(SEXP filename, SEXP parameters) {
+SEXP LGBM_R_DatasetCreateFromFile(SEXP filename, SEXP parameters,
+                                  SEXP reference) {
   DatasetHandle h = nullptr;
+  DatasetHandle ref = nullptr;
+  if (reference != R_NilValue && R_ExternalPtrAddr(reference) != nullptr)
+    ref = R_ExternalPtrAddr(reference);
   CHECK_CALL(LGBM_DatasetCreateFromFile(CHAR(Rf_asChar(filename)),
                                         CHAR(Rf_asChar(parameters)),
-                                        nullptr, &h));
+                                        ref, &h));
   SEXP out = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
   UNPROTECT(1);
   return out;
@@ -134,12 +147,61 @@ SEXP LGBM_R_BoosterPredictForMat(SEXP handle, SEXP mat, SEXP nrow,
   int num_class = 1;
   CHECK_CALL(LGBM_BoosterGetNumClasses(get_handle(handle), &num_class));
   if (num_class < 1) num_class = 1;
-  SEXP out = PROTECT(Rf_allocVector(REALSXP, (long)nr * num_class));
+  /* SHAP contributions (type 3, lgb.interprete) emit one value per
+   * feature plus the bias, per class */
+  long per_row = (Rf_asInteger(predict_type) == 3)
+      ? (long)num_class * (nc + 1) : (long)num_class;
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (long)nr * per_row));
   int64_t out_len = 0;
   CHECK_CALL(LGBM_BoosterPredictForMat(
       get_handle(handle), REAL(mat), C_API_DTYPE_FLOAT64, nr, nc,
       0 /* column-major */, Rf_asInteger(predict_type),
       Rf_asInteger(num_iteration), "", &out_len, REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_BoosterAddValidData(SEXP handle, SEXP valid) {
+  CHECK_CALL(LGBM_BoosterAddValidData(get_handle(handle),
+                                      get_handle(valid)));
+  return R_NilValue;
+}
+
+SEXP LGBM_R_BoosterGetEval(SEXP handle, SEXP data_idx) {
+  /* metric values of one data set (0 = train, 1.. = valids in add
+   * order) — feeds lgb.train's valids/record/early-stopping loop
+   * (reference R-package/R/lgb.train.R eval flow) */
+  int cnt = 0;
+  CHECK_CALL(LGBM_BoosterGetEvalCounts(get_handle(handle), &cnt));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, cnt));
+  int got = 0;
+  CHECK_CALL(LGBM_BoosterGetEval(get_handle(handle),
+                                 Rf_asInteger(data_idx), &got,
+                                 REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_BoosterSaveModelToString(SEXP handle, SEXP num_iteration) {
+  /* model text as an R string — the payload saveRDS.lgb.Booster
+   * serializes (reference R-package/R/saveRDS.lgb.Booster.R) */
+  int64_t len = 0;
+  CHECK_CALL(LGBM_BoosterSaveModelToString(
+      get_handle(handle), Rf_asInteger(num_iteration), 0, &len,
+      nullptr));
+  std::string buf(static_cast<size_t>(len) + 1, '\0');
+  CHECK_CALL(LGBM_BoosterSaveModelToString(
+      get_handle(handle), Rf_asInteger(num_iteration),
+      static_cast<int64_t>(buf.size()), &len, &buf[0]));
+  return Rf_mkString(buf.c_str());
+}
+
+SEXP LGBM_R_BoosterLoadModelFromString(SEXP model_str) {
+  BoosterHandle h = nullptr;
+  int iters = 0;
+  CHECK_CALL(LGBM_BoosterLoadModelFromString(CHAR(Rf_asChar(model_str)),
+                                             &iters, &h));
+  SEXP out = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
   UNPROTECT(1);
   return out;
 }

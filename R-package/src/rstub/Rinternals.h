@@ -43,6 +43,7 @@ int Rf_length(SEXP x);
 double* REAL(SEXP x);
 SEXP Rf_allocVector(unsigned type, long n);
 SEXP Rf_ScalarInteger(int v);
+SEXP Rf_mkString(const char* s);
 
 /* GC protection is a no-op outside R */
 #define PROTECT(x) (x)

@@ -82,3 +82,16 @@ SEXP RStub_MakeString(const char* str) {
   s->str = strdup(str);
   return s;
 }
+
+SEXP Rf_mkString(const char* s) {
+  /* real R copies into a CHARSXP-backed STRSXP; mirror the copy so the
+   * caller's buffer lifetime doesn't matter */
+  size_t n = strlen(s);
+  char* copy = (char*)malloc(n + 1);
+  memcpy(copy, s, n + 1);
+  SEXP out = (SEXP)calloc(1, sizeof(SEXPREC));
+  out->sexptype = CHARSXP;
+  out->length = (long)n;
+  out->str = copy;
+  return out;
+}

@@ -145,6 +145,115 @@ def heldout_scores(gbdt, cfg, vbins_np):
     return np.asarray(total)
 
 
+REF_LTR_SEC_PER_TREE_ROW = 215.32 / (500 * 2_270_296)  # MS-LTR row,
+# docs/Experiments.rst:108-145 (2,270,296 rows, 500 trees, 215.32 s)
+
+
+def make_ltr_data(n_queries, f=136, seed=11, docs_lo=60, docs_hi=180,
+                  w=None):
+    """Synthetic MS-LTR-shaped ranking task: variable-size queries,
+    graded 0-4 relevance from a noisy latent score with a per-query
+    offset (so ranking within queries is learnable but absolute scores
+    are not)."""
+    rng = np.random.RandomState(seed)
+    sizes = rng.randint(docs_lo, docs_hi + 1, size=n_queries)
+    n = int(sizes.sum())
+    X = rng.randn(n, f).astype(np.float32)
+    if w is None:
+        w = (rng.randn(f) * (rng.rand(f) > 0.5)).astype(np.float32)
+    latent = X @ w + np.repeat(rng.randn(n_queries) * 2.0, sizes) \
+        + rng.randn(n).astype(np.float32) * 2.0
+    # graded labels by global quantiles (MS-LTR-like skew toward 0)
+    qs = np.quantile(latent, [0.55, 0.78, 0.90, 0.97])
+    y = np.digitize(latent, qs).astype(np.float32)
+    return X.astype(np.float64), y, sizes, w
+
+
+def ndcg_at_k(y, s, sizes, k=10):
+    """Mean NDCG@k over queries (gain 2^label - 1, log2 discounts)."""
+    out = []
+    start = 0
+    for sz in sizes:
+        yl = y[start:start + sz]
+        sl = s[start:start + sz]
+        start += sz
+        kk = min(k, sz)
+        order = np.argsort(-sl, kind="stable")[:kk]
+        gains = 2.0 ** yl[order] - 1
+        disc = 1.0 / np.log2(np.arange(2, kk + 2))
+        dcg = float(np.sum(gains * disc))
+        best = np.sort(yl)[::-1][:kk]
+        idcg = float(np.sum((2.0 ** best - 1) * disc))
+        out.append(dcg / idcg if idcg > 0 else 0.0)
+    return float(np.mean(out))
+
+
+def run_ltr_scale():
+    """Lambdarank perf point at MS-LTR shape (round-3 verdict #8): the
+    per-query pairwise kernels get a wall-clock number, gated on
+    held-out NDCG@10 actually learning the synthetic concept."""
+    import lightgbm_tpu as lgb
+
+    n_queries = int(os.environ.get("BENCH_LTR_QUERIES", 18_900))
+    iters = int(os.environ.get("BENCH_LTR_ITERS", 30))
+    X, y, sizes, w = make_ltr_data(n_queries)
+    Xv, yv, sizes_v, _ = make_ltr_data(2000, seed=12, w=w)
+    rows = X.shape[0]
+
+    params = {
+        "objective": "lambdarank", "num_leaves": NUM_LEAVES,
+        "max_bin": MAX_BIN, "learning_rate": 0.1, "verbose": -1,
+        "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
+        "hist_compute_dtype": "bfloat16",
+        "quantized_grad": os.environ.get("BENCH_QUANTIZED", "1") != "0",
+    }
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params(params)
+    t0 = time.time()
+    dtrain = lgb.Dataset(X, label=y, group=sizes)
+    core = dtrain.construct(cfg)
+    prep_s = time.time() - t0
+    gbdt = GBDT(cfg, core)
+
+    def drain():
+        np.asarray(gbdt.scores[:, :8])
+
+    chunk = max(1, min(int(os.environ.get("BENCH_CHUNK", 10)),
+                       iters // 2))
+    t0 = time.time()
+    gbdt.train_chunk(chunk)
+    drain()
+    compile_s = time.time() - t0
+    n_chunks = max(1, (iters - chunk) // chunk)
+    t0 = time.time()
+    for _ in range(n_chunks):
+        gbdt.train_chunk(chunk)
+    drain()
+    per_tree = (time.time() - t0) / (n_chunks * chunk)
+    iters = chunk * (1 + n_chunks)      # trees actually trained
+
+    vcore = lgb.Dataset(Xv, label=yv, group=sizes_v,
+                        reference=dtrain).construct(cfg)
+    scores = heldout_scores(gbdt, cfg, vcore.group_bins)
+    ndcg = ndcg_at_k(yv, scores, sizes_v, k=10)
+    ndcg0 = ndcg_at_k(yv, np.zeros_like(scores), sizes_v, k=10)
+    if not (ndcg >= ndcg0 + 0.03):
+        raise SystemExit(
+            f"lambdarank NDCG@10 ({ndcg:.4f}) did not clear the "
+            f"untrained baseline ({ndcg0:.4f}) — ranking gate failed")
+    ref_scaled = REF_LTR_SEC_PER_TREE_ROW * rows * iters
+    return {
+        "rows": rows, "iters": iters, "task": "lambdarank",
+        "queries": n_queries,
+        "value": round(per_tree * iters, 3),
+        "vs_baseline": round(ref_scaled / (per_tree * iters), 3),
+        "ndcg10": round(ndcg, 6), "ndcg10_untrained": round(ndcg0, 6),
+        "prep_s": round(prep_s, 3), "compile_s": round(compile_s, 3),
+        "per_tree_ms": round(per_tree * 1e3, 2),
+    }
+
+
 def run_local_reference(X, y, Xv, yv, params, iters):
     """Train the ACTUAL reference CPU binary (.refbuild/lightgbm) on the
     SAME generated data on THIS machine (round-3 verdict #2: the scaled
@@ -332,6 +441,8 @@ def main():
         # information
         scales.append(run_scale(BENCH_ROWS_BIG, BENCH_ITERS_BIG, params,
                                 check_f32=False))
+    if os.environ.get("BENCH_LTR", "1") != "0":
+        scales.append(run_ltr_scale())
 
     result = {
         "metric": f"higgs_synth_{BENCH_ROWS//1000}k_{BENCH_ITERS}trees_s",
@@ -355,6 +466,13 @@ def main():
     print(json.dumps(result))
     # diagnostics on stderr so the stdout contract stays one line
     for s in scales:
+        if s.get("task") == "lambdarank":
+            print(f"ltr rows={s['rows']} per_tree={s['per_tree_ms']}ms "
+                  f"vs_baseline={s['vs_baseline']} "
+                  f"ndcg10={s['ndcg10']} (untrained "
+                  f"{s['ndcg10_untrained']}) prep={s['prep_s']}s",
+                  file=sys.stderr)
+            continue
         extra = ""
         if "vs_local_reference" in s:
             extra = (f" vs_local_ref={s['vs_local_reference']} "

@@ -318,6 +318,10 @@ class Config:
     # (~1.6 ms/pass at 1M x 28 x 63 on v5e), faster than streaming a
     # precomputed one-hot and pack-free; False restores the round-3
     # streamed/packed kernel ladder
+    native_binning: bool = True     # dense numerical matrices: bin via
+    # the native std::lower_bound loop (bit-identical to the numpy
+    # searchsorted path, ~10x faster — numpy dominates large-matrix
+    # prep otherwise)
     force_pallas_interpret: bool = False  # test seam: run the Pallas
     # kernel paths (incl. the fused-route grower wiring) in interpret
     # mode on CPU — slow, for CI coverage of the TPU-only code paths

@@ -396,7 +396,10 @@ class TreeGrower:
                 f"{budget >> 20}; using the slower on-the-fly rebuild "
                 "(see docs/ROOFLINE.md regime table)")
         self.ohb = None
-        self.binsT = (jnp.asarray(bins_np.T)
+        # transposed on DEVICE from the already-uploaded bins: a host
+        # transpose + second upload of the (N, G) matrix doubles the
+        # host->device traffic at the 10.5M scale
+        self.binsT = (jnp.transpose(self.bins)
                       if self.use_fused or self.use_tiled else None)
         self._route_cols = 15 + (self.max_feature_bin + 7) // 8
         # trace-scoped override: callers thread the one-hot through

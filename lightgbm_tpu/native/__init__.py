@@ -33,7 +33,8 @@ def _build() -> Optional[str]:
     if os.path.exists(_LIB_PATH) and \
             os.path.getmtime(_LIB_PATH) >= newest_src:
         return _LIB_PATH
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+    cmd = ["g++", "-O3", "-march=native", "-funroll-loops", "-std=c++17",
+           "-shared", "-fPIC", "-pthread",
            "-o", _LIB_PATH] + srcs
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)

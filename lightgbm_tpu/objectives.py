@@ -552,13 +552,20 @@ class LambdarankNDCG(Objective):
             Log.fatal("Label exceeds label_gain range in lambdarank")
         # padded (Q, M) row-index matrix; -1 = padding
         Q, M = self.num_queries, self.max_query
-        idx = np.full((Q, M), -1, dtype=np.int32)
+        # query-chunked pairwise: the (Q, M, M) pair tensor at MS-LTR
+        # scale (~19k queries x 140 docs) would need tens of GB if
+        # materialized at once; chunks bound the live intermediate to
+        # ~128 MB and lax.map runs them sequentially
+        qc = max(1, min(Q, (1 << 25) // max(M * M, 1)))
+        q_pad = (Q + qc - 1) // qc * qc
+        self._q_chunk = qc
+        idx = np.full((q_pad, M), -1, dtype=np.int32)
         for q in range(Q):
             idx[q, :sizes[q]] = np.arange(qb[q], qb[q + 1])
         self._qidx = jnp.asarray(idx)
         self._qmask = jnp.asarray(idx >= 0)
         # inverse max DCG at k per query (reference dcg_calculator.cpp)
-        inv = np.zeros(Q, dtype=np.float64)
+        inv = np.zeros(q_pad, dtype=np.float64)
         for q in range(Q):
             lab = np.sort(self.label[qb[q]:qb[q + 1]])[::-1]
             k = min(self.optimize_pos_at, len(lab))
@@ -577,53 +584,67 @@ class LambdarankNDCG(Objective):
         sig = self.sigmoid
         qidx = self._qidx
         qmask = self._qmask
-        safe = jnp.maximum(qidx, 0)
-        s = score[safe]                                    # (Q, M)
-        s = jnp.where(qmask, s, -jnp.inf)
-        labels = self._qlabel.astype(jnp.int32)
-        gains = self._label_gain_dev[jnp.clip(labels, 0, None)]
+        q_pad, M = qidx.shape
+        qc = self._q_chunk
+        nc = q_pad // qc
 
-        # rank positions (descending score, stable)
-        order = jnp.argsort(-s, axis=1, stable=True)
-        rank = jnp.argsort(order, axis=1)                  # (Q, M) position
-        discount = 1.0 / jnp.log2(2.0 + rank.astype(jnp.float32))
+        def chunk(args):
+            qidx_c, qmask_c, qlabel_c, inv_c = args
+            safe = jnp.maximum(qidx_c, 0)
+            s = score[safe]                                # (qc, M)
+            s = jnp.where(qmask_c, s, -jnp.inf)
+            labels = qlabel_c.astype(jnp.int32)
+            gains = self._label_gain_dev[jnp.clip(labels, 0, None)]
 
-        best = jnp.max(jnp.where(qmask, s, -jnp.inf), axis=1, keepdims=True)
-        worst = jnp.min(jnp.where(qmask, s, jnp.inf), axis=1, keepdims=True)
-        has_spread = best != worst
+            # rank positions (descending score, stable)
+            order = jnp.argsort(-s, axis=1, stable=True)
+            rank = jnp.argsort(order, axis=1)              # (qc, M)
+            discount = 1.0 / jnp.log2(2.0 + rank.astype(jnp.float32))
 
-        # pairwise (Q, M, M): i = high (larger label), j = low
-        li = labels[:, :, None]
-        lj = labels[:, None, :]
-        pair_ok = (li > lj) & qmask[:, :, None] & qmask[:, None, :]
-        ds = s[:, :, None] - s[:, None, :]                # delta score
-        dg = gains[:, :, None] - gains[:, None, :]
-        pd = jnp.abs(discount[:, :, None] - discount[:, None, :])
-        delta_ndcg = dg * pd * self._inv_max_dcg[:, None, None]
-        delta_ndcg = jnp.where(
-            has_spread[:, :, None],
-            delta_ndcg / (0.01 + jnp.abs(ds)), delta_ndcg)
-        ds_safe = jnp.where(pair_ok, ds, 0.0)
-        p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * ds_safe * sig))
-        p_hess = p_lambda * (2.0 - p_lambda)
-        lam = jnp.where(pair_ok, -p_lambda * delta_ndcg, 0.0)
-        hes = jnp.where(pair_ok, 2.0 * p_hess * delta_ndcg, 0.0)
-        # high gets +lambda, low gets -lambda; hessian adds on both
-        g_q = lam.sum(axis=2) - lam.sum(axis=1)            # (Q, M)
-        h_q = hes.sum(axis=2) + hes.sum(axis=1)
+            best = jnp.max(jnp.where(qmask_c, s, -jnp.inf), axis=1,
+                           keepdims=True)
+            worst = jnp.min(jnp.where(qmask_c, s, jnp.inf), axis=1,
+                            keepdims=True)
+            has_spread = best != worst
 
-        if self._weight_dev is not None:
-            w = self._weight_dev[safe]
-            g_q = g_q * w
-            h_q = h_q * w
+            # pairwise (qc, M, M): i = high (larger label), j = low
+            li = labels[:, :, None]
+            lj = labels[:, None, :]
+            pair_ok = (li > lj) & qmask_c[:, :, None] & qmask_c[:, None, :]
+            ds = s[:, :, None] - s[:, None, :]            # delta score
+            dg = gains[:, :, None] - gains[:, None, :]
+            pd = jnp.abs(discount[:, :, None] - discount[:, None, :])
+            delta_ndcg = dg * pd * inv_c[:, None, None]
+            delta_ndcg = jnp.where(
+                has_spread[:, :, None],
+                delta_ndcg / (0.01 + jnp.abs(ds)), delta_ndcg)
+            ds_safe = jnp.where(pair_ok, ds, 0.0)
+            p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * ds_safe * sig))
+            p_hess = p_lambda * (2.0 - p_lambda)
+            lam = jnp.where(pair_ok, -p_lambda * delta_ndcg, 0.0)
+            hes = jnp.where(pair_ok, 2.0 * p_hess * delta_ndcg, 0.0)
+            # high gets +lambda, low gets -lambda; hessian adds on both
+            g_q = lam.sum(axis=2) - lam.sum(axis=1)        # (qc, M)
+            h_q = hes.sum(axis=2) + hes.sum(axis=1)
+
+            if self._weight_dev is not None:
+                w = self._weight_dev[safe]
+                g_q = g_q * w
+                h_q = h_q * w
+            return g_q, h_q
+
+        g_all, h_all = jax.lax.map(chunk, (
+            qidx.reshape(nc, qc, M), qmask.reshape(nc, qc, M),
+            self._qlabel.reshape(nc, qc, M),
+            self._inv_max_dcg.reshape(nc, qc)))
 
         grad = jnp.zeros_like(score)
         hess = jnp.zeros_like(score)
         flat_idx = jnp.where(qmask, qidx, score.shape[0])
         grad = grad.at[flat_idx.reshape(-1)].add(
-            g_q.reshape(-1), mode="drop")
+            g_all.reshape(-1), mode="drop")
         hess = hess.at[flat_idx.reshape(-1)].add(
-            h_q.reshape(-1), mode="drop")
+            h_all.reshape(-1), mode="drop")
         return grad, hess
 
 

@@ -16,7 +16,7 @@
 #ifdef __cplusplus
 extern "C" {
 #endif
-extern SEXP LGBM_R_DatasetCreateFromMat(SEXP, SEXP, SEXP, SEXP);
+extern SEXP LGBM_R_DatasetCreateFromMat(SEXP, SEXP, SEXP, SEXP, SEXP);
 extern SEXP LGBM_R_DatasetSetField(SEXP, SEXP, SEXP);
 extern SEXP LGBM_R_DatasetFree(SEXP);
 extern SEXP LGBM_R_BoosterCreate(SEXP, SEXP);
@@ -26,6 +26,10 @@ extern SEXP LGBM_R_BoosterSaveModel(SEXP, SEXP, SEXP);
 extern SEXP LGBM_R_BoosterPredictForMat(SEXP, SEXP, SEXP, SEXP, SEXP,
                                         SEXP);
 extern SEXP LGBM_R_BoosterFree(SEXP);
+extern SEXP LGBM_R_BoosterAddValidData(SEXP, SEXP);
+extern SEXP LGBM_R_BoosterGetEval(SEXP, SEXP);
+extern SEXP LGBM_R_BoosterSaveModelToString(SEXP, SEXP);
+extern SEXP LGBM_R_BoosterLoadModelFromString(SEXP);
 #ifdef __cplusplus
 }
 #endif
@@ -59,14 +63,50 @@ int main(int argc, char** argv) {
   SEXP ds = LGBM_R_DatasetCreateFromMat(
       s_mat, RStub_MakeInt(n), RStub_MakeInt(f),
       RStub_MakeString("objective=binary verbose=-1 num_leaves=15 "
-                       "min_data_in_leaf=5"));
+                       "min_data_in_leaf=5"), R_NilValue);
   LGBM_R_DatasetSetField(ds, RStub_MakeString("label"),
                          RStub_MakeReal(label, n));
+  /* held-out valid set for the lgb.train valids/early-stopping path */
+  const int nv = 200;
+  double* vmat = (double*)malloc(sizeof(double) * nv * f);
+  double* vlabel = (double*)malloc(sizeof(double) * nv);
+  for (int i = 0; i < nv; ++i) {
+    double x0 = 0, x1 = 0;
+    for (int j = 0; j < f; ++j) {
+      double v = frand();
+      vmat[j * nv + i] = v;
+      if (j == 0) x0 = v;
+      if (j == 1) x1 = v;
+    }
+    vlabel[i] = (x0 - 0.7 * x1 > 0.0) ? 1.0 : 0.0;
+  }
+  SEXP s_vmat = RStub_MakeReal(vmat, (long)nv * f);
+  SEXP dv = LGBM_R_DatasetCreateFromMat(
+      s_vmat, RStub_MakeInt(nv), RStub_MakeInt(f),
+      RStub_MakeString("objective=binary verbose=-1 num_leaves=15 "
+                       "min_data_in_leaf=5"), ds /* mapper-aligned */);
+  LGBM_R_DatasetSetField(dv, RStub_MakeString("label"),
+                         RStub_MakeReal(vlabel, nv));
+
   SEXP bst = LGBM_R_BoosterCreate(
       ds, RStub_MakeString("objective=binary verbose=-1 num_leaves=15 "
-                           "min_data_in_leaf=5"));
+                           "min_data_in_leaf=5 metric=binary_logloss"));
+  LGBM_R_BoosterAddValidData(bst, dv);
+  double first_eval = -1.0, last_eval = -1.0;
   for (int it = 0; it < 20; ++it) {
     LGBM_R_BoosterUpdateOneIter(bst);
+    SEXP ev = LGBM_R_BoosterGetEval(bst, RStub_MakeInt(1));
+    if (Rf_length(ev) < 1) {
+      fprintf(stderr, "empty eval at iter %d\n", it);
+      return 7;
+    }
+    last_eval = REAL(ev)[0];
+    if (it == 0) first_eval = last_eval;
+  }
+  if (!(last_eval < first_eval)) {
+    fprintf(stderr, "valid logloss did not fall: %g -> %g\n",
+            first_eval, last_eval);
+    return 8;
   }
   SEXP pred = LGBM_R_BoosterPredictForMat(
       bst, s_mat, RStub_MakeInt(n), RStub_MakeInt(f), RStub_MakeInt(0),
@@ -91,11 +131,53 @@ int main(int argc, char** argv) {
     double d = fabs(REAL(pred)[i] - REAL(pred2)[i]);
     if (d > maxdiff) maxdiff = d;
   }
+  /* SHAP contributions (lgb.interprete's predict path): per-row
+   * feature contributions + bias must sum to the raw score */
+  SEXP raw = LGBM_R_BoosterPredictForMat(
+      bst, s_mat, RStub_MakeInt(n), RStub_MakeInt(f), RStub_MakeInt(1),
+      RStub_MakeInt(-1));
+  SEXP contrib = LGBM_R_BoosterPredictForMat(
+      bst, s_mat, RStub_MakeInt(n), RStub_MakeInt(f), RStub_MakeInt(3),
+      RStub_MakeInt(-1));
+  if (Rf_length(contrib) != (long)n * (f + 1)) {
+    fprintf(stderr, "bad contrib length %d\n", Rf_length(contrib));
+    return 9;
+  }
+  double worst_gap = 0.0;
+  for (int i = 0; i < n; ++i) {
+    double s_sum = 0.0;
+    for (int j = 0; j <= f; ++j) s_sum += REAL(contrib)[i * (f + 1) + j];
+    double gap = fabs(s_sum - REAL(raw)[i]);
+    if (gap > worst_gap) worst_gap = gap;
+  }
+  if (worst_gap > 1e-4) {
+    fprintf(stderr, "contribs don't sum to raw score (gap %g)\n",
+            worst_gap);
+    return 10;
+  }
+
+  /* model-string round trip (saveRDS/readRDS.lgb.Booster payload) */
+  SEXP mstr = LGBM_R_BoosterSaveModelToString(bst, RStub_MakeInt(-1));
+  SEXP bst3 = LGBM_R_BoosterLoadModelFromString(mstr);
+  SEXP pred3 = LGBM_R_BoosterPredictForMat(
+      bst3, s_mat, RStub_MakeInt(n), RStub_MakeInt(f), RStub_MakeInt(0),
+      RStub_MakeInt(-1));
+  double maxdiff3 = 0.0;
+  for (int i = 0; i < n; ++i) {
+    double d = fabs(REAL(pred)[i] - REAL(pred3)[i]);
+    if (d > maxdiff3) maxdiff3 = d;
+  }
+
   LGBM_R_BoosterFree(bst);
   LGBM_R_BoosterFree(bst2);
+  LGBM_R_BoosterFree(bst3);
   LGBM_R_DatasetFree(ds);
-  printf("R-HOST OK acc=%.3f maxdiff=%g\n", acc, maxdiff);
+  LGBM_R_DatasetFree(dv);
+  printf("R-HOST OK acc=%.3f maxdiff=%g eval %g->%g contrib_gap=%g "
+         "strdiff=%g\n", acc, maxdiff, first_eval, last_eval,
+         worst_gap, maxdiff3);
   if (acc < 0.85) return 5;
   if (maxdiff > 1e-10) return 6;
+  if (maxdiff3 > 1e-10) return 11;
   return 0;
 }

@@ -20,6 +20,34 @@ from .utils.log import Log
 BINARY_TOKEN = b"______LightGBM_TPU_Binary_File_Token______\n"
 FORMAT_VERSION = 1
 
+# Virtual file schemes (the reference's VirtualFileReader/Writer +
+# HDFSFile seam, src/io/file_io.cpp:54-165).  HDFS itself is a
+# PERMANENT descope — no Hadoop client exists in the target
+# environments — but the dispatch seam is kept: register an opener for
+# a scheme ("hdfs", "s3", "gs", ...) and every binary-cache read/write
+# routes through it.  fsspec plugs in as
+# ``register_file_scheme("s3", fsspec.open)``.
+_SCHEME_OPENERS = {}
+
+
+def register_file_scheme(scheme: str, opener) -> None:
+    """``opener(path, mode)`` must return a binary file-like object."""
+    _SCHEME_OPENERS[scheme.lower()] = opener
+
+
+def _open(filename: str, mode: str):
+    if "://" in filename:
+        scheme = filename.split("://", 1)[0].lower()
+        op = _SCHEME_OPENERS.get(scheme)
+        if op is None:
+            Log.fatal(
+                f"no opener registered for scheme '{scheme}://' — "
+                "register one with lightgbm_tpu.dataset_io."
+                "register_file_scheme (HDFS is intentionally out of "
+                "scope; any fsspec-style opener plugs in here)")
+        return op(filename, mode)
+    return open(filename, mode)
+
 
 def save_binary(dataset: Dataset, filename: str) -> None:
     payload = {
@@ -41,7 +69,7 @@ def save_binary(dataset: Dataset, filename: str) -> None:
         "monotone": dataset.monotone_constraints,
         "categorical_features": dataset._categorical_features,
     }
-    with open(filename, "wb") as f:
+    with _open(filename, "wb") as f:
         f.write(BINARY_TOKEN)
         pickle.dump(payload, f, protocol=4)
     Log.info(f"Saved binned dataset to binary file {filename}")
@@ -49,14 +77,17 @@ def save_binary(dataset: Dataset, filename: str) -> None:
 
 def is_binary_file(filename: str) -> bool:
     try:
-        with open(filename, "rb") as f:
+        with _open(filename, "rb") as f:
             return f.read(len(BINARY_TOKEN)) == BINARY_TOKEN
-    except OSError:
+    except Exception:
+        # a probe, not an assertion: unreadable paths, unregistered
+        # schemes, and opener-specific errors all mean "not a binary
+        # dataset file" here
         return False
 
 
 def load_binary(filename: str) -> Dataset:
-    with open(filename, "rb") as f:
+    with _open(filename, "rb") as f:
         token = f.read(len(BINARY_TOKEN))
         if token != BINARY_TOKEN:
             Log.fatal(f"{filename} is not a lightgbm_tpu binary dataset")

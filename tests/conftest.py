@@ -86,3 +86,43 @@ def native_lib():
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+# ---------------------------------------------------------------------------
+# `fast` smoke tier: one representative test per subsystem (marker
+# applied here so the test files stay uncluttered).  pytest -m fast -q
+# is the inner development loop; "not slow" is the thorough tier.
+_FAST_TESTS = {
+    "test_binary",                      # engine end-to-end
+    "test_regression",
+    "test_missing_value_nan",           # missing-value semantics
+    "test_categorical_handling",        # categorical splits
+    "test_save_load_pickle_roundtrip",  # model text IO
+    "test_simple_numerical",            # binning
+    "test_zero_gets_own_bin",
+    "test_bundles_exclusive_features",  # EFB
+    "test_apply_splits_matches_reference_over_256_groups",  # partition
+    "test_pallas_kernel_matches_einsum_interpret",          # hist
+    "test_subbyte_streamed_kernels_match_pack1_interpret",
+    "test_fused_grower_wiring_interpret_matches_xla_path",
+    "test_data_parallel_matches_serial",                    # mesh
+    "test_dataset_booster_lifecycle",   # C API
+    "test_round4_symbol_tail",
+    "test_classifier_binary",           # sklearn surface
+    "test_cv",                          # cv + callbacks
+    "test_early_stopping",
+    "test_shap_contribs_sum",           # SHAP
+    "test_virtual_file_scheme_hook",    # IO seams
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    matched = set()
+    for item in items:
+        base = item.name.split("[")[0]
+        if base in _FAST_TESTS and "slow" not in item.keywords:
+            item.add_marker(pytest.mark.fast)
+            matched.add(base)
+    missing = _FAST_TESTS - matched
+    # renames must not silently shrink the smoke tier
+    assert not missing, f"fast-tier tests not collected: {missing}"

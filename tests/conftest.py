@@ -124,5 +124,9 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.fast)
             matched.add(base)
     missing = _FAST_TESTS - matched
-    # renames must not silently shrink the smoke tier
-    assert not missing, f"fast-tier tests not collected: {missing}"
+    # renames must not silently shrink the smoke tier (only checkable
+    # when the whole suite was collected — single-file runs see a
+    # subset)
+    if missing and len(items) > 80:
+        raise pytest.UsageError(
+            f"fast-tier tests not collected: {missing}")

@@ -30,16 +30,72 @@ public final class LightGBMNative {
 
     public static native long datasetCreateFromMat(
         double[] data, int nrow, int ncol, String params);
+    public static native long datasetCreateFromMatWithReference(
+        double[] data, int nrow, int ncol, String params,
+        long reference);
+    public static native long datasetCreateFromFile(
+        String filename, String params);
+    public static native long datasetCreateFromCSR(
+        int[] indptr, int[] indices, double[] values, int numCol,
+        String params);
+    public static native long datasetGetSubset(
+        long handle, int[] usedRowIndices, String params);
     public static native void datasetSetField(
         long handle, String field, double[] data);
+    public static native int datasetGetNumData(long handle);
+    public static native int datasetGetNumFeature(long handle);
+    public static native void datasetSaveBinary(
+        long handle, String filename);
+    public static native void datasetSetFeatureNames(
+        long handle, String[] names);
+    public static native String[] datasetGetFeatureNames(long handle);
     public static native void datasetFree(long handle);
+
     public static native long boosterCreate(long dataset, String params);
     public static native long boosterCreateFromModelfile(String filename);
+    public static native long boosterLoadModelFromString(String model);
+    public static native void boosterAddValidData(
+        long handle, long validDataset);
     public static native int boosterUpdateOneIter(long handle);
+    public static native int boosterUpdateOneIterCustom(
+        long handle, float[] grad, float[] hess);
+    public static native void boosterRollbackOneIter(long handle);
+    public static native int boosterGetNumClasses(long handle);
+    public static native int boosterGetCurrentIteration(long handle);
+    public static native int boosterNumberOfTotalModel(long handle);
+    public static native int boosterGetNumFeature(long handle);
+    public static native String[] boosterGetFeatureNames(long handle);
+    public static native int boosterGetEvalCounts(long handle);
+    public static native String[] boosterGetEvalNames(long handle);
+    public static native double[] boosterGetEval(
+        long handle, int dataIdx);
+    public static native void boosterResetParameter(
+        long handle, String params);
+    public static native void boosterResetTrainingData(
+        long handle, long dataset);
+    public static native void boosterMerge(long handle, long other);
     public static native void boosterSaveModel(
         long handle, int numIteration, String filename);
+    public static native String boosterSaveModelToString(
+        long handle, int numIteration);
+    public static native String boosterDumpModel(
+        long handle, int numIteration);
+    public static native double[] boosterFeatureImportance(
+        long handle, int numIteration, int importanceType);
+    public static native long boosterCalcNumPredict(
+        long handle, int numRow, int predictType, int numIteration);
+    public static native double boosterGetLeafValue(
+        long handle, int treeIdx, int leafIdx);
+    public static native void boosterSetLeafValue(
+        long handle, int treeIdx, int leafIdx, double value);
     public static native double[] boosterPredictForMat(
         long handle, double[] data, int nrow, int ncol,
         int predictType, int numIteration);
+    public static native double[] boosterPredictForCSR(
+        long handle, int[] indptr, int[] indices, double[] values,
+        int numCol, int predictType, int numIteration);
+    public static native void boosterPredictForFile(
+        long handle, String dataFile, int hasHeader, int predictType,
+        int numIteration, String resultFile);
     public static native void boosterFree(long handle);
 }

@@ -24,6 +24,7 @@ extern "C" {
 typedef int32_t jint;
 typedef int64_t jlong;
 typedef double jdouble;
+typedef float jfloat;
 typedef uint8_t jboolean;
 typedef int32_t jsize;
 
@@ -32,6 +33,9 @@ typedef jobject jclass;
 typedef jobject jstring;
 typedef jobject jarray;
 typedef jarray jdoubleArray;
+typedef jarray jintArray;
+typedef jarray jfloatArray;
+typedef jarray jobjectArray;
 typedef jobject jthrowable;
 
 struct JNINativeInterface_;
@@ -51,6 +55,14 @@ struct JNINativeInterface_ {
                                      jint);
   void (*SetDoubleArrayRegion)(JNIEnv*, jdoubleArray, jsize, jsize,
                                const jdouble*);
+  jstring (*NewStringUTF)(JNIEnv*, const char*);
+  jobjectArray (*NewObjectArray)(JNIEnv*, jsize, jclass, jobject);
+  void (*SetObjectArrayElement)(JNIEnv*, jobjectArray, jsize, jobject);
+  jobject (*GetObjectArrayElement)(JNIEnv*, jobjectArray, jsize);
+  jint* (*GetIntArrayElements)(JNIEnv*, jintArray, jboolean*);
+  void (*ReleaseIntArrayElements)(JNIEnv*, jintArray, jint*, jint);
+  jfloat* (*GetFloatArrayElements)(JNIEnv*, jfloatArray, jboolean*);
+  void (*ReleaseFloatArrayElements)(JNIEnv*, jfloatArray, jfloat*, jint);
 };
 
 #define JNIEXPORT

@@ -18,35 +18,111 @@
 
 #include "jni_min.h"
 
-/* LGBM_* C ABI (lightgbm_tpu/native/include/lgbm_tpu_c_api.h) */
+/* LGBM_* C ABI (lightgbm_tpu/native/include/lightgbm_tpu_c_api.h) */
 typedef void* DatasetHandle;
 typedef void* BoosterHandle;
 extern const char* LGBM_GetLastError(void);
+extern int LGBM_DatasetCreateFromFile(const char*, const char*,
+                                      DatasetHandle, DatasetHandle*);
 extern int LGBM_DatasetCreateFromMat(const void*, int, int32_t, int32_t,
                                      int, const char*, DatasetHandle,
                                      DatasetHandle*);
+extern int LGBM_DatasetCreateFromCSR(const void*, int, const int32_t*,
+                                     const void*, int, int64_t, int64_t,
+                                     int64_t, const char*, DatasetHandle,
+                                     DatasetHandle*);
+extern int LGBM_DatasetGetSubset(const DatasetHandle, const int32_t*,
+                                 int32_t, const char*, DatasetHandle*);
 extern int LGBM_DatasetSetField(DatasetHandle, const char*, const void*,
                                 int, int);
+extern int LGBM_DatasetGetNumData(DatasetHandle, int32_t*);
+extern int LGBM_DatasetGetNumFeature(DatasetHandle, int32_t*);
+extern int LGBM_DatasetSaveBinary(DatasetHandle, const char*);
+extern int LGBM_DatasetSetFeatureNames(DatasetHandle, const char**, int);
+extern int LGBM_DatasetGetFeatureNames(DatasetHandle, char**, int*);
 extern int LGBM_DatasetFree(DatasetHandle);
 extern int LGBM_BoosterCreate(DatasetHandle, const char*, BoosterHandle*);
 extern int LGBM_BoosterCreateFromModelfile(const char*, int*,
                                            BoosterHandle*);
+extern int LGBM_BoosterLoadModelFromString(const char*, int*,
+                                           BoosterHandle*);
+extern int LGBM_BoosterAddValidData(BoosterHandle, const DatasetHandle);
 extern int LGBM_BoosterUpdateOneIter(BoosterHandle, int*);
+extern int LGBM_BoosterUpdateOneIterCustom(BoosterHandle, const float*,
+                                           const float*, int64_t, int*);
+extern int LGBM_BoosterRollbackOneIter(BoosterHandle);
 extern int LGBM_BoosterGetNumClasses(BoosterHandle, int*);
 extern int LGBM_BoosterGetCurrentIteration(BoosterHandle, int*);
+extern int LGBM_BoosterNumberOfTotalModel(BoosterHandle, int*);
+extern int LGBM_BoosterGetNumFeature(BoosterHandle, int*);
+extern int LGBM_BoosterGetFeatureNames(BoosterHandle, int*, char**);
+extern int LGBM_BoosterGetEvalCounts(BoosterHandle, int*);
+extern int LGBM_BoosterGetEvalNames(BoosterHandle, int*, char**);
+extern int LGBM_BoosterGetEval(BoosterHandle, int, int*, double*);
+extern int LGBM_BoosterResetParameter(BoosterHandle, const char*);
+extern int LGBM_BoosterResetTrainingData(BoosterHandle,
+                                         const DatasetHandle);
+extern int LGBM_BoosterMerge(BoosterHandle, BoosterHandle);
 extern int LGBM_BoosterSaveModel(BoosterHandle, int, const char*);
+extern int LGBM_BoosterSaveModelToString(BoosterHandle, int, int64_t,
+                                         int64_t*, char*);
+extern int LGBM_BoosterDumpModel(BoosterHandle, int, int64_t, int64_t*,
+                                 char*);
+extern int LGBM_BoosterFeatureImportance(BoosterHandle, int, int,
+                                         double*);
+extern int LGBM_BoosterCalcNumPredict(BoosterHandle, int, int, int,
+                                      int64_t*);
+extern int LGBM_BoosterGetLeafValue(BoosterHandle, int, int, double*);
+extern int LGBM_BoosterSetLeafValue(BoosterHandle, int, int, double);
 extern int LGBM_BoosterPredictForMat(BoosterHandle, const void*, int,
                                      int32_t, int32_t, int, int, int,
                                      const char*, int64_t*, double*);
+extern int LGBM_BoosterPredictForCSR(BoosterHandle, const void*, int,
+                                     const int32_t*, const void*, int,
+                                     int64_t, int64_t, int64_t, int, int,
+                                     const char*, int64_t*, double*);
+extern int LGBM_BoosterPredictForFile(BoosterHandle, const char*, int,
+                                      int, int, const char*, const char*);
 extern int LGBM_BoosterFree(BoosterHandle);
 
 #define C_API_DTYPE_FLOAT64 1
+#define C_API_DTYPE_INT32 2
 
 static void throw_on_error(JNIEnv* env, int status) {
   if (status != 0) {
     jclass exc = (*env)->FindClass(env, "java/lang/RuntimeException");
     (*env)->ThrowNew(env, exc, LGBM_GetLastError());
   }
+}
+
+/* caller buffers for the LGBM_*Get*Names two-call convention (each
+ * slot >= 256 bytes, see lightgbm_tpu_c_api.h) */
+static char** alloc_name_bufs(int n) {
+  char** v = (char**)malloc(sizeof(char*) * (size_t)(n > 0 ? n : 1));
+  for (int i = 0; i < n; ++i) v[i] = (char*)malloc(256);
+  return v;
+}
+
+static void free_name_bufs(char** v, int n) {
+  for (int i = 0; i < n; ++i) free(v[i]);
+  free(v);
+}
+
+static jobjectArray names_to_java(JNIEnv* env, int n, char** bufs) {
+  jclass strcls = (*env)->FindClass(env, "java/lang/String");
+  jobjectArray arr = (*env)->NewObjectArray(env, (jsize)n, strcls, NULL);
+  for (int i = 0; i < n; ++i) {
+    (*env)->SetObjectArrayElement(env, arr, (jsize)i,
+                                  (*env)->NewStringUTF(env, bufs[i]));
+  }
+  return arr;
+}
+
+static jdoubleArray doubles_to_java(JNIEnv* env, const double* v,
+                                    jsize n) {
+  jdoubleArray res = (*env)->NewDoubleArray(env, n);
+  (*env)->SetDoubleArrayRegion(env, res, 0, n, v);
+  return res;
 }
 
 JNIEXPORT jlong JNICALL
@@ -188,4 +264,477 @@ Java_com_lightgbm_tpu_LightGBMNative_boosterFree(JNIEnv* env, jclass cls,
   (void)cls;
   throw_on_error(env,
                  LGBM_BoosterFree((BoosterHandle)(intptr_t)handle));
+}
+
+/* ---- round-4 SWIG-breadth tail: dataset surface ------------------- */
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetCreateFromFile(
+    JNIEnv* env, jclass cls, jstring filename, jstring params) {
+  (void)cls;
+  const char* f = (*env)->GetStringUTFChars(env, filename, NULL);
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  DatasetHandle h = NULL;
+  int rc = LGBM_DatasetCreateFromFile(f, p, NULL, &h);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  (*env)->ReleaseStringUTFChars(env, filename, f);
+  throw_on_error(env, rc);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetCreateFromMatWithReference(
+    JNIEnv* env, jclass cls, jdoubleArray data, jint nrow, jint ncol,
+    jstring params, jlong reference) {
+  (void)cls;
+  jdouble* d = (*env)->GetDoubleArrayElements(env, data, NULL);
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  DatasetHandle h = NULL;
+  int rc = LGBM_DatasetCreateFromMat(d, C_API_DTYPE_FLOAT64, nrow, ncol,
+                                     1, p,
+                                     (DatasetHandle)(intptr_t)reference,
+                                     &h);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  (*env)->ReleaseDoubleArrayElements(env, data, d, JNI_ABORT);
+  throw_on_error(env, rc);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetCreateFromCSR(
+    JNIEnv* env, jclass cls, jintArray indptr, jintArray indices,
+    jdoubleArray values, jint num_col, jstring params) {
+  (void)cls;
+  jsize nindptr = (*env)->GetArrayLength(env, indptr);
+  jsize nelem = (*env)->GetArrayLength(env, values);
+  jint* ip = (*env)->GetIntArrayElements(env, indptr, NULL);
+  jint* ix = (*env)->GetIntArrayElements(env, indices, NULL);
+  jdouble* v = (*env)->GetDoubleArrayElements(env, values, NULL);
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  DatasetHandle h = NULL;
+  int rc = LGBM_DatasetCreateFromCSR(ip, C_API_DTYPE_INT32,
+                                     (const int32_t*)ix, v,
+                                     C_API_DTYPE_FLOAT64,
+                                     (int64_t)nindptr, (int64_t)nelem,
+                                     (int64_t)num_col, p, NULL, &h);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  (*env)->ReleaseDoubleArrayElements(env, values, v, JNI_ABORT);
+  (*env)->ReleaseIntArrayElements(env, indices, ix, JNI_ABORT);
+  (*env)->ReleaseIntArrayElements(env, indptr, ip, JNI_ABORT);
+  throw_on_error(env, rc);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetGetSubset(
+    JNIEnv* env, jclass cls, jlong handle, jintArray used_rows,
+    jstring params) {
+  (void)cls;
+  jsize n = (*env)->GetArrayLength(env, used_rows);
+  jint* rows = (*env)->GetIntArrayElements(env, used_rows, NULL);
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  DatasetHandle h = NULL;
+  int rc = LGBM_DatasetGetSubset((DatasetHandle)(intptr_t)handle,
+                                 (const int32_t*)rows, (int32_t)n, p,
+                                 &h);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  (*env)->ReleaseIntArrayElements(env, used_rows, rows, JNI_ABORT);
+  throw_on_error(env, rc);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetGetNumData(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int32_t n = 0;
+  throw_on_error(env, LGBM_DatasetGetNumData(
+      (DatasetHandle)(intptr_t)handle, &n));
+  return (jint)n;
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetGetNumFeature(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int32_t n = 0;
+  throw_on_error(env, LGBM_DatasetGetNumFeature(
+      (DatasetHandle)(intptr_t)handle, &n));
+  return (jint)n;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetSaveBinary(
+    JNIEnv* env, jclass cls, jlong handle, jstring filename) {
+  (void)cls;
+  const char* f = (*env)->GetStringUTFChars(env, filename, NULL);
+  int rc = LGBM_DatasetSaveBinary((DatasetHandle)(intptr_t)handle, f);
+  (*env)->ReleaseStringUTFChars(env, filename, f);
+  throw_on_error(env, rc);
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetSetFeatureNames(
+    JNIEnv* env, jclass cls, jlong handle, jobjectArray names) {
+  (void)cls;
+  jsize n = (*env)->GetArrayLength(env, names);
+  const char** v = (const char**)malloc(sizeof(char*) * (size_t)n);
+  jobject* objs = (jobject*)malloc(sizeof(jobject) * (size_t)n);
+  for (jsize i = 0; i < n; ++i) {
+    objs[i] = (*env)->GetObjectArrayElement(env, names, i);
+    v[i] = (*env)->GetStringUTFChars(env, (jstring)objs[i], NULL);
+  }
+  int rc = LGBM_DatasetSetFeatureNames((DatasetHandle)(intptr_t)handle,
+                                       v, (int)n);
+  for (jsize i = 0; i < n; ++i) {
+    (*env)->ReleaseStringUTFChars(env, (jstring)objs[i], v[i]);
+  }
+  free(objs);
+  free((void*)v);
+  throw_on_error(env, rc);
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_datasetGetFeatureNames(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int n = 0;
+  int rc = LGBM_DatasetGetFeatureNames((DatasetHandle)(intptr_t)handle,
+                                       NULL, &n);
+  if (rc != 0) { throw_on_error(env, rc); return NULL; }
+  char** bufs = alloc_name_bufs(n);
+  rc = LGBM_DatasetGetFeatureNames((DatasetHandle)(intptr_t)handle,
+                                   bufs, &n);
+  jobjectArray res = (rc == 0) ? names_to_java(env, n, bufs) : NULL;
+  free_name_bufs(bufs, n);
+  throw_on_error(env, rc);
+  return res;
+}
+
+/* ---- round-4 SWIG-breadth tail: booster surface ------------------- */
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterLoadModelFromString(
+    JNIEnv* env, jclass cls, jstring model) {
+  (void)cls;
+  const char* m = (*env)->GetStringUTFChars(env, model, NULL);
+  int iters = 0;
+  BoosterHandle h = NULL;
+  int rc = LGBM_BoosterLoadModelFromString(m, &iters, &h);
+  (*env)->ReleaseStringUTFChars(env, model, m);
+  throw_on_error(env, rc);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jstring JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterSaveModelToString(
+    JNIEnv* env, jclass cls, jlong handle, jint num_iteration) {
+  (void)cls;
+  int64_t need = 0;
+  int rc = LGBM_BoosterSaveModelToString(
+      (BoosterHandle)(intptr_t)handle, (int)num_iteration, 0, &need,
+      NULL);
+  if (rc != 0) { throw_on_error(env, rc); return NULL; }
+  char* buf = (char*)malloc((size_t)need);
+  rc = LGBM_BoosterSaveModelToString((BoosterHandle)(intptr_t)handle,
+                                     (int)num_iteration, need, &need,
+                                     buf);
+  jstring res = (rc == 0) ? (*env)->NewStringUTF(env, buf) : NULL;
+  free(buf);
+  throw_on_error(env, rc);
+  return res;
+}
+
+JNIEXPORT jstring JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterDumpModel(
+    JNIEnv* env, jclass cls, jlong handle, jint num_iteration) {
+  (void)cls;
+  int64_t need = 0;
+  int rc = LGBM_BoosterDumpModel((BoosterHandle)(intptr_t)handle,
+                                 (int)num_iteration, 0, &need, NULL);
+  if (rc != 0) { throw_on_error(env, rc); return NULL; }
+  char* buf = (char*)malloc((size_t)need);
+  rc = LGBM_BoosterDumpModel((BoosterHandle)(intptr_t)handle,
+                             (int)num_iteration, need, &need, buf);
+  jstring res = (rc == 0) ? (*env)->NewStringUTF(env, buf) : NULL;
+  free(buf);
+  throw_on_error(env, rc);
+  return res;
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterUpdateOneIterCustom(
+    JNIEnv* env, jclass cls, jlong handle, jfloatArray grad,
+    jfloatArray hess) {
+  (void)cls;
+  jsize n = (*env)->GetArrayLength(env, grad);
+  if ((*env)->GetArrayLength(env, hess) != n) {
+    jclass exc = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, exc, "grad/hess length mismatch");
+    return 0;
+  }
+  jfloat* g = (*env)->GetFloatArrayElements(env, grad, NULL);
+  jfloat* h = (*env)->GetFloatArrayElements(env, hess, NULL);
+  int finished = 0;
+  int rc = LGBM_BoosterUpdateOneIterCustom(
+      (BoosterHandle)(intptr_t)handle, g, h, (int64_t)n, &finished);
+  (*env)->ReleaseFloatArrayElements(env, hess, h, JNI_ABORT);
+  (*env)->ReleaseFloatArrayElements(env, grad, g, JNI_ABORT);
+  throw_on_error(env, rc);
+  return (jint)finished;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterRollbackOneIter(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  throw_on_error(env, LGBM_BoosterRollbackOneIter(
+      (BoosterHandle)(intptr_t)handle));
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterGetNumClasses(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int n = 0;
+  throw_on_error(env, LGBM_BoosterGetNumClasses(
+      (BoosterHandle)(intptr_t)handle, &n));
+  return (jint)n;
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterGetCurrentIteration(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int n = 0;
+  throw_on_error(env, LGBM_BoosterGetCurrentIteration(
+      (BoosterHandle)(intptr_t)handle, &n));
+  return (jint)n;
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterNumberOfTotalModel(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int n = 0;
+  throw_on_error(env, LGBM_BoosterNumberOfTotalModel(
+      (BoosterHandle)(intptr_t)handle, &n));
+  return (jint)n;
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterGetNumFeature(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int n = 0;
+  throw_on_error(env, LGBM_BoosterGetNumFeature(
+      (BoosterHandle)(intptr_t)handle, &n));
+  return (jint)n;
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterGetFeatureNames(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int n = 0;
+  int rc = LGBM_BoosterGetFeatureNames((BoosterHandle)(intptr_t)handle,
+                                       &n, NULL);
+  if (rc != 0) { throw_on_error(env, rc); return NULL; }
+  char** bufs = alloc_name_bufs(n);
+  rc = LGBM_BoosterGetFeatureNames((BoosterHandle)(intptr_t)handle, &n,
+                                   bufs);
+  jobjectArray res = (rc == 0) ? names_to_java(env, n, bufs) : NULL;
+  free_name_bufs(bufs, n);
+  throw_on_error(env, rc);
+  return res;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterAddValidData(
+    JNIEnv* env, jclass cls, jlong handle, jlong valid) {
+  (void)cls;
+  throw_on_error(env, LGBM_BoosterAddValidData(
+      (BoosterHandle)(intptr_t)handle,
+      (DatasetHandle)(intptr_t)valid));
+}
+
+JNIEXPORT jint JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterGetEvalCounts(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int n = 0;
+  throw_on_error(env, LGBM_BoosterGetEvalCounts(
+      (BoosterHandle)(intptr_t)handle, &n));
+  return (jint)n;
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterGetEvalNames(
+    JNIEnv* env, jclass cls, jlong handle) {
+  (void)cls;
+  int n = 0;
+  int rc = LGBM_BoosterGetEvalNames((BoosterHandle)(intptr_t)handle, &n,
+                                    NULL);
+  if (rc != 0) { throw_on_error(env, rc); return NULL; }
+  char** bufs = alloc_name_bufs(n);
+  rc = LGBM_BoosterGetEvalNames((BoosterHandle)(intptr_t)handle, &n,
+                                bufs);
+  jobjectArray res = (rc == 0) ? names_to_java(env, n, bufs) : NULL;
+  free_name_bufs(bufs, n);
+  throw_on_error(env, rc);
+  return res;
+}
+
+JNIEXPORT jdoubleArray JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterGetEval(
+    JNIEnv* env, jclass cls, jlong handle, jint data_idx) {
+  (void)cls;
+  int cap = 0;
+  int rc = LGBM_BoosterGetEvalCounts((BoosterHandle)(intptr_t)handle,
+                                     &cap);
+  if (rc != 0) { throw_on_error(env, rc); return NULL; }
+  double* vals = (double*)malloc(sizeof(double)
+                                 * (size_t)(cap > 0 ? cap : 1));
+  int n = 0;
+  rc = LGBM_BoosterGetEval((BoosterHandle)(intptr_t)handle,
+                           (int)data_idx, &n, vals);
+  jdoubleArray res =
+      (rc == 0) ? doubles_to_java(env, vals, (jsize)n) : NULL;
+  free(vals);
+  throw_on_error(env, rc);
+  return res;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterResetParameter(
+    JNIEnv* env, jclass cls, jlong handle, jstring params) {
+  (void)cls;
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  int rc = LGBM_BoosterResetParameter((BoosterHandle)(intptr_t)handle,
+                                      p);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  throw_on_error(env, rc);
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterResetTrainingData(
+    JNIEnv* env, jclass cls, jlong handle, jlong dataset) {
+  (void)cls;
+  throw_on_error(env, LGBM_BoosterResetTrainingData(
+      (BoosterHandle)(intptr_t)handle,
+      (DatasetHandle)(intptr_t)dataset));
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterMerge(
+    JNIEnv* env, jclass cls, jlong handle, jlong other) {
+  (void)cls;
+  throw_on_error(env, LGBM_BoosterMerge(
+      (BoosterHandle)(intptr_t)handle,
+      (BoosterHandle)(intptr_t)other));
+}
+
+JNIEXPORT jdoubleArray JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterPredictForCSR(
+    JNIEnv* env, jclass cls, jlong handle, jintArray indptr,
+    jintArray indices, jdoubleArray values, jint num_col,
+    jint predict_type, jint num_iteration) {
+  (void)cls;
+  jsize nindptr = (*env)->GetArrayLength(env, indptr);
+  jsize nelem = (*env)->GetArrayLength(env, values);
+  int64_t cap = 0;
+  int rc = LGBM_BoosterCalcNumPredict(
+      (BoosterHandle)(intptr_t)handle, (int)(nindptr - 1),
+      (int)predict_type, (int)num_iteration, &cap);
+  if (rc != 0) { throw_on_error(env, rc); return NULL; }
+  jint* ip = (*env)->GetIntArrayElements(env, indptr, NULL);
+  jint* ix = (*env)->GetIntArrayElements(env, indices, NULL);
+  jdouble* v = (*env)->GetDoubleArrayElements(env, values, NULL);
+  double* out = (double*)malloc(sizeof(double)
+                                * (size_t)(cap > 0 ? cap : 1));
+  int64_t out_len = 0;
+  rc = LGBM_BoosterPredictForCSR(
+      (BoosterHandle)(intptr_t)handle, ip, C_API_DTYPE_INT32,
+      (const int32_t*)ix, v, C_API_DTYPE_FLOAT64, (int64_t)nindptr,
+      (int64_t)nelem, (int64_t)num_col, (int)predict_type,
+      (int)num_iteration, "", &out_len, out);
+  (*env)->ReleaseDoubleArrayElements(env, values, v, JNI_ABORT);
+  (*env)->ReleaseIntArrayElements(env, indices, ix, JNI_ABORT);
+  (*env)->ReleaseIntArrayElements(env, indptr, ip, JNI_ABORT);
+  jdoubleArray res =
+      (rc == 0) ? doubles_to_java(env, out, (jsize)out_len) : NULL;
+  free(out);
+  throw_on_error(env, rc);
+  return res;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterPredictForFile(
+    JNIEnv* env, jclass cls, jlong handle, jstring data_file,
+    jint has_header, jint predict_type, jint num_iteration,
+    jstring result_file) {
+  (void)cls;
+  const char* df = (*env)->GetStringUTFChars(env, data_file, NULL);
+  const char* rf = (*env)->GetStringUTFChars(env, result_file, NULL);
+  int rc = LGBM_BoosterPredictForFile(
+      (BoosterHandle)(intptr_t)handle, df, (int)has_header,
+      (int)predict_type, (int)num_iteration, "", rf);
+  (*env)->ReleaseStringUTFChars(env, result_file, rf);
+  (*env)->ReleaseStringUTFChars(env, data_file, df);
+  throw_on_error(env, rc);
+}
+
+JNIEXPORT jdoubleArray JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterFeatureImportance(
+    JNIEnv* env, jclass cls, jlong handle, jint num_iteration,
+    jint importance_type) {
+  (void)cls;
+  int nfeat = 0;
+  int rc = LGBM_BoosterGetNumFeature((BoosterHandle)(intptr_t)handle,
+                                     &nfeat);
+  if (rc != 0) { throw_on_error(env, rc); return NULL; }
+  double* out = (double*)malloc(sizeof(double)
+                                * (size_t)(nfeat > 0 ? nfeat : 1));
+  rc = LGBM_BoosterFeatureImportance((BoosterHandle)(intptr_t)handle,
+                                     (int)num_iteration,
+                                     (int)importance_type, out);
+  jdoubleArray res =
+      (rc == 0) ? doubles_to_java(env, out, (jsize)nfeat) : NULL;
+  free(out);
+  throw_on_error(env, rc);
+  return res;
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterCalcNumPredict(
+    JNIEnv* env, jclass cls, jlong handle, jint num_row,
+    jint predict_type, jint num_iteration) {
+  (void)cls;
+  int64_t n = 0;
+  throw_on_error(env, LGBM_BoosterCalcNumPredict(
+      (BoosterHandle)(intptr_t)handle, (int)num_row, (int)predict_type,
+      (int)num_iteration, &n));
+  return (jlong)n;
+}
+
+JNIEXPORT jdouble JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterGetLeafValue(
+    JNIEnv* env, jclass cls, jlong handle, jint tree_idx,
+    jint leaf_idx) {
+  (void)cls;
+  double v = 0.0;
+  throw_on_error(env, LGBM_BoosterGetLeafValue(
+      (BoosterHandle)(intptr_t)handle, (int)tree_idx, (int)leaf_idx,
+      &v));
+  return (jdouble)v;
+}
+
+JNIEXPORT void JNICALL
+Java_com_lightgbm_tpu_LightGBMNative_boosterSetLeafValue(
+    JNIEnv* env, jclass cls, jlong handle, jint tree_idx, jint leaf_idx,
+    jdouble value) {
+  (void)cls;
+  throw_on_error(env, LGBM_BoosterSetLeafValue(
+      (BoosterHandle)(intptr_t)handle, (int)tree_idx, (int)leaf_idx,
+      (double)value));
 }

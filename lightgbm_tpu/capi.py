@@ -205,11 +205,15 @@ def LGBM_DatasetPushRowsByCSR(handle, indptr, indices, data,
 @_api
 def LGBM_DatasetCreateFromFile(filename: str, parameters: str,
                                reference=None, out=None) -> int:
-    """reference c_api.h:53-66."""
+    """reference c_api.h:53-66.  Constructed eagerly: the reference's
+    c_api parses and bins the file at create (c_api.cpp
+    DatasetLoader::LoadFromFile), so C callers may query num_data /
+    num_feature immediately."""
     params = _parse_params(parameters)
     ref = _get(reference) if reference else None
     ds = Dataset(str(filename), reference=ref, params=params,
                  free_raw_data=False)
+    ds.construct()
     out[0] = _register(ds)
     return 0
 

@@ -52,6 +52,9 @@ int LTPU_EnsureInitialized(void);
 
 /* ---- error handling */
 const char* LGBM_GetLastError(void);
+/* reference c_api.h:768 — embedders (custom objectives calling back
+ * into the host) set the error slot themselves. */
+int LGBM_SetLastError(const char* msg);
 
 /* ---- Dataset */
 int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
@@ -72,6 +75,49 @@ int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
 int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
 int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
 int LGBM_DatasetFree(DatasetHandle handle);
+/* CSR rows (reference c_api.h:147-180).  indptr_type / data_type are
+ * C_API_DTYPE_* codes; indices are int32. */
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+/* CSC columns (reference c_api.h:183-216). */
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+/* Row subset sharing the parent's bin mappers (reference
+ * c_api.h:195-210). */
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out);
+/* Feature names (reference c_api.h:212-230).  On Get, each
+ * out_strs[i] must point at a caller buffer of >= 256 bytes; pass
+ * out_strs == NULL to only query the count. */
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names);
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** out_strs,
+                                int* out_len);
+/* Streaming ingestion (reference c_api.h:68-145): mappers fitted from
+ * per-column samples (or copied from an existing dataset), then rows
+ * pushed in chunks. */
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out);
+int LGBM_DatasetPushRows(DatasetHandle handle, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row);
+int LGBM_DatasetPushRowsByCSR(DatasetHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row);
 
 /* ---- Booster */
 int LGBM_BoosterCreate(const DatasetHandle train_data,
@@ -113,6 +159,60 @@ int LGBM_BoosterDumpModel(BoosterHandle handle, int num_iteration,
                           char* out_str);
 int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
                                   int importance_type, double* out_results);
+/* Append other's trees onto handle (reference c_api.h:330-338). */
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models);
+int LGBM_BoosterResetParameter(BoosterHandle handle,
+                               const char* parameters);
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data);
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+/* Name lists: each out_strs[i] must point at a caller buffer of
+ * >= 256 bytes; pass out_strs == NULL to only query the count
+ * (reference c_api.h:430-446). */
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs);
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs);
+/* Converted in-training scores of train (data_idx 0) / valid set
+ * data_idx-1 (reference c_api.h:520-548).  Size out_result with
+ * GetNumPredict first. */
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val);
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val);
+/* Result-buffer size for a prediction call (reference
+ * c_api.h:520-535). */
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len);
+/* Sparse prediction (reference c_api.h:574-659).  parameter is
+ * reserved (the reference parses extra predict params there). */
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+/* Batch file prediction, one row per line (reference
+ * c_api.h:495-518). */
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename);
 
 /* ---- Network (reference c_api.h:749-762; see capi.py for the TPU
  * semantics — rendezvous goes through jax.distributed, these warn) */

@@ -284,6 +284,65 @@ int copy_string_out(PyObject* str, int64_t buffer_len, int64_t* out_len,
   return 0;
 }
 
+// Writable float64 numpy view over a caller buffer (no copy) — for
+// out_result parameters the Python side fills by slice assignment.
+PyObject* writable_f64(double* buf, int64_t nelem) {
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(buf),
+      static_cast<Py_ssize_t>(nelem * sizeof(double)), PyBUF_WRITE);
+  if (mv == nullptr) { set_error_from_python(); return nullptr; }
+  PyObject* arr = PyObject_CallMethod(g_np, "frombuffer", "Os", mv,
+                                      "float64");
+  Py_DECREF(mv);
+  if (arr == nullptr) set_error_from_python();
+  return arr;
+}
+
+// Copy a Python sequence of strings into caller char* buffers (>= 256
+// bytes each, truncating) — the Get*Names output convention.
+int copy_names_out(PyObject* seq, int* out_len, char** out_strs) {
+  Py_ssize_t n = PySequence_Size(seq);
+  if (n < 0) { set_error_from_python(); return -1; }
+  if (out_len != nullptr) *out_len = static_cast<int>(n);
+  if (out_strs != nullptr) {
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* item = PySequence_GetItem(seq, i);
+      const char* c = item ? PyUnicode_AsUTF8(item) : nullptr;
+      if (c == nullptr) {
+        set_error_from_python();
+        Py_XDECREF(item);
+        return -1;
+      }
+      std::strncpy(out_strs[i], c, 255);
+      out_strs[i][255] = '\0';
+      Py_DECREF(item);
+    }
+  }
+  return 0;
+}
+
+// numpy (indptr, indices, data) triple from reference-style CSR/CSC
+// buffers.  Fills three new references; returns 0 on success.
+int csx_arrays(const void* indptr, int indptr_type, const int32_t* indices,
+               const void* data, int data_type, int64_t nindptr,
+               int64_t nelem, PyObject** out_indptr, PyObject** out_indices,
+               PyObject** out_data) {
+  if (indptr_type != C_API_DTYPE_INT32 && indptr_type != C_API_DTYPE_INT64) {
+    g_last_error = "indptr_type must be int32 or int64";
+    return -1;
+  }
+  PyObject* p = array_from_buffer(indptr, indptr_type, nindptr);
+  if (p == nullptr) return -1;
+  PyObject* ix = array_from_buffer(indices, C_API_DTYPE_INT32, nelem);
+  if (ix == nullptr) { Py_DECREF(p); return -1; }
+  PyObject* d = array_from_buffer(data, data_type, nelem);
+  if (d == nullptr) { Py_DECREF(p); Py_DECREF(ix); return -1; }
+  *out_indptr = p;
+  *out_indices = ix;
+  *out_data = d;
+  return 0;
+}
+
 #define LTPU_ENTER()                      \
   if (ensure_init_locked() != 0) return -1; \
   GilScope gil_scope__
@@ -694,6 +753,367 @@ int LGBM_NetworkInit(const char* machines, int local_listen_port,
 int LGBM_NetworkFree(void) {
   LTPU_ENTER();
   return call_simple("LGBM_NetworkFree", PyTuple_New(0));
+}
+
+/* ---------------------------------------------- full-surface tail
+ * (round 4: the SWIG-breadth symbols so JNI/R hosts see the same
+ * flat ABI the reference's swig/lightgbmlib.i wraps) */
+
+int LGBM_SetLastError(const char* msg) {
+  g_last_error = msg ? msg : "";
+  if (g_capi != nullptr) {
+    GilScope gil_scope__;
+    call_simple("LGBM_SetLastError", Py_BuildValue("(s)", msg ? msg : ""));
+  }
+  return 0;
+}
+
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  LTPU_ENTER();
+  PyObject *p, *ix, *d;
+  if (csx_arrays(indptr, indptr_type, indices, data, data_type, nindptr,
+                 nelem, &p, &ix, &d) != 0) return -1;
+  PyObject* ref = reference ? PyLong_FromSsize_t(handle_int(reference))
+                            : (Py_INCREF(Py_None), Py_None);
+  PyObject* args = Py_BuildValue("(NNNLsN)", p, ix, d,
+                                 static_cast<long long>(num_col),
+                                 parameters ? parameters : "", ref);
+  PyObject* h = nullptr;
+  int rc = call_with_out("LGBM_DatasetCreateFromCSR", args, &h);
+  if (rc == 0) {
+    *out = reinterpret_cast<DatasetHandle>(PyLong_AsSsize_t(h));
+    Py_DECREF(h);
+  }
+  return rc;
+}
+
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  LTPU_ENTER();
+  PyObject *p, *ix, *d;
+  if (csx_arrays(col_ptr, col_ptr_type, indices, data, data_type, ncol_ptr,
+                 nelem, &p, &ix, &d) != 0) return -1;
+  PyObject* ref = reference ? PyLong_FromSsize_t(handle_int(reference))
+                            : (Py_INCREF(Py_None), Py_None);
+  PyObject* args = Py_BuildValue("(NNNLsN)", p, ix, d,
+                                 static_cast<long long>(num_row),
+                                 parameters ? parameters : "", ref);
+  PyObject* h = nullptr;
+  int rc = call_with_out("LGBM_DatasetCreateFromCSC", args, &h);
+  if (rc == 0) {
+    *out = reinterpret_cast<DatasetHandle>(PyLong_AsSsize_t(h));
+    Py_DECREF(h);
+  }
+  return rc;
+}
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out) {
+  LTPU_ENTER();
+  PyObject* idx = array_from_buffer(used_row_indices, C_API_DTYPE_INT32,
+                                    num_used_row_indices);
+  if (idx == nullptr) return -1;
+  PyObject* args = Py_BuildValue("(nNis)", handle_int(handle), idx,
+                                 static_cast<int>(num_used_row_indices),
+                                 parameters ? parameters : "");
+  PyObject* h = nullptr;
+  int rc = call_with_out("LGBM_DatasetGetSubset", args, &h);
+  if (rc == 0) {
+    *out = reinterpret_cast<DatasetHandle>(PyLong_AsSsize_t(h));
+    Py_DECREF(h);
+  }
+  return rc;
+}
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names) {
+  LTPU_ENTER();
+  PyObject* names = PyList_New(num_feature_names);
+  if (names == nullptr) { set_error_from_python(); return -1; }
+  for (int i = 0; i < num_feature_names; ++i) {
+    PyObject* s = PyUnicode_FromString(feature_names[i]);
+    if (s == nullptr) {
+      set_error_from_python();
+      Py_DECREF(names);
+      return -1;
+    }
+    PyList_SetItem(names, i, s);  // steals
+  }
+  PyObject* args = Py_BuildValue("(nNi)", handle_int(handle), names,
+                                 num_feature_names);
+  return call_simple("LGBM_DatasetSetFeatureNames", args);
+}
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** out_strs,
+                                int* out_len) {
+  LTPU_ENTER();
+  /* python slice-assigns the names into out_strs (its optional
+   * out_len defaults to None); count comes from the filled list */
+  PyObject* strs = PyList_New(0);
+  PyObject* args = Py_BuildValue("(nO)", handle_int(handle), strs);
+  int rc = call_simple("LGBM_DatasetGetFeatureNames", args);
+  if (rc == 0) rc = copy_names_out(strs, out_len, out_strs);
+  Py_DECREF(strs);
+  return rc;
+}
+
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(nL)", handle_int(reference),
+                                 static_cast<long long>(num_total_row));
+  PyObject* h = nullptr;
+  int rc = call_with_out("LGBM_DatasetCreateByReference", args, &h);
+  if (rc == 0) {
+    *out = reinterpret_cast<DatasetHandle>(PyLong_AsSsize_t(h));
+    Py_DECREF(h);
+  }
+  return rc;
+}
+
+int LGBM_DatasetPushRows(DatasetHandle handle, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row) {
+  LTPU_ENTER();
+  PyObject* arr = array_from_buffer(data, data_type,
+                                    static_cast<int64_t>(nrow) * ncol);
+  if (arr == nullptr) return -1;
+  PyObject* args = Py_BuildValue("(nNiii)", handle_int(handle), arr,
+                                 static_cast<int>(nrow),
+                                 static_cast<int>(ncol),
+                                 static_cast<int>(start_row));
+  return call_simple("LGBM_DatasetPushRows", args);
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row) {
+  LTPU_ENTER();
+  PyObject *p, *ix, *d;
+  if (csx_arrays(indptr, indptr_type, indices, data, data_type, nindptr,
+                 nelem, &p, &ix, &d) != 0) return -1;
+  PyObject* args = Py_BuildValue("(nNNNLi)", handle_int(handle), p, ix, d,
+                                 static_cast<long long>(num_col),
+                                 static_cast<int>(start_row));
+  return call_simple("LGBM_DatasetPushRowsByCSR", args);
+}
+
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(nn)", handle_int(handle),
+                                 handle_int(other_handle));
+  return call_simple("LGBM_BoosterMerge", args);
+}
+
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(n)", handle_int(handle));
+  PyObject* v = nullptr;
+  int rc = call_with_out("LGBM_BoosterNumberOfTotalModel", args, &v);
+  if (rc == 0) {
+    *out_models = static_cast<int>(PyLong_AsLong(v));
+    Py_DECREF(v);
+  }
+  return rc;
+}
+
+int LGBM_BoosterResetParameter(BoosterHandle handle,
+                               const char* parameters) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(ns)", handle_int(handle),
+                                 parameters ? parameters : "");
+  return call_simple("LGBM_BoosterResetParameter", args);
+}
+
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(nn)", handle_int(handle),
+                                 handle_int(train_data));
+  return call_simple("LGBM_BoosterResetTrainingData", args);
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(n)", handle_int(handle));
+  PyObject* v = nullptr;
+  int rc = call_with_out("LGBM_BoosterGetNumFeature", args, &v);
+  if (rc == 0) { *out_len = static_cast<int>(PyLong_AsLong(v)); Py_DECREF(v); }
+  return rc;
+}
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(n)", handle_int(handle));
+  PyObject* names = nullptr;  /* python: out_strs[0] = [names] */
+  int rc = call_with_out("LGBM_BoosterGetFeatureNames", args, &names);
+  if (rc != 0) return rc;
+  rc = copy_names_out(names, out_len, out_strs);
+  Py_DECREF(names);
+  return rc;
+}
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(n)", handle_int(handle));
+  PyObject* names = nullptr;
+  int rc = call_with_out("LGBM_BoosterGetEvalNames", args, &names);
+  if (rc != 0) return rc;
+  rc = copy_names_out(names, out_len, out_strs);
+  Py_DECREF(names);
+  return rc;
+}
+
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(ni)", handle_int(handle), data_idx);
+  PyObject* v = nullptr;
+  int rc = call_with_out("LGBM_BoosterGetNumPredict", args, &v);
+  if (rc == 0) {
+    *out_len = static_cast<int64_t>(PyLong_AsLongLong(v));
+    Py_DECREF(v);
+  }
+  return rc;
+}
+
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result) {
+  LTPU_ENTER();
+  /* capacity from GetNumPredict, then let python slice-assign into a
+   * writable view of the caller's buffer */
+  PyObject* nargs = Py_BuildValue("(ni)", handle_int(handle), data_idx);
+  PyObject* nv = nullptr;
+  int rc = call_with_out("LGBM_BoosterGetNumPredict", nargs, &nv);
+  if (rc != 0) return rc;
+  int64_t cap = static_cast<int64_t>(PyLong_AsLongLong(nv));
+  Py_DECREF(nv);
+  PyObject* arr = writable_f64(out_result, cap);
+  if (arr == nullptr) return -1;
+  PyObject* len_list = PyList_New(1);
+  Py_INCREF(Py_None);
+  PyList_SetItem(len_list, 0, Py_None);
+  PyObject* args = Py_BuildValue("(niON)", handle_int(handle), data_idx,
+                                 len_list, arr);
+  rc = call_simple("LGBM_BoosterGetPredict", args);
+  if (rc == 0 && out_len != nullptr) {
+    PyObject* n0 = PyList_GetItem(len_list, 0);
+    *out_len = (n0 != Py_None)
+                   ? static_cast<int64_t>(PyLong_AsLongLong(n0)) : 0;
+  }
+  Py_DECREF(len_list);
+  return rc;
+}
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(nii)", handle_int(handle), tree_idx,
+                                 leaf_idx);
+  PyObject* v = nullptr;
+  int rc = call_with_out("LGBM_BoosterGetLeafValue", args, &v);
+  if (rc == 0) { *out_val = PyFloat_AsDouble(v); Py_DECREF(v); }
+  return rc;
+}
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(niid)", handle_int(handle), tree_idx,
+                                 leaf_idx, val);
+  return call_simple("LGBM_BoosterSetLeafValue", args);
+}
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(niii)", handle_int(handle), num_row,
+                                 predict_type, num_iteration);
+  PyObject* v = nullptr;
+  int rc = call_with_out("LGBM_BoosterCalcNumPredict", args, &v);
+  if (rc == 0) {
+    *out_len = static_cast<int64_t>(PyLong_AsLongLong(v));
+    Py_DECREF(v);
+  }
+  return rc;
+}
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  LTPU_ENTER();
+  (void)parameter;  // reserved, as in PredictForMat
+  PyObject *p, *ix, *d;
+  if (csx_arrays(indptr, indptr_type, indices, data, data_type, nindptr,
+                 nelem, &p, &ix, &d) != 0) return -1;
+  PyObject* args = Py_BuildValue("(nNNNLii)", handle_int(handle), p, ix, d,
+                                 static_cast<long long>(num_col),
+                                 predict_type, num_iteration);
+  PyObject* pred = nullptr;
+  int rc = call_with_out("LGBM_BoosterPredictForCSR", args, &pred);
+  if (rc != 0) return rc;
+  rc = copy_to_doubles(pred, out_result, out_len);
+  Py_DECREF(pred);
+  return rc;
+}
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  LTPU_ENTER();
+  (void)parameter;
+  PyObject *p, *ix, *d;
+  if (csx_arrays(col_ptr, col_ptr_type, indices, data, data_type, ncol_ptr,
+                 nelem, &p, &ix, &d) != 0) return -1;
+  PyObject* args = Py_BuildValue("(nNNNLii)", handle_int(handle), p, ix, d,
+                                 static_cast<long long>(num_row),
+                                 predict_type, num_iteration);
+  PyObject* pred = nullptr;
+  int rc = call_with_out("LGBM_BoosterPredictForCSC", args, &pred);
+  if (rc != 0) return rc;
+  rc = copy_to_doubles(pred, out_result, out_len);
+  Py_DECREF(pred);
+  return rc;
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename) {
+  LTPU_ENTER();
+  PyObject* args = Py_BuildValue("(nsiiiss)", handle_int(handle),
+                                 data_filename, data_has_header,
+                                 predict_type, num_iteration,
+                                 parameter ? parameter : "",
+                                 result_filename);
+  return call_simple("LGBM_BoosterPredictForFile", args);
 }
 
 }  /* extern "C" */

@@ -43,6 +43,36 @@ def test_jni_binding_executes_via_fake_env(native_lib, tmp_path):
     assert "JNI-HOST OK" in run.stdout
 
 
+def _java_entry_points(path):
+    import re
+    with open(path) as fh:
+        return re.findall(r"Java_com_lightgbm_tpu_LightGBMNative_"
+                          r"(\w+)", fh.read())
+
+
+def test_jni_surface_is_swig_breadth():
+    """Every Java_* entry point in the binding must be declared on the
+    Java class AND exercised by the fake-env host driver — so the
+    surface can only shrink by visibly editing all three files.  The
+    floor pins SWIG breadth (40 fns), not the round-2 9-function
+    slice."""
+    import re
+    binding = set(_java_entry_points(os.path.join(JNI, "lightgbm_jni.c")))
+    driver = _java_entry_points(
+        os.path.join(REPO, "tests", "jni_host_driver.c"))
+    # an entry point only declared (extern) in the driver appears once;
+    # a called one appears at least twice
+    uncalled = {fn for fn in binding if driver.count(fn) < 2}
+    assert not uncalled, \
+        f"entry points not exercised by driver: {uncalled}"
+    with open(os.path.join(JNI, "LightGBMNative.java")) as fh:
+        java_src = fh.read()
+    undeclared = {fn for fn in binding
+                  if not re.search(rf"\b{fn}\(", java_src)}
+    assert not undeclared, f"not declared on the Java class: {undeclared}"
+    assert len(binding) >= 40
+
+
 @pytest.mark.skipif(shutil.which("javac") is None or
                     os.environ.get("JAVA_HOME") is None,
                     reason="no JDK")

@@ -299,7 +299,9 @@ PyObject* writable_f64(double* buf, int64_t nelem) {
 }
 
 // Copy a Python sequence of strings into caller char* buffers (>= 256
-// bytes each, truncating) — the Get*Names output convention.
+// bytes each) — the Get*Names output convention.  A name that does
+// not fit is an ERROR, never a silent truncation (a truncated name
+// would corrupt any name-keyed lookup downstream).
 int copy_names_out(PyObject* seq, int* out_len, char** out_strs) {
   Py_ssize_t n = PySequence_Size(seq);
   if (n < 0) { set_error_from_python(); return -1; }
@@ -313,8 +315,13 @@ int copy_names_out(PyObject* seq, int* out_len, char** out_strs) {
         Py_XDECREF(item);
         return -1;
       }
-      std::strncpy(out_strs[i], c, 255);
-      out_strs[i][255] = '\0';
+      if (std::strlen(c) >= 256) {
+        g_last_error = "name longer than the 256-byte Get*Names "
+                       "buffer convention: " + std::string(c, 64);
+        Py_DECREF(item);
+        return -1;
+      }
+      std::strcpy(out_strs[i], c);
       Py_DECREF(item);
     }
   }

@@ -65,6 +65,34 @@ def test_sparse_predict_matches_dense_predict():
                                   bst.predict(d, pred_leaf=True))
 
 
+def test_wide_sparse_predict_compacts_to_used_features():
+    """A model over a wide sparse matrix references only its split-on
+    features; predict must stage dense chunks over THAT width (exact
+    column-subset compaction), never the full matrix width."""
+    X, d, y = _sparse_task(n=600, f=20)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 8,
+                    verbose_eval=False)
+    wide = 50_000
+    Xw = sp.hstack(
+        [X, sp.random(X.shape[0], wide - X.shape[1], density=1e-5,
+                      random_state=np.random.RandomState(1))]).tocsr()
+    compact = bst._compact_for_sparse(-1, wide)
+    assert compact is not None
+    _, used = compact
+    assert used.size <= 20  # splits only ever touched the real block
+    p_wide = bst.predict(Xw)
+    np.testing.assert_allclose(p_wide, bst.predict(d), atol=0)
+    # leaf indices are invariant under the column remap
+    np.testing.assert_array_equal(bst.predict(Xw, pred_leaf=True),
+                                  bst.predict(d, pred_leaf=True))
+    # staging chunk is sized by used width: the full-width fallback at
+    # this shape would need > 300 chunks; compaction needs exactly 1
+    rows_per_chunk = max(1, (128 << 20) // (8 * used.size))
+    assert rows_per_chunk >= Xw.shape[0]
+
+
 def test_sparse_onehot_columns_bundle():
     rng = np.random.RandomState(3)
     z = rng.randint(0, 12, 1500)

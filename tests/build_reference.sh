@@ -16,4 +16,13 @@ cd "$BUILD_DIR"
 cmake -DCMAKE_BUILD_TYPE=Release -DUSE_OPENMP=ON "$REF_SRC" \
     > cmake.log 2>&1
 make -j"$(nproc)" lightgbm > make.log 2>&1
+# the reference CMake sets EXECUTABLE_OUTPUT_PATH to ITS source dir;
+# move the ELF here and leave the read-only reference tree untouched
+if [ -f "$REF_SRC/lightgbm" ]; then
+    mv "$REF_SRC/lightgbm" "$BUILD_DIR/lightgbm"
+fi
+if [ ! -f "$BUILD_DIR/lightgbm" ]; then
+    echo "ERROR: no binary at $BUILD_DIR/lightgbm (see make.log)" >&2
+    exit 1
+fi
 echo "built: $BUILD_DIR/lightgbm"

@@ -1,6 +1,13 @@
 """Round-4 probes, part 2: the two Mosaic capabilities the cheap
 partition kernel needs.
 
+jax 0.9 status (re-run after the image's 0.8.x -> 0.9.0 upgrade):
+P5 still unsupported (same gather shape-check / compiler crash).
+P6 REGRESSED — the dynamic-offset VMEM->HBM async copy that worked
+under 0.8.x now crashes the 0.9 Mosaic compiler (remote_compile 500);
+only the unwired partition prototype used it.  P7 works with the
+masked-row store spelling below (0.9 rejects scalar stores to VMEM).
+
 P5  dynamic LANE gather in VMEM: out[:, d] = x[:, idx[d]] — compaction
     by index gather (15x less MXU than a permutation matmul).  Tried
     as jnp.take / take_along_axis / x[:, idx] spellings.
@@ -98,7 +105,11 @@ def probe_smem_carry():
             out_ref[:] = jnp.zeros_like(out_ref)
 
         k = jnp.sum(x_ref[:].astype(jnp.int32))
-        out_ref[0, i] = cnt[0]
+        # jax 0.9 Mosaic rejects scalar stores to VMEM
+        # ("Cannot store scalars to VMEM"); a masked full-row store
+        # expresses the same per-step write and lowers fine
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 8), 1)
+        out_ref[:] = jnp.where(lane == i, cnt[0], out_ref[:])
         cnt[0] = cnt[0] + k
 
     x = jnp.ones((8, 8, 128), jnp.int8)

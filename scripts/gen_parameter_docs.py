@@ -1,0 +1,278 @@
+"""Generate docs/Parameters.md from the Config dataclass source.
+
+The reference maintains docs/Parameters.rst by hand next to
+include/LightGBM/config.h; here the parameter reference is DERIVED
+from `lightgbm_tpu/config.py` (sections, fields, defaults, inline
+comments, alias table) merged with the curated descriptions below —
+`tests/test_docs.py` regenerates it and fails on drift, so the doc can
+never fall out of sync with the code.
+
+Usage: python scripts/gen_parameter_docs.py [--check]
+"""
+import dataclasses
+import io
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+from lightgbm_tpu.config import Config, PARAM_ALIASES  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "docs", "Parameters.md")
+
+SECTION_TITLES = {
+    "core task": "Core",
+    "boosting": "Boosting / objective",
+    "tree": "Tree learning",
+    "dart": "DART",
+    "goss": "GOSS",
+    "io": "IO / dataset",
+    "network": "Distributed network",
+    "tpu-specific (new; no reference analog)": "TPU-specific (no reference analog)",
+}
+
+# Reference-parity parameters whose meaning isn't carried by a source
+# comment.  One line each; semantics match the reference
+# (docs/Parameters.rst) unless the line says otherwise.
+DESC = {
+    "task": "`train`, `predict`, `convert_model` or `refit` (CLI)",
+    "objective": "loss to optimize: regression / regression_l1 / huber / fair / poisson / quantile / mape / gamma / tweedie / binary / multiclass / multiclassova / cross_entropy / cross_entropy_lambda / lambdarank",
+    "boosting_type": "`gbdt`, `dart`, `goss` or `rf`",
+    "device": "`tpu` (accelerated path; `gpu` and `cpu` alias to it with a warning)",
+    "tree_learner": "`serial`, `feature`, `data` or `voting` — the four reference parallelism strategies, mapped to mesh shardings",
+    "num_threads": "accepted for compatibility; host-side work uses numpy/native threads, device work is the TPU program",
+    "seed": "master seed; derives the per-subsystem seeds below",
+    "num_machines": "process count for multi-host training (`jax.distributed`)",
+    "verbose": "<0 fatal only, 0 warnings, 1 info, >1 debug",
+    "num_iterations": "boosting rounds (trees per class)",
+    "learning_rate": "shrinkage applied to each tree's output",
+    "num_class": "classes for multiclass objectives",
+    "early_stopping_round": "stop when no validation metric improves in this many rounds (0 = off)",
+    "output_freq": "evaluate/log metrics every this many iterations",
+    "is_training_metric": "also evaluate metrics on the training set",
+    "snapshot_freq": "save a model snapshot every this many iterations (CLI)",
+    "sigmoid": "sigmoid scale for binary / cross-entropy / lambdarank",
+    "boost_from_average": "initialize scores from the label average (reference boost_from_average)",
+    "alpha": "huber loss delta / quantile level",
+    "fair_c": "fair-loss c parameter",
+    "poisson_max_delta_step": "safeguard on poisson hessians",
+    "tweedie_variance_power": "tweedie variance power in [1, 2)",
+    "reg_sqrt": "fit sqrt(label) and square predictions (regression)",
+    "scale_pos_weight": "weight multiplier on positive class (binary)",
+    "is_unbalance": "auto-reweight classes by frequency (binary)",
+    "max_position": "NDCG truncation for lambdarank",
+    "label_gain": "per-label relevance gains (default 2^i - 1)",
+    "metric": "evaluation metric list (empty = objective's default)",
+    "ndcg_eval_at": "NDCG/MAP evaluation positions",
+    "num_leaves": "max leaves per tree",
+    "max_depth": "max tree depth (-1 = unlimited)",
+    "min_data_in_leaf": "minimum rows per leaf",
+    "min_sum_hessian_in_leaf": "minimum hessian mass per leaf",
+    "lambda_l1": "L1 leaf regularization",
+    "lambda_l2": "L2 leaf regularization",
+    "min_gain_to_split": "minimum gain for a split to be applied",
+    "max_delta_step": "clamp on leaf output magnitude (0 = off)",
+    "feature_fraction": "fraction of features sampled per tree",
+    "feature_fraction_seed": "seed for feature sampling",
+    "bagging_fraction": "fraction of rows sampled when bagging",
+    "bagging_freq": "re-draw the bag every this many iterations (0 = off)",
+    "bagging_seed": "seed for bagging",
+    "max_bin": "max histogram bins per feature",
+    "min_data_in_bin": "minimum rows per bin during mapper construction",
+    "bin_construct_sample_cnt": "sample size used to fit bin mappers",
+    "data_random_seed": "seed for sampling during dataset construction",
+    "monotone_constraints": "per-feature monotonicity (-1/0/1)",
+    "max_cat_threshold": "max categories on one side of a categorical split",
+    "cat_l2": "L2 regularization in categorical split gain",
+    "cat_smooth": "smoothing for categorical value ordering",
+    "max_cat_to_onehot": "categories at or below this use one-vs-rest splits",
+    "top_k": "votes per machine in the voting-parallel learner",
+    "forcedsplits_filename": "JSON file of forced top-of-tree splits",
+    "drop_rate": "fraction of trees dropped per DART iteration",
+    "max_drop": "max trees dropped per iteration (-1 = unlimited)",
+    "skip_drop": "probability of skipping the drop entirely",
+    "xgboost_dart_mode": "xgboost-style DART normalization",
+    "uniform_drop": "uniform tree-drop sampling",
+    "drop_seed": "seed for DART drops",
+    "top_rate": "GOSS: fraction of largest-gradient rows kept",
+    "other_rate": "GOSS: fraction of remaining rows sampled",
+    "data": "training data path (CLI)",
+    "valid_data": "validation data path(s) (CLI)",
+    "input_model": "model file to continue from / predict with",
+    "output_model": "model file written after training",
+    "output_result": "prediction output path",
+    "convert_model": "if-else C++ output path for task=convert_model",
+    "convert_model_language": "only `cpp` is supported (as in the reference)",
+    "has_header": "data files carry a header row",
+    "label_column": "label column (index or `name:` prefix)",
+    "weight_column": "weight column",
+    "group_column": "query/group column for ranking",
+    "ignore_column": "columns to drop",
+    "categorical_column": "columns to treat as categorical",
+    "is_pre_partition": "distributed: data is already partitioned per machine",
+    "use_two_round_loading": "stream the file twice instead of holding the float matrix",
+    "is_save_binary_file": "save the binned dataset next to the data file",
+    "is_enable_sparse": "enable sparse-aware construction",
+    "enable_bundle": "exclusive feature bundling (EFB)",
+    "max_conflict_rate": "max nonzero-conflict rate allowed inside a bundle",
+    "is_enable_bundle": "alias field kept for config echo parity",
+    "min_data_in_group": "minimum rows per categorical group",
+    "use_missing": "enable missing-value handling",
+    "zero_as_missing": "treat zeros as missing",
+    "num_iteration_predict": "iterations used at predict time (-1 = all)",
+    "is_predict_raw_score": "CLI predict: raw scores",
+    "is_predict_leaf_index": "CLI predict: leaf indices",
+    "is_predict_contrib": "CLI predict: SHAP contributions",
+    "pred_early_stop": "margin-based early exit during prediction",
+    "pred_early_stop_freq": "check the margin every this many trees",
+    "pred_early_stop_margin": "margin threshold for prediction early stop",
+    "local_listen_port": "rendezvous port (multi-host init)",
+    "time_out": "network timeout, minutes",
+    "machine_list_file": "file listing ip:port per machine",
+    "machines": "comma-separated ip:port list",
+    "mesh_shape": "device mesh shape for sharded training (e.g. `8` or `4,2`)",
+    "mesh_axes": "mesh axis names matching mesh_shape",
+    "deterministic": "bit-deterministic mode (fixed reduction orders)",
+    "extra": "unrecognized key=value params: warned, kept, echoed into the model file",
+}
+
+
+def parse_config_source():
+    """(ordered) [(section, [(field, type, default, comment)])] from
+    the Config dataclass source block."""
+    src_path = os.path.join(REPO, "lightgbm_tpu", "config.py")
+    with open(src_path) as fh:
+        lines = fh.read().splitlines()
+    # isolate the dataclass body
+    start = next(i for i, l in enumerate(lines)
+                 if l.startswith("class Config"))
+    end = next(i for i in range(start, len(lines))
+               if "__post_init__" in lines[i])
+    sections = []
+    cur_fields = []
+    cur_name = "Core"
+    last_field = None
+    field_re = re.compile(
+        r"^    (\w+): ([A-Za-z_\[\]., ]+?) = (.+?)(?:\s{2,}# (.*))?$")
+    for raw in lines[start:end]:
+        m = re.match(r"^    # -- (.+?) --", raw)
+        if m:
+            if cur_fields:
+                sections.append((cur_name, cur_fields))
+            cur_name = SECTION_TITLES.get(m.group(1), m.group(1))
+            cur_fields = []
+            last_field = None
+            continue
+        m = field_re.match(raw)
+        if m:
+            name, typ, default, comment = m.groups()
+            if "dataclasses.field" in default:
+                default = "{}"
+            cur_fields.append([name, typ.strip(), default,
+                               (comment or "").strip()])
+            last_field = cur_fields[-1]
+            continue
+        m = re.match(r"^    # (.*)$", raw)
+        if m and last_field is not None:
+            last_field[3] = (last_field[3] + " " + m.group(1)).strip()
+            continue
+        if not raw.strip():
+            last_field = None
+    if cur_fields:
+        sections.append((cur_name, cur_fields))
+    return sections
+
+
+def generate() -> str:
+    aliases = {}
+    for a, canon in PARAM_ALIASES.items():
+        aliases.setdefault(canon, []).append(a)
+    cfg_fields = {f.name for f in dataclasses.fields(Config)}
+
+    out = io.StringIO()
+    out.write(
+        "# Parameters\n\n"
+        "All parameters accepted by `lightgbm_tpu` — the counterpart of "
+        "the reference's `docs/Parameters.rst` (config struct: "
+        "`include/LightGBM/config.h:94-306`).  Reference parameters keep "
+        "their reference semantics; the final section is TPU-native "
+        "surface with no reference analog.\n\n"
+        "Parameters are accepted as `key=value` pairs (CLI / config "
+        "file), as a `params` dict (Python / C API), or as keyword "
+        "arguments on the sklearn estimators.  Aliases below map onto "
+        "the canonical name exactly as in the reference alias table "
+        "(`config.h:364-457`).\n\n"
+        "*Generated by `scripts/gen_parameter_docs.py` from "
+        "`lightgbm_tpu/config.py` — edit the source, not this file "
+        "(`tests/test_docs.py` enforces sync).*\n")
+    documented = set()
+    for section, fields in parse_config_source():
+        out.write(f"\n## {section}\n\n")
+        out.write("| Parameter | Default | Aliases | Description |\n")
+        out.write("|---|---|---|---|\n")
+        for name, _typ, default, comment in fields:
+            documented.add(name)
+            # curated description wins (the inline comment is usually
+            # a terser note of the same thing); source comments carry
+            # the TPU-specific fields, which have no curated entry
+            desc = DESC.get(name) or comment or ""
+            desc = desc.replace("|", "\\|")
+            al = ", ".join(f"`{a}`" for a in sorted(aliases.get(name, [])))
+            dshow = default.replace("|", "\\|")
+            out.write(f"| `{name}` | `{dshow}` | {al} | {desc} |\n")
+    missing = cfg_fields - documented
+    if missing:
+        raise SystemExit(f"fields not parsed from source: {missing}")
+    return out.getvalue()
+
+
+def check_parsed_defaults(sections):
+    """Parsed default strings must literal-eval to the live dataclass
+    defaults — catches regex drift (e.g. a one-space inline comment
+    folding into the captured default) that regeneration alone would
+    reproduce rather than detect."""
+    import ast
+    live = {f.name: f for f in dataclasses.fields(Config)}
+    for _section, fields in sections:
+        for name, _typ, default, _comment in fields:
+            f = live[name]
+            if f.default is dataclasses.MISSING:   # default_factory
+                continue
+            try:
+                parsed = ast.literal_eval(default)
+            except (ValueError, SyntaxError):
+                raise SystemExit(
+                    f"unparseable default for {name!r}: {default!r} "
+                    "(inline comment folded into the default?)")
+            if parsed != f.default:
+                raise SystemExit(
+                    f"parsed default for {name!r} ({parsed!r}) != "
+                    f"dataclass default ({f.default!r})")
+
+
+def main():
+    check_parsed_defaults(parse_config_source())
+    text = generate()
+    if "--check" in sys.argv:
+        try:
+            with open(OUT) as fh:
+                current = fh.read()
+        except FileNotFoundError:
+            current = None
+        if current != text:
+            print("docs/Parameters.md is missing or out of date — run "
+                  "python scripts/gen_parameter_docs.py",
+                  file=sys.stderr)
+            return 1
+        return 0
+    with open(OUT, "w") as fh:
+        fh.write(text)
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

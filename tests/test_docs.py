@@ -1,0 +1,21 @@
+"""Generated documentation stays in sync with the code it documents."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parameters_md_in_sync():
+    """docs/Parameters.md is generated from lightgbm_tpu/config.py —
+    a Config field added/changed without regenerating must fail here
+    (run: python scripts/gen_parameter_docs.py).  The generator itself
+    asserts every Config field is emitted and that parsed defaults
+    literal-eval to the live dataclass defaults."""
+    run = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "gen_parameter_docs.py"),
+         "--check"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert run.returncode == 0, run.stderr or run.stdout

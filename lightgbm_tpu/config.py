@@ -280,7 +280,6 @@ class Config:
     # (bfloat16 roughly doubles MXU throughput at ~0.4% grad rounding;
     # opt in for benchmarks, keep float32 for reference parity)
     row_chunk: int = 65536          # rows per histogram-scan chunk
-    growth_policy: str = "leafwise"  # leafwise (gain-budgeted frontier) | depthwise
     frontier_width: int = 0         # max splits applied per frontier round
     # (0 = auto: min(128, num_leaves-1) — one 128-lane MXU strip)
     hist_kernel: str = "auto"       # auto | pallas | paired | xla

@@ -170,7 +170,10 @@ def parse_config_source():
         if m:
             name, typ, default, comment = m.groups()
             if "dataclasses.field" in default:
-                default = "{}"
+                # render the factory's product, not the field() call
+                live = next(f for f in dataclasses.fields(Config)
+                            if f.name == name)
+                default = repr(live.default_factory())
             cur_fields.append([name, typ.strip(), default,
                                (comment or "").strip()])
             last_field = cur_fields[-1]
@@ -186,7 +189,7 @@ def parse_config_source():
     return sections
 
 
-def generate() -> str:
+def generate(sections) -> str:
     aliases = {}
     for a, canon in PARAM_ALIASES.items():
         aliases.setdefault(canon, []).append(a)
@@ -209,7 +212,7 @@ def generate() -> str:
         "`lightgbm_tpu/config.py` — edit the source, not this file "
         "(`tests/test_docs.py` enforces sync).*\n")
     documented = set()
-    for section, fields in parse_config_source():
+    for section, fields in sections:
         out.write(f"\n## {section}\n\n")
         out.write("| Parameter | Default | Aliases | Description |\n")
         out.write("|---|---|---|---|\n")
@@ -254,8 +257,9 @@ def check_parsed_defaults(sections):
 
 
 def main():
-    check_parsed_defaults(parse_config_source())
-    text = generate()
+    sections = parse_config_source()
+    check_parsed_defaults(sections)
+    text = generate(sections)
     if "--check" in sys.argv:
         try:
             with open(OUT) as fh:

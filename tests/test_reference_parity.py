@@ -123,6 +123,66 @@ def test_our_model_accepted_by_reference_binary(tmp_path, binary_data,
     np.testing.assert_allclose(bst.predict(Xt), ref_pred, atol=1e-5)
 
 
+def test_reference_lambdarank_model_interop(tmp_path):
+    """Ranking: reference-trained lambdarank model -> our Booster
+    predicts identically; our lambdarank model -> reference binary
+    predicts identically (query side files resolved by both)."""
+    model = tmp_path / "ref_model.txt"
+    pred_out = tmp_path / "ref_pred.txt"
+    _run_ref(tmp_path, "task=train", "objective=lambdarank",
+             f"data={REF_EXAMPLES}/lambdarank/rank.train",
+             "num_trees=15", "num_leaves=31", "min_data_in_leaf=20",
+             "verbosity=-1", f"output_model={model}")
+    _run_ref(tmp_path, "task=predict",
+             f"data={REF_EXAMPLES}/lambdarank/rank.test",
+             f"input_model={model}", f"output_result={pred_out}")
+    ref_pred = np.loadtxt(pred_out)
+    from lightgbm_tpu.data_loader import _load_libsvm
+    Xt, _ = _load_libsvm(f"{REF_EXAMPLES}/lambdarank/rank.test")
+    bst = lgb.Booster(model_file=str(model))
+    np.testing.assert_allclose(bst.predict(Xt), ref_pred, atol=1e-5)
+
+    # ours -> reference
+    X, y = _load_libsvm(f"{REF_EXAMPLES}/lambdarank/rank.train")
+    group = np.loadtxt(
+        f"{REF_EXAMPLES}/lambdarank/rank.train.query").astype(int)
+    ours = lgb.train({"objective": "lambdarank", "num_leaves": 31,
+                      "min_data_in_leaf": 20, "verbose": -1},
+                     lgb.Dataset(X, label=y, group=group), 15,
+                     verbose_eval=False)
+    our_model = tmp_path / "our_model.txt"
+    ours.save_model(str(our_model))
+    our_pred_out = tmp_path / "our_ref_pred.txt"
+    _run_ref(tmp_path, "task=predict",
+             f"data={REF_EXAMPLES}/lambdarank/rank.test",
+             f"input_model={our_model}",
+             f"output_result={our_pred_out}")
+    np.testing.assert_allclose(ours.predict(Xt),
+                               np.loadtxt(our_pred_out), atol=1e-5)
+
+
+def test_reference_multiclass_model_interop(tmp_path):
+    """Softmax: the reference's 5-class example model loads and the
+    (n, 5) probability matrix matches its own predict output."""
+    model = tmp_path / "ref_model.txt"
+    pred_out = tmp_path / "ref_pred.txt"
+    _run_ref(tmp_path, "task=train", "objective=multiclass",
+             "num_class=5",
+             f"data={REF_EXAMPLES}/multiclass_classification/multiclass.train",
+             "num_trees=10", "num_leaves=31", "verbosity=-1",
+             f"output_model={model}")
+    _run_ref(tmp_path, "task=predict",
+             f"data={REF_EXAMPLES}/multiclass_classification/multiclass.test",
+             f"input_model={model}", f"output_result={pred_out}")
+    ref_pred = np.loadtxt(pred_out)
+    Xt, _ = _load_tsv(
+        f"{REF_EXAMPLES}/multiclass_classification/multiclass.test")
+    bst = lgb.Booster(model_file=str(model))
+    ours = bst.predict(Xt)
+    assert ours.shape == ref_pred.shape == (Xt.shape[0], 5)
+    np.testing.assert_allclose(ours, ref_pred, atol=1e-5)
+
+
 def test_training_accuracy_parity_binary(binary_data, ref_binary_model,
                                          our_binary_model):
     """Same data + config trained by both implementations: held-out

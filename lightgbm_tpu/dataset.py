@@ -548,8 +548,28 @@ class Dataset:
            nfu, p(bounds_flat, ctypes.c_double), p(boff, ctypes.c_long),
            p(use_nan, ctypes.c_ubyte), p(nan_bin, ctypes.c_long),
            p(res, ctypes.c_ubyte))
-        for j, f in enumerate(feats):
-            out[:, f.group] = res[j]
+        scatter = getattr(lib, "ltpu_scatter_cols", None)
+        cols = np.asarray([f.group for f in feats], np.int64)
+        if scatter is not None and out.flags.c_contiguous \
+                and out.dtype == np.uint8 and out.shape[0] == n:
+            # out.shape[0] == n guards the raw-pointer write: a clamped
+            # group_bins slice (out-of-range push_rows row_start) must
+            # fall through to the numpy path, which raises a broadcast
+            # error instead of writing past the buffer
+            # blocked-transpose write: numpy's strided per-column
+            # assignment dominated wide-matrix prep (see bin_dense.cpp)
+            if not getattr(scatter, "argtypes", None):
+                scatter.restype = None
+                scatter.argtypes = [
+                    ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
+                    ctypes.c_long, ctypes.POINTER(ctypes.c_long),
+                    ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+            scatter(p(res, ctypes.c_ubyte), nfu, n,
+                    p(cols, ctypes.c_long), p(out, ctypes.c_ubyte),
+                    out.shape[1])
+        else:
+            for j, f in enumerate(feats):
+                out[:, f.group] = res[j]
         return True
 
     # ------------------------------------------------------------------

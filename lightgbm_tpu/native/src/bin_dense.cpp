@@ -16,27 +16,38 @@ extern "C" void ltpu_bin_dense(
     const double* bounds_flat, const long* bounds_off,
     const unsigned char* use_nan, const long* nan_bin,
     unsigned char* out /* (n_used, n) feature-major */) {
-  for (long j = 0; j < n_used; ++j) {
-    const double* ub = bounds_flat + bounds_off[j];
-    const long len = bounds_off[j + 1] - bounds_off[j];
-    const long fi = feat_idx[j];
-    const bool un = use_nan[j] != 0;
-    const unsigned char nb = (unsigned char)nan_bin[j];
-    unsigned char* o = out + j * n;
-    const double* col = X + fi;
-    // branchless compare-count (== lower_bound index for a sorted
-    // array), row-blocked so the per-bound loop vectorizes over a
-    // contiguous row buffer: the per-value binary search costs ~6
-    // dependent mispredicting branches on random data; this form runs
-    // at SIMD compare throughput
-    constexpr long BK = 512;
-    double buf[BK];
-    unsigned short cnt[BK];
-    unsigned char nanv[BK];
-    for (long i0 = 0; i0 < n; i0 += BK) {
-      const long m = (n - i0 < BK) ? (n - i0) : BK;
+  // Loop order: row blocks OUTER, features INNER.  A row-major X
+  // column gather strides f_total*8 bytes, so feature-outer order
+  // misses DRAM on every value once the matrix is wide (136-feature
+  // MS-LTR prep ran 2x slower per value than 28-feature HIGGS).  With
+  // the row block held in cache, only the first feature's gather
+  // touches DRAM; the rest hit L2.  BK shrinks for very wide rows so
+  // the block (BK * f_total * 8B) stays cache-resident.
+  constexpr long BKMAX = 512;
+  long bk = BKMAX;
+  if (f_total > 0) {
+    const long fit = (2L << 20) / (8 * f_total);  // ~2 MB of block
+    if (fit < bk) bk = fit < 64 ? 64 : (fit / 64) * 64;
+  }
+  double buf[BKMAX];
+  unsigned short cnt[BKMAX];
+  unsigned char nanv[BKMAX];
+  for (long i0 = 0; i0 < n; i0 += bk) {
+    const long m = (n - i0 < bk) ? (n - i0) : bk;
+    const double* xb = X + i0 * f_total;
+    for (long j = 0; j < n_used; ++j) {
+      const double* ub = bounds_flat + bounds_off[j];
+      const long len = bounds_off[j + 1] - bounds_off[j];
+      const double* col = xb + feat_idx[j];
+      const bool un = use_nan[j] != 0;
+      const unsigned char nb = (unsigned char)nan_bin[j];
+      unsigned char* o = out + j * n + i0;
+      // branchless compare-count (== lower_bound index for a sorted
+      // array) over a contiguous row buffer: the per-value binary
+      // search costs ~6 dependent mispredicting branches on random
+      // data; this form runs at SIMD compare throughput
       for (long i = 0; i < m; ++i) {
-        double v = col[(i0 + i) * f_total];
+        double v = col[i * f_total];
         const bool is_nan = std::isnan(v);
         nanv[i] = is_nan ? 1 : 0;
         buf[i] = is_nan ? 0.0 : v;
@@ -47,7 +58,27 @@ extern "C" void ltpu_bin_dense(
         for (long i = 0; i < m; ++i) cnt[i] += (ubb < buf[i]) ? 1 : 0;
       }
       for (long i = 0; i < m; ++i)
-        o[i0 + i] = (nanv[i] && un) ? nb : (unsigned char)cnt[i];
+        o[i] = (nanv[i] && un) ? nb : (unsigned char)cnt[i];
+    }
+  }
+}
+
+// Feature-major (n_used, n) bin rows -> row-major (n, g_total) packed
+// matrix columns.  numpy's out[:, g] = res[j] pays a DRAM-missing
+// g_total-strided byte write per value (it dominated wide-matrix prep
+// once the binning itself was cache-blocked); transposing through an
+// L1-resident row block runs at copy throughput.
+extern "C" void ltpu_scatter_cols(
+    const unsigned char* res, long n_used, long n,
+    const long* col_idx, unsigned char* out, long g_total) {
+  constexpr long B = 256;
+  for (long i0 = 0; i0 < n; i0 += B) {
+    const long m = (n - i0 < B) ? (n - i0) : B;
+    unsigned char* ob = out + i0 * g_total;
+    for (long j = 0; j < n_used; ++j) {
+      const unsigned char* r = res + j * n + i0;
+      unsigned char* o = ob + col_idx[j];
+      for (long i = 0; i < m; ++i) o[i * g_total] = r[i];
     }
   }
 }

@@ -173,7 +173,10 @@ def parse_config_source():
                 # render the factory's product, not the field() call
                 live = next(f for f in dataclasses.fields(Config)
                             if f.name == name)
-                default = repr(live.default_factory())
+                default = repr(
+                    live.default_factory()
+                    if live.default_factory is not dataclasses.MISSING
+                    else live.default)
             cur_fields.append([name, typ.strip(), default,
                                (comment or "").strip()])
             last_field = cur_fields[-1]

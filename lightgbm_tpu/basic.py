@@ -211,6 +211,55 @@ class Dataset:
             return self.data.shape[1]
         return _to_matrix(self.data).shape[1]
 
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """reference basic.py Dataset.set_reference: align this
+        dataset's bin mappers to another's.  Must precede construct."""
+        if self._core is not None and reference is not self.reference:
+            Log.fatal("Cannot set reference after the Dataset has "
+                      "been constructed; create a new Dataset")
+        self.reference = reference
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        """reference basic.py Dataset.set_feature_name."""
+        if self._core is not None and isinstance(feature_name,
+                                                 (list, tuple)):
+            nf = self._core.num_total_features
+            if len(feature_name) != nf:
+                Log.fatal(f"Length of feature_name "
+                          f"({len(feature_name)}) does not match the "
+                          f"number of features ({nf})")
+            self._core.feature_names = list(feature_name)
+        self.feature_name = feature_name
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """reference basic.py Dataset.set_categorical_feature — the
+        categorical set shapes the bin mappers, so it cannot change
+        after construction."""
+        if self._core is not None and \
+                categorical_feature != self.categorical_feature:
+            Log.fatal("Cannot set categorical feature after the "
+                      "Dataset has been constructed; create a new "
+                      "Dataset")
+        self.categorical_feature = categorical_feature
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100) -> set:
+        """reference basic.py Dataset.get_ref_chain: the set of
+        datasets reachable through .reference links."""
+        head = self
+        chain = set()
+        count = 0
+        while count < ref_limit:
+            chain.add(head)
+            if head.reference is not None and head.reference not in chain:
+                head = head.reference
+                count += 1
+            else:
+                break
+        return chain
+
     def subset(self, used_indices, params=None) -> "Dataset":
         if self.data is None:
             Log.fatal("Cannot subset: raw data was freed — construct "

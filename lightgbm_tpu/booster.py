@@ -70,6 +70,8 @@ class Booster:
         self.max_feature_idx = 0
         self.objective_str = "regression"
         self.average_output = False
+        self._train_data_name = "training"
+        self._attrs: Dict[str, str] = {}
 
         if model_file is not None:
             with open(model_file) as f:
@@ -80,6 +82,13 @@ class Booster:
             return
         if train_set is None:
             return
+
+        # the reference python package accepts a lazy Dataset here
+        # (basic.py Booster.__init__ constructs it); engine.train
+        # passes an already-constructed core
+        if hasattr(train_set, "construct") and \
+                callable(train_set.construct):
+            train_set = train_set.construct(self.config)
 
         from .boosting import create_boosting
         self.gbdt = create_boosting(self.config, train_set,
@@ -190,6 +199,11 @@ class Booster:
 
     # ------------------------------------------------------------------
     def update(self, train_set=None, fobj=None) -> bool:
+        if self.gbdt is not None and self.gbdt.train_set is None:
+            # reference contract: free_dataset() ends training even
+            # though the device-resident state could technically go on
+            Log.fatal("Booster datasets were freed (free_dataset) — "
+                      "cannot continue training")
         if fobj is not None:
             score = self._current_train_scores()
             grad, hess = fobj(score, self.gbdt.train_set)
@@ -547,8 +561,120 @@ class Booster:
         return raw
 
     # ------------------------------------------------------------------
+    def _n_train_eval_rows(self) -> int:
+        """gbdt emits training metric rows FIRST; datasets are told
+        apart by position, never by name (a valid set may be literally
+        named 'training')."""
+        if self.gbdt is None:
+            return 0
+        return sum(len(m.names()) for m in self.gbdt.train_metrics)
+
     def eval(self) -> List:
-        return self.gbdt.eval_metrics() if self.gbdt else []
+        out = self.gbdt.eval_metrics() if self.gbdt else []
+        if self._train_data_name != "training":
+            k = self._n_train_eval_rows()
+            out = [(self._train_data_name, m, v, b) if i < k
+                   else (d, m, v, b)
+                   for i, (d, m, v, b) in enumerate(out)]
+        return out
+
+    def eval_train(self) -> List:
+        """reference basic.py Booster.eval_train: training-set metric
+        rows only."""
+        if self.gbdt is not None and not self.gbdt.train_metrics:
+            if self.gbdt.train_set is None:
+                Log.fatal("Booster datasets were freed (free_dataset) "
+                          "— cannot evaluate training metrics")
+            self.gbdt.add_train_metrics()
+        return self.eval()[:self._n_train_eval_rows()]
+
+    def eval_valid(self) -> List:
+        """reference basic.py Booster.eval_valid: validation rows only."""
+        return self.eval()[self._n_train_eval_rows():]
+
+    def add_valid(self, data, name: str) -> "Booster":
+        """reference basic.py Booster.add_valid."""
+        if self.gbdt is None:
+            Log.fatal("Cannot add validation data to a booster without "
+                      "a training session (file-loaded model)")
+        core = data.construct(self.config) if hasattr(data, "construct") \
+            else data
+        self.gbdt.add_valid(core, name)
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """reference basic.py Booster.set_train_data_name: the label
+        eval() reports for the training rows."""
+        self._train_data_name = name
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """reference basic.py Booster.reset_parameter — learning_rate
+        plus plain config scalars (the surface
+        LGBM_BoosterResetParameter forwards here)."""
+        if "learning_rate" in params and self.gbdt is not None:
+            self.gbdt.shrinkage_rate = float(params["learning_rate"])
+        for k, v in params.items():
+            if k != "learning_rate" and hasattr(self.config, k):
+                cur = getattr(self.config, k)
+                try:
+                    if isinstance(cur, bool):
+                        # bool('false') is True — parse string forms
+                        setattr(self.config, k, str(v).lower()
+                                in ("1", "true", "yes", "on"))
+                    else:
+                        setattr(self.config, k, type(cur)(v))
+                except (TypeError, ValueError):
+                    pass
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """reference basic.py Booster.get_leaf_output."""
+        self._sync_models()
+        return float(self.models[int(tree_id)].leaf_value[int(leaf_id)])
+
+    def attr(self, key: str) -> Optional[str]:
+        """reference basic.py Booster.attr: free-form string
+        attributes (python-side, like the reference)."""
+        return self._attrs.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """reference basic.py Booster.set_attr: value None deletes."""
+        for k, v in kwargs.items():
+            if v is None:
+                self._attrs.pop(k, None)
+            else:
+                self._attrs[k] = str(v)
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """reference basic.py Booster.free_dataset: release the
+        training/validation data (prediction still works; further
+        update() calls error)."""
+        if self.gbdt is not None:
+            self._sync_models()
+            self.gbdt.train_set = None
+            self.gbdt.valid_sets = []
+            self.gbdt.valid_names = []
+        return self
+
+    def free_network(self) -> "Booster":
+        """reference basic.py Booster.free_network (socket rendezvous
+        has no TPU analog — see LGBM_NetworkFree)."""
+        return self
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """reference basic.py Booster.set_network: accepted for call
+        compatibility; multi-host setup goes through
+        jax.distributed.initialize + mesh_shape (warns like
+        LGBM_NetworkInit)."""
+        from .capi import LGBM_NetworkInit
+        LGBM_NetworkInit(machines if isinstance(machines, str)
+                         else ",".join(machines), local_listen_port,
+                         listen_time_out, num_machines)
+        return self
 
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: int = -1) -> None:

@@ -646,22 +646,7 @@ def LGBM_BoosterResetParameter(handle, parameters: str) -> int:
     """reference c_api.h:395-403 — currently learning_rate (the
     parameter the reference's reset path exercises in tests) plus any
     plain config scalars."""
-    bst = _get(handle)
-    params = _parse_params(parameters)
-    if "learning_rate" in params:
-        bst.gbdt.shrinkage_rate = float(params["learning_rate"])
-    for k, v in params.items():
-        if hasattr(bst.config, k) and k != "learning_rate":
-            cur = getattr(bst.config, k)
-            try:
-                if isinstance(cur, bool):
-                    # bool('false') is True — parse the string forms
-                    setattr(bst.config, k, str(v).lower()
-                            in ("1", "true", "yes", "on"))
-                else:
-                    setattr(bst.config, k, type(cur)(v))
-            except (TypeError, ValueError):
-                pass
+    _get(handle).reset_parameter(_parse_params(parameters))
     return 0
 
 

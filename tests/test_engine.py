@@ -452,3 +452,45 @@ def test_device_predict_matches_host():
     np.testing.assert_allclose(
         bst.predict(X_test, num_iteration=5, device=True),
         bst.predict(X_test, num_iteration=5, device=False), atol=1e-6)
+
+
+def test_python_surface_tail_matches_reference_basic():
+    """The reference python package's Dataset/Booster method tail
+    (basic.py): add_valid + eval_train/eval_valid,
+    set_train_data_name, attr/set_attr, get_leaf_output,
+    reset_parameter, free_dataset, get_ref_chain,
+    set_feature_name/set_reference/set_categorical_feature."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(800, 6)
+    y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15}
+
+    train = lgb.Dataset(X[:600], label=y[:600])
+    train.set_feature_name([f"f{i}" for i in range(6)])
+    train.set_categorical_feature("auto")
+    valid = lgb.Dataset(X[600:], label=y[600:]).set_reference(train)
+    assert train in valid.get_ref_chain()
+
+    bst = lgb.Booster(lgb.Config.from_params(params), train_set=train)
+    bst.set_train_data_name("trn").add_valid(valid, "vld")
+    for _ in range(5):
+        bst.update()
+    tr = bst.eval_train()
+    va = bst.eval_valid()
+    assert tr and all(r[0] == "trn" for r in tr)
+    assert va and all(r[0] == "vld" for r in va)
+    assert np.isfinite([r[2] for r in tr + va]).all()
+
+    leaf0 = bst.get_leaf_output(0, 0)
+    assert np.isfinite(leaf0)
+    bst.set_attr(note="hello", extra="1").set_attr(extra=None)
+    assert bst.attr("note") == "hello" and bst.attr("extra") is None
+
+    bst.reset_parameter({"learning_rate": 0.05})
+    assert bst.gbdt.shrinkage_rate == 0.05
+
+    preds_before = bst.predict(X[600:])
+    bst.free_dataset()
+    np.testing.assert_allclose(bst.predict(X[600:]), preds_before)
+    with pytest.raises(Exception):
+        bst.update()

@@ -89,7 +89,11 @@ class Dataset:
                 self.group = extras["group"]
         ref_core = None
         if self.reference is not None:
-            ref_core = self.reference.construct(config)
+            # the reference may be a lazy handle or an already
+            # constructed core (Booster.add_valid aligns to the core)
+            ref_core = self.reference.construct(config) \
+                if hasattr(self.reference, "construct") \
+                else self.reference
         # validation frames must encode pandas categoricals against the
         # TRAIN-time category lists (the reference aligns valid frames
         # to the train categories and errors on mismatch)
@@ -222,14 +226,18 @@ class Dataset:
 
     def set_feature_name(self, feature_name) -> "Dataset":
         """reference basic.py Dataset.set_feature_name."""
-        if self._core is not None and isinstance(feature_name,
-                                                 (list, tuple)):
-            nf = self._core.num_total_features
-            if len(feature_name) != nf:
+        if isinstance(feature_name, (list, tuple)):
+            nf = None
+            if self._core is not None:
+                nf = self._core.num_total_features
+            elif getattr(self.data, "ndim", 0) == 2:
+                nf = self.data.shape[1]
+            if nf is not None and len(feature_name) != nf:
                 Log.fatal(f"Length of feature_name "
                           f"({len(feature_name)}) does not match the "
                           f"number of features ({nf})")
-            self._core.feature_names = list(feature_name)
+            if self._core is not None:
+                self._core.feature_names = list(feature_name)
         self.feature_name = feature_name
         return self
 
@@ -244,6 +252,16 @@ class Dataset:
                       "Dataset")
         self.categorical_feature = categorical_feature
         return self
+
+    def construct_aligned(self, ref_core, config) -> CoreDataset:
+        """Construct with bins aligned to ``ref_core`` when nothing
+        pinned the mappers yet — the reference package's
+        train()/add_valid set_reference behavior.  Already-constructed
+        or explicitly-referenced datasets are left alone (the
+        bin-alignment gate in gbdt.add_valid rejects mismatches)."""
+        if self._core is None and self.reference is None:
+            self.reference = ref_core
+        return self.construct(config)
 
     def get_ref_chain(self, ref_limit: int = 100) -> set:
         """reference basic.py Dataset.get_ref_chain: the set of

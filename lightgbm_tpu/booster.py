@@ -72,6 +72,7 @@ class Booster:
         self.average_output = False
         self._train_data_name = "training"
         self._attrs: Dict[str, str] = {}
+        self._datasets_freed = False
 
         if model_file is not None:
             with open(model_file) as f:
@@ -199,11 +200,11 @@ class Booster:
 
     # ------------------------------------------------------------------
     def update(self, train_set=None, fobj=None) -> bool:
-        if self.gbdt is not None and self.gbdt.train_set is None:
-            # reference contract: free_dataset() ends training even
-            # though the device-resident state could technically go on
-            Log.fatal("Booster datasets were freed (free_dataset) — "
-                      "cannot continue training")
+        if self.gbdt is None or self.gbdt.train_set is None:
+            # reference contract: no training session (file-loaded
+            # model, or free_dataset() ended it)
+            Log.fatal("Cannot update: booster has no training session "
+                      "(file-loaded model or datasets were freed)")
         if fobj is not None:
             score = self._current_train_scores()
             grad, hess = fobj(score, self.gbdt.train_set)
@@ -211,6 +212,10 @@ class Booster:
         return self.gbdt.train_one_iter()
 
     def rollback_one_iter(self):
+        if self.gbdt is None:
+            Log.fatal("Cannot rollback: booster has no training "
+                      "session (file-loaded model or datasets were "
+                      "freed)")
         self.gbdt.rollback_one_iter()
         # a later update() can restore the same tree COUNT with a
         # different tree — a length-keyed stack cache would serve the
@@ -580,25 +585,39 @@ class Booster:
 
     def eval_train(self) -> List:
         """reference basic.py Booster.eval_train: training-set metric
-        rows only."""
-        if self.gbdt is not None and not self.gbdt.train_metrics:
-            if self.gbdt.train_set is None:
+        rows only (valid-set metrics are not computed)."""
+        if self.gbdt is None:
+            if self._datasets_freed:
                 Log.fatal("Booster datasets were freed (free_dataset) "
                           "— cannot evaluate training metrics")
+            return []
+        if not self.gbdt.train_metrics:
             self.gbdt.add_train_metrics()
-        return self.eval()[:self._n_train_eval_rows()]
+        out = self.gbdt.eval_metrics("train")
+        return [(self._train_data_name, m, v, b)
+                for (_d, m, v, b) in out]
 
     def eval_valid(self) -> List:
-        """reference basic.py Booster.eval_valid: validation rows only."""
-        return self.eval()[self._n_train_eval_rows():]
+        """reference basic.py Booster.eval_valid: validation rows only
+        (training metrics are not computed)."""
+        return self.gbdt.eval_metrics("valid") if self.gbdt else []
 
     def add_valid(self, data, name: str) -> "Booster":
-        """reference basic.py Booster.add_valid."""
+        """reference basic.py Booster.add_valid.  Unconstructed lazy
+        datasets are bin-aligned to the training mappers automatically
+        (the reference package calls set_reference in train(); a valid
+        set binned with its OWN mappers would evaluate trees whose
+        thresholds live in train bin space — silently wrong)."""
         if self.gbdt is None:
             Log.fatal("Cannot add validation data to a booster without "
                       "a training session (file-loaded model)")
-        core = data.construct(self.config) if hasattr(data, "construct") \
-            else data
+        if hasattr(data, "construct_aligned"):
+            core = data.construct_aligned(self.gbdt.train_set,
+                                          self.config)
+        elif hasattr(data, "construct"):
+            core = data.construct(self.config)
+        else:
+            core = data
         self.gbdt.add_valid(core, name)
         return self
 
@@ -648,14 +667,20 @@ class Booster:
         return self
 
     def free_dataset(self) -> "Booster":
-        """reference basic.py Booster.free_dataset: release the
-        training/validation data (prediction still works; further
-        update() calls error)."""
+        """reference basic.py Booster.free_dataset: ACTUALLY release
+        the training/validation state — the grower holds the binned
+        device matrix and padded score arrays (GBs at HIGGS scale), so
+        dropping only the dataset handle would free almost nothing.
+        Models are flushed to host first; prediction still works
+        (host walk / raw-feature stacked device path); further
+        update() calls error."""
         if self.gbdt is not None:
             self._sync_models()
-            self.gbdt.train_set = None
-            self.gbdt.valid_sets = []
-            self.gbdt.valid_names = []
+            self.best_iteration = max(self.best_iteration,
+                                      self.gbdt.best_iteration)
+            self.gbdt = None
+            self._device_stale = True
+            self._datasets_freed = True
         return self
 
     def free_network(self) -> "Booster":

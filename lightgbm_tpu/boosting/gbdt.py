@@ -222,6 +222,18 @@ class GBDT:
             Log.fatal("multi-host training does not support validation "
                       "sets yet (metric scores live sharded across "
                       "hosts) — evaluate after training instead")
+        # bin-alignment gate: validation trees are walked in TRAIN bin
+        # space, so the valid set's mappers must be the training
+        # mappers (feature_infos encodes the bin bounds — equal infos
+        # means numerically identical binning).  The reference's
+        # c_api/python package reject unaligned validation data too.
+        if self.train_set is not None and \
+                valid_set is not self.train_set and \
+                valid_set.feature_infos() != self.train_set.feature_infos():
+            Log.fatal(f"validation set {name!r} is not bin-aligned to "
+                      "the training data — create it with "
+                      "reference=<train dataset> (its own bin mappers "
+                      "differ from the training mappers)")
         metrics = create_metrics(self.config)
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
@@ -778,26 +790,32 @@ class GBDT:
                                  tree_arrays.leaf_value))
 
     # ------------------------------------------------------------------
-    def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
-        """Returns (dataset_name, metric_name, value, bigger_better)."""
+    def eval_metrics(self, which: str = "all"
+                     ) -> List[Tuple[str, str, float, bool]]:
+        """Returns (dataset_name, metric_name, value, bigger_better).
+        ``which``: 'all', 'train' or 'valid' — scoped so eval_train /
+        eval_valid don't pay for metrics they discard."""
         self.timer.start("metric")
         try:
-            return self._eval_metrics_impl()
+            return self._eval_metrics_impl(which)
         finally:
             self.timer.stop("metric")
 
-    def _eval_metrics_impl(self):
+    def _eval_metrics_impl(self, which="all"):
         out = []
-        if self.train_metrics:
+        if self.train_metrics and which in ("all", "train"):
             s = self._scores_for_eval(self.scores[:, :self.num_data])
             for m in self.train_metrics:
                 for name, v in zip(m.names(), m.eval(s, self.objective)):
                     out.append(("training", name, v, m.bigger_is_better))
-        for vs, vname in zip(self.valid_sets, self.valid_names):
-            s = self._scores_for_eval(vs.scores)
-            for m in vs.metrics:
-                for name, v in zip(m.names(), m.eval(s, self.objective)):
-                    out.append((vname, name, v, m.bigger_is_better))
+        if which in ("all", "valid"):
+            for vs, vname in zip(self.valid_sets, self.valid_names):
+                s = self._scores_for_eval(vs.scores)
+                for m in vs.metrics:
+                    for name, v in zip(m.names(),
+                                       m.eval(s, self.objective)):
+                        out.append((vname, name, v,
+                                    m.bigger_is_better))
         return out
 
     def _scores_for_eval(self, scores):

@@ -69,12 +69,12 @@ class RF(GBDT):
         self.iter_ += 1
         return False
 
-    def eval_metrics(self):
+    def eval_metrics(self, which: str = "all"):
         """Scores are already in output space (averaged converted
         outputs) — metrics must not re-apply the objective transform."""
         saved = self.objective
         self.objective = None
         try:
-            return super().eval_metrics()
+            return super().eval_metrics(which)
         finally:
             self.objective = saved

@@ -61,6 +61,15 @@ def _api(fn):
     return wrapper
 
 
+def _session(bst):
+    """gbdt of an active training session; clean error otherwise
+    (file-loaded model, or free_dataset ended the session)."""
+    if bst.gbdt is None:
+        raise RuntimeError("booster has no training session "
+                           "(file-loaded model or datasets were freed)")
+    return bst.gbdt
+
+
 def LGBM_GetLastError() -> str:
     """reference c_api.h:46-50."""
     return _last_error[0]
@@ -306,7 +315,7 @@ def LGBM_BoosterAddValidData(handle, valid_data) -> int:
     bst = _get(handle)
     vs = _get(valid_data)
     core = vs.construct(bst.config) if hasattr(vs, "construct") else vs
-    bst.gbdt.add_valid(core, f"valid_{len(bst.gbdt.valid_sets)}")
+    _session(bst).add_valid(core, f"valid_{len(bst.gbdt.valid_sets)}")
     return 0
 
 
@@ -353,10 +362,11 @@ def LGBM_BoosterGetEval(handle, data_idx: int, out=None) -> int:
     """reference c_api.h:458-472: metric values for one dataset
     (0 = training, i = i-th validation set)."""
     bst = _get(handle)
-    if data_idx == 0 and not bst.gbdt.train_metrics:
-        bst.gbdt.add_train_metrics()
-    results = bst.gbdt.eval_metrics()
-    names = ["training"] + bst.gbdt.valid_names
+    g = _session(bst)
+    if data_idx == 0 and not g.train_metrics:
+        g.add_train_metrics()
+    results = g.eval_metrics()
+    names = ["training"] + g.valid_names
     want = names[data_idx] if data_idx < len(names) else None
     out[0] = [v for (dname, _m, v, _b) in results if dname == want]
     return 0
@@ -433,9 +443,10 @@ def LGBM_BoosterGetEvalCounts(handle, out=None) -> int:
     """reference c_api.h:430-437: number of metrics per dataset (so C
     callers can size the LGBM_BoosterGetEval result buffer)."""
     bst = _get(handle)
-    if not bst.gbdt.train_metrics:
-        bst.gbdt.add_train_metrics()
-    out[0] = sum(len(m.names()) for m in bst.gbdt.train_metrics)
+    g = _session(bst)
+    if not g.train_metrics:
+        g.add_train_metrics()
+    out[0] = sum(len(m.names()) for m in g.train_metrics)
     return 0
 
 
@@ -443,10 +454,11 @@ def LGBM_BoosterGetEvalCounts(handle, out=None) -> int:
 def LGBM_BoosterGetEvalNames(handle, out=None) -> int:
     """reference c_api.h:439-446."""
     bst = _get(handle)
-    if not bst.gbdt.train_metrics:
-        bst.gbdt.add_train_metrics()
+    g = _session(bst)
+    if not g.train_metrics:
+        g.add_train_metrics()
     names: List[str] = []
-    for m in bst.gbdt.train_metrics:
+    for m in g.train_metrics:
         names.extend(m.names())
     out[0] = names
     return 0
@@ -583,7 +595,7 @@ def LGBM_BoosterGetNumPredict(handle, data_idx: int,
     """reference c_api.h:520-530 — prediction count for train (0) or
     valid set data_idx-1."""
     bst = _get(handle)
-    g = bst.gbdt
+    g = _session(bst)
     if data_idx == 0:
         n = g.num_data
     else:
@@ -599,7 +611,7 @@ def LGBM_BoosterGetPredict(handle, data_idx: int, out_len=None,
     converted (sigmoid/softmax) scores of the training set (0) or
     validation set data_idx-1, class-major."""
     bst = _get(handle)
-    g = bst.gbdt
+    g = _session(bst)
     if data_idx == 0:
         raw = np.asarray(g.scores[:, :g.num_data], dtype=np.float64)
     else:

@@ -47,10 +47,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
         core_train = train_set.construct(config)
     else:
         core_train = train_set
-    valid_sets = [vs if not hasattr(vs, "construct")
-                  else (core_train if vs is train_set
-                        else vs.construct(config))
-                  for vs in (valid_sets or [])]
+    aligned = []
+    for vs in (valid_sets or []):
+        if not hasattr(vs, "construct"):
+            aligned.append(vs)
+        elif vs is train_set:
+            aligned.append(core_train)
+        else:
+            # bin-align lazy valid sets to the training mappers (the
+            # reference package calls set_reference in train(); a
+            # valid set binned with its own mappers would evaluate
+            # trees whose thresholds live in train bin space)
+            aligned.append(vs.construct_aligned(core_train, config)
+                           if hasattr(vs, "construct_aligned")
+                           else vs.construct(config))
+    valid_sets = aligned
     train_set = core_train
 
     booster = Booster(config=config, train_set=train_set,

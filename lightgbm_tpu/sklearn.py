@@ -105,6 +105,7 @@ class LGBMModel(_SKBase):
         self._n_classes = None
         self._evals_result = None
         self._best_iteration = -1
+        self._best_score: Dict[str, Dict[str, float]] = {}
         self._objective = objective
 
     # -- sklearn protocol -------------------------------------------------
@@ -207,6 +208,16 @@ class LGBMModel(_SKBase):
             callbacks=callbacks)
         self._evals_result = evals_result
         self._best_iteration = self._Booster.best_iteration
+        # best_score_ (reference sklearn.py): per-dataset per-metric
+        # value at the best iteration (last iteration when no early
+        # stopping fired)
+        self._best_score: Dict[str, Dict[str, float]] = {}
+        at = (self._best_iteration - 1) if self._best_iteration and \
+            self._best_iteration > 0 else -1
+        for dname, metrics in evals_result.items():
+            self._best_score[dname] = {
+                mname: vals[at] for mname, vals in metrics.items()
+                if vals}
         self._n_features = train_set.num_feature()
         # sklearn's check_is_fitted detects fitted state from instance
         # attributes with a trailing underscore
@@ -246,6 +257,30 @@ class LGBMModel(_SKBase):
     @property
     def n_features_(self):
         return self._n_features
+
+    @property
+    def best_score_(self):
+        """reference sklearn.py: {dataset: {metric: value}} at the
+        best iteration."""
+        if self._Booster is None:
+            raise RuntimeError("No booster found; call fit first")
+        return self._best_score
+
+    @property
+    def objective_(self):
+        """reference sklearn.py: the concrete objective used to fit."""
+        if self._Booster is None:
+            raise RuntimeError("No booster found; call fit first")
+        return self.objective if self.objective is not None \
+            else self._default_objective()
+
+    def apply(self, X, num_iteration=None):
+        """reference sklearn.py LGBMModel.apply: predicted leaf index
+        of every tree for every sample."""
+        if self._Booster is None:
+            raise RuntimeError("Estimator not fitted")
+        return self._Booster.predict(X, num_iteration=num_iteration
+                                     or -1, pred_leaf=True)
 
 
 class LGBMRegressor(_SKRegressor, LGBMModel):

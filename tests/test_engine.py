@@ -494,3 +494,40 @@ def test_python_surface_tail_matches_reference_basic():
     np.testing.assert_allclose(bst.predict(X[600:]), preds_before)
     with pytest.raises(Exception):
         bst.update()
+
+
+def test_unaligned_valid_sets_are_auto_referenced():
+    """A lazy valid set passed without reference= must be bin-aligned
+    to the training mappers (reference package train()/add_valid call
+    set_reference) — own-mapper binning would evaluate train-space
+    thresholds against foreign bins and yield silently wrong metrics."""
+    rng = np.random.RandomState(11)
+    X = rng.randn(1200, 6) * 3.0
+    y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15}
+    # shifted valid draw: misaligned bins would distort badly
+    Xv = rng.randn(400, 6) * 3.0 + 0.5
+    yv = (Xv[:, 0] > 0).astype(float)
+
+    res = {}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 10,
+                    valid_sets=[lgb.Dataset(Xv, label=yv)],  # no ref
+                    evals_result=res, verbose_eval=False)
+    ll_engine = res["valid_0"]["binary_logloss"][-1]
+
+    # explicit predict on raw features = ground truth
+    p = np.clip(bst.predict(Xv), 1e-7, 1 - 1e-7)
+    ll_true = -np.mean(yv * np.log(p) + (1 - yv) * np.log(1 - p))
+    assert abs(ll_engine - ll_true) < 5e-3, (ll_engine, ll_true)
+
+    # same auto-alignment through Booster.add_valid
+    bst2 = lgb.Booster(lgb.Config.from_params(params),
+                       train_set=lgb.Dataset(X, label=y))
+    bst2.add_valid(lgb.Dataset(Xv, label=yv), "v")   # no reference
+    for _ in range(10):
+        bst2.update()
+    (name, _m, ll_av, _b), = bst2.eval_valid()
+    assert name == "v"
+    p2 = np.clip(bst2.predict(Xv), 1e-7, 1 - 1e-7)
+    ll2 = -np.mean(yv * np.log(p2) + (1 - yv) * np.log(1 - p2))
+    assert abs(ll_av - ll2) < 5e-3, (ll_av, ll2)

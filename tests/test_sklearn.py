@@ -164,3 +164,18 @@ def test_sklearn_check_estimator_basics():
     X2 = rng.randn(80, 4)
     reg.fit(X2, X2[:, 1])
     assert reg.predict(X2).shape == (80,)
+
+
+def test_apply_best_score_objective_properties():
+    """reference sklearn.py tail: apply() leaf indices,
+    best_score_ at the best iteration, objective_ resolution."""
+    X, y = load_breast_cancer(return_X_y=True)
+    clf = lgb.LGBMClassifier(n_estimators=8, num_leaves=15, verbose=-1)
+    clf.fit(X, y, eval_set=[(X, y)], verbose=False)
+    leaves = clf.apply(X)
+    assert leaves.shape == (X.shape[0], 8)
+    assert leaves.dtype.kind == "i"
+    assert clf.objective_ == "binary"
+    bs = clf.best_score_
+    assert bs and all(
+        np.isfinite(v) for d in bs.values() for v in d.values())

@@ -1,0 +1,57 @@
+"""Advanced walkthrough (counterpart of the reference's
+examples/python-guide/advanced_example.py): categorical features,
+model-string round trip, continued training, learning-rate reset via
+callback, custom objective/metric, SHAP contributions."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(3)
+n = 4000
+X = rng.randn(n, 6)
+X[:, 5] = rng.randint(0, 8, n)              # categorical column
+y = (X[:, 0] + (X[:, 5] >= 4) * 1.5 > 0.5).astype(float)
+
+params = {"objective": "binary", "num_leaves": 31, "verbose": -1}
+train_data = lgb.Dataset(X, label=y, categorical_feature=[5],
+                         free_raw_data=False)
+
+print("Training with a categorical feature...")
+bst = lgb.train(params, train_data, 30)
+
+print("Model-string round trip...")
+s = bst.model_to_string()
+bst2 = lgb.Booster(model_str=s)
+assert np.abs(bst2.predict(X) - bst.predict(X)).max() < 1e-12
+
+print("Continued training (init_model) + decaying learning rate...")
+bst = lgb.train(params, train_data, 20, init_model=bst,
+                callbacks=[lgb.reset_parameter(
+                    learning_rate=lambda it: 0.1 * (0.99 ** it))])
+print(f"Total trees after continuation: {bst.num_trees()}")
+
+print("Custom objective and metric...")
+
+
+def logistic_obj(preds, dataset):
+    labels = dataset.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return (p - labels).astype(np.float32), \
+        (p * (1.0 - p)).astype(np.float32)
+
+
+def error_rate(preds, dataset):
+    labels = dataset.get_label()
+    return "error", float(((preds > 0) != (labels > 0.5)).mean()), False
+
+
+bstc = lgb.train(params, train_data, 20, fobj=logistic_obj,
+                 feval=error_rate, valid_sets=[train_data],
+                 verbose_eval=False)
+print("Custom-objective booster trained", bstc.num_trees(), "trees")
+
+print("SHAP contributions...")
+contrib = bst.predict(X[:100], pred_contrib=True)
+raw = bst.predict(X[:100], raw_score=True)
+assert np.abs(contrib.sum(axis=1) - raw).max() < 1e-6
+print("Contributions sum to the raw score. Done.")

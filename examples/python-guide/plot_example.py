@@ -1,0 +1,40 @@
+"""Plotting walkthrough (counterpart of the reference's
+examples/python-guide/plot_example.py).  Writes PNGs when matplotlib
+is available; prints a note otherwise."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(5)
+X = rng.randn(2000, 6)
+y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+
+evals = {}
+train = lgb.Dataset(X, label=y)
+bst = lgb.train({"objective": "binary", "verbose": -1,
+                 "metric": "binary_logloss", "num_leaves": 15},
+                train, 30, valid_sets=[train], valid_names=["train"],
+                evals_result=evals, verbose_eval=False)
+
+try:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+except ImportError:
+    print("matplotlib not installed — skipping the figures")
+    raise SystemExit(0)
+
+ax = lgb.plot_importance(bst, max_num_features=6)
+ax.figure.savefig("importance.png")
+print("Wrote importance.png")
+
+ax = lgb.plot_metric(evals, metric="binary_logloss")
+ax.figure.savefig("metric.png")
+print("Wrote metric.png")
+
+try:
+    ax = lgb.plot_tree(bst, tree_index=0)
+    ax.figure.savefig("tree.png")
+    print("Wrote tree.png")
+except Exception as e:  # graphviz module or its `dot` binary missing
+    print(f"plot_tree skipped ({type(e).__name__}: {e})")

@@ -1,0 +1,40 @@
+"""Simple python-package walkthrough (counterpart of the reference's
+examples/python-guide/simple_example.py): Dataset -> train with a
+validation set -> early stopping -> predict -> save/load."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(7)
+X = rng.randn(5000, 10)
+y = (X[:, 0] * 1.2 - X[:, 1] + 0.3 * rng.randn(5000) > 0).astype(float)
+X_train, X_test = X[:4000], X[4000:]
+y_train, y_test = y[:4000], y[4000:]
+
+train_data = lgb.Dataset(X_train, label=y_train)
+valid_data = lgb.Dataset(X_test, label=y_test, reference=train_data)
+
+params = {
+    "objective": "binary",
+    "metric": ["binary_logloss", "auc"],
+    "num_leaves": 31,
+    "learning_rate": 0.1,
+    "verbose": -1,
+}
+
+print("Starting training...")
+bst = lgb.train(params, train_data, num_boost_round=100,
+                valid_sets=[valid_data],
+                early_stopping_rounds=10)
+
+print("Saving model...")
+bst.save_model("model.txt")
+
+print("Predicting...")
+y_prob = bst.predict(X_test)
+acc = ((y_prob > 0.5) == (y_test > 0.5)).mean()
+print(f"Held-out accuracy: {acc:.3f}")
+
+bst2 = lgb.Booster(model_file="model.txt")
+assert np.abs(bst2.predict(X_test) - y_prob).max() < 1e-12
+print("Reloaded model predicts identically.")

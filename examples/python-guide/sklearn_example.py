@@ -1,0 +1,29 @@
+"""sklearn-API walkthrough (counterpart of the reference's
+examples/python-guide/sklearn_example.py): estimator fit/predict,
+early stopping, feature importances, grid search."""
+import numpy as np
+from sklearn.model_selection import GridSearchCV, train_test_split
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(11)
+X = rng.randn(3000, 8)
+y = X[:, 0] * 2.0 - X[:, 1] ** 2 + 0.5 * rng.randn(3000)
+X_train, X_test, y_train, y_test = train_test_split(X, y, random_state=0)
+
+print("Starting training...")
+reg = lgb.LGBMRegressor(num_leaves=31, learning_rate=0.1,
+                        n_estimators=60, verbose=-1)
+reg.fit(X_train, y_train, eval_set=[(X_test, y_test)],
+        eval_metric="l2", early_stopping_rounds=10, verbose=False)
+
+mse = np.mean((reg.predict(X_test) - y_test) ** 2)
+print(f"MSE: {mse:.4f}  best_score_: {reg.best_score_}")
+print("Feature importances:", list(reg.feature_importances_))
+
+print("Grid search...")
+gs = GridSearchCV(lgb.LGBMRegressor(verbose=-1, n_estimators=20),
+                  {"num_leaves": [15, 31], "learning_rate": [0.05, 0.1]},
+                  cv=3)
+gs.fit(X_train, y_train)
+print("Best params:", gs.best_params_)

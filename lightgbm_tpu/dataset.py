@@ -169,6 +169,24 @@ class Dataset:
     def label(self) -> np.ndarray:
         return self.metadata.label
 
+    # reference-compatible accessors: custom fobj/feval callbacks are
+    # handed this core object and expect the python package's
+    # Dataset.get_label()/get_weight()/get_group() surface
+    def get_field(self, name: str):
+        return self.metadata.get_field(name)
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_group(self):
+        return self.get_field("group")
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
     # ------------------------------------------------------------------
     @classmethod
     def from_matrix(cls, data: np.ndarray, label=None, weight=None,

@@ -73,3 +73,22 @@ def test_virtual_file_scheme_hook(tmp_path):
 
     with pytest.raises(Exception, match="no opener registered"):
         dataset_io.load_binary("hdfs://nowhere/x.bin")
+
+
+@pytest.mark.slow
+def test_python_guide_examples_run(tmp_path):
+    """Every examples/python-guide script runs to completion (they
+    synthesize their own data and write artifacts to cwd)."""
+    guide = os.path.join(REPO, "examples", "python-guide")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    for script in sorted(os.listdir(guide)):
+        if not script.endswith(".py"):
+            continue
+        run = subprocess.run(
+            [sys.executable, os.path.join(guide, script)],
+            cwd=tmp_path, capture_output=True, text=True, env=env,
+            timeout=900)
+        assert run.returncode == 0, \
+            f"{script}: {run.stdout[-800:]}\n{run.stderr[-1500:]}"

@@ -274,6 +274,10 @@ def run_ltr_scale():
     # binary with .query side files and records its NDCG@10 on the
     # same held-out draw)
     if os.environ.get("BENCH_LOCAL_REF_LTR", "1") != "0":
+        # free the TPU training state before the minutes-long host-side
+        # reference run (write_csv makes another full float64 copy)
+        del gbdt, dtrain, vcore
+        gc.collect()
         ref = run_local_reference(
             X, y, Xv, yv, params,
             int(os.environ.get("BENCH_REF_ITERS_LTR", 10)),

@@ -246,7 +246,7 @@ class TreeGrower:
         on_tpu = jax.default_backend() in ("tpu", "axon")
         self.chunk = _pick_chunk(n, self.num_groups, self.max_group_bin,
                                  cdt.itemsize,
-                                 min_chunk=4096 if on_tpu else 1024)
+                                 min_chunk=8192 if on_tpu else 1024)
         self.num_data = n
         # multi-host: this process holds only ITS row shard of the bin
         # matrix (parallel/distributed.py finalize_global); every host
@@ -318,6 +318,16 @@ class TreeGrower:
         self.pallas_paired = self.use_pallas and hk == "paired"
         blk = int(getattr(config, "pallas_hist_block", 2048))
         self.pallas_block = blk if self.n_padded % blk == 0 else 1024
+        # tiled-iota kernels stream ~G bytes/row instead of the G*B-byte
+        # one-hot, so their per-block fixed cost (route decode, iota
+        # rebuild) wants much larger blocks than the streamed kernels'
+        # DMA-tuned 2048 (see config.pallas_hist_block_tiled)
+        tblk = int(getattr(config, "pallas_hist_block_tiled", 8192))
+        self.pallas_block_tiled = 1024
+        for cand in (tblk, 8192, 4096, 2048, 1024):
+            if cand <= self.n_padded and self.n_padded % cand == 0:
+                self.pallas_block_tiled = cand
+                break
         # int8 quantized training (see _hist_kernel_body_q): histogram
         # matmuls on the int8 MXU with one grad/hess scale per tree.
         # The int32 accumulator bounds rows at N*127 < 2^31.
@@ -695,18 +705,19 @@ class TreeGrower:
 
         def run(strips):
             def go(_):
-                # block=2048 measured fastest on v5e (4096 fits scoped
-                # VMEM for 1-strip but benched 16% slower — the DMA
-                # pipeline prefers the finer granularity)
                 if self.use_tiled:
                     from ..ops.histogram import \
                         compute_group_histograms_fused_tiled
                     h, leaf2 = compute_group_histograms_fused_tiled(
                         self.binsT, wT, scales, st.leaf_id,
                         st.route_tab, rights, max_group_bin=B,
-                        block=self.pallas_block, strips=strips,
+                        block=self.pallas_block_tiled, strips=strips,
                         interpret=self._interp)
                 else:
+                    # streamed-one-hot kernel: block=2048 measured
+                    # fastest on v5e (4096 fits scoped VMEM for 1-strip
+                    # but benched 16% slower — its 3.6 MB/block DMA
+                    # pipeline prefers the finer granularity)
                     h, leaf2 = compute_group_histograms_fused(
                         ohb, self.binsT, wT, scales, st.leaf_id,
                         st.route_tab, rights, max_group_bin=B,
@@ -748,8 +759,8 @@ class TreeGrower:
         def run_packed(strips):
             return compute_group_histograms_q_tiled(
                 self.binsT, wT, scales, leaf_id, slots,
-                max_group_bin=B, block=self.pallas_block, strips=strips,
-                interpret=self._interp)
+                max_group_bin=B, block=self.pallas_block_tiled,
+                strips=strips, interpret=self._interp)
 
         return self._packed_dispatch(full, run_packed, slots,
                                      slots.shape[0])

@@ -37,10 +37,14 @@ def _pick_chunk(n: int, num_groups: int, max_group_bin: int,
                 min_chunk: int = 4096) -> int:
     """Row-chunk size bounding the materialized one-hot to ~64 MB.
 
-    ``min_chunk`` also sets the padding granularity: 4096 on real TPU
-    (every Pallas block size up to 4096 must divide the padded row
-    count), 1024 elsewhere — a 569-row test dataset padded to 4096
-    rows pays 7x the row work on the CPU backend for nothing."""
+    ``min_chunk`` also sets the padding granularity when the grower
+    calls this: 8192 on real TPU (every Pallas block size up to 8192 —
+    the tiled-iota kernels' preferred block — must divide the padded
+    row count), 1024 elsewhere — a 569-row test dataset padded to
+    8192 rows pays 14x the row work on the CPU backend for nothing.
+    The signature default (4096) only serves the standalone XLA
+    histogram path's internal chunking, where no padding invariant
+    rides on it."""
     per_row = max(num_groups * max_group_bin * itemsize, 1)
     chunk = max(min_chunk, min(n, target_bytes // per_row))
     return int(max(min_chunk, (chunk // min_chunk) * min_chunk))

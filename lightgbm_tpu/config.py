@@ -281,7 +281,10 @@ class Config:
     # opt in for benchmarks, keep float32 for reference parity)
     row_chunk: int = 65536          # rows per histogram-scan chunk
     frontier_width: int = 0         # max splits applied per frontier round
-    # (0 = auto: min(128, num_leaves-1) — one 128-lane MXU strip)
+    # (0 = auto: min(84, num_leaves-1) — two 42-leaf strips of the
+    # channel-packed histogram kernel, the fastest measured ladder at
+    # the 1M bench shape; growth order near the leaf cap is a
+    # documented, quality-bounded deviation from one-split-at-a-time)
     hist_kernel: str = "auto"       # auto | pallas | paired | xla
     hist_packed_dispatch: bool = True  # lax.cond to the channel-packed
     # kernel on narrow frontiers (off: always the full-width kernel)

@@ -207,13 +207,16 @@ class TreeGrower:
         # hard bound on frontier rounds (the while_loop exits early when
         # no leaf splits)
         self.max_rounds = config.num_leaves - 1
-        # frontier width: max splits applied per round.  126 = 3 strips
-        # of the channel-packed histogram kernel (3 x PACKED_STRIP), so
-        # every round's refresh runs at the cheapest lane packing for
-        # its width; a wider cap would not reduce round count in
-        # practice but would force the 3x-wider unpacked kernel.
+        # frontier width: max splits applied per round.  84 = 2 strips
+        # of the channel-packed histogram kernel (2 x PACKED_STRIP):
+        # at the 1M bench shape the 2-strip ladder beats both 126
+        # (extra 3-strip passes, 25.9 ms/tree) and 64 (more rounds,
+        # 26.0) at 25.2 ms/tree with held-out AUC unchanged — growth
+        # order near the leaf cap is a DOCUMENTED deviation whose
+        # quality effect tests/test_reference_parity.py bounds, and
+        # under gain exhaustion any width grows bit-identical trees.
         self.frontier = min(config.num_leaves - 1,
-                            config.frontier_width or 126)
+                            config.frontier_width or 84)
 
         # histogram memory governance (reference histogram_pool_size,
         # config.h:216 + HistogramPool LRU): when the per-leaf cache

@@ -202,9 +202,10 @@ def test_bench_config_255_leaf_parity(tmp_path):
     """The bench config (num_leaves=255, max_bin=63) proven against the
     reference binary at scale (round-3 verdict weak #3): model exchange
     must hold to 1e-5 in BOTH directions for deep 255-leaf trees, the
-    frontier budget (126 splits/round) must not change the grown trees
-    (a narrower budget yields bit-identical predictions), and the
-    held-out metric stays within 2% of the reference's."""
+    frontier budget (default 84 splits/round) must not change the grown
+    trees under gain exhaustion (any narrower budget yields
+    bit-identical predictions), and when the leaf cap binds the width
+    effect and the reference gap are bounded by held-out logloss."""
     rng = np.random.RandomState(7)
     n, f = 30_000, 28
     X = rng.randn(n, f)

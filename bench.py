@@ -223,6 +223,9 @@ def run_ltr_scale():
         "hist_compute_dtype": "bfloat16",
         "quantized_grad": os.environ.get("BENCH_QUANTIZED", "1") != "0",
     }
+    extra = os.environ.get("BENCH_PARAMS")
+    if extra:
+        params.update(json.loads(extra))
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
     cfg = Config.from_params(params)

@@ -281,21 +281,25 @@ class Config:
     # opt in for benchmarks, keep float32 for reference parity)
     row_chunk: int = 65536          # rows per histogram-scan chunk
     frontier_width: int = 0         # max splits applied per frontier round
-    # (0 = auto: min(84, num_leaves-1) — two 42-leaf strips of the
-    # channel-packed histogram kernel, the fastest measured ladder at
-    # the 1M bench shape; growth order near the leaf cap is a
-    # documented, quality-bounded deviation from one-split-at-a-time)
+    # (0 = auto: min(126, num_leaves-1) — three 42-leaf strips of the
+    # channel-packed histogram kernel.  84 is ~3% faster at the 1M
+    # binary bench shape but measurably hurts lambdarank NDCG at 255
+    # leaves; growth order near the leaf cap is a documented,
+    # quality-bounded deviation from one-split-at-a-time)
     hist_kernel: str = "auto"       # auto | pallas | paired | xla
     hist_packed_dispatch: bool = True  # lax.cond to the channel-packed
     # kernel on narrow frontiers (off: always the full-width kernel)
     pallas_hist_block: int = 2048   # rows per Pallas histogram block
     # (streamed-one-hot kernels; the 3.6 MB/block DMA prefers 2048)
-    pallas_hist_block_tiled: int = 8192  # rows per block for the
+    pallas_hist_block_tiled: int = 0  # rows per block for the
     # tiled-iota kernels, whose HBM stream is only the (G, N) packed
     # bins (~0.2 MB/block): larger blocks amortize the in-VMEM one-hot
-    # rebuild — 8192 measured 25.7 vs 26.5 ms/tree (block 2048) at the
-    # 1M bench shape; falls back to the largest power-of-two block
-    # dividing the padded row count
+    # rebuild, but the (m_pad, hist_width) int32 output block lives in
+    # scoped VMEM so wide-G shapes want smaller row blocks.  0 = auto:
+    # keep block*width near the measured 8192*1792 sweet spot, clamped
+    # to [2048, 8192] (8192 at the 28-feature bench shape: 25.9 vs
+    # 26.5 ms/tree; 2048 at 136 features: 288 vs 308), then the
+    # largest power-of-two block dividing the padded row count
     quantized_grad: bool = False    # int8-MXU quantized histogram
     # construction (one grad/hess scale per tree; the TPU analog of
     # LightGBM v4 quantized training, arXiv 2207.09682) — TPU path only

@@ -207,16 +207,17 @@ class TreeGrower:
         # hard bound on frontier rounds (the while_loop exits early when
         # no leaf splits)
         self.max_rounds = config.num_leaves - 1
-        # frontier width: max splits applied per round.  84 = 2 strips
-        # of the channel-packed histogram kernel (2 x PACKED_STRIP):
-        # at the 1M bench shape the 2-strip ladder beats both 126
-        # (extra 3-strip passes, 25.9 ms/tree) and 64 (more rounds,
-        # 26.0) at 25.2 ms/tree with held-out AUC unchanged — growth
-        # order near the leaf cap is a DOCUMENTED deviation whose
-        # quality effect tests/test_reference_parity.py bounds, and
-        # under gain exhaustion any width grows bit-identical trees.
+        # frontier width: max splits applied per round.  126 = 3 strips
+        # of the channel-packed histogram kernel (3 x PACKED_STRIP).
+        # 84 (2 strips) is ~0.7 ms/tree faster at the 1M binary bench
+        # shape with AUC unchanged, but was measured to cost 0.06
+        # held-out NDCG@10 at the MS-LTR bench shape (0.266 vs 0.328,
+        # 255 leaves) — growth order near the leaf cap is quality-
+        # neutral for the binary task but NOT for lambdarank, so the
+        # default stays at the widest packed ladder and the knob is
+        # left to users who know their task tolerates it.
         self.frontier = min(config.num_leaves - 1,
-                            config.frontier_width or 84)
+                            config.frontier_width or 126)
 
         # histogram memory governance (reference histogram_pool_size,
         # config.h:216 + HistogramPool LRU): when the per-leaf cache
@@ -324,8 +325,19 @@ class TreeGrower:
         # tiled-iota kernels stream ~G bytes/row instead of the G*B-byte
         # one-hot, so their per-block fixed cost (route decode, iota
         # rebuild) wants much larger blocks than the streamed kernels'
-        # DMA-tuned 2048 (see config.pallas_hist_block_tiled)
-        tblk = int(getattr(config, "pallas_hist_block_tiled", 8192))
+        # DMA-tuned 2048 — but the (m_pad, hist_width) int32 output
+        # block lives in scoped VMEM, so wide-G shapes must shrink the
+        # row block again.  Measured on v5e: G*B_pad=1792 (28 feats,
+        # 63 bins) wants 8192 (25.9 vs 26.5 ms/tree); 8704 (136 feats)
+        # wants 2048 (288 vs 308 ms/tree).  Auto keeps block*width
+        # near the 8192*1792 sweet spot, clamped to [2048, 8192].
+        tblk = int(getattr(config, "pallas_hist_block_tiled", 0) or 0)
+        if not tblk:
+            from ..ops.histogram import tiled_hist_width
+            width = tiled_hist_width(self.num_groups, self.max_group_bin)
+            tblk = 2048
+            while tblk < 8192 and (2 * tblk) * width <= 8192 * 1792 * 2:
+                tblk *= 2
         self.pallas_block_tiled = 1024
         for cand in (tblk, 8192, 4096, 2048, 1024):
             if cand <= self.n_padded and self.n_padded % cand == 0:

@@ -852,6 +852,17 @@ def _unpack_strip_channels(out: jax.Array, strips: int, num_groups: int,
     return jnp.transpose(hist, (1, 2, 3, 0))
 
 
+def tiled_hist_width(num_groups: int, max_group_bin: int) -> int:
+    """Lane width of the tiled-iota kernels' output block: ``per_tile``
+    groups packed per 128-lane tile (the layout contract shared by
+    _hist_kernel_body_q_tiled / _fused_kernel_body_q_tiled and the
+    grower's VMEM-aware block-size heuristic)."""
+    b = max_group_bin
+    per_tile = max(1, 128 // b)
+    tile_w = 128 if b <= 128 else _round_up(b, 128)
+    return ((num_groups + per_tile - 1) // per_tile) * tile_w
+
+
 def _hist_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, slots_ref,
                               out_ref, *, strip, strips, max_group_bin,
                               num_groups):

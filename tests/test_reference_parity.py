@@ -202,7 +202,7 @@ def test_bench_config_255_leaf_parity(tmp_path):
     """The bench config (num_leaves=255, max_bin=63) proven against the
     reference binary at scale (round-3 verdict weak #3): model exchange
     must hold to 1e-5 in BOTH directions for deep 255-leaf trees, the
-    frontier budget (default 84 splits/round) must not change the grown
+    frontier budget (default 126 splits/round) must not change the grown
     trees under gain exhaustion (any narrower budget yields
     bit-identical predictions), and when the leaf cap binds the width
     effect and the reference gap are bounded by held-out logloss."""

@@ -521,10 +521,104 @@ class CrossEntropyLambda(Objective):
         return np.log1p(np.exp(raw))
 
 
+def _banded_take_plan(positions: np.ndarray, tile: int = 128):
+    """Plan an exact monotone permutation out[i] = x[positions[i]]
+    (ascending positions, -1 = emit 0) as per-``tile`` window takes +
+    one-hot matmuls.
+
+    Because valid positions ascend by exactly +1 (query rows are
+    consecutive in both the flat and the padded order), every
+    ``tile``-slot output tile reads from a 2-tile (2*128-element)
+    window of the input: lo = min valid position, hi <= lo + tile - 1,
+    so hi - (lo//tile)*tile <= (lo % tile) + tile - 1 < 2*tile.  This
+    is what makes the padded<->flat movement MXU work instead of an
+    XLA row gather (~80M rows/s on v5e — 28 ms per 2.26M-row pass).
+
+    Returns (wtiles (nt, 2) int32 window tile indices into the
+    128-row tiles of x, local (nt, tile) int32 in-window offsets with
+    2*tile as the emit-0 sentinel, nt_in_min = 1 + max window tile)."""
+    P = tile
+    out_len = len(positions)
+    assert out_len % P == 0
+    pos = positions.reshape(-1, P).astype(np.int64)
+    valid = pos >= 0
+    any_valid = valid.any(axis=1)
+    big = np.iinfo(np.int64).max
+    lo = np.where(any_valid,
+                  np.where(valid, pos, big).min(axis=1), 0)
+    base = lo // P
+    local = np.where(valid, pos - base[:, None] * P, 2 * P)
+    assert local.max(initial=0) <= 2 * P and local.min(initial=0) >= 0
+    wtiles = np.stack([base, base + 1], axis=1)
+    return (wtiles.astype(np.int32), local.astype(np.int32),
+            int(wtiles.max(initial=0)) + 1)
+
+
+def _window_onehot(loc):
+    """(tc, 128, 256) f32 0/1 select matrix from in-window offsets —
+    the single layout contract both banded directions share (the
+    scatter must be the exact transpose of the gather); the 256
+    sentinel matches no column and so emits/contributes 0."""
+    return (loc[:, :, None] ==
+            jnp.arange(256, dtype=jnp.int32)[None, None, :]
+            ).astype(jnp.float32)
+
+
+def _banded_gather(xt, wtiles, local, chunk):
+    """Exact banded permutation-gather: xt (nt_in, 128) f32 input
+    tiles; returns (nt, 128) f32 with out[t, p] = xt window value at
+    local[t, p] (0 at the sentinel).  The one-hot select runs as a
+    batched (128, 256) @ (256, 1) HIGHEST-precision dot — products
+    with an exact 0/1 operand reproduce f32 values."""
+    nt = wtiles.shape[0]
+    win = xt[wtiles.reshape(-1)].reshape(nt, 256)
+
+    def body(args):
+        loc, w = args
+        return jax.lax.dot_general(
+            _window_onehot(loc), w[:, :, None],
+            (((2,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST)[..., 0]
+
+    nc = nt // chunk
+    out = jax.lax.map(body, (local.reshape(nc, chunk, 128),
+                             win.reshape(nc, chunk, 256)))
+    return out.reshape(nt, 128)
+
+
+def _banded_scatter(gh, wtiles, local, nt_in, chunk):
+    """Exact transpose of :func:`_banded_gather`: gh (nt, 128, C)
+    padded-order values; returns (nt_in, 128, C) flat tiles with each
+    value added at its window position (windows of adjacent tiles
+    overlap, so the per-tile transposed dots are combined by a
+    tile-row scatter-add — 128-row payloads, not scalar rows)."""
+    nt, _, C = gh.shape
+
+    def body(args):
+        loc, g = args
+        # (tc, 256, C) = sum_p oh[t, p, w] * g[t, p, c]
+        return jax.lax.dot_general(
+            _window_onehot(loc), g, (((1,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST)
+
+    nc = nt // chunk
+    parts = jax.lax.map(body, (local.reshape(nc, chunk, 128),
+                               gh.reshape(nc, chunk, 128, C)))
+    parts = parts.reshape(nt * 2, 128, C)
+    out = jnp.zeros((nt_in, 128, C), jnp.float32)
+    return out.at[wtiles.reshape(-1)].add(parts, mode="drop")
+
+
 class LambdarankNDCG(Objective):
     """reference rank_objective.hpp:19-200: per-query pairwise lambdas
     with |ΔNDCG| weighting; the sorted O(n^2) pair loop becomes a masked
-    pairwise matrix per padded query, vmapped across queries."""
+    pairwise matrix per padded query, vmapped across queries.
+
+    The flat<->padded score/gradient movement runs as banded
+    permutation matmuls (:func:`_banded_take_plan`): XLA's row
+    gather/scatter on TPU costs ~28 ms per 2.26M rows per pass (~87
+    ms/tree at the MS-LTR bench shape), while the banded form is
+    ~6x cheaper and exact."""
     name = "lambdarank"
     need_accurate_prediction = False
 
@@ -575,10 +669,50 @@ class LambdarankNDCG(Objective):
         self._inv_max_dcg = jnp.asarray(inv.astype(np.float32))
         self._label_gain_dev = jnp.asarray(
             self.label_gain.astype(np.float32))
-        # per-row labels gathered into padded layout
+        # per-row labels (and weights) gathered into padded layout ONCE
+        # — they are static across trees
         safe = np.maximum(idx, 0)
         self._qlabel = jnp.asarray(
             self.label[safe].astype(np.float32) * (idx >= 0))
+        if self.weight is not None:
+            self._qweight = jnp.asarray(
+                np.asarray(self.weight)[safe].astype(np.float32)
+                * (idx >= 0))
+        else:
+            self._qweight = None
+        # banded flat<->padded movement plan (see _banded_take_plan):
+        # positions = flattened qidx, padded to a 128-slot multiple and
+        # chunk-aligned so both lax.maps split evenly
+        flat_pos = idx.reshape(-1)
+        npos = len(flat_pos)
+        nt = -(-npos // 128)
+        # tile chunk bounds the per-step one-hot to ~67 MB at 512; tiny
+        # (test-sized) datasets keep their raw tile count instead of
+        # paying a 512-tile round-up
+        self._tile_chunk = min(512, nt)
+        nt = -(-nt // self._tile_chunk) * self._tile_chunk
+        flat_pos = np.concatenate(
+            [flat_pos, np.full(nt * 128 - npos, -1, np.int64)])
+        wtiles, local, nt_in_min = _banded_take_plan(flat_pos)
+        self._bp_wtiles = jnp.asarray(wtiles)
+        self._bp_local = jnp.asarray(local)
+        self._bp_nt_in_min = nt_in_min
+        self._bp_out_len = npos
+
+    def _padded_scores(self, score):
+        """Flat (padded) training scores -> (q_pad, M) padded layout
+        via the banded plan; -inf outside valid slots."""
+        S = score.shape[0]
+        target = max(self._bp_nt_in_min, -(-S // 128)) * 128
+        if target != S:
+            score = jnp.pad(score, (0, target - S))
+        xt = score.reshape(-1, 128)
+        ps = _banded_gather(xt, self._bp_wtiles, self._bp_local,
+                            self._tile_chunk)
+        ps = ps.reshape(-1)[:self._bp_out_len]
+        q_pad, M = self._qidx.shape
+        ps = ps.reshape(q_pad, M)
+        return jnp.where(self._qmask, ps, -jnp.inf)
 
     def get_gradients(self, score):
         sig = self.sigmoid
@@ -587,12 +721,11 @@ class LambdarankNDCG(Objective):
         q_pad, M = qidx.shape
         qc = self._q_chunk
         nc = q_pad // qc
+        pscore = self._padded_scores(score)
+        pweight = self._qweight
 
         def chunk(args):
-            qidx_c, qmask_c, qlabel_c, inv_c = args
-            safe = jnp.maximum(qidx_c, 0)
-            s = score[safe]                                # (qc, M)
-            s = jnp.where(qmask_c, s, -jnp.inf)
+            qmask_c, qlabel_c, inv_c, s, w_c = args
             labels = qlabel_c.astype(jnp.int32)
             gains = self._label_gain_dev[jnp.clip(labels, 0, None)]
 
@@ -627,24 +760,32 @@ class LambdarankNDCG(Objective):
             g_q = lam.sum(axis=2) - lam.sum(axis=1)        # (qc, M)
             h_q = hes.sum(axis=2) + hes.sum(axis=1)
 
-            if self._weight_dev is not None:
-                w = self._weight_dev[safe]
-                g_q = g_q * w
-                h_q = h_q * w
+            if pweight is not None:       # static at trace time
+                g_q = g_q * w_c
+                h_q = h_q * w_c
             return g_q, h_q
 
+        # no-weight runs map a broadcast dummy so the pytree shape is
+        # fixed; chunk never reads it (static branch above)
+        wmap = (pweight.reshape(nc, qc, M) if pweight is not None
+                else jnp.zeros((nc, 1, 1), jnp.float32))
         g_all, h_all = jax.lax.map(chunk, (
-            qidx.reshape(nc, qc, M), qmask.reshape(nc, qc, M),
+            qmask.reshape(nc, qc, M),
             self._qlabel.reshape(nc, qc, M),
-            self._inv_max_dcg.reshape(nc, qc)))
+            self._inv_max_dcg.reshape(nc, qc),
+            pscore.reshape(nc, qc, M), wmap))
 
-        grad = jnp.zeros_like(score)
-        hess = jnp.zeros_like(score)
-        flat_idx = jnp.where(qmask, qidx, score.shape[0])
-        grad = grad.at[flat_idx.reshape(-1)].add(
-            g_all.reshape(-1), mode="drop")
-        hess = hess.at[flat_idx.reshape(-1)].add(
-            h_all.reshape(-1), mode="drop")
+        # padded (q_pad, M) lambdas -> flat rows through the transposed
+        # banded plan (an exact scatter-add; see _banded_scatter)
+        gh = jnp.stack([g_all.reshape(-1), h_all.reshape(-1)], axis=-1)
+        pad_tail = self._bp_local.shape[0] * 128 - gh.shape[0]
+        if pad_tail:
+            gh = jnp.pad(gh, ((0, pad_tail), (0, 0)))
+        nt_in = max(self._bp_nt_in_min, -(-score.shape[0] // 128))
+        flat = _banded_scatter(gh.reshape(-1, 128, 2), self._bp_wtiles,
+                               self._bp_local, nt_in, self._tile_chunk)
+        grad = flat[..., 0].reshape(-1)[:score.shape[0]]
+        hess = flat[..., 1].reshape(-1)[:score.shape[0]]
         return grad, hess
 
 

@@ -966,12 +966,23 @@ class TreeGrower:
             # the last round's selected splits were never routed (the
             # loop exited before the next refresh) — apply them once,
             # and ride the per-row POST-route leaf value on the same
-            # (N, L) one-hot dot so the boosting score update needs no
-            # separate leaf_value_broadcast pass (callers ignore
-            # row_val when RenewTreeOutput will change leaf values)
-            leaf_id, row_val = apply_route_table(
-                self.bins, leaf_id, final.route_tab,
-                values=final.tree.leaf_value)
+            # pass so the boosting score update needs no separate
+            # leaf_value_broadcast (callers ignore row_val when
+            # RenewTreeOutput will change leaf values).  Tiled path:
+            # in-VMEM Pallas broadcast; the XLA form materializes an
+            # (N, L_pad) bf16 one-hot + (N, K) rows in HBM (~16
+            # ms/tree at HIGGS scale)
+            if self.use_tiled:
+                from ..ops.histogram import route_apply_tiled
+                leaf_id, row_val = route_apply_tiled(
+                    self.binsT, leaf_id, final.route_tab,
+                    final.tree.leaf_value,
+                    block=self.pallas_block_tiled,
+                    interpret=self._interp)
+            else:
+                leaf_id, row_val = apply_route_table(
+                    self.bins, leaf_id, final.route_tab,
+                    values=final.tree.leaf_value)
         tree = final.tree._replace(num_leaves=final.num_leaves)
         return tree, leaf_id, row_val
 

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .partition import MISSING_NAN, MISSING_ZERO
+from .partition import MISSING_NAN, MISSING_ZERO, ROUTE_FIXED_COLS
 
 
 def _pick_chunk(n: int, num_groups: int, max_group_bin: int,
@@ -976,12 +976,15 @@ def compute_group_histograms_pre_packed(
     return hist
 
 
-def _route_prologue_T(binb, leaf, routeT, *, num_groups, nb):
+def _route_prologue_T(binb, leaf, routeT, *, num_groups, nb,
+                      with_decision=False):
     """Shared transposed routing prologue of the fused kernels: apply
     the pending per-leaf route table to a block's rows.  ``binb`` is
     the (G, C) int32 bins block, ``leaf`` the (1, C) int32 leaf ids,
     ``routeT`` the (K, Lpad) transposed route table in VMEM.  Returns
-    the (1, C) post-route leaf ids.
+    the (1, C) post-route leaf ids — plus ``(went_right, scal)`` when
+    ``with_decision`` (the exit-route kernel reads its bf16-split
+    leaf-value columns out of the same ``scal`` dot).
 
     This is the in-kernel transposed form of ops/partition.py
     route_rows — see the NOTE there: any semantic change MUST land in
@@ -1031,7 +1034,10 @@ def _route_prologue_T(binb, leaf, routeT, *, num_groups, nb):
     cat_left = (byte_val >> (fbin % 8)) & 1
 
     go_left = jnp.where(iscat, cat_left, num_left)
-    return jnp.where(active, jnp.where(go_left > 0, leaf, rs), leaf)
+    new_leaf = jnp.where(active, jnp.where(go_left > 0, leaf, rs), leaf)
+    if with_decision:
+        return new_leaf, active & (go_left <= 0), scal
+    return new_leaf
 
 
 def _tiled_lhs(leaf, w, slot_col, *, strip, strips):
@@ -1175,9 +1181,8 @@ def compute_group_histograms_fused(
         raise ValueError(f"N ({n}) must be a multiple of block ({block})")
     slot_col = _pack_slot_tiles(slots, strips)[:, None]  # (128*strips, 1)
 
-    L, K = route_tab.shape
-    l_pad = max(128, ((L + 127) // 128) * 128)
-    routeT = jnp.zeros((K, l_pad), jnp.float32).at[:, :L].set(route_tab.T)
+    routeT = _transpose_pad_route(route_tab)
+    K = route_tab.shape[1]
     m_pad = 128 * strips
 
     kern = functools.partial(_fused_kernel_body, strip=PACKED_STRIP,
@@ -1282,9 +1287,8 @@ def compute_group_histograms_fused_tiled(
         raise ValueError(f"N ({n}) must be a multiple of block ({block})")
     slot_col = _pack_slot_tiles(slots, strips)[:, None]  # (m_pad, 1)
 
-    L, K = route_tab.shape
-    l_pad = max(128, ((L + 127) // 128) * 128)
-    routeT = jnp.zeros((K, l_pad), jnp.float32).at[:, :L].set(route_tab.T)
+    routeT = _transpose_pad_route(route_tab)
+    K = route_tab.shape[1]
     m_pad = 128 * strips
 
     kern = functools.partial(_fused_kernel_body_q_tiled, strip=PACKED_STRIP,
@@ -1313,6 +1317,84 @@ def compute_group_histograms_fused_tiled(
     hist = _tiled_out_to_hist(out, strips, num_groups, b).astype(
         jnp.float32) * scales[None, None, None, :]
     return hist, leaf_out[0]
+
+
+def _transpose_pad_route(table: jax.Array) -> jax.Array:
+    """(L, K) route table -> (K, l_pad) transposed, zero-padded to a
+    128-multiple leaf axis — the in-VMEM orientation every fused/route
+    kernel consumes (an all-zero column routes nothing)."""
+    L, K = table.shape
+    l_pad = max(128, ((L + 127) // 128) * 128)
+    return jnp.zeros((K, l_pad), jnp.float32).at[:, :L].set(table.T)
+
+
+def _route_value_kernel_body(binsT_ref, leafT_ref, routeT_ref,
+                             leaf_out_ref, val_out_ref, *, num_groups,
+                             nb):
+    """Exit-route kernel: apply the final pending route table and emit
+    each row's POST-route leaf value, with the one-hot broadcast in
+    VMEM — the XLA form (ops/partition.py apply_route_table)
+    materializes an (N, L_pad) bf16 one-hot plus (N, K) scalar rows in
+    HBM, ~16 ms/tree at HIGGS scale.  Value columns ride the same
+    scal dot as six bf16-split columns (exact f32 reassembly)."""
+    leaf = leafT_ref[:]                                  # (1, C) int32
+    new_leaf, went_right, scal = _route_prologue_T(
+        binsT_ref[:].astype(jnp.int32), leaf, routeT_ref[:],
+        num_groups=num_groups, nb=nb, with_decision=True)
+    leaf_out_ref[:] = new_leaf
+    k0 = ROUTE_FIXED_COLS + nb
+    vk = scal[k0:k0 + 1] + scal[k0 + 1:k0 + 2] + scal[k0 + 2:k0 + 3]
+    vr = scal[k0 + 3:k0 + 4] + scal[k0 + 4:k0 + 5] + scal[k0 + 5:k0 + 6]
+    val = jnp.where(went_right, vr, vk)
+    val_out_ref[:] = jnp.where(leaf >= 0, val, 0.0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret"))
+def route_apply_tiled(binsT: jax.Array, leaf_id: jax.Array,
+                      route_tab: jax.Array, values: jax.Array, *,
+                      block: int = 8192, interpret: bool = False):
+    """Pallas exit-route: same contract as ops/partition.py
+    apply_route_table(..., values=...) — returns ``(new_leaf,
+    row_value)`` — but streams only binsT + leaf ids and builds the
+    per-row table broadcast in VMEM."""
+    from .partition import extend_table_with_values
+
+    num_groups = binsT.shape[0]
+    if num_groups >= 65536:  # fg // 256 must stay bf16-exact
+        raise ValueError(
+            "route_apply_tiled supports at most 65535 feature groups, "
+            f"got {num_groups} — the route table encodes the group "
+            "index as two bf16-exact bytes (hi/lo)")
+    n = binsT.shape[1]
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    ncols = route_tab.shape[1]
+    routeT = _transpose_pad_route(extend_table_with_values(route_tab,
+                                                           values))
+
+    kern = functools.partial(_route_value_kernel_body,
+                             num_groups=num_groups,
+                             nb=ncols - ROUTE_FIXED_COLS)
+    leaf_out, val_out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec(routeT.shape, lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(binsT, leaf_id[None, :], routeT)
+    return leaf_out[0], val_out[0]
 
 
 def expand_feature_histograms(group_hist: jax.Array, bin_map: jax.Array,

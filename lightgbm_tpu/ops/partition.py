@@ -161,6 +161,21 @@ def _split3_bf16(v: jax.Array) -> list:
             lo.astype(jnp.float32)[:, None]]
 
 
+def extend_table_with_values(table: jax.Array,
+                             values: jax.Array) -> jax.Array:
+    """Append the exit-route leaf-VALUE columns to a route table: the
+    keep-slot and right-child values, each as three bf16-split columns
+    so the bf16 one-hot broadcast dot reassembles exact f32.  The ONE
+    definition shared by the XLA router (apply_route_table) and the
+    Pallas exit-route kernel (ops/histogram.py route_apply_tiled) —
+    both read columns [ncols, ncols+6) by this layout."""
+    rs_l = (table[:, 8].astype(jnp.int32) * 256
+            + table[:, 9].astype(jnp.int32))
+    v_right = values[jnp.clip(rs_l, 0, values.shape[0] - 1)]
+    return jnp.concatenate(
+        [table] + _split3_bf16(values) + _split3_bf16(v_right), axis=1)
+
+
 def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
                       table: jax.Array, values=None):
     """Re-label rows from a packed (L, 15+nb) route table (XLA form:
@@ -182,13 +197,7 @@ def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
     L = table.shape[0]
     ncols = table.shape[1]
     if values is not None:
-        rs_l = (table[:, 8].astype(jnp.int32) * 256
-                + table[:, 9].astype(jnp.int32))
-        v_keep = values
-        v_right = values[jnp.clip(rs_l, 0, values.shape[0] - 1)]
-        table = jnp.concatenate(
-            [table] + _split3_bf16(v_keep) + _split3_bf16(v_right),
-            axis=1)
+        table = extend_table_with_values(table, values)
     safe_l = jnp.clip(leaf_id, 0, L - 1)
     ohl = (safe_l[:, None]
            == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)

@@ -247,3 +247,50 @@ def test_fused_grower_wiring_interpret_matches_xla_path():
     assert np.abs(p_xla - p_fused).mean() < 0.02
     acc = ((p_fused > 0.5) == y).mean()
     assert acc > 0.9
+
+
+def test_route_apply_tiled_matches_xla_interpret():
+    """Pallas exit-route kernel (route_apply_tiled) == XLA
+    apply_route_table(values=...): leaf ids exactly AND the bf16-split
+    leaf-value columns reassemble the same f32 row values — pins the
+    column layout contract of extend_table_with_values on both sides."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import route_apply_tiled
+    from lightgbm_tpu.ops.partition import (MISSING_NAN, MISSING_NONE,
+                                            MISSING_ZERO,
+                                            apply_route_table,
+                                            build_route_table)
+
+    rng = np.random.RandomState(4)
+    N, G, B, L = 1024, 6, 16, 12
+    bins = rng.randint(0, B, (N, G)).astype(np.uint8)
+    leaf = rng.randint(-1, 6, N).astype(np.int32)
+    values = rng.randn(L).astype(np.float32) * 3
+
+    sm = np.zeros(L, bool)
+    sm[:4] = True
+    tab = build_route_table(
+        jnp.asarray(sm),
+        jnp.asarray(np.array([0, 2, 5, 3] + [0] * 8, np.int32)),
+        jnp.zeros(L, jnp.int32), jnp.full(L, B, jnp.int32),
+        jnp.zeros(L, jnp.int32), jnp.full(L, B - 1, jnp.int32),
+        jnp.asarray(np.array([0, 0, 0, 1] + [0] * 8, bool)),
+        jnp.asarray(np.array([7, 3, 11, 5] + [0] * 8, np.int32)),
+        jnp.asarray(np.array([1, 0, 1, 0] + [0] * 8, bool)),
+        jnp.asarray(np.array([MISSING_NONE, MISSING_ZERO, MISSING_NAN, 0]
+                             + [0] * 8, np.int32)),
+        jnp.asarray(np.array([0, 2, 0, 0] + [0] * 8, np.int32)),
+        jnp.full(L, B, jnp.int32),
+        jnp.asarray(rng.rand(L, B) > 0.5),
+        jnp.asarray(np.array([6, 7, 8, 9] + [0] * 8, np.int32)))
+
+    want_leaf, want_val = apply_route_table(
+        jnp.asarray(bins), jnp.asarray(leaf), tab,
+        values=jnp.asarray(values))
+    got_leaf, got_val = route_apply_tiled(
+        jnp.asarray(bins.T), jnp.asarray(leaf), tab,
+        jnp.asarray(values), block=256, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_leaf),
+                                  np.asarray(want_leaf))
+    np.testing.assert_array_equal(np.asarray(got_val),
+                                  np.asarray(want_val))

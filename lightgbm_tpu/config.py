@@ -325,6 +325,11 @@ class Config:
     hist_fused_route: bool = True   # apply pending split routing inside
     # the next round's histogram kernel (single chip, streamed one-hot)
     # instead of a separate XLA routing pass per round
+    hist_split_route: bool = False  # tiled path: run the pending split
+    # routing as its own Pallas pass (route_only_tiled) and keep every
+    # histogram pass route-free, instead of fusing the route into the
+    # first histogram pass — same deferred-route semantics, different
+    # kernel decomposition (perf A/B; see docs/ROOFLINE.md)
     hist_kernel_tiled: bool = True  # quantized path: tiled-iota in-VMEM
     # one-hot rebuild (no resident one-hot at all — HBM stream is just
     # the transposed packed bins).  Measured at the MXU floor

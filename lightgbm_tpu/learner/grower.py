@@ -408,6 +408,18 @@ class TreeGrower:
                           and self.frontier <= 3 * PACKED_STRIP
                           and (self.use_tiled or ohb_bytes <= budget)
                           and getattr(config, "hist_fused_route", True))
+        # split-route variant of the tiled fused path: routing runs as
+        # its own Pallas pass and every histogram pass is the plain
+        # (route-free) tiled kernel — same deferred-route semantics,
+        # different kernel decomposition (A/B knob; see ROOFLINE)
+        self.split_route = (self.use_tiled and self.use_fused
+                            and getattr(config, "hist_split_route",
+                                        False))
+        if getattr(config, "hist_split_route", False) \
+                and not self.split_route:
+            Log.warning("hist_split_route ignored: it needs the tiled "
+                        "fused path (quantized_grad on a single TPU "
+                        "device, frontier within the packed ladder)")
         self.use_quant_otf = (self.use_quant_otf and not self.use_fused
                               and not self.use_tiled)
         self.use_pre_ohb = (self.use_pallas and not self.pallas_paired
@@ -1023,7 +1035,17 @@ class TreeGrower:
         cfg = self.cfg_scalars
         cache = st.hist_cache
 
-        if self.use_fused:
+        if self.use_fused and self.split_route:
+            # split-route: apply the pending table in a dedicated
+            # Pallas pass, then histogram with the route-free kernel
+            from ..ops.histogram import route_only_tiled
+            new_leaf = route_only_tiled(
+                self.binsT, st.leaf_id, st.route_tab,
+                block=self.pallas_block_tiled, interpret=self._interp)
+            st = st._replace(leaf_id=new_leaf)
+            right_hist = self._hist_kernel_q_tiled(new_leaf, rights,
+                                                   quant)
+        elif self.use_fused:
             # the pending route (last round's splits) is applied INSIDE
             # the histogram kernel just before each row contributes
             right_hist, new_leaf = self._hist_kernel_fused(
@@ -1036,6 +1058,9 @@ class TreeGrower:
         safe_p = jnp.clip(parents, 0, L - 1)
         if self.use_hist_cache:
             left_hist = cache[safe_p] - right_hist
+        elif self.use_fused and self.split_route:
+            left_hist = self.policy.constrain_hist(
+                self._hist_kernel_q_tiled(st.leaf_id, parents, quant))
         elif self.use_fused:
             # no-cache mode: the parent slot now hosts the LEFT child's
             # rows (routing already applied; re-application is

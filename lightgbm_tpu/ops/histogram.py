@@ -1349,6 +1349,52 @@ def _route_value_kernel_body(binsT_ref, leafT_ref, routeT_ref,
     val_out_ref[:] = jnp.where(leaf >= 0, val, 0.0)
 
 
+def _route_only_kernel_body(binsT_ref, leafT_ref, routeT_ref,
+                            leaf_out_ref, *, num_groups, nb):
+    """Route-only kernel: the per-round split routing as its own
+    stream, leaving the histogram passes to the plain (route-free)
+    tiled kernel — the split-route alternative to fusing the route
+    into the histogram kernel's first pass."""
+    leaf_out_ref[:] = _route_prologue_T(
+        binsT_ref[:].astype(jnp.int32), leafT_ref[:], routeT_ref[:],
+        num_groups=num_groups, nb=nb)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def route_only_tiled(binsT: jax.Array, leaf_id: jax.Array,
+                     route_tab: jax.Array, *, block: int = 8192,
+                     interpret: bool = False) -> jax.Array:
+    """Apply a pending route table to leaf ids via the in-VMEM
+    broadcast (no histogram, no values).  Returns the (N,) post-route
+    leaf ids."""
+    num_groups = binsT.shape[0]
+    if num_groups >= 65536:  # fg // 256 must stay bf16-exact
+        raise ValueError(
+            "route_only_tiled supports at most 65535 feature groups, "
+            f"got {num_groups} — the route table encodes the group "
+            "index as two bf16-exact bytes (hi/lo)")
+    n = binsT.shape[1]
+    if n % block != 0:
+        raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    routeT = _transpose_pad_route(route_tab)
+    kern = functools.partial(
+        _route_only_kernel_body, num_groups=num_groups,
+        nb=route_tab.shape[1] - ROUTE_FIXED_COLS)
+    leaf_out = pl.pallas_call(
+        kern,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec(routeT.shape, lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        interpret=interpret,
+    )(binsT, leaf_id[None, :], routeT)
+    return leaf_out[0]
+
+
 @functools.partial(
     jax.jit, static_argnames=("block", "interpret"))
 def route_apply_tiled(binsT: jax.Array, leaf_id: jax.Array,

@@ -294,3 +294,33 @@ def test_route_apply_tiled_matches_xla_interpret():
                                   np.asarray(want_leaf))
     np.testing.assert_array_equal(np.asarray(got_val),
                                   np.asarray(want_val))
+
+
+def test_split_route_grows_identical_trees():
+    """hist_split_route=True (dedicated route_only_tiled pass + plain
+    tiled histograms) must grow byte-identical models to the default
+    fused decomposition — the A/B knob changes kernels, not
+    semantics."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(1536, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(1536)
+         > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+            "quantized_grad": True, "hist_compute_dtype": "bfloat16",
+            "force_pallas_interpret": True, "min_data_in_leaf": 5}
+    m0 = lgb.train(base, lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    m1 = lgb.train(dict(base, hist_split_route=True),
+                   lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    assert m0.model_to_string() == m1.model_to_string()
+
+    # no-cache mode (histogram_pool_size=0 drops subtraction and
+    # histograms BOTH children directly) exercises the split-route
+    # left-histogram branch too
+    nc0 = lgb.train(dict(base, histogram_pool_size=0.001),
+                    lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    nc1 = lgb.train(dict(base, histogram_pool_size=0.001,
+                         hist_split_route=True),
+                    lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    assert nc0.model_to_string() == nc1.model_to_string()

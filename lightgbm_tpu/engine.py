@@ -24,12 +24,36 @@ def train(params: Dict[str, Any], train_set: Dataset,
           fobj: Optional[Callable] = None,
           feval: Optional[Callable] = None,
           init_model: Optional[Union[str, "Booster"]] = None,
+          feature_name: Union[str, Sequence[str]] = "auto",
+          categorical_feature: Union[str, Sequence] = "auto",
           early_stopping_rounds: Optional[int] = None,
           evals_result: Optional[dict] = None,
           verbose_eval: Union[bool, int] = True,
+          learning_rates: Optional[Union[Sequence[float],
+                                         Callable]] = None,
+          keep_training_booster: bool = False,
           callbacks: Optional[Sequence[Callable]] = None) -> Booster:
-    """Train a gradient-boosted model (reference engine.py:18-229)."""
+    """Train a gradient-boosted model (reference engine.py:18-229;
+    parameter order follows the reference signature engine.py:18-24).
+
+    ``feature_name``/``categorical_feature`` apply to a still-lazy
+    train_set before construction (engine.py:122-123);
+    ``learning_rates`` (list or callable of the iteration index) is
+    sugar for a reset_parameter callback (engine.py:167-168);
+    ``keep_training_booster=False`` (the reference default,
+    engine.py:224-226) releases the training state after the final
+    flush — the returned booster predicts and serves as ``init_model``
+    for continued training, but update() on it errors."""
     params = dict(params or {})
+    if feature_name != "auto" and hasattr(train_set, "set_feature_name"):
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto" \
+            and hasattr(train_set, "set_categorical_feature"):
+        train_set.set_categorical_feature(categorical_feature)
+    if learning_rates is not None:
+        from .callback import reset_parameter
+        callbacks = list(callbacks or []) + [
+            reset_parameter(learning_rate=learning_rates)]
     if early_stopping_rounds is not None and not any(
             k in params for k in ("early_stopping_round",
                                   "early_stopping_rounds", "early_stopping")):
@@ -178,6 +202,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if booster.gbdt is not None and booster.gbdt.timer.acc:
         Log.debug("training phase timings: "
                   + booster.gbdt.timer.report())
+    if not keep_training_booster:
+        # reference engine.py:224-226: the default return is a
+        # predictor — training state (binned device matrix, padded
+        # score arrays) is released; prediction and use as init_model
+        # keep working
+        booster.free_dataset()
     return booster
 
 
@@ -281,7 +311,10 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         bst = train(params, dtrain, num_boost_round, valid_sets=[dtest],
                     valid_names=["valid"], fobj=fobj, feval=feval,
                     early_stopping_rounds=early_stopping_rounds,
-                    evals_result=er, verbose_eval=False)
+                    evals_result=er, verbose_eval=False,
+                    # the reference's cv never frees fold boosters —
+                    # a returned CVBooster stays trainable/evaluable
+                    keep_training_booster=True)
         boosters.append(bst)
         fold_evals.append(er.get("valid", {}))
 

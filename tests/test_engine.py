@@ -604,3 +604,45 @@ def test_unaligned_valid_sets_are_auto_referenced():
     p2 = np.clip(bst2.predict(Xv), 1e-7, 1 - 1e-7)
     ll2 = -np.mean(yv * np.log(p2) + (1 - yv) * np.log(1 - p2))
     assert abs(ll_av - ll2) < 5e-3, (ll_av, ll2)
+
+
+def test_train_kwargs_reference_tail():
+    """The four reference train() kwargs (engine.py:18-40):
+    learning_rates, keep_training_booster, feature_name,
+    categorical_feature."""
+    rng = np.random.RandomState(11)
+    X = rng.randn(600, 5)
+    X[:, 2] = rng.randint(0, 4, 600)  # categorical-ish column
+    y = (X[:, 0] + (X[:, 2] == 1) > 0.3).astype(float)
+
+    # feature_name + categorical_feature applied pre-construct
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, ds, 5,
+                    feature_name=[f"col{i}" for i in range(5)],
+                    categorical_feature=["col2"])
+    dumped = bst.dump_model()
+    assert dumped["feature_names"] == [f"col{i}" for i in range(5)]
+    assert any(t for t in dumped["tree_info"])
+
+    # learning_rates: callable decay == explicit reset_parameter list
+    lrs = [0.1 * (0.5 ** i) for i in range(6)]
+    ds2 = lgb.Dataset(X, label=y, free_raw_data=False)
+    a = lgb.train({"objective": "binary", "verbose": -1}, ds2, 6,
+                  learning_rates=lambda it: 0.1 * (0.5 ** it))
+    ds3 = lgb.Dataset(X, label=y, free_raw_data=False)
+    b = lgb.train({"objective": "binary", "verbose": -1}, ds3, 6,
+                  callbacks=[lgb.reset_parameter(learning_rate=lrs)])
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-6)
+
+    # keep_training_booster: default False releases training state
+    # (update() errors, predict works); True keeps it trainable
+    ds4 = lgb.Dataset(X, label=y, free_raw_data=False)
+    frozen = lgb.train({"objective": "binary", "verbose": -1}, ds4, 3)
+    assert frozen.predict(X).shape == (600,)
+    with pytest.raises(Exception):
+        frozen.update()
+    ds5 = lgb.Dataset(X, label=y, free_raw_data=False)
+    live = lgb.train({"objective": "binary", "verbose": -1}, ds5, 3,
+                     keep_training_booster=True)
+    live.update()
+    assert live.num_trees() == 4

@@ -55,8 +55,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .carrier import CARRIER_ROWS, TILE, carrier_row_map
-from .partition import MISSING_NAN, MISSING_ZERO
+from carrier import CARRIER_ROWS, TILE, carrier_row_map
+from lightgbm_tpu.ops.partition import MISSING_NAN, MISSING_ZERO
 
 BT = 16            # tiles per block (block = 2048 columns)
 STAGE = 8          # tiles per staging buffer flush

@@ -1,6 +1,8 @@
 """Standalone validation + timing of the leaf-partition kernel.
 
-Drives ops/partition_kernel.py on synthetic data through two rounds
+Drives scripts/partition_kernel.py (the round-4 rejected leaf-partition
+prototype, quarantined here with its carrier layout — see
+docs/PARTITION_DESIGN.md for the full record) on synthetic data through two rounds
 (root split, then both children) and checks every carried byte against
 a numpy simulation; then times a full-N round at 1M columns.
 
@@ -12,16 +14,17 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from lightgbm_tpu.ops.carrier import (CARRIER_ROWS, TILE,
+from carrier import (CARRIER_ROWS, TILE,
                                       assemble_carrier, carrier_row_map,
                                       rows_to_f32, rows_to_i32,
                                       rows_to_leaf)
-from lightgbm_tpu.ops.partition_kernel import (BT, NCOLS_TAB,
+from partition_kernel import (BT, NCOLS_TAB,
                                                allocate_children,
                                                build_step_table,
                                                partition_round)
@@ -205,7 +208,7 @@ def timing(n=1_000_000):
     tab = build_step_table(jnp.asarray([0]), jnp.asarray([tiles]),
                            route_cols, a_use, e_use,
                            jnp.ones(1, bool), cap)
-    from lightgbm_tpu.ops.partition_kernel import partition_round as pr
+    from partition_kernel import partition_round as pr
     pr_nojit = pr.__wrapped__   # un-jitted: called inside our own jit
 
     import time as _t

@@ -388,6 +388,58 @@ def run_local_reference(X, y, Xv, yv, params, iters,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_higgs_real(params):
+    """Real-HIGGS anchor (round-4 verdict #6): when the UCI HIGGS
+    dataset is available — BENCH_HIGGS_PATH pointing at HIGGS.csv[.gz],
+    or BENCH_HIGGS=1 to attempt the UCI download — train the bench
+    config on the true data and report held-out AUC against the
+    reference's published 0.845 (docs/Experiments.rst:125-129, last
+    500k rows held out per the experiment's convention).  Returns the
+    scale dict, or None with a stderr note when the data cannot be
+    obtained (this image has zero egress, so the download attempt
+    documents the impossibility rather than working around it)."""
+    import gzip
+
+    path = os.environ.get("BENCH_HIGGS_PATH")
+    if not path and os.environ.get("BENCH_HIGGS") == "1":
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".data", "HIGGS.csv.gz")
+        if not os.path.exists(path):
+            url = ("https://archive.ics.uci.edu/ml/machine-learning-"
+                   "databases/00280/HIGGS.csv.gz")
+            try:
+                import urllib.request
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                urllib.request.urlretrieve(url, path + ".part")
+                os.replace(path + ".part", path)
+            except Exception as e:
+                print(f"real-HIGGS download failed ({type(e).__name__}:"
+                      f" {e}) — this environment has no egress; "
+                      "synthetic-only caveat stands (BASELINE.md)",
+                      file=sys.stderr)
+                return None
+    if not path or not os.path.exists(path):
+        return None
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        arr = np.loadtxt(f, delimiter=",", dtype=np.float32)
+    y, X = arr[:, 0], arr[:, 1:]
+    Xt, yt = X[-500_000:], y[-500_000:]
+    X, y = X[:-500_000], y[:-500_000]
+    import lightgbm_tpu as lgb
+    (gbdt, cfg, dtrain, prep_s, compile_s, per_tree,
+     cold_total_s) = train_timed(params, X, y,
+                                 int(os.environ.get("BENCH_HIGGS_ITERS",
+                                                    100)))
+    vcore = lgb.Dataset(Xt, label=yt, reference=dtrain).construct(cfg)
+    auc = auc_score(yt, heldout_scores(gbdt, cfg, vcore.group_bins))
+    return {"rows": int(X.shape[0]), "task": "higgs_real",
+            "auc": round(auc, 6), "auc_published_ref": 0.845154,
+            "per_tree_ms": round(per_tree * 1e3, 2),
+            "prep_s": round(prep_s, 3)}
+
+
 def run_scale(rows, iters, params, check_f32, local_ref=False,
               ref_iters=None):
     """Train + evaluate one scale point; returns its metrics dict."""
@@ -499,6 +551,9 @@ def main():
             ref_iters=int(os.environ.get("BENCH_REF_ITERS_BIG", 10))))
     if os.environ.get("BENCH_LTR", "1") != "0":
         scales.append(run_ltr_scale())
+    higgs = run_higgs_real(params)
+    if higgs is not None:
+        scales.append(higgs)
 
     result = {
         "metric": f"higgs_synth_{BENCH_ROWS//1000}k_{BENCH_ITERS}trees_s",

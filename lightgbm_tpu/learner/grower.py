@@ -144,6 +144,18 @@ class GrowerState(NamedTuple):
     # lazily inside the next round's histogram kernel; all-zero = no-op)
 
 
+def _get_shard_map():
+    """Version shim for the shard_map API (jax>=0.8 moved it out of
+    experimental and renamed check_rep -> check_vma) — ONE definition
+    for every learner path."""
+    try:
+        from jax import shard_map as _sm
+        return functools.partial(_sm, check_vma=False)
+    except ImportError:          # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as _sm
+        return functools.partial(_sm, check_rep=False)
+
+
 def _encode_leaf(leaf_slot):
     """LightGBM child encoding: ~leaf (negative) marks a leaf index."""
     return -(leaf_slot + 1)
@@ -285,7 +297,7 @@ class TreeGrower:
                 bins_np = np.concatenate(
                     [bins_np,
                      np.zeros((pad, bins_np.shape[1]), dtype=np.uint8)])
-            self.bins = self.policy.place_rows(bins_np)
+            self.bins = self.policy.place_bins(bins_np)
             self._row_valid = self.policy.place_rows(
                 np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
         # the Pallas kernel path: single TPU device only (its sequential
@@ -455,6 +467,19 @@ class TreeGrower:
                     pack=self.ohb_pack)
         self._is_voting = (self.policy.mesh is not None
                            and config.tree_learner == "voting")
+        # feature-parallel shard_map path: vertical partition with a
+        # SplitInfo-only election — needs the group count to divide
+        # the mesh (otherwise the constraint-sharded fallback runs,
+        # which exchanges histograms)
+        # bins_spec presence means the policy actually took the
+        # feature (vertical-partition) branch — a 'data'-axis mesh
+        # with tree_learner=feature must NOT run the shard_map
+        # election against row-sharded inputs
+        self._is_feature_par = (
+            self.policy.mesh is not None
+            and config.tree_learner == "feature"
+            and getattr(self.policy, "bins_spec", None) is not None
+            and self.num_groups % self.policy.mesh.size == 0)
         self._train_tree = jax.jit(self._train_tree_impl)
 
     # ------------------------------------------------------------------
@@ -641,12 +666,7 @@ class TreeGrower:
         produced involuntary full rematerializations (round-3 verdict
         weak#2) — row-scale all-gathers inside the while body."""
         from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map as _sm
-            shard_map = functools.partial(_sm, check_vma=False)
-        except ImportError:          # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map as _sm
-            shard_map = functools.partial(_sm, check_rep=False)
+        shard_map = _get_shard_map()
 
         mesh = self.policy.mesh
         axis = self.policy.row_spec[0]
@@ -952,6 +972,10 @@ class TreeGrower:
             def body_fn(st):
                 return self._round_voting(st, grad, hess, counts,
                                           feature_mask)
+        elif self._is_feature_par:
+            def body_fn(st):
+                return self._round_feature(st, grad, hess, counts,
+                                           feature_mask)
         else:
             # gradients are fixed for the whole tree, so the int8
             # quantization (one scale per channel) happens once here
@@ -1382,12 +1406,7 @@ class TreeGrower:
         growth while keeping the same communication scale."""
         from functools import partial
         from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map as _sm
-            shard_map = functools.partial(_sm, check_vma=False)
-        except ImportError:          # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map as _sm
-            shard_map = functools.partial(_sm, check_rep=False)
+        shard_map = _get_shard_map()
 
         cfg = self.cfg_scalars
         L = self.num_leaves
@@ -1445,6 +1464,125 @@ class TreeGrower:
             self.f_missing[sel], self.f_default_bin[sel],
             self.f_monotone[sel], self.f_is_cat[sel], feature_mask[sel])
         return res, gains, hist, sel
+
+    # ------------------------------------------------------------------
+    def _feature_find_splits(self, st: GrowerState, grad, hess, counts,
+                             feature_mask):
+        """Feature-parallel split search (reference
+        feature_parallel_tree_learner.cpp): the bin matrix is COLUMN-
+        sharded over the mesh (the vertical partition), each shard
+        histograms and searches ONLY its own feature groups, and the
+        only cross-shard traffic is the per-leaf SplitInfo election
+        (SyncUpGlobalBestSplit, parallel_tree_learner.h:184-207) —
+        per-leaf scalars plus the winner's categorical bitset, never
+        histograms.  Requires num_groups divisible by the mesh size
+        (the grower falls back to the constraint-sharded path
+        otherwise)."""
+        from functools import partial
+        shard_map = _get_shard_map()
+        from jax.sharding import PartitionSpec as P
+
+        cfg = self.cfg_scalars
+        L = self.num_leaves
+        mesh = self.policy.mesh
+        d = mesh.size
+        axis = mesh.axis_names[0]
+        g_per = self.num_groups // d
+        B = self.max_group_bin
+        Bf = self.max_feature_bin
+        rep = P()
+        nout = 9      # payload members; +1 for the global best gain
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(None, axis), rep, rep, rep, rep, rep,
+                           rep, rep),
+                 out_specs=tuple([rep] * (nout + 1)))
+        def inner(bins_l, g, h, c, leaf_id, mask, min_c, max_c):
+            sid = jax.lax.axis_index(axis)
+            local_hist = compute_group_histograms(
+                bins_l, g, h, c, leaf_id, num_leaves=L,
+                max_group_bin=B,
+                compute_dtype=self.config.hist_compute_dtype,
+                chunk=bins_l.shape[0])                # (L, g_per, B, 3)
+            totals = compute_leaf_totals(g, h, c, leaf_id, L)
+            owned = (self.f_group // g_per) == sid    # (F,)
+            bm = jnp.where(owned[:, None] & (self.bin_map >= 0),
+                           self.bin_map - sid * g_per * B, -1)
+            feat_hist = expand_feature_histograms(
+                local_hist, bm, jnp.where(owned, self.fix_bin, -1),
+                totals)
+            res, gains = self._run_finders(
+                feat_hist, totals[:, 0], totals[:, 1], totals[:, 2],
+                min_c, max_c, cfg, self.f_num_bin, self.f_missing,
+                self.f_default_bin, self.f_monotone, self.f_is_cat,
+                mask)
+            gains = jnp.where(owned[None, :], gains, NEG_INF)
+            bf = jnp.argmax(gains, axis=1).astype(jnp.int32)  # (L,)
+            bg = jnp.take_along_axis(gains, bf[:, None], axis=1)[:, 0]
+
+            def al(a):
+                return jnp.take_along_axis(a, bf[:, None], axis=1)[:, 0]
+
+            if self.has_categorical:
+                hist_chosen = jnp.take_along_axis(
+                    feat_hist, bf[:, None, None, None], axis=1)[:, 0]
+                cat_mask_l = build_cat_bitset(
+                    hist_chosen, al(res.threshold), al(res.cat_dir),
+                    self.f_num_bin[bf], self.f_missing[bf], cfg)
+            else:
+                cat_mask_l = jnp.zeros((L, Bf), bool)
+
+            # SplitInfo election: all-gather per-leaf scalars only
+            allg = jax.lax.all_gather(bg, axis)       # (d, L)
+            best_shard = jnp.argmax(allg, axis=0)     # (L,)
+            oh = (jnp.arange(d, dtype=jnp.int32)[:, None]
+                  == best_shard[None, :])             # (d, L)
+
+            def pick(p):
+                pg = jax.lax.all_gather(p, axis)      # (d, L, ...)
+                w = oh.reshape(oh.shape + (1,) * (pg.ndim - 2))
+                return jnp.sum(jnp.where(w, pg, 0), axis=0)
+
+            payload = (bf.astype(jnp.float32), al(res.threshold),
+                       al(res.default_left).astype(jnp.float32),
+                       al(res.left_sum_grad), al(res.left_sum_hess),
+                       al(res.left_count), al(res.left_output),
+                       al(res.right_output),
+                       cat_mask_l.astype(jnp.float32))
+            out = tuple(pick(p) for p in payload)
+            return out + (jnp.max(allg, axis=0),)
+
+        (bf_f, thr, dleft, lsg, lsh, lsc, lout, rout, cat_f,
+         best_gain) = inner(self.bins, grad, hess, counts, st.leaf_id,
+                            feature_mask, st.leaf_min_c, st.leaf_max_c)
+        return (best_gain, bf_f.astype(jnp.int32), thr,
+                dleft > 0.5, lsg, lsh, lsc, lout, rout, cat_f > 0.5)
+
+    def _round_feature(self, st: GrowerState, grad, hess, counts,
+                       feature_mask) -> GrowerState:
+        """Full-frontier round for the feature-parallel learner —
+        identical split selection to serial (exact global election),
+        with only SplitInfo-scale collectives."""
+        L = self.num_leaves
+
+        (best_gain, best_f, thr, dleft, lsg, lsh, lsc, lout, rout,
+         cat_mask) = self._feature_find_splits(st, grad, hess, counts,
+                                               feature_mask)
+
+        slot = jnp.arange(L, dtype=jnp.int32)
+        active = slot < st.num_leaves
+        depth_ok = (self.max_depth <= 0) | \
+            (st.tree.leaf_depth < self.max_depth)
+        cand_m = active & depth_ok & (best_gain > 0.0)
+        key = jnp.where(cand_m, best_gain, NEG_INF)
+        order = jnp.argsort(-key)
+        rank = jnp.argsort(order).astype(jnp.int32)
+        budget = L - st.num_leaves
+        do_split = cand_m & (rank < budget)
+        k = do_split.sum().astype(jnp.int32)
+        return self._apply_selection(
+            st, do_split, rank, k, best_gain, best_f, thr, dleft,
+            lsg, lsh, lsc, lout, rout, cat_mask)
 
     # ------------------------------------------------------------------
     def _round_voting(self, st: GrowerState, grad, hess, counts,

@@ -1481,24 +1481,25 @@ def leaf_value_broadcast(leaf_id: jax.Array, values: jax.Array) -> jax.Array:
     """Per-row lookup ``values[leaf_id]`` without a gather.
 
     Arbitrary-index gathers are slow on TPU; a leaf one-hot matmul hits
-    the MXU instead.  Exactness: ``values`` is split into THREE bf16
-    terms (hi = bf16 rounding, then two bf16 roundings of the
-    residuals), covering 3x8 mantissa bits — the residual error is
-    ~2^-24 relative, i.e. f32-ulp level.  The one-hot picks exactly one
-    leaf per row so the f32-accumulated sum has no cross-term error.
-    Rows with negative leaf_id get 0.0.
+    the MXU instead.  Exactness: ``values`` is split into THREE
+    bf16-exact terms via ops/partition.py _split3_bf16 (bitmask
+    truncation — NOT dtype round-trips, which XLA's excess-precision
+    simplification cancels inside jit, silently zeroing the residual
+    terms; see _split3_bf16), covering 3x~8 mantissa bits — residual
+    ~2^-21 relative.  The one-hot picks exactly one leaf per row so
+    the f32-accumulated sum has no cross-term error.  Rows with
+    negative leaf_id get 0.0.
 
     Args: leaf_id (N,) int32; values (L,) f32.  Returns (N,) f32.
     """
+    from .partition import _split3_bf16
+
     L = values.shape[0]
     oh = (leaf_id[:, None]
           == jnp.arange(L, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
-    hi = values.astype(jnp.bfloat16)
-    r1 = values - hi.astype(jnp.float32)
-    mid = r1.astype(jnp.bfloat16)
-    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
-    rhs = jnp.stack([hi, mid, lo], axis=1)                # (L, 3)
-    out = jnp.dot(oh, rhs, preferred_element_type=jnp.float32)
+    rhs = jnp.concatenate(_split3_bf16(values), axis=1)   # (L, 3)
+    out = jnp.dot(oh, rhs.astype(jnp.bfloat16),
+                  preferred_element_type=jnp.float32)
     return out[:, 0] + out[:, 1] + out[:, 2]
 
 

@@ -150,15 +150,29 @@ def route_rows(rows, leaf_id, gb, with_decision=False):
 
 
 def _split3_bf16(v: jax.Array) -> list:
-    """f32 (L,) -> three bf16-exact f32 columns summing to v at ~f32
-    precision (the leaf_value_broadcast trick, ops/histogram.py)."""
-    hi = v.astype(jnp.bfloat16)
-    r1 = v - hi.astype(jnp.float32)
-    mid = r1.astype(jnp.bfloat16)
-    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
-    return [hi.astype(jnp.float32)[:, None],
-            mid.astype(jnp.float32)[:, None],
-            lo.astype(jnp.float32)[:, None]]
+    """f32 (L,) -> three bf16-exact f32 columns summing to v within
+    ~2^-21 relative (the leaf_value_broadcast trick, ops/histogram.py).
+
+    Built with BITMASK truncation, NOT f32->bf16->f32 dtype
+    round-trips: this runtime compiles with
+    ``--xla_allow_excess_precision``, under which XLA cancels the
+    convert pairs inside jit and the mid/lo columns silently become
+    zero — measured as exit-route row values collapsing to bf16
+    (0.015 absolute on unit-scale leaf values).  Masking the low 16
+    mantissa bits produces the same bf16-exact components through
+    arithmetic the simplifier must preserve."""
+    mask = jnp.uint32(0xFFFF0000)
+
+    def trunc(x):
+        b = jax.lax.bitcast_convert_type(x.astype(jnp.float32),
+                                         jnp.uint32)
+        return jax.lax.bitcast_convert_type(b & mask, jnp.float32)
+
+    hi = trunc(v)
+    r1 = v - hi
+    mid = trunc(r1)
+    lo = trunc(r1 - mid)
+    return [hi[:, None], mid[:, None], lo[:, None]]
 
 
 def extend_table_with_values(table: jax.Array,

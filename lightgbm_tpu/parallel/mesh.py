@@ -96,11 +96,33 @@ class ShardingPolicy:
             f_axis = FEATURE_AXIS if FEATURE_AXIS in axes else axes[0]
             self.row_spec = None                    # rows replicated
             self.hist_spec = P(None, f_axis, None, None)
+            # vertical partition (the reference's feature-parallel data
+            # layout, feature_parallel_tree_learner.cpp): each device
+            # owns its feature-group COLUMNS of the bin matrix, so the
+            # histogram contraction is local per shard and only the
+            # SplitInfo election + the owner's per-row routing decision
+            # cross the network — without this, the SPMD partitioner
+            # splits the replicated-bins contraction over rows and
+            # all-reduces FULL histograms (caught by the
+            # __graft_entry__ collective gate)
+            self.bins_spec = P(None, f_axis)
         else:
             self.row_spec = None
             self.hist_spec = None
 
     # ------------------------------------------------------------------
+    def place_bins(self, arr):
+        """Place the (N, G) bin matrix: column-sharded for the
+        feature-parallel learner (vertical partition) when the group
+        count divides the mesh — the shard_map SplitInfo-election path
+        needs even shards; uneven group counts fall back to the row
+        placement (replicated bins, constraint-sharded histograms)."""
+        spec = getattr(self, "bins_spec", None)
+        if self.mesh is not None and spec is not None \
+                and arr.shape[1] % self.mesh.size == 0:
+            return jax.device_put(arr, NamedSharding(self.mesh, spec))
+        return self.place_rows(arr)
+
     def place_rows(self, arr):
         """Place a row-indexed array ((N,) or (N, G)).  Multi-host: the
         array is the ASSEMBLED global view (host h's rows at

@@ -99,3 +99,100 @@ def test_onchip_fused_route_hist(case):
     np.testing.assert_array_equal(np.asarray(got_leaf),
                                   np.asarray(want_leaf))
     assert _close(want[:42], got_hist)
+
+
+def test_onchip_q_tiled_kernel(case):
+    """Tiled-iota kernel (the r4+ DEFAULT quantized path,
+    learner/grower.py _hist_kernel_q_tiled): int32 accumulation is
+    exact, so it must match the int8-quantized reference exactly."""
+    from lightgbm_tpu.ops.histogram import compute_group_histograms_q_tiled
+    bins, grad, hess, cnt, leaf, ref, (N, G, B, L) = case
+    wq, scales = quantize_gradients(grad, hess, cnt)
+    slots = jnp.arange(31, dtype=jnp.int32)
+    want = compute_group_histograms_q_packed(
+        bins, wq, scales, leaf, slots, max_group_bin=B, block=1024)
+    for block in (2048, 8192):
+        got = compute_group_histograms_q_tiled(
+            jnp.asarray(np.asarray(bins).T), wq.T, scales, leaf, slots,
+            max_group_bin=B, block=block, strips=1)
+        np.testing.assert_array_equal(np.asarray(want),
+                                      np.asarray(got), err_msg=str(block))
+    # quantized-vs-f32 tolerance against the float reference
+    assert _close(ref, got[:31], tol=2e-2)
+
+
+def test_onchip_fused_tiled_kernel(case):
+    """Fused route + tiled-iota kernel — the kernel the DEFAULT
+    training path actually executes every round (grower run():
+    use_tiled branch).  Routing bit-identical to the XLA router;
+    histogram identical to the non-fused tiled kernel after routing."""
+    from lightgbm_tpu.ops.histogram import (
+        compute_group_histograms_fused_tiled,
+        compute_group_histograms_q_tiled)
+    bins, grad, hess, cnt, leaf, ref, (N, G, B, L) = case
+    rng = np.random.RandomState(1)
+    sm = np.zeros(L, bool)
+    sm[:6] = True
+    tab = build_route_table(
+        jnp.asarray(sm),
+        jnp.asarray(rng.randint(0, G, L).astype(np.int32)),
+        jnp.zeros(L, jnp.int32), jnp.full(L, B, jnp.int32),
+        jnp.zeros(L, jnp.int32), jnp.full(L, B - 1, jnp.int32),
+        jnp.asarray(np.array([0, 1] * 15 + [0], bool)),
+        jnp.asarray(rng.randint(0, B, L).astype(np.int32)),
+        jnp.asarray(rng.rand(L) > 0.5),
+        jnp.asarray(rng.randint(0, 3, L).astype(np.int32)),
+        jnp.asarray(rng.randint(0, 4, L).astype(np.int32)),
+        jnp.full(L, B, jnp.int32),
+        jnp.asarray(rng.rand(L, B) > 0.5),
+        jnp.asarray((np.arange(L) + 40).astype(np.int32)))
+    want_leaf = apply_route_table(bins, leaf, tab)
+    wq, scales = quantize_gradients(grad, hess, cnt)
+    slots = jnp.arange(42, dtype=jnp.int32)
+    want = compute_group_histograms_q_tiled(
+        jnp.asarray(np.asarray(bins).T), wq.T, scales, want_leaf, slots,
+        max_group_bin=B, block=2048, strips=1)
+    for strips in (1, 2):
+        s = jnp.arange(42 * strips, dtype=jnp.int32)
+        got_hist, got_leaf = compute_group_histograms_fused_tiled(
+            jnp.asarray(np.asarray(bins).T), wq.T, scales, leaf, tab, s,
+            max_group_bin=B, block=2048, strips=strips)
+        np.testing.assert_array_equal(np.asarray(got_leaf),
+                                      np.asarray(want_leaf),
+                                      err_msg=str(strips))
+        np.testing.assert_array_equal(np.asarray(want),
+                                      np.asarray(got_hist)[:42],
+                                      err_msg=str(strips))
+
+
+def test_onchip_route_apply_tiled(case):
+    """Pallas exit-route kernel (the r5 DEFAULT tree-exit path):
+    (new_leaf, row_value) bit-identical to the XLA apply_route_table
+    on chip."""
+    from lightgbm_tpu.ops.histogram import route_apply_tiled
+    bins, grad, hess, cnt, leaf, ref, (N, G, B, L) = case
+    rng = np.random.RandomState(2)
+    sm = np.zeros(L, bool)
+    sm[:8] = True
+    tab = build_route_table(
+        jnp.asarray(sm),
+        jnp.asarray(rng.randint(0, G, L).astype(np.int32)),
+        jnp.zeros(L, jnp.int32), jnp.full(L, B, jnp.int32),
+        jnp.zeros(L, jnp.int32), jnp.full(L, B - 1, jnp.int32),
+        jnp.asarray(np.array([0, 1] * 15 + [1], bool)),
+        jnp.asarray(rng.randint(0, B, L).astype(np.int32)),
+        jnp.asarray(rng.rand(L) > 0.5),
+        jnp.asarray(rng.randint(0, 3, L).astype(np.int32)),
+        jnp.asarray(rng.randint(0, 4, L).astype(np.int32)),
+        jnp.full(L, B, jnp.int32),
+        jnp.asarray(rng.rand(L, B) > 0.5),
+        jnp.asarray((np.arange(L) + 40).astype(np.int32)))
+    values = jnp.asarray(rng.randn(L).astype(np.float32) * 2)
+    want_leaf, want_val = apply_route_table(bins, leaf, tab,
+                                            values=values)
+    got_leaf, got_val = route_apply_tiled(
+        jnp.asarray(np.asarray(bins).T), leaf, tab, values, block=2048)
+    np.testing.assert_array_equal(np.asarray(got_leaf),
+                                  np.asarray(want_leaf))
+    np.testing.assert_array_equal(np.asarray(got_val),
+                                  np.asarray(want_val))

@@ -1,0 +1,112 @@
+# Training callbacks (reference R-package/R/callback.R, 432 LoC):
+# each callback is a closure receiving the per-iteration environment
+# env with fields model, params, iteration, begin_iteration,
+# end_iteration, eval_list (list of list(data_name, name, value,
+# higher_better)), and met_early_stop (settable).  Callbacks carrying
+# attr "is_pre_iteration" run before the boosting update.
+
+.cb_env <- function(model, params, iteration, begin_iteration,
+                    end_iteration, eval_list) {
+  env <- new.env(parent = emptyenv())
+  env$model <- model
+  env$params <- params
+  env$iteration <- iteration
+  env$begin_iteration <- begin_iteration
+  env$end_iteration <- end_iteration
+  env$eval_list <- eval_list
+  env$met_early_stop <- FALSE
+  env
+}
+
+# Print the evaluation results every `period` iterations (reference
+# cb.print.evaluation).
+cb.print.evaluation <- function(period = 1L) {
+  callback <- function(env) {
+    if (period <= 0L || length(env$eval_list) == 0L) return(invisible())
+    i <- env$iteration
+    if (i %% period != 0L && i != env$begin_iteration
+        && i != env$end_iteration) {
+      return(invisible())
+    }
+    msg <- paste(vapply(env$eval_list, function(ev)
+      sprintf("%s's %s:%g", ev$data_name, ev$name, ev$value),
+      character(1L)), collapse = "  ")
+    cat(sprintf("[%d]  %s\n", i, msg))
+    invisible()
+  }
+  attr(callback, "name") <- "cb.print.evaluation"
+  callback
+}
+
+# Record every evaluation into `acc` (an environment the caller keeps;
+# reference cb.record.evaluation records into env$model$record_evals).
+cb.record.evaluation <- function(acc) {
+  stopifnot(is.environment(acc))
+  callback <- function(env) {
+    for (ev in env$eval_list) {
+      key <- paste(ev$data_name, ev$name, sep = ".")
+      # env [[ ]] errors on a missing binding (unlike lists) — read
+      # through get0 so the first iteration starts the vector
+      acc[[key]] <- c(get0(key, envir = acc, inherits = FALSE),
+                      ev$value)
+    }
+    invisible()
+  }
+  attr(callback, "name") <- "cb.record.evaluation"
+  callback
+}
+
+# Reset booster parameters on a schedule: each element of new_params is
+# either a vector (one value per iteration) or function(iteration,
+# total) (reference cb.reset.parameters).  Runs PRE-iteration.
+cb.reset.parameters <- function(new_params) {
+  stopifnot(is.list(new_params), length(names(new_params)) > 0L)
+  callback <- function(env) {
+    i <- env$iteration - env$begin_iteration + 1L
+    total <- env$end_iteration - env$begin_iteration + 1L
+    p <- lapply(new_params, function(v) {
+      if (is.function(v)) v(i, total) else v[[min(i, length(v))]]
+    })
+    .Call("LGBM_R_BoosterResetParameter", env$model$handle,
+          .params_str(p))
+    invisible()
+  }
+  attr(callback, "name") <- "cb.reset.parameters"
+  attr(callback, "is_pre_iteration") <- TRUE
+  callback
+}
+
+# Early stopping on the FIRST metric of the first validation set
+# (reference cb.early.stop; lgb.train's early_stopping_rounds argument
+# builds this callback).
+cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  best_score <- NULL
+  best_iter <- -1L
+  wait <- 0L
+  callback <- function(env) {
+    if (length(env$eval_list) == 0L) return(invisible())
+    ev <- env$eval_list[[1L]]
+    improved <- is.null(best_score) ||
+      (if (ev$higher_better) ev$value > best_score
+       else ev$value < best_score)
+    if (improved) {
+      best_score <<- ev$value
+      best_iter <<- env$iteration
+      wait <<- 0L
+    } else {
+      wait <<- wait + 1L
+      if (wait >= stopping_rounds) {
+        if (verbose) {
+          cat(sprintf("Early stopping, best iteration is [%d] %g\n",
+                      best_iter, best_score))
+        }
+        env$met_early_stop <- TRUE
+        env$best_iter <- best_iter
+        env$best_score <- best_score
+      }
+    }
+    invisible()
+  }
+  attr(callback, "name") <- "cb.early.stop"
+  callback
+}

@@ -1,0 +1,189 @@
+# lgb.Dataset generics — the construction / introspection / slicing
+# surface of the reference's R-package/R/lgb.Dataset.R (1093 LoC of R6
+# there; environment-backed S3 here), over the .Call shim
+# (src/lightgbm_R.cpp) into liblgbm_tpu.so.  The C entry points this
+# file drives are executed in CI by tests/r_host_driver.c.
+#
+# An lgb.Dataset is a mutable environment: `raw` (matrix or filename)
+# plus `info` fields until construction, then `handle` (EXTPTRSXP).
+# The reference's R6 Dataset has the same lazy lifecycle
+# (lgb.Dataset.R $construct).
+
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, params = list(),
+                        reference = NULL, colnames = NULL,
+                        categorical_feature = NULL,
+                        free_raw_data = TRUE) {
+  env <- new.env(parent = emptyenv())
+  env$raw <- data
+  env$params <- params
+  env$reference <- reference
+  env$info <- list(label = label, weight = weight, group = group,
+                   init_score = init_score)
+  env$colnames <- colnames
+  env$categorical_feature <- categorical_feature
+  env$free_raw_data <- isTRUE(free_raw_data)
+  env$handle <- NULL
+  structure(list(env = env), class = "lgb.Dataset")
+}
+
+# Construct (bin) the dataset if not yet constructed; returns the
+# dataset invisibly (reference lgb.Dataset.construct).
+lgb.Dataset.construct <- function(dataset) {
+  stopifnot(inherits(dataset, "lgb.Dataset"))
+  e <- dataset$env
+  if (!is.null(e$handle)) return(invisible(dataset))
+  params <- e$params
+  if (!is.null(e$categorical_feature)) {
+    params$categorical_feature <-
+      paste(e$categorical_feature, collapse = ",")
+  }
+  pstr <- .params_str(params)
+  ref_h <- NULL
+  if (!is.null(e$reference)) {
+    lgb.Dataset.construct(e$reference)
+    ref_h <- e$reference$env$handle
+  }
+  if (is.character(e$raw)) {
+    e$handle <- .Call("LGBM_R_DatasetCreateFromFile", e$raw, pstr,
+                      ref_h)
+  } else {
+    m <- e$raw
+    storage.mode(m) <- "double"
+    e$handle <- .Call("LGBM_R_DatasetCreateFromMat", m, nrow(m),
+                      ncol(m), pstr, ref_h)
+  }
+  for (field in names(e$info)) {
+    v <- e$info[[field]]
+    if (!is.null(v)) {
+      .Call("LGBM_R_DatasetSetField", e$handle, field, as.double(v))
+    }
+  }
+  if (!is.null(e$colnames)) {
+    .Call("LGBM_R_DatasetSetFeatureNames", e$handle,
+          paste(e$colnames, collapse = "\t"))
+  }
+  if (e$free_raw_data && !is.character(e$raw)) e$raw <- NULL
+  invisible(dataset)
+}
+
+# Validation set binned with the training set's mappers (reference
+# lgb.Dataset.create.valid).
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL,
+                                     params = list(), ...) {
+  stopifnot(inherits(dataset, "lgb.Dataset"))
+  lgb.Dataset(data, label = label, params = params,
+              reference = dataset, ...)
+}
+
+# Persist the binned representation (reference
+# lgb.Dataset.save.binary over LGBM_DatasetSaveBinary); the file
+# reloads through lgb.Dataset(filename).
+lgb.Dataset.save.binary <- function(dataset, fname) {
+  lgb.Dataset.construct(dataset)
+  .Call("LGBM_R_DatasetSaveBinary", dataset$env$handle, fname)
+  invisible(dataset)
+}
+
+# Mark categorical features; only before construction (the reference
+# resets an already-constructed handle — here that would silently
+# rebin, so it errors the way R6 active bindings do).
+lgb.Dataset.set.categorical <- function(dataset, categorical_feature) {
+  stopifnot(inherits(dataset, "lgb.Dataset"))
+  if (!is.null(dataset$env$handle)) {
+    stop("set.categorical must run before the dataset is constructed")
+  }
+  dataset$env$categorical_feature <- categorical_feature
+  invisible(dataset)
+}
+
+# --- generics ---------------------------------------------------------
+
+dim.lgb.Dataset <- function(x) {
+  e <- x$env
+  if (is.null(e$handle)) {
+    if (is.character(e$raw)) lgb.Dataset.construct(x)
+    else return(c(nrow(e$raw), ncol(e$raw)))
+  }
+  c(.Call("LGBM_R_DatasetGetNumData", e$handle),
+    .Call("LGBM_R_DatasetGetNumFeature", e$handle))
+}
+
+dimnames.lgb.Dataset <- function(x) {
+  list(NULL, x$env$colnames)
+}
+
+`dimnames<-.lgb.Dataset` <- function(x, value) {
+  if (!is.list(value) || length(value) != 2L) {
+    stop("dimnames must be a list of (row names, column names)")
+  }
+  x$env$colnames <- value[[2L]]
+  if (!is.null(x$env$handle) && !is.null(value[[2L]])) {
+    .Call("LGBM_R_DatasetSetFeatureNames", x$env$handle,
+          paste(value[[2L]], collapse = "\t"))
+  }
+  x
+}
+
+slice <- function(dataset, ...) UseMethod("slice")
+
+# Row subset sharing the parent's bin mappers (reference slice over
+# LGBM_DatasetGetSubset; idxset is 1-based like all of R).
+slice.lgb.Dataset <- function(dataset, idxset, ...) {
+  lgb.Dataset.construct(dataset)
+  e <- dataset$env
+  sub_h <- .Call("LGBM_R_DatasetGetSubset", e$handle,
+                 as.double(idxset - 1L), .params_str(e$params))
+  out <- lgb.Dataset(NULL, params = e$params)
+  out$env$handle <- sub_h
+  out$env$colnames <- e$colnames
+  for (field in names(e$info)) {
+    v <- e$info[[field]]
+    if (!is.null(v) && field != "group") {
+      out$env$info[[field]] <- v[idxset]
+    }
+  }
+  out
+}
+
+getinfo <- function(dataset, ...) UseMethod("getinfo")
+
+getinfo.lgb.Dataset <- function(dataset, name, ...) {
+  if (!name %in% c("label", "weight", "init_score", "group")) {
+    stop("getinfo: name must be label, weight, init_score or group")
+  }
+  e <- dataset$env
+  if (!is.null(e$handle)) {
+    return(.Call("LGBM_R_DatasetGetField", e$handle, name))
+  }
+  e$info[[name]]
+}
+
+setinfo <- function(dataset, ...) UseMethod("setinfo")
+
+setinfo.lgb.Dataset <- function(dataset, name, info, ...) {
+  if (!name %in% c("label", "weight", "init_score", "group")) {
+    stop("setinfo: name must be label, weight, init_score or group")
+  }
+  e <- dataset$env
+  e$info[[name]] <- info
+  if (!is.null(e$handle)) {
+    .Call("LGBM_R_DatasetSetField", e$handle, name, as.double(info))
+  }
+  invisible(dataset)
+}
+
+lgb.Dataset.free <- function(dataset) {
+  e <- dataset$env
+  if (!is.null(e$handle)) {
+    .Call("LGBM_R_DatasetFree", e$handle)
+    e$handle <- NULL
+  }
+  invisible(dataset)
+}
+
+# internal: constructed handle of a dataset (shared by lgb.train etc.)
+.ds_handle <- function(dataset) {
+  lgb.Dataset.construct(dataset)
+  dataset$env$handle
+}

@@ -1,0 +1,38 @@
+# lgb.prepare: coerce a data.frame's factor/character columns to
+# NUMERIC codes so the frame can feed lgb.Dataset (reference
+# R-package/R/lgb.prepare.R — same contract, fresh implementation;
+# data.table inputs are modified by reference like the original).
+#
+# Returns the cleaned data; see lgb.prepare_rules to keep the mapping
+# for applying to future datasets.
+
+lgb.prepare <- function(data) {
+  .lgbtpu_prepare_impl(data, to_integer = FALSE)
+}
+
+# shared engine of lgb.prepare / lgb.prepare2: factors keep their level
+# order (ordinality respected), characters are factorized first
+.lgbtpu_prepare_impl <- function(data, to_integer) {
+  cast <- if (to_integer) as.integer else as.numeric
+  conv <- function(x) {
+    if (is.character(x)) x <- as.factor(x)
+    if (is.factor(x)) cast(x) else x
+  }
+  if (inherits(data, "data.table")) {
+    cols <- names(data)[vapply(data, function(x)
+      is.character(x) || is.factor(x), logical(1L))]
+    if (length(cols) > 0L) {
+      data.table::set(data, j = cols,
+                      value = lapply(data[, cols, with = FALSE], conv))
+    }
+    return(data)
+  }
+  if (!inherits(data, "data.frame")) {
+    stop("lgb.prepare: data must be a data.frame (or data.table), got ",
+         paste(class(data), collapse = " & "))
+  }
+  fix <- which(vapply(data, function(x)
+    is.character(x) || is.factor(x), logical(1L)))
+  if (length(fix) > 0L) data[fix] <- lapply(data[fix], conv)
+  data
+}

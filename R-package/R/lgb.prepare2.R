@@ -1,0 +1,8 @@
+# lgb.prepare2: like lgb.prepare but converts factor/character columns
+# to INTEGER codes — the half-memory variant (reference
+# R-package/R/lgb.prepare2.R).  The result still needs as.matrix()
+# before lgb.Dataset.
+
+lgb.prepare2 <- function(data) {
+  .lgbtpu_prepare_impl(data, to_integer = TRUE)
+}

@@ -1,0 +1,52 @@
+# lgb.prepare_rules: factor/character -> numeric conversion that also
+# RETURNS the level->code mapping, so validation/scoring frames can be
+# converted with the training frame's exact rules (reference
+# R-package/R/lgb.prepare_rules.R — same contract, fresh
+# implementation).  Unseen levels under existing rules become 0, the
+# reference's NA-overwrite convention.
+
+lgb.prepare_rules <- function(data, rules = NULL) {
+  .lgbtpu_prepare_rules_impl(data, rules, to_integer = FALSE)
+}
+
+.lgbtpu_prepare_rules_impl <- function(data, rules, to_integer) {
+  cast <- if (to_integer) as.integer else as.numeric
+  is_dt <- inherits(data, "data.table")
+  if (!is_dt && !inherits(data, "data.frame")) {
+    stop("lgb.prepare_rules: data must be a data.frame (or ",
+         "data.table), got ", paste(class(data), collapse = " & "))
+  }
+
+  set_col <- function(j, value) {
+    if (is_dt) data.table::set(data, j = j, value = value)
+    else data[[j]] <<- value
+  }
+
+  if (!is.null(rules)) {
+    for (col in names(rules)) {
+      v <- unname(rules[[col]][as.character(data[[col]])])
+      v[is.na(v)] <- 0          # unseen level -> 0 (reference behavior)
+      set_col(col, cast(v))
+    }
+    return(list(data = data, rules = rules))
+  }
+
+  rules <- list()
+  fix <- which(vapply(data, function(x)
+    is.character(x) || is.factor(x), logical(1L)))
+  for (j in fix) {
+    col <- data[[j]]
+    if (is.factor(col)) {
+      lev <- levels(col)                 # ordinality respected
+    } else {
+      lev <- levels(as.factor(unique(col)))
+    }
+    codes <- cast(seq_along(lev))
+    names(codes) <- lev
+    rules[[colnames(data)[j]]] <- codes
+    v <- unname(codes[as.character(col)])
+    v[is.na(v)] <- 0
+    set_col(colnames(data)[j], cast(v))
+  }
+  list(data = data, rules = rules)
+}

@@ -1,0 +1,7 @@
+# lgb.prepare_rules2: the INTEGER-code variant of lgb.prepare_rules
+# (reference R-package/R/lgb.prepare_rules2.R) — keeps the same rules
+# list shape so rules from either variant interchange.
+
+lgb.prepare_rules2 <- function(data, rules = NULL) {
+  .lgbtpu_prepare_rules_impl(data, rules, to_integer = TRUE)
+}

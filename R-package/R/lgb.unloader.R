@@ -1,0 +1,20 @@
+# lgb.unloader: remove the package (and optionally every lgb.Booster /
+# lgb.Dataset object in an environment) so the shared library can be
+# re-loaded cleanly — reference R-package/R/lgb.unloader.R.
+
+lgb.unloader <- function(restore = TRUE, wipe = FALSE,
+                         envir = .GlobalEnv) {
+  try(detach("package:lightgbm", unload = TRUE), silent = TRUE)
+  if (wipe) {
+    held <- Filter(function(nm) {
+      obj <- get(nm, envir = envir)
+      inherits(obj, "lgb.Booster") || inherits(obj, "lgb.Dataset")
+    }, ls(envir = envir))
+    if (length(held) > 0L) rm(list = held, envir = envir)
+    gc(verbose = FALSE)
+  }
+  if (restore) {
+    library(lightgbm)
+  }
+  invisible(NULL)
+}

@@ -13,28 +13,16 @@
         collapse = " ")
 }
 
-lgb.Dataset <- function(data, label = NULL, params = list(),
-                        reference = NULL) {
-  # `reference` aligns this dataset's bin mappers to a training set's
-  # (required for valids — reference R-package/R/lgb.Dataset.R)
-  pstr <- .params_str(params)
-  ref_h <- if (is.null(reference)) NULL else reference$handle
-  if (is.character(data)) {
-    h <- .Call("LGBM_R_DatasetCreateFromFile", data, pstr, ref_h)
-  } else {
-    storage.mode(data) <- "double"
-    h <- .Call("LGBM_R_DatasetCreateFromMat", data, nrow(data),
-               ncol(data), pstr, ref_h)
-  }
-  if (!is.null(label)) {
-    .Call("LGBM_R_DatasetSetField", h, "label", as.double(label))
-  }
-  structure(list(handle = h), class = "lgb.Dataset")
-}
+# lgb.Dataset and its generics live in lgb.Dataset.R (the lazy
+# environment-backed dataset, slice/getinfo/setinfo/dim, construct /
+# create.valid / save.binary / set.categorical); callbacks in
+# callback.R; data preparation in lgb.prepare*.R; lgb.unloader.R
+# unloads the package.
 
 lgb.train <- function(params, data, nrounds = 100L, valids = list(),
                       record = TRUE, eval_freq = 1L,
-                      early_stopping_rounds = NULL, verbose = 1L) {
+                      early_stopping_rounds = NULL, verbose = 1L,
+                      callbacks = list()) {
   # Training loop with validation tracking + early stopping (reference
   # R-package/R/lgb.train.R): `valids` is a named list of lgb.Dataset;
   # per-eval metric values are recorded into $record_evals and the
@@ -42,20 +30,38 @@ lgb.train <- function(params, data, nrounds = 100L, valids = list(),
   # framework metrics here are smaller-is-better except auc/ndcg,
   # handled by sign) selects $best_iter under early stopping.
   stopifnot(inherits(data, "lgb.Dataset"))
-  h <- .Call("LGBM_R_BoosterCreate", data$handle, .params_str(params))
+  h <- .Call("LGBM_R_BoosterCreate", .ds_handle(data),
+             .params_str(params))
   for (v in valids) {
     stopifnot(inherits(v, "lgb.Dataset"))
-    .Call("LGBM_R_BoosterAddValidData", h, v$handle)
+    .Call("LGBM_R_BoosterAddValidData", h, .ds_handle(v))
   }
+  # the reference wires early_stopping_rounds through cb.early.stop
+  # (R-package/R/lgb.train.R) — ONE stopping implementation
+  if (!is.null(early_stopping_rounds)) {
+    callbacks <- c(callbacks,
+                   list(cb.early.stop(early_stopping_rounds,
+                                      verbose = verbose > 0L)))
+  }
+  pre_cbs <- Filter(function(cb)
+    isTRUE(attr(cb, "is_pre_iteration")), callbacks)
+  post_cbs <- Filter(function(cb)
+    !isTRUE(attr(cb, "is_pre_iteration")), callbacks)
+  booster_obj <- structure(list(handle = h), class = "lgb.Booster")
   metric_name <- if (!is.null(params$metric)) params$metric[[1L]] else ""
   bigger_better <- metric_name %in% c("auc", "ndcg", "map")
   record_evals <- list()
   best_score <- if (bigger_better) -Inf else Inf
   best_iter <- -1L
-  since_best <- 0L
   for (i in seq_len(nrounds)) {
+    cb_env <- NULL
+    if (length(callbacks) > 0L) {
+      cb_env <- .cb_env(booster_obj, params, i, 1L, nrounds, list())
+      for (cb in pre_cbs) cb(cb_env)
+    }
     finished <- .Call("LGBM_R_BoosterUpdateOneIter", h)
     if (length(valids) > 0L && (i %% eval_freq == 0L)) {
+      eval_list <- list()
       for (vi in seq_along(valids)) {
         ev <- .Call("LGBM_R_BoosterGetEval", h, as.integer(vi))
         vname <- names(valids)[vi]
@@ -66,26 +72,31 @@ lgb.train <- function(params, data, nrounds = 100L, valids = list(),
         if (verbose > 0L) {
           cat(sprintf("[%d] %s %s: %g\n", i, vname, metric_name, ev[1L]))
         }
+        if (length(ev) > 0L) {
+          eval_list[[length(eval_list) + 1L]] <- list(
+            data_name = vname, name = metric_name, value = ev[1L],
+            higher_better = bigger_better)
+        }
         if (vi == 1L && length(ev) > 0L) {
           improved <- if (bigger_better) ev[1L] > best_score else
             ev[1L] < best_score
           if (improved) {
             best_score <- ev[1L]
             best_iter <- i
-            since_best <- 0L
-          } else {
-            since_best <- since_best + eval_freq
           }
         }
       }
-      if (!is.null(early_stopping_rounds) &&
-          since_best >= early_stopping_rounds) {
-        if (verbose > 0L) {
-          cat(sprintf("Early stopping at iteration %d (best %d)\n",
-                      i, best_iter))
+      if (!is.null(cb_env)) {
+        cb_env$eval_list <- eval_list
+        for (cb in post_cbs) cb(cb_env)
+        if (isTRUE(cb_env$met_early_stop)) {
+          best_iter <- cb_env$best_iter
+          best_score <- cb_env$best_score
+          break
         }
-        break
       }
+    } else if (!is.null(cb_env)) {
+      for (cb in post_cbs) cb(cb_env)
     }
     if (finished != 0L) break
   }
@@ -290,11 +301,6 @@ readRDS.lgb.Booster <- function(file, ...) {
                  best_score = payload$best_score,
                  record_evals = payload$record_evals),
             class = "lgb.Booster")
-}
-
-lgb.Dataset.free <- function(dataset) {
-  .Call("LGBM_R_DatasetFree", dataset$handle)
-  invisible(NULL)
 }
 
 lgb.Booster.free <- function(booster) {

@@ -1,7 +1,8 @@
 # Smoke: train + predict the reference binary example through the
 # C ABI.  Run from the repo root after building the shim (README):
 #   Rscript R-package/demo/binary.R
-source("R-package/R/lightgbm.R")
+for (fr in list.files("R-package/R", pattern = "\\.R$",
+                      full.names = TRUE)) source(fr)
 dyn.load("R-package/src/lightgbm_R.so")
 
 raw <- as.matrix(read.table("/root/reference/examples/binary_classification/binary.train"))
@@ -22,3 +23,26 @@ bst2 <- lgb.load("/tmp/r_model.txt")
 p2 <- predict(bst2, X)
 stopifnot(max(abs(p - p2)) < 1e-10)
 cat("save/load roundtrip ok\n")
+
+# Dataset generics (lgb.Dataset.R): dim/slice/getinfo/setinfo +
+# binary save; prepare + callbacks exercised on the same data
+stopifnot(all(dim(ds) == dim(X)))
+sub <- slice(ds, 1:500)
+stopifnot(dim(sub)[1] == 500L)
+setinfo(ds, "weight", rep(1.0, nrow(X)))
+stopifnot(length(getinfo(ds, "weight")) == nrow(X))
+lgb.Dataset.save.binary(ds, "/tmp/r_ds.bin")
+ds_bin <- lgb.Dataset("/tmp/r_ds.bin")
+stopifnot(dim(ds_bin)[1] == nrow(X))
+df <- data.frame(a = c("x", "y", "x"), b = factor(c("u", "v", "u")),
+                 c = 1:3)
+pr <- lgb.prepare_rules(df)
+stopifnot(is.numeric(pr$data$a), length(pr$rules) == 2L)
+er_acc <- new.env()
+bst3 <- lgb.train(list(objective = "binary", verbose = -1,
+                       metric = "binary_logloss"), ds, nrounds = 5L,
+                  valids = list(train = ds), verbose = 0L,
+                  callbacks = list(cb.record.evaluation(er_acc),
+                                   cb.print.evaluation(2L)))
+stopifnot(length(er_acc[["train.binary_logloss"]]) == 5L)
+cat("generics + callbacks ok\n")

@@ -16,6 +16,7 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <vector>
 
 extern "C" {
 typedef void* DatasetHandle;
@@ -42,6 +43,15 @@ int LGBM_BoosterGetEval(BoosterHandle, int, int*, double*);
 int LGBM_BoosterSaveModelToString(BoosterHandle, int, int64_t, int64_t*,
                                   char*);
 int LGBM_BoosterLoadModelFromString(const char*, int*, BoosterHandle*);
+int LGBM_DatasetGetField(DatasetHandle, const char*, int*, const void**,
+                         int*);
+int LGBM_DatasetGetNumData(DatasetHandle, int32_t*);
+int LGBM_DatasetGetNumFeature(DatasetHandle, int32_t*);
+int LGBM_DatasetSaveBinary(DatasetHandle, const char*);
+int LGBM_DatasetGetSubset(DatasetHandle, const int32_t*, int32_t,
+                          const char*, DatasetHandle*);
+int LGBM_DatasetSetFeatureNames(DatasetHandle, const char**, int);
+int LGBM_BoosterResetParameter(BoosterHandle, const char*);
 }
 
 #define C_API_DTYPE_FLOAT64 1
@@ -211,6 +221,95 @@ SEXP LGBM_R_BoosterFree(SEXP handle) {
     CHECK_CALL(LGBM_BoosterFree(get_handle(handle)));
     R_ClearExternalPtr(handle);
   }
+  return R_NilValue;
+}
+
+/* --- Dataset generics surface (round 5: the lgb.Dataset.R generics —
+ * getinfo/setinfo, dim, slice, save.binary — over the same ABI rows
+ * the reference shim exposes, src/lightgbm_R.cpp Dataset block). */
+
+SEXP LGBM_R_DatasetGetField(SEXP handle, SEXP name) {
+  const char* nm = CHAR(Rf_asChar(name));
+  int out_len = 0, out_type = 0;
+  const void* ptr = nullptr;
+  CHECK_CALL(LGBM_DatasetGetField(get_handle(handle), nm, &out_len,
+                                  &ptr, &out_type));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, out_len));
+  for (int i = 0; i < out_len; ++i) {
+    switch (out_type) {
+      case 0:  /* float32 */
+        REAL(out)[i] = (double)((const float*)ptr)[i];
+        break;
+      case 2:  /* int32 (query boundaries) */
+        REAL(out)[i] = (double)((const int32_t*)ptr)[i];
+        break;
+      default: /* float64 */
+        REAL(out)[i] = ((const double*)ptr)[i];
+    }
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_DatasetGetNumData(SEXP handle) {
+  int32_t n = 0;
+  CHECK_CALL(LGBM_DatasetGetNumData(get_handle(handle), &n));
+  return Rf_ScalarInteger((int)n);
+}
+
+SEXP LGBM_R_DatasetGetNumFeature(SEXP handle) {
+  int32_t n = 0;
+  CHECK_CALL(LGBM_DatasetGetNumFeature(get_handle(handle), &n));
+  return Rf_ScalarInteger((int)n);
+}
+
+SEXP LGBM_R_DatasetSaveBinary(SEXP handle, SEXP filename) {
+  CHECK_CALL(LGBM_DatasetSaveBinary(get_handle(handle),
+                                    CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+SEXP LGBM_R_DatasetGetSubset(SEXP handle, SEXP idx, SEXP parameters) {
+  /* idx arrives as R doubles of 0-BASED row indices (the R wrapper
+   * converts from 1-based) */
+  int n = Rf_length(idx);
+  std::string buf(sizeof(int32_t) * (size_t)n, '\0');
+  int32_t* rows = reinterpret_cast<int32_t*>(&buf[0]);
+  for (int i = 0; i < n; ++i) rows[i] = (int32_t)REAL(idx)[i];
+  DatasetHandle out = nullptr;
+  CHECK_CALL(LGBM_DatasetGetSubset(get_handle(handle), rows, n,
+                                   CHAR(Rf_asChar(parameters)), &out));
+  SEXP res = PROTECT(R_MakeExternalPtr(out, R_NilValue, R_NilValue));
+  UNPROTECT(1);
+  return res;
+}
+
+SEXP LGBM_R_BoosterResetParameter(SEXP handle, SEXP parameters) {
+  CHECK_CALL(LGBM_BoosterResetParameter(get_handle(handle),
+                                        CHAR(Rf_asChar(parameters))));
+  return R_NilValue;
+}
+
+SEXP LGBM_R_DatasetSetFeatureNames(SEXP handle, SEXP names_joined) {
+  /* feature names cross as ONE tab-joined string (the rstub host has
+   * no STRSXP vectors; real R builds the same joined form) */
+  std::string joined(CHAR(Rf_asChar(names_joined)));
+  std::vector<std::string> parts;
+  size_t start = 0;
+  while (start <= joined.size()) {
+    size_t tab = joined.find('\t', start);
+    if (tab == std::string::npos) {
+      parts.push_back(joined.substr(start));
+      break;
+    }
+    parts.push_back(joined.substr(start, tab - start));
+    start = tab + 1;
+  }
+  std::vector<const char*> ptrs;
+  for (auto& s : parts) ptrs.push_back(s.c_str());
+  CHECK_CALL(LGBM_DatasetSetFeatureNames(get_handle(handle),
+                                         ptrs.data(),
+                                         (int)ptrs.size()));
   return R_NilValue;
 }
 

@@ -52,6 +52,25 @@ class Dataset:
             config = Config.from_params(self.params)
         data = self.data
         label = self.label
+        if isinstance(data, str):
+            # the reference's DatasetLoader sniffs the binary token on
+            # EVERY file load (dataset_loader.cpp LoadFromBinFile /
+            # CheckCanLoadFromBin) — a saved binary cache must load
+            # wherever a text file would
+            from .dataset_io import is_binary_file, load_binary
+            if is_binary_file(data):
+                self._core = load_binary(data)
+                if self.label is not None:
+                    self._core.metadata.set_label(self.label)
+                if self.weight is not None:
+                    self._core.metadata.set_weight(self.weight)
+                if self.group is not None:
+                    self._core.metadata.set_group(self.group)
+                if self.init_score is not None:
+                    self._core.metadata.set_init_score(self.init_score)
+                if isinstance(self.feature_name, (list, tuple)):
+                    self._core.feature_names = list(self.feature_name)
+                return self._core
         streaming_ok = (isinstance(data, str)
                         and config.use_two_round_loading
                         and self.reference is None

@@ -1558,28 +1558,34 @@ class TreeGrower:
         return (best_gain, bf_f.astype(jnp.int32), thr,
                 dleft > 0.5, lsg, lsh, lsc, lout, rout, cat_f > 0.5)
 
-    def _round_feature(self, st: GrowerState, grad, hess, counts,
-                       feature_mask) -> GrowerState:
-        """Full-frontier round for the feature-parallel learner —
-        identical split selection to serial (exact global election),
-        with only SplitInfo-scale collectives."""
+    def _select_frontier(self, st: GrowerState, best_gain):
+        """Full-frontier candidate selection shared by the voting and
+        feature-parallel rounds: gain-ranked splits within the leaf
+        budget (the cached serial `_round` layers forced-split and
+        frontier-width terms on top of the same scheme).  Returns
+        (do_split, rank, k)."""
         L = self.num_leaves
-
-        (best_gain, best_f, thr, dleft, lsg, lsh, lsc, lout, rout,
-         cat_mask) = self._feature_find_splits(st, grad, hess, counts,
-                                               feature_mask)
-
         slot = jnp.arange(L, dtype=jnp.int32)
         active = slot < st.num_leaves
         depth_ok = (self.max_depth <= 0) | \
             (st.tree.leaf_depth < self.max_depth)
         cand_m = active & depth_ok & (best_gain > 0.0)
         key = jnp.where(cand_m, best_gain, NEG_INF)
-        order = jnp.argsort(-key)
+        order = jnp.argsort(-key)                   # best first, stable
         rank = jnp.argsort(order).astype(jnp.int32)
         budget = L - st.num_leaves
         do_split = cand_m & (rank < budget)
-        k = do_split.sum().astype(jnp.int32)
+        return do_split, rank, do_split.sum().astype(jnp.int32)
+
+    def _round_feature(self, st: GrowerState, grad, hess, counts,
+                       feature_mask) -> GrowerState:
+        """Full-frontier round for the feature-parallel learner —
+        identical split selection to serial (exact global election),
+        with only SplitInfo-scale collectives."""
+        (best_gain, best_f, thr, dleft, lsg, lsh, lsc, lout, rout,
+         cat_mask) = self._feature_find_splits(st, grad, hess, counts,
+                                               feature_mask)
+        do_split, rank, k = self._select_frontier(st, best_gain)
         return self._apply_selection(
             st, do_split, rank, k, best_gain, best_f, thr, dleft,
             lsg, lsh, lsc, lout, rout, cat_mask)
@@ -1601,18 +1607,7 @@ class TreeGrower:
         best_gain = jnp.take_along_axis(gains, best_fc[:, None],
                                         axis=1)[:, 0]
         best_f = best_fc if sel is None else sel[best_fc]
-        slot = jnp.arange(L, dtype=jnp.int32)
-        active = slot < st.num_leaves
-        depth_ok = (self.max_depth <= 0) | \
-            (st.tree.leaf_depth < self.max_depth)
-        cand_m = active & depth_ok & (best_gain > 0.0)
-
-        key = jnp.where(cand_m, best_gain, NEG_INF)
-        order = jnp.argsort(-key)                   # best first, stable
-        rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
-        budget = L - st.num_leaves
-        do_split = cand_m & (rank < budget)
-        k = do_split.sum().astype(jnp.int32)
+        do_split, rank, k = self._select_frontier(st, best_gain)
 
         def at_leaf(arr2d):
             # res arrays live in the (possibly compacted) finder space

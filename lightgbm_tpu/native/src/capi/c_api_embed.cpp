@@ -441,7 +441,10 @@ int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
   Py_DECREF(arr);
   if (contig == nullptr) { set_error_from_python(); return -1; }
   Py_buffer view;
-  if (PyObject_GetBuffer(contig, &view, PyBUF_CONTIG_RO) != 0) {
+  // PyBUF_FORMAT is required or view.format stays NULL and float32
+  // fields misdetect as int32 (their bits then read as ~1.07e9)
+  if (PyObject_GetBuffer(contig, &view,
+                         PyBUF_CONTIG_RO | PyBUF_FORMAT) != 0) {
     set_error_from_python();
     Py_DECREF(contig);
     return -1;

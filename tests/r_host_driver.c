@@ -8,6 +8,13 @@
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <unistd.h>
+
+/* all verdicts leave through _exit: the embedded CPython + jax thread
+ * pools make glibc DSO-destructor order hostile after main returns
+ * (observed ~1-in-3 post-main SIGSEGV once a second booster existed),
+ * and a teardown crash would mask the diagnostic exit code */
+#define FINISH(code) do { fflush(NULL); _exit(code); } while (0)
 
 #include "Rinternals.h"
 
@@ -30,6 +37,13 @@ extern SEXP LGBM_R_BoosterAddValidData(SEXP, SEXP);
 extern SEXP LGBM_R_BoosterGetEval(SEXP, SEXP);
 extern SEXP LGBM_R_BoosterSaveModelToString(SEXP, SEXP);
 extern SEXP LGBM_R_BoosterLoadModelFromString(SEXP);
+extern SEXP LGBM_R_DatasetGetField(SEXP, SEXP);
+extern SEXP LGBM_R_DatasetGetNumData(SEXP);
+extern SEXP LGBM_R_DatasetGetNumFeature(SEXP);
+extern SEXP LGBM_R_DatasetSaveBinary(SEXP, SEXP);
+extern SEXP LGBM_R_DatasetGetSubset(SEXP, SEXP, SEXP);
+extern SEXP LGBM_R_DatasetSetFeatureNames(SEXP, SEXP);
+extern SEXP LGBM_R_DatasetCreateFromFile(SEXP, SEXP, SEXP);
 #ifdef __cplusplus
 }
 #endif
@@ -98,7 +112,7 @@ int main(int argc, char** argv) {
     SEXP ev = LGBM_R_BoosterGetEval(bst, RStub_MakeInt(1));
     if (Rf_length(ev) < 1) {
       fprintf(stderr, "empty eval at iter %d\n", it);
-      return 7;
+      FINISH(7);
     }
     last_eval = REAL(ev)[0];
     if (it == 0) first_eval = last_eval;
@@ -106,14 +120,14 @@ int main(int argc, char** argv) {
   if (!(last_eval < first_eval)) {
     fprintf(stderr, "valid logloss did not fall: %g -> %g\n",
             first_eval, last_eval);
-    return 8;
+    FINISH(8);
   }
   SEXP pred = LGBM_R_BoosterPredictForMat(
       bst, s_mat, RStub_MakeInt(n), RStub_MakeInt(f), RStub_MakeInt(0),
       RStub_MakeInt(-1));
   if (Rf_length(pred) != n) {
     fprintf(stderr, "bad prediction length %d\n", Rf_length(pred));
-    return 4;
+    FINISH(4);
   }
   int correct = 0;
   for (int i = 0; i < n; ++i)
@@ -141,7 +155,7 @@ int main(int argc, char** argv) {
       RStub_MakeInt(-1));
   if (Rf_length(contrib) != (long)n * (f + 1)) {
     fprintf(stderr, "bad contrib length %d\n", Rf_length(contrib));
-    return 9;
+    FINISH(9);
   }
   double worst_gap = 0.0;
   for (int i = 0; i < n; ++i) {
@@ -153,7 +167,7 @@ int main(int argc, char** argv) {
   if (worst_gap > 1e-4) {
     fprintf(stderr, "contribs don't sum to raw score (gap %g)\n",
             worst_gap);
-    return 10;
+    FINISH(10);
   }
 
   /* model-string round trip (saveRDS/readRDS.lgb.Booster payload) */
@@ -168,16 +182,97 @@ int main(int argc, char** argv) {
     if (d > maxdiff3) maxdiff3 = d;
   }
 
+  /* --- Dataset generics surface (lgb.Dataset.R: dim, getinfo/setinfo,
+   * slice, lgb.Dataset.save.binary — round-5 R-surface tail) --- */
+  if (Rf_asInteger(LGBM_R_DatasetGetNumData(ds)) != n) {
+    fprintf(stderr, "GetNumData != %d\n", n);
+    FINISH(12);
+  }
+  if (Rf_asInteger(LGBM_R_DatasetGetNumFeature(ds)) != f) {
+    fprintf(stderr, "GetNumFeature != %d\n", f);
+    FINISH(13);
+  }
+  /* setinfo/getinfo round trip on weights + label readback */
+  double* w = (double*)malloc(sizeof(double) * n);
+  for (int i = 0; i < n; ++i) w[i] = 1.0 + (i % 3) * 0.25;
+  LGBM_R_DatasetSetField(ds, RStub_MakeString("weight"),
+                         RStub_MakeReal(w, n));
+  SEXP got_w = LGBM_R_DatasetGetField(ds, RStub_MakeString("weight"));
+  SEXP got_l = LGBM_R_DatasetGetField(ds, RStub_MakeString("label"));
+  if (Rf_length(got_w) != n || Rf_length(got_l) != n) {
+    fprintf(stderr, "getinfo lengths %d/%d\n", Rf_length(got_w),
+            Rf_length(got_l));
+    FINISH(14);
+  }
+  double field_gap = 0.0;
+  for (int i = 0; i < n; ++i) {
+    double dw = fabs(REAL(got_w)[i] - w[i]);
+    double dl = fabs(REAL(got_l)[i] - label[i]);
+    if (dw > field_gap) field_gap = dw;
+    if (dl > field_gap) field_gap = dl;
+  }
+  if (field_gap > 1e-6) {
+    fprintf(stderr, "set/getinfo round trip gap %g\n", field_gap);
+    FINISH(15);
+  }
+  /* feature names (dimnames<-) */
+  LGBM_R_DatasetSetFeatureNames(
+      ds, RStub_MakeString("c0\tc1\tc2\tc3\tc4"));
+  /* slice: first 300 rows; a booster must train on the subset */
+  double* idx = (double*)malloc(sizeof(double) * 300);
+  for (int i = 0; i < 300; ++i) idx[i] = (double)i; /* 0-based */
+  SEXP sub = LGBM_R_DatasetGetSubset(
+      ds, RStub_MakeReal(idx, 300),
+      RStub_MakeString("objective=binary verbose=-1 num_leaves=15 "
+                       "min_data_in_leaf=5"));
+  if (Rf_asInteger(LGBM_R_DatasetGetNumData(sub)) != 300) {
+    fprintf(stderr, "subset num_data != 300\n");
+    FINISH(16);
+  }
+  /* the subset must carry the sliced metadata: its label field is the
+   * parent's first 300 labels */
+  SEXP sub_l = LGBM_R_DatasetGetField(sub, RStub_MakeString("label"));
+  if (Rf_length(sub_l) != 300) {
+    fprintf(stderr, "subset label length %d\n", Rf_length(sub_l));
+    FINISH(18);
+  }
+  for (int i = 0; i < 300; ++i) {
+    if (fabs(REAL(sub_l)[i] - label[i]) > 1e-6) {
+      fprintf(stderr, "subset label mismatch at %d\n", i);
+      FINISH(19);
+    }
+  }
+  SEXP bsub = LGBM_R_BoosterCreate(
+      sub, RStub_MakeString("objective=binary verbose=-1 num_leaves=15 "
+                            "min_data_in_leaf=5"));
+  for (int it = 0; it < 3; ++it) LGBM_R_BoosterUpdateOneIter(bsub);
+  /* save.binary: write + reload the binary cache as a dataset */
+  char bin_path[512];
+  snprintf(bin_path, sizeof bin_path, "%s.dsbin", model_path);
+  LGBM_R_DatasetSaveBinary(ds, RStub_MakeString(bin_path));
+  SEXP ds_bin = LGBM_R_DatasetCreateFromFile(
+      RStub_MakeString(bin_path),
+      RStub_MakeString("objective=binary verbose=-1 num_leaves=15 "
+                       "min_data_in_leaf=5"), R_NilValue);
+  if (Rf_asInteger(LGBM_R_DatasetGetNumData(ds_bin)) != n) {
+    fprintf(stderr, "binary-reloaded num_data != %d\n", n);
+    FINISH(17);
+  }
+  LGBM_R_BoosterFree(bsub);
+  LGBM_R_DatasetFree(sub);
+  LGBM_R_DatasetFree(ds_bin);
+
   LGBM_R_BoosterFree(bst);
   LGBM_R_BoosterFree(bst2);
   LGBM_R_BoosterFree(bst3);
   LGBM_R_DatasetFree(ds);
   LGBM_R_DatasetFree(dv);
   printf("R-HOST OK acc=%.3f maxdiff=%g eval %g->%g contrib_gap=%g "
-         "strdiff=%g\n", acc, maxdiff, first_eval, last_eval,
-         worst_gap, maxdiff3);
-  if (acc < 0.85) return 5;
-  if (maxdiff > 1e-10) return 6;
-  if (maxdiff3 > 1e-10) return 11;
-  return 0;
+         "strdiff=%g field_gap=%g\n", acc, maxdiff, first_eval,
+         last_eval, worst_gap, maxdiff3, field_gap);
+  int rc = 0;
+  if (acc < 0.85) rc = 5;
+  if (maxdiff > 1e-10) rc = 6;
+  if (maxdiff3 > 1e-10) rc = 11;
+  FINISH(rc);
 }

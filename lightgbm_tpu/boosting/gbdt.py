@@ -378,6 +378,31 @@ class GBDT:
             donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
+    def _host_qkey(self, class_idx: int):
+        """Per-(iteration, class) stochastic-rounding key for the
+        HOST-DRIVEN tree paths (RF, custom gradients) — the fused
+        chunk derives its own inside _boost_one."""
+        if not self._quant_stochastic():
+            return None
+        import jax as _jax
+        seed = int(self._iter_key_rng.randint(0, 2**31 - 1))
+        return _jax.random.fold_in(_jax.random.PRNGKey(seed), class_idx)
+
+    def _quant_stochastic(self) -> bool:
+        """Whether the int8 quantization rounds stochastically (the v4
+        recipe; REQUIRED by skewed-gradient objectives like lambdarank
+        — see ops/histogram.py quantize_gradients).  Auto mode defers
+        to the objective's need_stochastic_quant."""
+        if not self.grower.use_quant:
+            return False
+        mode = int(getattr(self.config, "quant_stochastic_rounding",
+                           -1))
+        if mode >= 0:
+            return bool(mode)
+        return (self.objective is not None
+                and getattr(self.objective, "need_stochastic_quant",
+                            False))
+
     def can_chunk(self) -> bool:
         """Whether multi-iteration fused chunks are valid: plain GBDT
         gradients only.  DART/RF mutate state between iterations on the
@@ -408,9 +433,14 @@ class GBDT:
         trees = []
         nl = jnp.int32(1)
         new_vscores = list(vscores)
+        # stochastic-rounding key for the int8 quantization (folded off
+        # the iteration key so the bagging/GOSS streams are untouched)
+        kq = (jax.random.fold_in(key, 0x51AB)
+              if self._quant_stochastic() else None)
         for k in range(self.num_class):
             tree, leaf_id, row_val = self.grower._train_tree_impl(
-                g[k], h[k], counts, fmask[k], ohb)
+                g[k], h[k], counts, fmask[k], ohb,
+                qkey=None if kq is None else jax.random.fold_in(kq, k))
             tree = self._finalize_tree(tree, leaf_id, k, scores, counts)
             # a no-split tree must contribute nothing (the reference
             # skips UpdateScore when num_leaves==1, gbdt.cpp:427-460)
@@ -477,10 +507,13 @@ class GBDT:
             self._bag_state = self._full_counts > 0
         seeds = np.asarray([self._iter_key_rng.randint(0, 2**31 - 1)
                             for _ in range(n_iters)], np.uint32)
-        if self._np_keys_ok and not use_bag and not self._sample_active():
+        if self._np_keys_ok and not use_bag \
+                and not self._sample_active() \
+                and not self._quant_stochastic():
             # keys unused by the chunk body (no bagging draw, no GOSS
-            # sampling): reuse a cached device array and skip the
-            # per-chunk host->device transfer entirely
+            # sampling, no stochastic quantization rounding): reuse a
+            # cached device array and skip the per-chunk host->device
+            # transfer entirely
             cache = getattr(self, "_chunk_keys", None)
             if cache is None or cache.shape[0] != n_iters:
                 cache = jnp.zeros((n_iters, 2), jnp.uint32)
@@ -624,7 +657,8 @@ class GBDT:
         for k in range(self.num_class):
             feature_mask = self._feature_mask()
             tree_arrays, leaf_id, _ = self.grower.train_tree(
-                g[k], h[k], counts, feature_mask)
+                g[k], h[k], counts, feature_mask,
+                qkey=self._host_qkey(k))
             tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k,
                                               self.scores, counts)
             ok = (tree_arrays.num_leaves > 1).astype(jnp.float32)

@@ -48,7 +48,8 @@ class RF(GBDT):
         for k in range(self.num_class):
             feature_mask = self._feature_mask()
             tree_arrays, leaf_id, _ = self.grower.train_tree(
-                g[k], h[k], counts, feature_mask)
+                g[k], h[k], counts, feature_mask,
+                qkey=self._host_qkey(k))
             tree_arrays = self._finalize_tree(tree_arrays, leaf_id, k,
                                               self.scores, counts)
             # convert leaf outputs (reference rf.hpp ConvertTreeOutput)

@@ -303,6 +303,14 @@ class Config:
     quantized_grad: bool = False    # int8-MXU quantized histogram
     # construction (one grad/hess scale per tree; the TPU analog of
     # LightGBM v4 quantized training, arXiv 2207.09682) — TPU path only
+    quant_stochastic_rounding: int = -1  # round the quantized
+    # gradients stochastically (the v4 recipe, unbiased in
+    # expectation): -1 = auto (the objective decides — lambdarank
+    # REQUIRES it: deterministic rounding zeroes the long tail of
+    # pairwise lambdas, measured 0.33 vs 0.64 held-out NDCG@10 at the
+    # MS-LTR shape, while binary/regression gradients are well-spread
+    # and skip the ~7% per-tree RNG cost), 0 = always deterministic,
+    # 1 = always stochastic
     histogram_pool_size: float = -1.0  # MB bound on the per-leaf
     # histogram cache (reference config.h:216 + the LRU HistogramPool,
     # feature_histogram.hpp:653-823).  -1 = unbounded.  When the

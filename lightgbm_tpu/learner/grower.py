@@ -605,15 +605,17 @@ class TreeGrower:
 
     # ------------------------------------------------------------------
     def train_tree(self, grad: jax.Array, hess: jax.Array,
-                   counts: jax.Array, feature_mask: jax.Array
+                   counts: jax.Array, feature_mask: jax.Array,
+                   qkey=None
                    ) -> Tuple[TreeArrays, jax.Array, Optional[jax.Array]]:
         """Grow one tree.  grad/hess/counts are (n_padded,) with zeros
-        for out-of-bag and padded rows.  Returns (tree, final leaf_id,
-        per-row post-route leaf value or None — see
-        _train_tree_inner)."""
+        for out-of-bag and padded rows.  ``qkey`` enables stochastic
+        quantization rounding (see quantize_gradients).  Returns
+        (tree, final leaf_id, per-row post-route leaf value or None —
+        see _train_tree_inner)."""
         return self._train_tree(grad, hess, counts, feature_mask,
                                 self.ohb, self.bins, self.binsT,
-                                self._row_valid)
+                                self._row_valid, qkey)
 
     # ------------------------------------------------------------------
     def _hist_kernel(self, grad, hess, counts, leaf_id, slots=None,
@@ -942,7 +944,7 @@ class TreeGrower:
     # ------------------------------------------------------------------
     def _train_tree_impl(self, grad, hess, counts, feature_mask,
                          ohb=None, bins=None, binsT=None,
-                         row_valid=None):
+                         row_valid=None, qkey=None):
         """``ohb``/``bins``/``binsT``/``row_valid`` are the O(N) device
         arrays, threaded through the caller's jit boundary as ARGUMENTS
         and bound to their attributes for the dynamic extent of the
@@ -961,12 +963,13 @@ class TreeGrower:
             self._row_valid = row_valid
         try:
             return self._train_tree_inner(grad, hess, counts,
-                                          feature_mask)
+                                          feature_mask, qkey=qkey)
         finally:
             self._ohb_arg = None
             self.bins, self.binsT, self._row_valid = saved
 
-    def _train_tree_inner(self, grad, hess, counts, feature_mask):
+    def _train_tree_inner(self, grad, hess, counts, feature_mask,
+                          qkey=None):
         state = self._init_state(grad, hess, counts)
         if self._is_voting:
             def body_fn(st):
@@ -978,8 +981,10 @@ class TreeGrower:
                                            feature_mask)
         else:
             # gradients are fixed for the whole tree, so the int8
-            # quantization (one scale per channel) happens once here
-            quant = (quantize_gradients(grad, hess, counts)
+            # quantization (one scale per channel) happens once here;
+            # qkey enables the stochastic rounding the skewed-gradient
+            # objectives need (see quantize_gradients)
+            quant = (quantize_gradients(grad, hess, counts, key=qkey)
                      if self.use_quant else None)
             if quant is not None and (self.use_fused or self.use_tiled):
                 # the fused/tiled kernels stream weights lane-major

@@ -338,13 +338,40 @@ def _hist_kernel_body_q(bins_ref, wq_ref, leaf_ref, emat_ref, bcol_ref,
         preferred_element_type=jnp.int32)
 
 
-def quantize_gradients(grad: jax.Array, hess: jax.Array, counts: jax.Array):
+def quantize_gradients(grad: jax.Array, hess: jax.Array, counts: jax.Array,
+                       key=None):
     """Per-channel symmetric int8 quantization (one scale per tree).
-    Returns ((N, 3) int32 quantized weights, (3,) f32 scales)."""
+    Returns ((N, 3) int32 quantized weights, (3,) f32 scales).
+
+    With ``key``, gradients and hessians round STOCHASTICALLY — the
+    v4 quantized-training recipe (arXiv 2207.09682: rounding to the
+    nearer level zeroes the long tail of small gradients whenever the
+    distribution is skewed, and stochastic rounding restores the
+    signal in expectation).  Measured on the MS-LTR lambdarank bench
+    shape: deterministic rounding costs 0.31 held-out NDCG@10 vs the
+    unquantized path (0.33 vs 0.64) because most pairwise lambdas are
+    orders below the per-tree max; see tests/test_engine.py
+    test_lambdarank_quantized_stochastic."""
     s_g = jnp.maximum(jnp.max(jnp.abs(grad)) / 127.0, 1e-30)
     s_h = jnp.maximum(jnp.max(jnp.abs(hess)) / 127.0, 1e-30)
-    wq = jnp.stack([jnp.round(grad / s_g), jnp.round(hess / s_h),
-                    counts], axis=1).astype(jnp.int32)
+    if key is None:
+        qg = jnp.round(grad / s_g)
+        qh = jnp.round(hess / s_h)
+    else:
+        kg, kh = jax.random.split(key)
+
+        def sround(x, k):
+            # clip AFTER rounding: f32 division can put the max-|grad|
+            # row a few ulp above 127, and rounding UP there would
+            # wrap to -128 at the kernels' int8 cast (sign-flipping
+            # the largest gradient)
+            f = jnp.floor(x)
+            r = f + (jax.random.uniform(k, x.shape) < (x - f))
+            return jnp.clip(r, -127.0, 127.0)
+
+        qg = sround(grad / s_g, kg)
+        qh = sround(hess / s_h, kh)
+    wq = jnp.stack([qg, qh, counts], axis=1).astype(jnp.int32)
     scales = jnp.stack([s_g, s_h, jnp.float32(1.0)])
     return wq, scales
 

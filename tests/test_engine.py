@@ -646,3 +646,58 @@ def test_train_kwargs_reference_tail():
                      keep_training_booster=True)
     live.update()
     assert live.num_trees() == 4
+
+
+def test_lambdarank_quantized_stochastic():
+    """Stochastic int8 rounding (the v4 quantized-training recipe):
+    deterministic rounding zeroes the long tail of small gradients
+    (measured 0.33 vs 0.64 held-out NDCG@10 on the MS-LTR bench
+    shape), stochastic rounding is unbiased in expectation.  Pins the
+    quantizer's statistics and the objective-driven auto mode."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import quantize_gradients
+
+    rng = np.random.RandomState(0)
+    # lambdarank-like skew: one large lambda, a long tail far below
+    # the int8 step (max/127)
+    grad = np.concatenate([[127.0], rng.rand(8191) * 0.25]) \
+        .astype(np.float32)
+    hess = np.abs(grad)
+    cnt = np.ones_like(grad)
+    wq_det, s_det = quantize_gradients(jnp.asarray(grad),
+                                       jnp.asarray(hess),
+                                       jnp.asarray(cnt))
+    # deterministic: the whole tail (< step/2) rounds to zero
+    assert float(jnp.sum(jnp.abs(wq_det[1:, 0]))) == 0.0
+    wq_s, s_s = quantize_gradients(jnp.asarray(grad), jnp.asarray(hess),
+                                   jnp.asarray(cnt),
+                                   key=jax.random.PRNGKey(3))
+    # stochastic: the dequantized tail SUM is preserved within
+    # sampling noise (n=8191 draws, p~0.125-0.25)
+    true_sum = float(grad[1:].sum())
+    got_sum = float(jnp.sum(wq_s[1:, 0]) * s_s[0])
+    assert abs(got_sum - true_sum) / true_sum < 0.05, (got_sum,
+                                                      true_sum)
+
+    # auto mode resolves per objective: lambdarank needs it, binary
+    # does not (the grower's use_quant gate is forced on for the check)
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+
+    X = rng.randn(256, 4)
+    y = (X[:, 0] > 0).astype(float)
+    for obj, want in (("binary", False), ("lambdarank", True)):
+        p = {"objective": obj, "verbose": -1}
+        kw = {"label": y}
+        if obj == "lambdarank":
+            kw["group"] = [64, 64, 64, 64]
+        cfg = Config.from_params(p)
+        core = lgb.Dataset(X, **kw).construct(cfg)
+        g = GBDT(cfg, core)
+        g.grower.use_quant = True          # CPU backend has it off
+        assert g._quant_stochastic() is want, obj
+        g.config.quant_stochastic_rounding = 1 - int(want)
+        assert g._quant_stochastic() is (not want), obj

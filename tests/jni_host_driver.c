@@ -11,6 +11,7 @@
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <unistd.h>
 #include <string.h>
 
 #include "../jni/jni_min.h"
@@ -294,11 +295,16 @@ static double frand(void) {
   return (double)(rng_state % 1000000ul) / 1000000.0 - 0.5;
 }
 
+/* verdicts leave through _exit: the embedded CPython + jax thread
+ * pools make glibc DSO-destructor order hostile after main returns
+ * (same post-main SIGSEGV class the R stub host hit once multiple
+ * boosters existed) */
 #define CHECK(cond, code, msg)                        \
   do {                                                \
     if (!(cond)) {                                    \
-      fprintf(stderr, "FAIL(%d): %s\n", code, msg);   \
-      return code;                                    \
+      fprintf(stderr, "FAIL(%d): %s\n", code, msg);  \
+      fflush(NULL);                                   \
+      _exit(code);                                    \
     }                                                 \
   } while (0)
 
@@ -617,5 +623,6 @@ int main(int argc, char** argv) {
   Java_com_lightgbm_tpu_LightGBMNative_datasetFree(env, NULL, ds_csr);
   Java_com_lightgbm_tpu_LightGBMNative_datasetFree(env, NULL, ds);
   printf("JNI-HOST OK acc=%.3f maxdiff=%g\n", acc, maxdiff);
-  return 0;
+  fflush(NULL);
+  _exit(0);
 }

@@ -11,6 +11,7 @@
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <unistd.h>
 
 #include "lightgbm_tpu_c_api.h"
 
@@ -18,7 +19,7 @@
   do {                                                               \
     if ((call) != 0) {                                               \
       fprintf(stderr, "FAILED %s: %s\n", #call, LGBM_GetLastError()); \
-      return 1;                                                      \
+      { fflush(NULL); _exit(1); }                                                      \
     }                                                                \
   } while (0)
 
@@ -52,7 +53,7 @@ int main(int argc, char** argv) {
   CHECK(LGBM_DatasetGetNumFeature(ds, &num_feat));
   if (num_data != n || num_feat != f) {
     fprintf(stderr, "dataset dims wrong: %d x %d\n", num_data, num_feat);
-    return 1;
+    { fflush(NULL); _exit(1); }
   }
 
   BoosterHandle bst = NULL;
@@ -69,14 +70,14 @@ int main(int argc, char** argv) {
   CHECK(LGBM_BoosterGetCurrentIteration(bst, &iter));
   if (iter != 20) {
     fprintf(stderr, "iteration count wrong: %d\n", iter);
-    return 1;
+    { fflush(NULL); _exit(1); }
   }
 
   int eval_count = 0;
   CHECK(LGBM_BoosterGetEvalCounts(bst, &eval_count));
   if (eval_count < 1) {
     fprintf(stderr, "eval count wrong: %d\n", eval_count);
-    return 1;
+    { fflush(NULL); _exit(1); }
   }
   double* evals = (double*)malloc(sizeof(double) * eval_count);
   int eval_len = 0;
@@ -84,7 +85,7 @@ int main(int argc, char** argv) {
   if (eval_len < 1 || !(evals[0] < 0.5)) {
     fprintf(stderr, "train logloss did not improve: n=%d v=%f\n", eval_len,
             eval_len > 0 ? evals[0] : -1.0);
-    return 1;
+    { fflush(NULL); _exit(1); }
   }
 
   int64_t pred_len = 0;
@@ -94,19 +95,19 @@ int main(int argc, char** argv) {
                                   preds));
   if (pred_len != n) {
     fprintf(stderr, "pred_len wrong: %lld\n", (long long)pred_len);
-    return 1;
+    { fflush(NULL); _exit(1); }
   }
   int correct = 0;
   for (int i = 0; i < n; ++i) {
     if (!(preds[i] >= 0.0 && preds[i] <= 1.0) || isnan(preds[i])) {
       fprintf(stderr, "pred out of range at %d: %f\n", i, preds[i]);
-      return 1;
+      { fflush(NULL); _exit(1); }
     }
     if ((preds[i] > 0.5) == (y[i] > 0.5f)) ++correct;
   }
   if (correct < (int)(0.9 * n)) {
     fprintf(stderr, "train accuracy too low: %d/%d\n", correct, n);
-    return 1;
+    { fflush(NULL); _exit(1); }
   }
 
   /* model string round-trip: save, reload, predictions must match */
@@ -125,7 +126,7 @@ int main(int argc, char** argv) {
     if (fabs(preds[i] - preds2[i]) > 1e-6) {
       fprintf(stderr, "round-trip mismatch at %d: %f vs %f\n", i, preds[i],
               preds2[i]);
-      return 1;
+      { fflush(NULL); _exit(1); }
     }
   }
 
@@ -135,7 +136,7 @@ int main(int argc, char** argv) {
   if (imp[0] + imp[1] <= imp[2] + imp[3]) {
     fprintf(stderr, "importance order wrong: %f %f %f %f\n", imp[0], imp[1],
             imp[2], imp[3]);
-    return 1;
+    { fflush(NULL); _exit(1); }
   }
 
   CHECK(LGBM_BoosterFree(bst2));
@@ -148,5 +149,5 @@ int main(int argc, char** argv) {
   free(X);
   free(y);
   printf("NATIVE_CAPI_OK\n");
-  return 0;
+  { fflush(NULL); _exit(0); }
 }

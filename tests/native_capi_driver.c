@@ -19,9 +19,14 @@
   do {                                                               \
     if ((call) != 0) {                                               \
       fprintf(stderr, "FAILED %s: %s\n", #call, LGBM_GetLastError()); \
-      { fflush(NULL); _exit(1); }                                                      \
+      FAIL(1);                                                      \
     }                                                                \
   } while (0)
+
+/* verdicts leave through _exit (see the embedding caveat in
+ * lightgbm_tpu/native/README.md: the embedded CPython + jax thread
+ * pools make glibc DSO-destructor order hostile after main returns) */
+#define FAIL(code) do { fflush(NULL); _exit(code); } while (0)
 
 int main(int argc, char** argv) {
   if (argc > 1) LTPU_AddSysPath(argv[1]);
@@ -53,7 +58,7 @@ int main(int argc, char** argv) {
   CHECK(LGBM_DatasetGetNumFeature(ds, &num_feat));
   if (num_data != n || num_feat != f) {
     fprintf(stderr, "dataset dims wrong: %d x %d\n", num_data, num_feat);
-    { fflush(NULL); _exit(1); }
+    FAIL(1);
   }
 
   BoosterHandle bst = NULL;
@@ -70,14 +75,14 @@ int main(int argc, char** argv) {
   CHECK(LGBM_BoosterGetCurrentIteration(bst, &iter));
   if (iter != 20) {
     fprintf(stderr, "iteration count wrong: %d\n", iter);
-    { fflush(NULL); _exit(1); }
+    FAIL(1);
   }
 
   int eval_count = 0;
   CHECK(LGBM_BoosterGetEvalCounts(bst, &eval_count));
   if (eval_count < 1) {
     fprintf(stderr, "eval count wrong: %d\n", eval_count);
-    { fflush(NULL); _exit(1); }
+    FAIL(1);
   }
   double* evals = (double*)malloc(sizeof(double) * eval_count);
   int eval_len = 0;
@@ -85,7 +90,7 @@ int main(int argc, char** argv) {
   if (eval_len < 1 || !(evals[0] < 0.5)) {
     fprintf(stderr, "train logloss did not improve: n=%d v=%f\n", eval_len,
             eval_len > 0 ? evals[0] : -1.0);
-    { fflush(NULL); _exit(1); }
+    FAIL(1);
   }
 
   int64_t pred_len = 0;
@@ -95,19 +100,19 @@ int main(int argc, char** argv) {
                                   preds));
   if (pred_len != n) {
     fprintf(stderr, "pred_len wrong: %lld\n", (long long)pred_len);
-    { fflush(NULL); _exit(1); }
+    FAIL(1);
   }
   int correct = 0;
   for (int i = 0; i < n; ++i) {
     if (!(preds[i] >= 0.0 && preds[i] <= 1.0) || isnan(preds[i])) {
       fprintf(stderr, "pred out of range at %d: %f\n", i, preds[i]);
-      { fflush(NULL); _exit(1); }
+      FAIL(1);
     }
     if ((preds[i] > 0.5) == (y[i] > 0.5f)) ++correct;
   }
   if (correct < (int)(0.9 * n)) {
     fprintf(stderr, "train accuracy too low: %d/%d\n", correct, n);
-    { fflush(NULL); _exit(1); }
+    FAIL(1);
   }
 
   /* model string round-trip: save, reload, predictions must match */
@@ -126,7 +131,7 @@ int main(int argc, char** argv) {
     if (fabs(preds[i] - preds2[i]) > 1e-6) {
       fprintf(stderr, "round-trip mismatch at %d: %f vs %f\n", i, preds[i],
               preds2[i]);
-      { fflush(NULL); _exit(1); }
+      FAIL(1);
     }
   }
 
@@ -136,7 +141,7 @@ int main(int argc, char** argv) {
   if (imp[0] + imp[1] <= imp[2] + imp[3]) {
     fprintf(stderr, "importance order wrong: %f %f %f %f\n", imp[0], imp[1],
             imp[2], imp[3]);
-    { fflush(NULL); _exit(1); }
+    FAIL(1);
   }
 
   CHECK(LGBM_BoosterFree(bst2));
@@ -149,5 +154,5 @@ int main(int argc, char** argv) {
   free(X);
   free(y);
   printf("NATIVE_CAPI_OK\n");
-  { fflush(NULL); _exit(0); }
+  FAIL(0);
 }

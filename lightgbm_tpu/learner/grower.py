@@ -432,6 +432,30 @@ class TreeGrower:
             Log.warning("hist_split_route ignored: it needs the tiled "
                         "fused path (quantized_grad on a single TPU "
                         "device, frontier within the packed ladder)")
+        # leaf-partitioned formulation (reference DataPartition insight,
+        # data_partition.hpp:109-161, under static shapes): rows are
+        # physically regrouped into block-aligned per-leaf segments each
+        # round and the histogram kernel runs an (8, C) weight-strip dot
+        # per block — no leaf one-hot, 16x less MXU work per streamed
+        # byte.  "auto" resolves OFF: the per-round permutation
+        # maintenance (XLA sort + row gathers) costs more than the MXU
+        # rows the segment dot frees — the measured decomposition is
+        # docs/PARTITION_DESIGN.md's round-6 record; the knob stays for
+        # on-chip A/B and for a future Mosaic dynamic-lane-gather
+        lp = str(getattr(config, "hist_leaf_partition", "auto")).lower()
+        want_lp = lp in ("on", "true", "1")
+        self.leaf_part = want_lp and self.use_tiled and self.use_fused
+        if want_lp and not self.leaf_part:
+            Log.warning("hist_leaf_partition=on ignored: it needs the "
+                        "tiled fused path (quantized_grad on a single "
+                        "TPU device, frontier within the packed ladder)")
+        # partition granularity = segment alignment unit = seg-kernel
+        # row block: small blocks waste less alignment capacity
+        # (num_leaves+1 buckets each pad up to one block), large blocks
+        # amortize the per-block fixed costs.  512 always divides
+        # n_padded here — the tiled path this rides on requires
+        # n_padded % 1024 == 0 (pallas_ok above)
+        self.leaf_part_block = 512
         self.use_quant_otf = (self.use_quant_otf and not self.use_fused
                               and not self.use_tiled)
         self.use_pre_ohb = (self.use_pallas and not self.pallas_paired
@@ -815,6 +839,59 @@ class TreeGrower:
                                      slots.shape[0])
 
     # ------------------------------------------------------------------
+    def _build_partition(self, leaf_id, quant):
+        """One round's leaf partition: the stable block-aligned segment
+        permutation plus the PARTITIONED operand copies (transposed
+        bins, quantized weights) the segment kernel streams.  Built
+        once per round and shared by the rights and parents passes.
+        The two row gathers here are the formulation's dominant cost —
+        see the cost note on ops/partition.py build_leaf_partition."""
+        from ..ops.partition import apply_partition, build_leaf_partition
+        wT, scales = quant                               # (3, N) int32
+        perm, blk_leaf, _ = build_leaf_partition(
+            leaf_id, num_slots=self.num_leaves,
+            block=self.leaf_part_block)
+        binsT_p = apply_partition(self.binsT, perm, axis=1)
+        wT_p = apply_partition(wT, perm, axis=1)
+        return binsT_p, wT_p, blk_leaf, scales
+
+    # ------------------------------------------------------------------
+    def _hist_kernel_seg(self, part, slots):
+        """Segment-addressed dispatch: map each partition block's
+        owning leaf to its frontier-slot position (tiny-table lookup)
+        and run the leaf-partitioned kernel at the narrowest output
+        width covering the valid slots (the seg kernel's VMEM
+        accumulator is 8 sublanes per slot, so wide frontiers ride the
+        same PACKED_STRIP ladder as the slot-packed kernels).  Valid
+        slots always occupy a PREFIX of ``slots`` (_round queues them
+        that way), so capping num_out at the ladder rung is safe.
+        Output follows ``slots`` order like every frontier kernel."""
+        from ..ops.histogram import compute_group_histograms_seg_tiled
+        binsT_p, wT_p, blk_leaf, scales = part
+        L1 = self.num_leaves + 1
+        W = slots.shape[0]
+        inv = jnp.full(L1, -1, jnp.int32).at[
+            jnp.where(slots >= 0, slots, L1)].set(
+            jnp.arange(W, dtype=jnp.int32), mode="drop")
+        blk_slot = jnp.where(blk_leaf >= 0,
+                             inv[jnp.clip(blk_leaf, 0, L1 - 1)], -1)
+
+        def run(num_out):
+            # positions >= num_out can only belong to invalid slots
+            # under the dispatch's count condition; mask them so the
+            # dynamic sublane write stays in bounds regardless
+            bs = jnp.where(blk_slot < num_out, blk_slot, -1)
+            return compute_group_histograms_seg_tiled(
+                binsT_p, wT_p, scales, bs, num_out=num_out,
+                max_group_bin=self.max_group_bin,
+                block=self.leaf_part_block, interpret=self._interp)
+
+        return self._packed_dispatch(
+            lambda _: run(W),
+            lambda strips: run(min(strips * PACKED_STRIP, W)),
+            slots, W)
+
+    # ------------------------------------------------------------------
     def _hist_kernel_q_otf(self, leaf_id, slots, L, quant):
         """Quantized on-the-fly dispatch: the packed-lane int8 kernel
         rebuilds the bin one-hot in VMEM (HBM stream = the (N, G) packed
@@ -1064,7 +1141,21 @@ class TreeGrower:
         cfg = self.cfg_scalars
         cache = st.hist_cache
 
-        if self.use_fused and self.split_route:
+        part = None
+        if self.use_fused and self.leaf_part:
+            # leaf-partitioned round: apply the pending route in its own
+            # Pallas pass, regroup rows into per-leaf segments ONCE (the
+            # permutation is amortized across the rights and — in
+            # no-cache mode — parents passes), then run the segment-
+            # addressed kernel whose LHS carries no leaf one-hot
+            from ..ops.histogram import route_only_tiled
+            new_leaf = route_only_tiled(
+                self.binsT, st.leaf_id, st.route_tab,
+                block=self.pallas_block_tiled, interpret=self._interp)
+            st = st._replace(leaf_id=new_leaf)
+            part = self._build_partition(new_leaf, quant)
+            right_hist = self._hist_kernel_seg(part, rights)
+        elif self.use_fused and self.split_route:
             # split-route: apply the pending table in a dedicated
             # Pallas pass, then histogram with the route-free kernel
             from ..ops.histogram import route_only_tiled
@@ -1087,6 +1178,11 @@ class TreeGrower:
         safe_p = jnp.clip(parents, 0, L - 1)
         if self.use_hist_cache:
             left_hist = cache[safe_p] - right_hist
+        elif self.use_fused and self.leaf_part:
+            # the round's partition serves the parents pass too — the
+            # parent slots host the LEFT children's (already-routed) rows
+            left_hist = self.policy.constrain_hist(
+                self._hist_kernel_seg(part, parents))
         elif self.use_fused and self.split_route:
             left_hist = self.policy.constrain_hist(
                 self._hist_kernel_q_tiled(st.leaf_id, parents, quant))

@@ -1081,11 +1081,15 @@ def _tiled_lhs(leaf, w, slot_col, *, strip, strips):
     return jnp.where(ohl, wl, jnp.zeros((), jnp.int32)).astype(jnp.int8)
 
 
-def _tiled_onehot_dots(lhs, binb, out_ref, *, max_group_bin, num_groups):
+def _tiled_onehot_dots(lhs, binb, out_ref, *, max_group_bin, num_groups,
+                       row_start=None):
     """Shared tiled-iota histogram accumulate: rebuild the bin one-hot
     per 128-lane tile from the (G, C) int32 bins block and dot ``lhs``
     ((m_pad, C) int8) into the tile's output slice.  See
-    _hist_kernel_body_q_tiled for the layout contract."""
+    _hist_kernel_body_q_tiled for the layout contract.  With
+    ``row_start`` (a traced scalar) the contribution lands in the
+    dynamic sublane window [row_start, row_start + lhs rows) — the
+    segment-addressed kernel's per-slot strip."""
     b = max_group_bin
     c = binb.shape[1]
     per_tile = max(1, 128 // b)
@@ -1104,9 +1108,14 @@ def _tiled_onehot_dots(lhs, binb, out_ref, *, max_group_bin, num_groups):
         if gs * b < tile_w:
             target = jnp.where(siota < gs * b, target, -1)
         oh = (target == siota).astype(jnp.int8)          # (tile_w, C)
-        out_ref[:, t * tile_w:(t + 1) * tile_w] += jax.lax.dot_general(
+        contrib = jax.lax.dot_general(
             lhs, oh, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32)
+        if row_start is None:
+            out_ref[:, t * tile_w:(t + 1) * tile_w] += contrib
+        else:
+            out_ref[pl.ds(row_start, lhs.shape[0]),
+                    t * tile_w:(t + 1) * tile_w] += contrib
 
 
 def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
@@ -1344,6 +1353,103 @@ def compute_group_histograms_fused_tiled(
     hist = _tiled_out_to_hist(out, strips, num_groups, b).astype(
         jnp.float32) * scales[None, None, None, :]
     return hist, leaf_out[0]
+
+
+def _hist_kernel_body_seg_tiled(blk_slot_ref, binsT_ref, wT_ref, out_ref,
+                                *, max_group_bin, num_groups):
+    """Segment-addressed tiled-iota kernel — the leaf-partitioned
+    formulation's histogram pass.  Rows arrive PHYSICALLY grouped by
+    leaf (ops/partition.py build_leaf_partition: block-aligned
+    segments), so each row block belongs to exactly ONE frontier slot
+    (``blk_slot_ref``, scalar-prefetched) and the LHS is the raw
+    (8, C) weight strip — rows 0..2 the quantized grad/hess/count
+    channels, rows 3..7 zero.  The leaf one-hot, its VPU build cost,
+    and the 128-row systolic dot (of which the slot-packed kernels use
+    3/128 per slot) all disappear: the dot runs 8 rows, 16x less MXU
+    work per streamed byte.  Dead blocks (slot -1: alignment gaps,
+    non-frontier segments, capacity tail) skip compute but still pay
+    their stream DMA — the formulation's floor is the stream, not the
+    dot (docs/PARTITION_DESIGN.md round-6 record has the full
+    decomposition)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    k = blk_slot_ref[i]
+
+    @pl.when(k >= 0)
+    def _accum():
+        c = wT_ref.shape[1]
+        w = wT_ref[:]                                    # (3, C) int32
+        riota = jax.lax.broadcasted_iota(jnp.int32, (8, c), 0)
+        wl = jnp.where(riota == 0, w[0:1, :],
+                       jnp.where(riota == 1, w[1:2, :],
+                                 jnp.where(riota == 2, w[2:3, :],
+                                           jnp.zeros((), jnp.int32))))
+        lhs = wl.astype(jnp.int8)                        # (8, C)
+        binb = binsT_ref[:].astype(jnp.int32)            # (G, C)
+        _tiled_onehot_dots(lhs, binb, out_ref,
+                           max_group_bin=max_group_bin,
+                           num_groups=num_groups, row_start=8 * k)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_out", "max_group_bin", "block",
+                              "interpret"))
+def compute_group_histograms_seg_tiled(
+        binsT_p: jax.Array, wT_p: jax.Array, scales: jax.Array,
+        blk_slot: jax.Array, *, num_out: int, max_group_bin: int,
+        block: int = 512, interpret: bool = False) -> jax.Array:
+    """Leaf-partitioned histogram: inputs are in PARTITIONED row order
+    (binsT_p (G, n_cap) uint8 and wT_p (3, n_cap) int32 gathered
+    through a build_leaf_partition permutation; gap rows carry zero
+    weight), ``blk_slot`` maps each row block to its output slot (-1 =
+    skip).  Returns (num_out, G, B, 3) f32 dequantized by ``scales`` —
+    same output contract as compute_group_histograms_q_tiled with
+    ``slots`` replaced by the block map.  VMEM note: the accumulator is
+    (8*num_out, hist_width) int32 — 7.2 MB at num_out=126 and the
+    bench shape, so wide frontiers want the caller to cap num_out the
+    way the slot-packed ladder does."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_groups = binsT_p.shape[0]
+    b = max_group_bin
+    per_tile = max(1, 128 // b)
+    tile_w = 128 if b <= 128 else _round_up(b, 128)
+    num_tiles = (num_groups + per_tile - 1) // per_tile
+    n_cap = binsT_p.shape[1]
+    if n_cap % block != 0:
+        raise ValueError(
+            f"n_cap ({n_cap}) must be a multiple of block ({block})")
+    m_out = 8 * num_out
+    kern = functools.partial(_hist_kernel_body_seg_tiled,
+                             max_group_bin=b, num_groups=num_groups)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_cap // block,),
+        in_specs=[
+            pl.BlockSpec((num_groups, block), lambda i, bs: (0, i)),
+            pl.BlockSpec((3, block), lambda i, bs: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m_out, num_tiles * tile_w),
+                               lambda i, bs: (0, 0)),
+    )
+    out = pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_out, num_tiles * tile_w),
+                                       jnp.int32),
+        interpret=interpret,
+    )(blk_slot.astype(jnp.int32), binsT_p, wT_p)
+    # slot k's channels live in rows [8k, 8k+3); tile layout matches
+    # the tiled-iota kernels (per_tile groups per 128-lane tile)
+    tiles = out.reshape(num_out, 8, num_tiles,
+                        tile_w)[:, :3, :, :per_tile * b]
+    full = tiles.reshape(num_out, 3, num_tiles * per_tile,
+                         b)[:, :, :num_groups]
+    hist = jnp.transpose(full, (0, 2, 3, 1))
+    return hist.astype(jnp.float32) * scales[None, None, None, :]
 
 
 def _transpose_pad_route(table: jax.Array) -> jax.Array:

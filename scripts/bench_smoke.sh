@@ -1,0 +1,23 @@
+#!/bin/sh
+# Fast bench.py plumbing check: tiny shapes, two iterations per scale,
+# no local-reference anchors, no f32 rerun.  Catches import/flag/JSON
+# regressions in the bench driver (the r5 bench shipped with a path
+# that could only fail under the perf driver, rc=124) from the test
+# suite instead — tests/test_bench_smoke.py runs this under the `slow`
+# marker and asserts the one-line JSON contract.
+#
+# Runs on whatever backend JAX selects (CPU included); the point is
+# plumbing, not performance.
+set -e
+cd "$(dirname "$0")/.."
+BENCH_ROWS=${BENCH_ROWS:-4096} \
+BENCH_ITERS=${BENCH_ITERS:-2} \
+BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \
+BENCH_LEAVES=${BENCH_LEAVES:-31} \
+BENCH_BIG=0 \
+BENCH_LTR_QUERIES=${BENCH_LTR_QUERIES:-40} \
+BENCH_LTR_ITERS=${BENCH_LTR_ITERS:-2} \
+BENCH_LOCAL_REF=0 \
+BENCH_SKIP_F32=1 \
+BENCH_BUDGET_S=${BENCH_BUDGET_S:-600} \
+exec python bench.py

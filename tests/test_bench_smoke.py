@@ -1,0 +1,43 @@
+"""bench.py plumbing regression gate.
+
+The r5 perf artifact was an rc=124 timeout — a bench-only code path
+(unbudgeted local-reference anchors) that nothing in the suite
+exercised.  This runs the tiny-N smoke driver (scripts/bench_smoke.sh:
+BENCH_ITERS=2, BENCH_LOCAL_REF=0) as a subprocess and pins the bench's
+stdout contract: exactly one parseable JSON line carrying every field
+the perf driver reads.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_smoke_json_contract():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_CHUNK="1")
+    run = subprocess.run(
+        ["sh", os.path.join(REPO, "scripts", "bench_smoke.sh")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=560)
+    assert run.returncode == 0, (run.stdout or "")[-2000:] + \
+        (run.stderr or "")[-2000:]
+    lines = [ln for ln in run.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got {lines!r}"
+    out = json.loads(lines[0])
+    for field in ("metric", "value", "unit", "vs_baseline", "auc",
+                  "auc_delta", "scales", "budget"):
+        assert field in out, f"missing {field}"
+    assert out["budget"]["elapsed_s"] <= out["budget"]["budget_s"]
+    tasks = {s.get("task", "binary") for s in out["scales"]}
+    assert "lambdarank" in tasks, "LTR scale must run in the smoke"
+    ltr = next(s for s in out["scales"] if s.get("task") == "lambdarank")
+    # the same-data NDCG gate must EXECUTE or say why it didn't
+    assert "ndcg_gate" in ltr
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -296,6 +296,84 @@ def test_route_apply_tiled_matches_xla_interpret():
                                   np.asarray(want_val))
 
 
+def test_seg_tiled_matches_q_tiled_interpret():
+    """Leaf-partitioned segment kernel == slot-packed tiled-iota kernel
+    (exact int accumulation) across bin widths incl. the bench shape's
+    B=63, with negative slots, empty leaves, and padded rows."""
+    from lightgbm_tpu.ops.histogram import (
+        compute_group_histograms_q_tiled,
+        compute_group_histograms_seg_tiled, quantize_gradients)
+    from lightgbm_tpu.ops.partition import (apply_partition,
+                                            build_leaf_partition)
+
+    for seed, (N, G, B, L, block) in ((7, (1024, 4, 8, 10, 128)),
+                                      (8, (2048, 5, 63, 20, 256))):
+        rng = np.random.RandomState(seed)
+        leaf = rng.randint(-1, L, N).astype(np.int32)
+        bins = rng.randint(0, B, (N, G)).astype(np.uint8)
+        grad = rng.randn(N).astype(np.float32)
+        hess = np.abs(rng.randn(N)).astype(np.float32)
+        cnt = np.ones(N, np.float32)
+        wq, scales = quantize_gradients(
+            jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(cnt))
+        wT = wq.T
+        binsT = jnp.asarray(bins.T)
+        slots_np = rng.permutation(L)[:6].astype(np.int32)
+        slots_np[3] = -1
+        slots = jnp.asarray(slots_np)
+        ref = np.asarray(compute_group_histograms_q_tiled(
+            binsT, wT, scales, jnp.asarray(leaf), slots,
+            max_group_bin=B, block=256, strips=1,
+            interpret=True))[:slots.shape[0]]
+
+        perm, blk_leaf, _ = build_leaf_partition(
+            jnp.asarray(leaf), num_slots=L, block=block)
+        binsT_p = apply_partition(binsT, perm, axis=1)
+        wT_p = apply_partition(wT, perm, axis=1)
+        inv = np.full(L + 1, -1, np.int32)
+        for i, s in enumerate(slots_np):
+            if s >= 0:
+                inv[s] = i
+        blk_np = np.asarray(blk_leaf)
+        blk_slot = np.where(blk_np >= 0, inv[np.clip(blk_np, 0, L)],
+                            -1).astype(np.int32)
+        got = np.asarray(compute_group_histograms_seg_tiled(
+            binsT_p, wT_p, scales, jnp.asarray(blk_slot),
+            num_out=slots.shape[0], max_group_bin=B, block=block,
+            interpret=True))
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_leaf_partition_grows_identical_trees():
+    """hist_leaf_partition=on (per-round physical regrouping + the
+    segment-addressed kernel) must grow byte-identical models to the
+    default fused tiled decomposition — the formulation changes the
+    kernels, not the semantics.  Runs on the interpret-mode CPU seam
+    like the split-route A/B test above."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(1536, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(1536)
+         > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+            "quantized_grad": True, "hist_compute_dtype": "bfloat16",
+            "force_pallas_interpret": True, "min_data_in_leaf": 5}
+    m0 = lgb.train(base, lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    m1 = lgb.train(dict(base, hist_leaf_partition="on"),
+                   lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    assert m0.model_to_string() == m1.model_to_string()
+
+    # no-cache mode histograms BOTH children through the partition —
+    # the parents pass shares the round's permutation
+    nc0 = lgb.train(dict(base, histogram_pool_size=0.001),
+                    lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    nc1 = lgb.train(dict(base, histogram_pool_size=0.001,
+                         hist_leaf_partition="on"),
+                    lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    assert nc0.model_to_string() == nc1.model_to_string()
+
+
 def test_split_route_grows_identical_trees():
     """hist_split_route=True (dedicated route_only_tiled pass + plain
     tiled histograms) must grow byte-identical models to the default

@@ -121,6 +121,40 @@ def test_onchip_q_tiled_kernel(case):
     assert _close(ref, got[:31], tol=2e-2)
 
 
+def test_onchip_seg_tiled_kernel(case):
+    """Leaf-partitioned segment kernel (r6, gated off by default):
+    Mosaic must accept the scalar-prefetched block map + dynamic
+    sublane accumulate, and the int32 accumulation must match the
+    slot-packed tiled kernel exactly.  This is the one-flag A/B the
+    r6 rejection record defers to chip-having sessions
+    (docs/PARTITION_DESIGN.md)."""
+    from lightgbm_tpu.ops.histogram import (
+        compute_group_histograms_q_tiled,
+        compute_group_histograms_seg_tiled)
+    from lightgbm_tpu.ops.partition import (apply_partition,
+                                            build_leaf_partition)
+    bins, grad, hess, cnt, leaf, ref, (N, G, B, L) = case
+    wq, scales = quantize_gradients(grad, hess, cnt)
+    slots = jnp.arange(31, dtype=jnp.int32)
+    binsT = jnp.asarray(np.asarray(bins).T)
+    want = compute_group_histograms_q_tiled(
+        binsT, wq.T, scales, leaf, slots, max_group_bin=B, block=1024,
+        strips=1)
+    perm, blk_leaf, _ = build_leaf_partition(leaf, num_slots=L,
+                                             block=512)
+    binsT_p = apply_partition(binsT, perm, axis=1)
+    wT_p = apply_partition(wq.T, perm, axis=1)
+    inv = jnp.full(L + 1, -1, jnp.int32).at[slots].set(
+        jnp.arange(slots.shape[0], dtype=jnp.int32))
+    blk_slot = jnp.where(blk_leaf >= 0,
+                         inv[jnp.clip(blk_leaf, 0, L)], -1)
+    got = compute_group_histograms_seg_tiled(
+        binsT_p, wT_p, scales, blk_slot, num_out=31, max_group_bin=B,
+        block=512)
+    np.testing.assert_array_equal(np.asarray(want)[:31],
+                                  np.asarray(got))
+
+
 def test_onchip_fused_tiled_kernel(case):
     """Fused route + tiled-iota kernel — the kernel the DEFAULT
     training path actually executes every round (grower run():

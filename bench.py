@@ -74,6 +74,12 @@ LOCAL_REF_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "LOCAL_REF.json")
 
 
+# measured axon-tunnel host round trip (docs/ROOFLINE.md r5) — the
+# dispatch cost a remote-attached chunk amortizes; used to report what
+# dispatch_chunk=auto WOULD pick on such a host from this run's slope
+AXON_DISPATCH_S = 0.22
+
+
 def budget_left() -> float:
     return BENCH_BUDGET_S - (time.time() - _T0)
 
@@ -114,6 +120,66 @@ def _local_ref_load() -> dict:
             return json.load(f)
     except (OSError, ValueError):
         return {}
+
+
+_EXPECTED_KEY_FIELDS = frozenset(
+    ("rows", "iters", "seed", "nl", "mb", "lr", "mdl", "msh",
+     "threads", "host"))
+_REQUIRED_RECORD_FIELDS = ("per_tree_ms", "threads", "iters")
+_LOCAL_REF_NOTES: list = []
+_LOCAL_REF_BAD: set = set()
+
+
+def validate_local_ref():
+    """Anchor-cache validation at bench startup (round 7): every
+    LOCAL_REF.json record's key must parse into exactly the CURRENT
+    key field set (_local_ref_key) and its payload must carry the
+    schema the ratios read — a record written by an older/newer key
+    format, or measured on a different host CPU, emits a skip-note
+    instead of silently anchoring this run.  Returns
+    (notes, bad_keys); bad keys are never served."""
+    data = _local_ref_load()
+    notes, bad = [], set()
+    host = _host_tag()
+    for key, rec in data.items():
+        if key == "_schema":          # documentation entry, not a record
+            continue
+        parts = str(key).split(":")
+        fields = {}
+        ok_parse = len(parts) >= 2
+        for p in parts[1:]:
+            if "=" not in p:
+                ok_parse = False
+                break
+            k, v = p.split("=", 1)
+            fields[k] = v
+        if not ok_parse or set(fields) != _EXPECTED_KEY_FIELDS:
+            missing = sorted(_EXPECTED_KEY_FIELDS - set(fields))
+            extra = sorted(set(fields) - _EXPECTED_KEY_FIELDS)
+            notes.append(
+                f"anchor key {key!r}: key-set drift (missing fields "
+                f"{missing}, unexpected {extra}) — record ignored; "
+                "re-measure with BENCH_LOCAL_REF_REFRESH=1")
+            bad.add(key)
+            continue
+        schema_ok = (isinstance(rec, dict)
+                     and ("skipped" in rec
+                          or (all(f in rec
+                                  for f in _REQUIRED_RECORD_FIELDS)
+                              and ("auc" in rec or "ndcg10" in rec))))
+        if not schema_ok:
+            notes.append(
+                f"anchor {key!r}: record schema drift (expected "
+                f"{list(_REQUIRED_RECORD_FIELDS)} + auc|ndcg10) — "
+                "record ignored")
+            bad.add(key)
+            continue
+        if fields["host"] != host:
+            notes.append(
+                f"anchor {key!r}: measured on host CPU "
+                f"{fields['host']!r}, this host is {host!r} — kept "
+                "for that host, cannot anchor this run")
+    return notes, bad
 
 
 def _local_ref_store(key: str, record: dict) -> None:
@@ -162,9 +228,74 @@ def auc_score(y, s):
     return float((ranks[pos].sum() - np_ * (np_ + 1) / 2) / (np_ * nn))
 
 
+def timed_chunks(gbdt, iters, chunk):
+    """Run the warm training loop in ``chunk``-sized fused dispatches
+    with the wall clock SPLIT into host/dispatch time (how long each
+    train_chunk call takes to RETURN — the async enqueue, which on a
+    remote-attached chip carries the dispatch RPC) and device wait
+    (the remainder up to the drain).  The split is what tracks
+    ROOFLINE headroom #3 (the ≈1-2 ms/tree host gap) as a series.
+    Returns the timing dict shared by every bench scale."""
+    def drain():
+        np.asarray(gbdt.scores[:, :8])
+
+    t0 = time.time()
+    gbdt.train_chunk(chunk)
+    drain()
+    compile_s = time.time() - t0
+    n_chunks = max(1, (iters - chunk) // chunk)
+    host_s = 0.0
+    t0 = time.time()
+    for _ in range(n_chunks):
+        tc = time.time()
+        gbdt.train_chunk(chunk)
+        host_s += time.time() - tc
+    drain()
+    steady_s = time.time() - t0
+    trees = n_chunks * chunk
+    return {
+        "compile_s": compile_s,
+        "steady_s": steady_s,
+        "per_tree": steady_s / trees,
+        "trees_total": trees + chunk,
+        "host_dispatch_s": host_s,
+        "device_wait_s": steady_s - host_s,
+        "host_ms_per_tree": host_s / trees * 1e3,
+        "device_ms_per_tree": (steady_s - host_s) / trees * 1e3,
+    }
+
+
+def chunk_slope_probe(gbdt, probes=(4, 16)):
+    """Fit the per-iteration chunk-slope series the r6 diagnosis
+    tracks, reported for BOTH this host's measured dispatch cost and
+    the known axon-RPC cost (the on-chip dispatch_chunk=auto
+    expectation).  Delegates to GBDT.tune_dispatch_chunk — the
+    dispatch_chunk=auto implementation — so the bench reports exactly
+    what auto would fit, including its compile-discard double pass,
+    return-vs-drain split and early-stop handling.  Consumes 2·Σprobes
+    real training iterations."""
+    from lightgbm_tpu.boosting.gbdt import pick_dispatch_chunk
+
+    chunk, info = gbdt.tune_dispatch_chunk(probes=probes)
+    probe_ms = {str(c): round(t * 1e3, 3)
+                for c, t in info.get("probe_per_tree_s", {}).items()}
+    if info.get("stopped") or "slope_s_per_iter" not in info:
+        return {"stopped": True, "probe_per_tree_ms": probe_ms}
+    base, slope = info["base_s"], info["slope_s_per_iter"]
+    return {
+        "probe_per_tree_ms": probe_ms,
+        "base_ms": round(base * 1e3, 3),
+        "slope_ms_per_iter": round(slope * 1e3, 4),
+        "host_dispatch_ms": round(info["dispatch_s"] * 1e3, 2),
+        "auto_pick_local": chunk,
+        "auto_pick_axon_rpc": pick_dispatch_chunk(base, slope,
+                                                  AXON_DISPATCH_S),
+    }
+
+
 def train_timed(cfg_params, X, y, iters):
     """Train ``iters`` trees; returns (gbdt, cfg, dtrain, prep_s,
-    compile_s, per_tree_s, cold_total_s)."""
+    timing dict — see timed_chunks)."""
     import lightgbm_tpu as lgb
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
@@ -176,55 +307,73 @@ def train_timed(cfg_params, X, y, iters):
     prep_s = time.time() - t0
     gbdt = GBDT(cfg, core)
 
-    def drain():
-        np.asarray(gbdt.scores[:, :8])
-
     chunk = max(1, min(int(os.environ.get("BENCH_CHUNK", 10)),
                        iters // 2))
-    t0 = time.time()
-    gbdt.train_chunk(chunk)
-    drain()
-    compile_s = time.time() - t0
-    n_chunks = max(1, (iters - chunk) // chunk)
-    t0 = time.time()
-    for _ in range(n_chunks):
-        gbdt.train_chunk(chunk)
-    drain()
-    steady_s = time.time() - t0
-    per_tree = steady_s / (n_chunks * chunk)
+    timing = timed_chunks(gbdt, iters, chunk)
     # the economics a first-time user actually pays: dataset prep +
     # first (compiling) chunk + the remaining chunks, as measured —
     # NOT the warm per-tree extrapolation the headline `value` reports
-    cold_total_s = prep_s + compile_s + steady_s
-    return gbdt, cfg, dtrain, prep_s, compile_s, per_tree, cold_total_s
+    timing["cold_total_s"] = prep_s + timing["compile_s"] \
+        + timing["steady_s"]
+    return gbdt, cfg, dtrain, prep_s, timing
+
+
+def attach_timing(out: dict, timing: dict) -> dict:
+    """Copy the host/device wall split (and the chunk-slope fit when
+    the probe ran) from a timed_chunks dict into a scale record — the
+    series ROOFLINE headroom #3 tracks."""
+    out["host_dispatch_ms_per_tree"] = round(
+        timing["host_ms_per_tree"], 3)
+    out["device_wait_ms_per_tree"] = round(
+        timing["device_ms_per_tree"], 3)
+    if "chunk_slope" in timing:
+        out["chunk_slope"] = timing["chunk_slope"]
+    return out
 
 
 def heldout_scores(gbdt, cfg, vbins_np):
     """Raw scores of the trained ensemble on a held-out binned matrix,
-    computed on device AFTER timing (one scan per pending tree stack)."""
+    computed on device AFTER timing (one scan per pending tree stack;
+    packed-carry stacks unpack their byte records inside the scan)."""
     import jax
     import jax.numpy as jnp
-    from lightgbm_tpu.ops.predict import predict_binned
+    from lightgbm_tpu.ops.predict import (predict_binned,
+                                          unpack_tree_records_device)
 
     g = gbdt.grower
     vbins = jnp.asarray(vbins_np)
     shrink = gbdt.shrinkage_rate
 
+    def acc(total, tr):
+        pv = predict_binned(tr, vbins, g.f_group, g.g2f_lut,
+                            g.f_missing, g.f_default_bin, g.f_num_bin,
+                            max_steps=cfg.num_leaves)
+        return total + shrink * pv
+
     @jax.jit
     def acc_stack(total, stack):
-        def body(carry, tr):
-            pv = predict_binned(tr, vbins, g.f_group, g.g2f_lut,
-                                g.f_missing, g.f_default_bin, g.f_num_bin,
-                                max_steps=cfg.num_leaves)
-            return carry + shrink * pv, None
-        out, _ = jax.lax.scan(body, total, stack)
+        out, _ = jax.lax.scan(lambda c, tr: (acc(c, tr), None),
+                              total, stack)
+        return out
+
+    @jax.jit
+    def acc_recs(total, recs):
+        def body(carry, rec):
+            tr = unpack_tree_records_device(rec, cfg.num_leaves,
+                                            g.max_feature_bin)
+            return acc(carry, tr), None
+        out, _ = jax.lax.scan(body, total, recs)
         return out
 
     total = jnp.full(vbins.shape[0], gbdt.init_score, jnp.float32)
     for p in gbdt._pending:
-        assert p[0] == "stack", "bench expects chunked training"
-        for stack in p[1]:
-            total = acc_stack(total, stack)
+        assert p[0] in ("stack", "rstack"), "bench expects chunked training"
+        if p[0] == "rstack":
+            for k in range(p[1].shape[1]):
+                total = acc_recs(total, p[1][:, k])
+        else:
+            for stack in p[1]:
+                total = acc_stack(total, stack)
     return np.asarray(total)
 
 
@@ -318,22 +467,12 @@ def run_ltr_scale():
     prep_s = time.time() - t0
     gbdt = GBDT(cfg, core)
 
-    def drain():
-        np.asarray(gbdt.scores[:, :8])
-
     chunk = max(1, min(int(os.environ.get("BENCH_CHUNK", 10)),
                        iters // 2))
-    t0 = time.time()
-    gbdt.train_chunk(chunk)
-    drain()
-    compile_s = time.time() - t0
-    n_chunks = max(1, (iters - chunk) // chunk)
-    t0 = time.time()
-    for _ in range(n_chunks):
-        gbdt.train_chunk(chunk)
-    drain()
-    per_tree = (time.time() - t0) / (n_chunks * chunk)
-    iters = chunk * (1 + n_chunks)      # trees actually trained
+    timing = timed_chunks(gbdt, iters, chunk)
+    compile_s = timing["compile_s"]
+    per_tree = timing["per_tree"]
+    iters = timing["trees_total"]       # trees actually trained
 
     vcore = lgb.Dataset(Xv, label=yv, group=sizes_v,
                         reference=dtrain).construct(cfg)
@@ -354,6 +493,7 @@ def run_ltr_scale():
         "prep_s": round(prep_s, 3), "compile_s": round(compile_s, 3),
         "per_tree_ms": round(per_tree * 1e3, 2),
     }
+    attach_timing(out, timing)
     # measured same-machine anchor for the ranking point too (round-4
     # verdict #2: 1.49x rested entirely on the scaled denominator and
     # the NDCG gate was only vs-untrained — this runs the reference
@@ -430,7 +570,8 @@ def run_local_reference(X, y, Xv, yv, params, iters,
     threads = os.cpu_count() or 1
     key = _local_ref_key(task, X.shape[0], iters, seed, params, threads)
     if os.environ.get("BENCH_LOCAL_REF_REFRESH") != "1":
-        cached = _local_ref_load().get(key)
+        cached = (None if key in _LOCAL_REF_BAD
+                  else _local_ref_load().get(key))
         if cached is not None:
             print(f"local reference anchor reused from LOCAL_REF.json "
                   f"[{key}]", file=sys.stderr)
@@ -572,30 +713,38 @@ def run_higgs_real(params):
     Xt, yt = X[-500_000:], y[-500_000:]
     X, y = X[:-500_000], y[:-500_000]
     import lightgbm_tpu as lgb
-    (gbdt, cfg, dtrain, prep_s, compile_s, per_tree,
-     cold_total_s) = train_timed(params, X, y,
-                                 int(os.environ.get("BENCH_HIGGS_ITERS",
-                                                    100)))
+    gbdt, cfg, dtrain, prep_s, timing = train_timed(
+        params, X, y, int(os.environ.get("BENCH_HIGGS_ITERS", 100)))
     vcore = lgb.Dataset(Xt, label=yt, reference=dtrain).construct(cfg)
     auc = auc_score(yt, heldout_scores(gbdt, cfg, vcore.group_bins))
-    return {"rows": int(X.shape[0]), "task": "higgs_real",
-            "auc": round(auc, 6), "auc_published_ref": 0.845154,
-            "per_tree_ms": round(per_tree * 1e3, 2),
-            "prep_s": round(prep_s, 3)}
+    return attach_timing(
+        {"rows": int(X.shape[0]), "task": "higgs_real",
+         "auc": round(auc, 6), "auc_published_ref": 0.845154,
+         "per_tree_ms": round(timing["per_tree"] * 1e3, 2),
+         "prep_s": round(prep_s, 3)}, timing)
 
 
 def run_scale(rows, iters, params, check_f32, local_ref=False,
-              ref_iters=None):
+              ref_iters=None, slope_probe=False):
     """Train + evaluate one scale point; returns its metrics dict."""
     import lightgbm_tpu as lgb
 
     X, y, w = make_data(rows, BENCH_FEATURES)
     Xv, yv, _ = make_data(VALID_ROWS, BENCH_FEATURES, seed=8, w=w)
-    (gbdt, cfg, dtrain, prep_s, compile_s, per_tree,
-     cold_total_s) = train_timed(params, X, y, iters)
+    gbdt, cfg, dtrain, prep_s, timing = train_timed(
+        params, X, y, iters)
+    compile_s = timing["compile_s"]
+    per_tree = timing["per_tree"]
+    cold_total_s = timing["cold_total_s"]
     total_equiv = per_tree * iters
     vcore = lgb.Dataset(Xv, label=yv, reference=dtrain).construct(cfg)
     auc = auc_score(yv, heldout_scores(gbdt, cfg, vcore.group_bins))
+    if slope_probe:
+        # AFTER the headline timing and the held-out AUC: the probe
+        # appends 2·Σprobes real trees to THIS model only, and the f32
+        # comparison below trains exactly `iters` — probing earlier
+        # would put an ensemble-size mismatch inside the 1e-3 gate
+        timing["chunk_slope"] = chunk_slope_probe(gbdt)
 
     auc_f32 = auc
     if check_f32 and params.get("quantized_grad"):
@@ -605,7 +754,7 @@ def run_scale(rows, iters, params, check_f32, local_ref=False,
         del gbdt, dtrain, vcore
         gc.collect()
         p32 = dict(params, quantized_grad=False)
-        g32, c32, d32, _, _, _, _ = train_timed(p32, X, y, iters)
+        g32, c32, d32, _, _ = train_timed(p32, X, y, iters)
         v32 = lgb.Dataset(Xv, label=yv, reference=d32).construct(c32)
         auc_f32 = auc_score(yv, heldout_scores(g32, c32, v32.group_bins))
         del g32, d32, v32
@@ -633,6 +782,7 @@ def run_scale(rows, iters, params, check_f32, local_ref=False,
         "cold_total_s": round(cold_total_s, 3),
         "per_tree_ms": round(per_tree * 1e3, 2),
     }
+    attach_timing(out, timing)
     if local_ref:
         if ref_iters is None:
             ref_iters = int(os.environ.get("BENCH_REF_ITERS",
@@ -666,9 +816,20 @@ def main():
     if extra:
         params.update(json.loads(extra))
 
+    # anchor-cache validation BEFORE any scale consults LOCAL_REF.json:
+    # drifted keys/records become stderr skip-notes and are never
+    # served (round-7 satellite; silently anchoring against a stale
+    # key set was the failure mode)
+    notes, bad = validate_local_ref()
+    _LOCAL_REF_NOTES.extend(notes)
+    _LOCAL_REF_BAD.update(bad)
+    for n in notes:
+        print(f"LOCAL_REF validation: {n}", file=sys.stderr)
+
     check_f32 = os.environ.get("BENCH_SKIP_F32") != "1"
-    primary = run_scale(BENCH_ROWS, BENCH_ITERS, params, check_f32,
-                        local_ref=True)
+    primary = run_scale(
+        BENCH_ROWS, BENCH_ITERS, params, check_f32, local_ref=True,
+        slope_probe=os.environ.get("BENCH_SLOPE_PROBE", "1") != "0")
     scales = [primary]
     if os.environ.get("BENCH_BIG", "1") != "0" \
             and BENCH_ROWS_BIG > BENCH_ROWS:
@@ -710,10 +871,21 @@ def main():
         "prep_s": primary["prep_s"],
         "compile_s": primary["compile_s"],
         "cold_total_s": primary["cold_total_s"],
+        # ROOFLINE headroom #3 series: device wait vs host/dispatch
+        # wall, per tree, at the primary scale
+        "host_dispatch_ms_per_tree": primary["host_dispatch_ms_per_tree"],
+        "device_wait_ms_per_tree": primary["device_wait_ms_per_tree"],
         "scales": scales,
         "budget": {"budget_s": BENCH_BUDGET_S,
                    "elapsed_s": round(time.time() - _T0, 1)},
     }
+    if "chunk_slope" in primary:
+        # the round-6/7 per-iteration chunk-slope fit and what
+        # dispatch_chunk=auto would pick locally and on an axon-RPC
+        # host (the on-chip A/B expectation for the next session)
+        result["chunk_slope"] = primary["chunk_slope"]
+    if _LOCAL_REF_NOTES:
+        result["local_ref_validation"] = _LOCAL_REF_NOTES
     if "vs_local_reference" in primary:
         # the MEASURED same-machine ratio (round-3 verdict #2): the
         # actual reference CPU binary on the same data on this host —

@@ -461,19 +461,29 @@ class Booster:
         entries = g.device_trees[:n_trees]
         while i < len(entries):
             e = entries[i]
-            if isinstance(e, tuple) and e and e[0] == "stackref":
+            if isinstance(e, tuple) and e and e[0] in ("stackref",
+                                                       "recref"):
                 stack = e[1]
                 j0 = e[2]
                 j1 = j0
                 while (i + (j1 - j0) + 1 < len(entries)
                        and isinstance(entries[i + (j1 - j0) + 1], tuple)
-                       and entries[i + (j1 - j0) + 1][0] == "stackref"
+                       and entries[i + (j1 - j0) + 1][0] == e[0]
                        and entries[i + (j1 - j0) + 1][1] is stack
-                       and entries[i + (j1 - j0) + 1][2] == j1 + 1):
+                       and entries[i + (j1 - j0) + 1][2] == j1 + 1
+                       and entries[i + (j1 - j0) + 1][3:] == e[3:]):
                     j1 += 1
                 count = j1 - j0 + 1
-                part = jax.tree_util.tree_map(
-                    lambda x: x[j0:j0 + count], stack)
+                if e[0] == "recref":
+                    # packed-carry chunk: unpack the record rows on
+                    # device (static slices + bitcasts, no gathers)
+                    from .ops.predict import unpack_tree_records_device
+                    part = unpack_tree_records_device(
+                        stack[j0:j0 + count, e[3]], cfg.num_leaves,
+                        gr.max_feature_bin)
+                else:
+                    part = jax.tree_util.tree_map(
+                        lambda x: x[j0:j0 + count], stack)
                 sh = jnp.asarray(np.asarray(
                     shrinks[i:i + count], np.float32))
                 total = acc_jit(total, part, sh)

@@ -33,6 +33,30 @@ from ..tree import Tree
 from ..utils.log import Log, PhaseTimer
 
 
+def fit_chunk_slope(times: Dict[int, float]) -> Tuple[float, float]:
+    """Least-squares fit of the per-iteration chunk cost model
+    ``per_tree(c) = base + slope * c`` from {chunk_len: per_tree_s}
+    probe timings (the ROOFLINE round-6 fit: 25.75 + 0.075·c ms on
+    v5e with the legacy 18-buffer carry).  Returns (base_s, slope_s)."""
+    cs = np.asarray(sorted(times), dtype=np.float64)
+    ts = np.asarray([times[int(c)] for c in cs], dtype=np.float64)
+    slope, base = np.polyfit(cs, ts, 1)
+    return float(base), float(slope)
+
+
+def pick_dispatch_chunk(base_s: float, slope_s: float, dispatch_s: float,
+                        cmin: int = 10, cmax: int = 90) -> int:
+    """Amortization point of ``per_tree(c) = base + slope·c +
+    dispatch/c``: c* = sqrt(dispatch / slope), clamped to [cmin, cmax].
+    A non-positive slope (the packed carry's target state) means longer
+    chunks are free — take cmax and amortize the dispatch RPC fully."""
+    del base_s                     # the additive base doesn't move c*
+    if slope_s <= 0.0:
+        return cmax
+    c = (max(dispatch_s, 0.0) / slope_s) ** 0.5
+    return int(min(max(round(c), cmin), cmax))
+
+
 class _ValidSet:
     """Per-validation-set device state (the ScoreUpdater analog,
     reference score_updater.hpp:17-120)."""
@@ -150,6 +174,15 @@ class GBDT:
         self._fused_step = None
         self._fused_chunk = None
         self._fused_chunk_n = 0
+        # packed tree carry (round 7): the fused chunk stacks each
+        # tree as ONE byte-packed record (tree.TreeRecordLayout) so
+        # the scan carries 2 output buffers instead of 18 — the
+        # round-6 diagnosis traced the per-iteration chunk penalty to
+        # the backend's handling of the 18 O(chunk) stacked outputs.
+        # "off" restores the legacy per-field carry (parity-pinned).
+        self._packed_carry = str(getattr(config, "packed_tree_carry",
+                                         "auto")).lower() \
+            not in ("off", "false", "0")
         self._bag_state: Optional[jax.Array] = None
         # early stopping state per (dataset, metric-output)
         self._best_score: Dict[Tuple[int, int], float] = {}
@@ -373,9 +406,13 @@ class GBDT:
                                        fmask, shrinkage, fresh_bag,
                                        vb, ohb)
 
+        # no donation here either: the same heap corruption bisected on
+        # the fused chunk (see _build_fused_chunk) reproduces on this
+        # per-iteration program once several booster shapes jit it in
+        # one process — the C-API suite's flaky SIGABRT/SIGSEGV inside
+        # jax eager dispatch traced to exactly this path (r7)
         self._fused_step = jax.jit(
-            step, static_argnames=("fresh_bag", "sample_active"),
-            donate_argnums=(0, 1))
+            step, static_argnames=("fresh_bag", "sample_active"))
 
     # ------------------------------------------------------------------
     def _host_qkey(self, class_idx: int):
@@ -469,9 +506,18 @@ class GBDT:
         (measured ~40% of wall-clock at one call per iteration), so
         headless stretches of training run chunked.  The reference has
         no analog: its Train loop is host-driven per iteration
-        (gbdt.cpp:318-336)."""
+        (gbdt.cpp:318-336).
+
+        Packed carry (default): each iteration's K trees leave the
+        scan as ONE (K, record_size) uint8 stack (grower.emit_tree_
+        record), so the while-loop carry holds two O(chunk) output
+        buffers — the packed records and the num_leaves series — and
+        the per-iteration chunk penalty the 18-buffer carry paid
+        disappears (tests/test_carry_hlo.py pins this in compiled
+        HLO)."""
         vbins = tuple(vs.bins for vs in self.valid_sets)
         shrinkage = self.shrinkage_rate
+        packed = self._packed_carry
 
         def chunk(scores, vscores, bag_mask, keys, fmasks, fresh_flags,
                   ohb=None, cap=None):
@@ -483,6 +529,9 @@ class GBDT:
                 scores, vscores, bag_mask, trees, nl = self._boost_one(
                     scores, vscores, bag_mask, key, fmask, shrinkage,
                     fresh_bag, vb, ohb)
+                if packed:
+                    trees = jnp.stack(
+                        [self.grower.emit_tree_record(t) for t in trees])
                 return (scores, vscores, bag_mask), (trees, nl)
 
             with self._bound_captives(cap):
@@ -491,7 +540,20 @@ class GBDT:
                     (keys, fmasks, fresh_flags))
             return scores, vscores, bag_mask, trees, nls
 
-        return jax.jit(chunk, donate_argnums=(0, 1))
+        # score donation is DISABLED on the fused chunk: donating the
+        # scores buffer into the chunk program intermittently corrupts
+        # the host heap on this jaxlib's CPU backend (glibc "corrupted
+        # double-linked list" / SIGSEGV mid-run, ~50% of 90-iteration
+        # runs once more than one chunk shape is compiled — bisected
+        # across {packed, legacy} x {donate, no-donate}: every crashing
+        # combination donated, every non-donating one was stable over
+        # 20+ runs).  The cost is one scores-sized device copy per
+        # CHUNK — noise against the chunk body; revisit on a jaxlib
+        # upgrade.  The per-iteration _fused_step donation fell to the
+        # same bisect: the C-API suite's long-flaky mid-suite SIGABRT/
+        # SIGSEGV (many booster shapes jitted per process) stopped
+        # reproducing (0/8) once its donation was dropped too.
+        return jax.jit(chunk)
 
     def train_chunk(self, n_iters: int) -> bool:
         """Run n_iters boosting iterations in one device program.
@@ -560,17 +622,28 @@ class GBDT:
         self._bag_state = bag
         bias0 = self.init_score if (self.iter_ == 0 and
                                     self.init_score != 0.0) else 0.0
-        # trees stay STACKED on device ((n_iters, ...) leaves) until
-        # flush_models — slicing per tree here would cost hundreds of
-        # tiny dispatches, defeating the point of chunking
-        stacks = list(trees)                      # one stack per class
-        self._pending.append(("stack", stacks, n_iters,
-                              self.shrinkage_rate, bias0))
-        for j in range(n_iters):
-            for stack in stacks:
-                self.device_trees.append(("stackref", stack, j))
-                self._tree_scale.append(1.0)
-                self._tree_shrink.append(self.shrinkage_rate)
+        # trees stay STACKED on device until flush_models — slicing per
+        # tree here would cost hundreds of tiny dispatches, defeating
+        # the point of chunking.  Packed carry: ONE (n_iters, K,
+        # record_size) uint8 stack; legacy: one TreeArrays stack per
+        # class.
+        if self._packed_carry:
+            self._pending.append(("rstack", trees, n_iters,
+                                  self.shrinkage_rate, bias0))
+            for j in range(n_iters):
+                for k in range(self.num_class):
+                    self.device_trees.append(("recref", trees, j, k))
+                    self._tree_scale.append(1.0)
+                    self._tree_shrink.append(self.shrinkage_rate)
+        else:
+            stacks = list(trees)                  # one stack per class
+            self._pending.append(("stack", stacks, n_iters,
+                                  self.shrinkage_rate, bias0))
+            for j in range(n_iters):
+                for stack in stacks:
+                    self.device_trees.append(("stackref", stack, j))
+                    self._tree_scale.append(1.0)
+                    self._tree_shrink.append(self.shrinkage_rate)
         self._nl_window.append(nls)          # stays stacked on device
         self._nl_count += n_iters
         self.iter_ += n_iters
@@ -578,6 +651,62 @@ class GBDT:
         if self._nl_count >= self._stop_check_every:
             return self._check_stop_window()
         return False
+
+    def tune_dispatch_chunk(self, probes: Tuple[int, int] = (4, 16),
+                            cmin: int = 10, cmax: int = 90):
+        """``dispatch_chunk=auto``: re-fit the per-iteration chunk
+        slope from two timed probe chunks and pick the amortization
+        point.  Each probe size runs TWICE — the first call compiles
+        (discarded), the second is timed; probe chunks are real
+        training iterations, not throwaway work.  The host dispatch
+        cost is the time train_chunk takes to RETURN (the async
+        enqueue, which on a remote-attached TPU carries the ~220 ms
+        RPC); the slope is fitted on the REMAINDER (return-to-drain,
+        the device execution) — folding the dispatch into the fitted
+        times would subtract dispatch/(c1·c2) from the slope and bias
+        the pick toward cmax exactly where dispatch is large.
+
+        Returns (chunk, info) where info records the fit
+        (base_s/slope_s/dispatch_s/per-probe timings), the training
+        iterations consumed, and whether the deferred no-split check
+        stopped training mid-probe."""
+        import time as _time
+
+        times: Dict[int, float] = {}
+        disp = []
+        iters_used = 0
+        stopped = False
+        for c in probes:
+            for timed in (False, True):
+                t0 = _time.perf_counter()
+                stop = self.train_chunk(c)
+                t_return = _time.perf_counter() - t0
+                jax.block_until_ready(self.scores)
+                t_total = _time.perf_counter() - t0
+                iters_used += c
+                if timed:
+                    times[c] = (t_total - t_return) / c
+                    disp.append(t_return)
+                if stop:
+                    stopped = True
+                    break
+            if stopped:
+                break
+        if stopped or len(times) < 2:
+            return cmin, {"iters_used": iters_used, "stopped": stopped,
+                          "probe_per_tree_s": times}
+        base_s, slope_s = fit_chunk_slope(times)
+        dispatch_s = float(np.median(disp))
+        chunk = pick_dispatch_chunk(base_s, slope_s, dispatch_s,
+                                    cmin=cmin, cmax=cmax)
+        info = {"iters_used": iters_used, "stopped": False,
+                "probe_per_tree_s": times, "base_s": base_s,
+                "slope_s_per_iter": slope_s, "dispatch_s": dispatch_s,
+                "chunk": chunk}
+        Log.debug(f"dispatch_chunk=auto fit: base {base_s * 1e3:.2f} ms "
+                  f"+ {slope_s * 1e3:.4f} ms/iter·chunk, dispatch "
+                  f"{dispatch_s * 1e3:.1f} ms -> chunk {chunk}")
+        return chunk, info
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
@@ -729,13 +858,15 @@ class GBDT:
             return
         pending, self._pending = self._pending, []
         # ONE device->host transfer for everything queued: per-tree
-        # entries are stacked, chunk entries already are stacks
+        # entries are stacked, chunk entries already are stacks (packed
+        # record stacks travel as their single uint8 buffer)
         plain = [p[1] for p in pending if p[0] == "tree"]
         stacked_plain = (jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *plain) if plain else None)
         chunk_stacks = [p[1] for p in pending if p[0] == "stack"]
-        host_plain, host_chunks = jax.device_get(
-            (stacked_plain, chunk_stacks))
+        rec_stacks = [p[1] for p in pending if p[0] == "rstack"]
+        host_plain, host_chunks, host_recs = jax.device_get(
+            (stacked_plain, chunk_stacks, rec_stacks))
 
         def append_tree(arrs, shrinkage, bias):
             t = Tree.from_grower_arrays(arrs, self.train_set)
@@ -756,6 +887,8 @@ class GBDT:
 
         i_plain = 0
         i_chunk = 0
+        i_rec = 0
+        layout = self.grower.record_layout
         for p in pending:
             if p[0] == "tree":
                 _, _tree, shrinkage, bias = p
@@ -763,6 +896,15 @@ class GBDT:
                         for f in host_plain._fields}
                 append_tree(arrs, shrinkage, bias)
                 i_plain += 1
+            elif p[0] == "rstack":
+                _, _recs, n_iters, shrinkage, bias0 = p
+                recs = host_recs[i_rec]       # (chunk, K, record_size)
+                i_rec += 1
+                for j in range(n_iters):
+                    for k in range(recs.shape[1]):
+                        arrs = layout.unpack_tree_record(recs[j, k])
+                        append_tree(arrs, shrinkage,
+                                    bias0 if j == 0 else 0.0)
             else:
                 _, _stacks, n_iters, shrinkage, bias0 = p
                 stacks = host_chunks[i_chunk]
@@ -879,13 +1021,19 @@ class GBDT:
         return False
 
     # ------------------------------------------------------------------
-    @staticmethod
-    def _materialize_devtree(entry):
+    def _materialize_devtree(self, entry):
         """device_trees entry -> TreeArrays (chunk entries are lazy
-        slices of a stacked chunk)."""
+        slices of a stacked chunk; packed-carry entries unpack their
+        byte record on device)."""
         if isinstance(entry, tuple) and entry and entry[0] == "stackref":
             _, stack, j = entry
             return jax.tree_util.tree_map(lambda x: x[j], stack)
+        if isinstance(entry, tuple) and entry and entry[0] == "recref":
+            from ..ops.predict import unpack_tree_records_device
+            _, recs, j, k = entry
+            return unpack_tree_records_device(
+                recs[j, k], self.config.num_leaves,
+                self.grower.max_feature_bin)
         return entry
 
     def rollback_one_iter(self) -> None:
@@ -896,12 +1044,12 @@ class GBDT:
         shrinkage = self.shrinkage_rate
         if self._pending:
             last = self._pending[-1]
-            if last[0] == "stack":
-                _, stacks, n, shrinkage, bias0 = last
+            if last[0] in ("stack", "rstack"):
+                kind, stacks, n, shrinkage, bias0 = last
                 if n <= 1:
                     self._pending.pop()
                 else:
-                    self._pending[-1] = ("stack", stacks, n - 1,
+                    self._pending[-1] = (kind, stacks, n - 1,
                                          shrinkage, bias0)
             else:
                 for _ in range(self.num_class):
@@ -931,6 +1079,8 @@ class GBDT:
         for p in self._pending:
             if p[0] == "stack":
                 n += p[2] * len(p[1])
+            elif p[0] == "rstack":
+                n += p[2] * p[1].shape[1]
             else:
                 n += 1
         return n

@@ -404,6 +404,30 @@ class Config:
     # permutation maintenance costs more than the MXU rows it frees on
     # this hardware generation (measured decomposition:
     # docs/PARTITION_DESIGN.md round-6 record)
+    dispatch_chunk: str = "auto"    # boosting iterations fused into ONE
+    # device program (lax.scan) during headless training stretches: an
+    # integer pins the chunk length; "auto" re-fits the per-iteration
+    # chunk slope from two timed probe chunks at run start and picks
+    # the amortization point sqrt(dispatch_cost / slope) — on a
+    # remote-attached TPU each dispatch is a ~220 ms RPC, so larger
+    # chunks amortize it, while the per-iteration carry cost grows
+    # with chunk length (docs/ROOFLINE.md round-6/7).  The packed tree
+    # carry (packed_tree_carry) is what makes long chunks cheap; this
+    # knob is the one-flag on-chip A/B for chunk-90-at-chunk-10-speed
+    packed_tree_carry: str = "auto"  # carry each finished tree through
+    # the fused dispatch scan as ONE byte-packed record buffer
+    # (tree.TreeRecordLayout) instead of 18 separate stacked output
+    # arrays — the round-6 diagnosis traced the per-iteration chunk
+    # penalty to the TPU backend's handling of the 18 O(chunk) loop-
+    # carried output stacks.  auto = on; "off" restores the legacy
+    # 18-array carry (byte-identical trees either way, pinned by test)
+    split_finder_ladder: bool = True  # run the best-split finder and
+    # the candidate-cache scatter at the narrowest packed-strip width
+    # covering the ACTIVE frontier (lax.cond ladder, like the
+    # histogram kernels) instead of always the full frontier cap —
+    # early rounds of every tree have 1-2 new leaves, and the finder's
+    # (2W, F, B) threshold sweep was the last frontier-capped cost
+    # (ROOFLINE headroom #2).  False restores the full-width finder
     compile_cache_dir: str = "~/.cache/lightgbm_tpu/jit"  # persistent
     # XLA compilation cache directory (jax_compilation_cache_dir):
     # repeat processes skip the multi-second cold compile (37 s at the
@@ -467,6 +491,23 @@ class Config:
                 "auto", "on", "off", "true", "false", "1", "0"):
             raise ValueError("hist_leaf_partition must be auto/on/off, "
                              f"got {self.hist_leaf_partition!r}")
+        if str(self.packed_tree_carry).lower() not in (
+                "auto", "on", "off", "true", "false", "1", "0"):
+            raise ValueError("packed_tree_carry must be auto/on/off, "
+                             f"got {self.packed_tree_carry!r}")
+        dc = str(self.dispatch_chunk).lower()
+        if dc != "auto":
+            try:
+                # integral only — truncating "2.9" would silently train
+                # with a different chunk than the user pinned (inf/nan
+                # fail is_integer, so they land here too)
+                f = float(dc)
+                if not f.is_integer() or f < 1:
+                    raise ValueError
+            except ValueError:
+                raise ValueError("dispatch_chunk must be 'auto' or a "
+                                 f"positive integer, got "
+                                 f"{self.dispatch_chunk!r}") from None
         # distributed learners force row pre-partition semantics
         if self.tree_learner != "serial" and self.num_machines == 1 \
                 and not self.mesh_shape:

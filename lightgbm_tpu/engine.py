@@ -135,14 +135,48 @@ def train(params: Dict[str, Any], train_set: Dataset,
                  and not booster.gbdt.valid_sets
                  and not booster.gbdt.train_metrics
                  and booster.gbdt.can_chunk())
-    chunk_size = 10
+    # dispatch_chunk: iterations fused per device program.  An integer
+    # pins it; "auto" re-fits the per-iteration chunk slope from two
+    # probe chunks and picks the amortization point against the
+    # measured dispatch cost (GBDT.tune_dispatch_chunk).  The probe
+    # pass only runs where it can pay off — a real accelerator (the
+    # dispatch RPC is what's being amortized; on the CPU simulation it
+    # is sub-ms and auto degenerates to the default 10) and a run long
+    # enough to absorb the probe iterations.
+    chunk_cfg = str(config.dispatch_chunk).lower()
+    chunk_size = 10 if chunk_cfg in ("auto", "") \
+        else max(1, int(float(chunk_cfg)))
 
     stopped_early = False
     iteration = 0
+    if chunkable and chunk_cfg in ("auto", "") and num_boost_round >= 60:
+        import jax
+        if jax.default_backend() in ("tpu", "axon"):
+            chunk_size, info = booster.gbdt.tune_dispatch_chunk()
+            iteration += info["iters_used"]
+            if info.get("stopped"):
+                num_boost_round = iteration
+            else:
+                Log.info(
+                    f"dispatch_chunk=auto: fitted slope "
+                    f"{info['slope_s_per_iter'] * 1e3:.4f} ms/iter·chunk,"
+                    f" dispatch {info['dispatch_s'] * 1e3:.1f} ms -> "
+                    f"chunk {chunk_size}")
     while iteration < num_boost_round:
-        if chunkable and num_boost_round - iteration >= chunk_size:
+        remaining = num_boost_round - iteration
+        if chunkable and remaining >= chunk_size:
             stop = booster.gbdt.train_chunk(chunk_size)
             iteration += chunk_size
+            if stop:
+                break
+            continue
+        if chunkable and 10 <= remaining < chunk_size:
+            # tail after a large (auto-picked) chunk: one odd-length
+            # chunk — a single extra compile — instead of up to
+            # chunk_size-1 per-iteration dispatches, each paying the
+            # RPC the chunking exists to amortize
+            stop = booster.gbdt.train_chunk(remaining)
+            iteration += remaining
             if stop:
                 break
             continue

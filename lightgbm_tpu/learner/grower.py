@@ -62,9 +62,15 @@ from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
                              quantize_gradients)
 from ..ops.partition import (apply_route_table, apply_splits,
                              build_route_table)
-from ..ops.split import (SplitResult, build_cat_bitset,
-                         find_categorical_splits, find_numerical_splits,
-                         gather_split_at_threshold)
+from ..ops.split import (CAND_CAT_DIR, CAND_COLS, CAND_DEFAULT_LEFT,
+                         CAND_FEATURE, CAND_GAIN, CAND_LOUT, CAND_LSC,
+                         CAND_LSG, CAND_LSH, CAND_ROUT, CAND_THRESHOLD,
+                         FORCED_COLS, FORCED_DEFAULT_LEFT, FORCED_GAIN,
+                         FORCED_LOUT, FORCED_LSC, FORCED_LSG, FORCED_LSH,
+                         FORCED_ROUT, FORCED_THRESHOLD,
+                         build_cat_bitset, find_best_split_block,
+                         forced_split_block, run_split_finders)
+from ..tree import TreeRecordLayout
 
 NEG_INF = -jnp.inf
 
@@ -90,36 +96,6 @@ class TreeArrays(NamedTuple):
     node_right: jax.Array        # (M,) int32
 
 
-class SplitCand(NamedTuple):
-    """Cached best split per leaf slot — the best_split_per_leaf_ analog
-    (reference serial_tree_learner.h best_split_per_leaf_ + SplitInfo,
-    split_info.hpp:18-288) as a struct of arrays, all (L,) / (L, B)."""
-    gain: jax.Array
-    feature: jax.Array       # int32 inner feature idx
-    threshold: jax.Array     # int32
-    default_left: jax.Array  # bool
-    lsg: jax.Array           # left sum_grad
-    lsh: jax.Array           # left sum_hess
-    lsc: jax.Array           # left count
-    lout: jax.Array          # constrained left output
-    rout: jax.Array          # constrained right output
-    cat_dir: jax.Array       # int32
-    cat_mask: jax.Array      # (L, B) bool
-
-
-class ForcedCand(NamedTuple):
-    """Cached forced-split evaluation per leaf (ForceSplits semantics,
-    reference serial_tree_learner.cpp:543-698), all (L,)."""
-    gain: jax.Array
-    threshold: jax.Array
-    default_left: jax.Array
-    lsg: jax.Array
-    lsh: jax.Array
-    lsc: jax.Array
-    lout: jax.Array
-    rout: jax.Array
-
-
 class GrowerState(NamedTuple):
     leaf_id: jax.Array
     num_leaves: jax.Array        # scalar int32
@@ -134,8 +110,12 @@ class GrowerState(NamedTuple):
     leaf_forced: jax.Array       # (L,) int32 forced-split spec idx (-1 none)
     tree: TreeArrays
     hist_cache: jax.Array        # (L, G, Bg, 3) f32 — per-leaf group hists
-    cand: SplitCand
-    forced_cand: ForcedCand
+    cand: jax.Array              # (L, CAND_COLS + Bf) f32 — the packed
+    # best_split_per_leaf_ cache (reference serial_tree_learner.h +
+    # SplitInfo, split_info.hpp:18-288); column layout in ops/split.py,
+    # refreshed with ONE width-bounded scatter per round
+    forced_cand: jax.Array       # (L, FORCED_COLS) f32 — cached forced-
+    # split evaluation (ForceSplits, serial_tree_learner.cpp:543-698)
     pend_parents: jax.Array      # (W,) slots whose hist/cands are stale
     pend_rights: jax.Array       # (W,) — refreshed at the NEXT round's
     # start (so the final round's refresh is never computed at all)
@@ -230,6 +210,17 @@ class TreeGrower:
         # left to users who know their task tolerates it.
         self.frontier = min(config.num_leaves - 1,
                             config.frontier_width or 126)
+        # frontier ladder for the split finder (round 7, ROOFLINE
+        # headroom #2): run the finder + candidate scatter at the
+        # narrowest packed-strip width covering the ACTIVE frontier —
+        # the early rounds of every tree have 1-2 new leaves while the
+        # (2W, F, B) threshold sweep was always paying the full cap
+        self.split_ladder = bool(getattr(config, "split_finder_ladder",
+                                         True))
+        # packed tree-record carry (round 7): fixed-offset byte layout
+        # the fused dispatch scan carries as ONE output stack
+        self.record_layout = TreeRecordLayout(self.num_leaves,
+                                              self.max_feature_bin)
 
         # histogram memory governance (reference histogram_pool_size,
         # config.h:216 + HistogramPool LRU): when the per-leaf cache
@@ -950,6 +941,15 @@ class TreeGrower:
                                      slots.shape[0])
 
     # ------------------------------------------------------------------
+    def emit_tree_record(self, tree: TreeArrays) -> jax.Array:
+        """Serialize one grown tree into its packed byte record
+        (tree.TreeRecordLayout): static-offset in-place dynamic-update-
+        slice writes into one (record_size,) uint8 buffer.  The fused
+        dispatch chunk stacks THIS as its only O(chunk) tree output
+        (gbdt._build_fused_chunk) instead of 18 per-field stacks."""
+        return self.record_layout.pack_tree_record(tree)
+
+    # ------------------------------------------------------------------
     def _init_state(self, grad, hess, counts) -> GrowerState:
         L = self.num_leaves
         M = L - 1
@@ -981,23 +981,10 @@ class TreeGrower:
         leaf_forced = jnp.full(L, -1, jnp.int32)
         if self.forced_count:
             leaf_forced = leaf_forced.at[0].set(0)
-        cand = SplitCand(
-            gain=jnp.full(L, NEG_INF, jnp.float32),
-            feature=jnp.zeros(L, jnp.int32),
-            threshold=jnp.zeros(L, jnp.int32),
-            default_left=jnp.zeros(L, bool),
-            lsg=jnp.zeros(L, jnp.float32), lsh=jnp.zeros(L, jnp.float32),
-            lsc=jnp.zeros(L, jnp.float32), lout=jnp.zeros(L, jnp.float32),
-            rout=jnp.zeros(L, jnp.float32),
-            cat_dir=jnp.zeros(L, jnp.int32),
-            cat_mask=jnp.zeros((L, B), bool))
-        forced_cand = ForcedCand(
-            gain=jnp.full(L, NEG_INF, jnp.float32),
-            threshold=jnp.zeros(L, jnp.int32),
-            default_left=jnp.zeros(L, bool),
-            lsg=jnp.zeros(L, jnp.float32), lsh=jnp.zeros(L, jnp.float32),
-            lsc=jnp.zeros(L, jnp.float32), lout=jnp.zeros(L, jnp.float32),
-            rout=jnp.zeros(L, jnp.float32))
+        cand = jnp.zeros((L, CAND_COLS + B), jnp.float32) \
+            .at[:, CAND_GAIN].set(NEG_INF)
+        forced_cand = jnp.zeros((L, FORCED_COLS), jnp.float32) \
+            .at[:, FORCED_GAIN].set(NEG_INF)
         W = self.frontier
         return GrowerState(
             route_tab=jnp.zeros((L, self._route_cols), jnp.float32),
@@ -1110,20 +1097,10 @@ class TreeGrower:
                      f_is_cat, feature_mask):
         """Best split per (leaf-row, feature) from per-feature hists.
         All leaf-shaped args are (L',) aligned with hist's first axis."""
-        num_res = find_numerical_splits(
-            hist, sum_grad, sum_hess, count, f_num_bin, f_missing,
-            f_default_bin, f_monotone, min_c, max_c, cfg)
-        if self.has_categorical:
-            cat_res = find_categorical_splits(
-                hist, sum_grad, sum_hess, count, f_num_bin, f_missing,
-                min_c, max_c, cfg)
-            icat = f_is_cat[None, :]
-            res = SplitResult(*[jnp.where(icat, c, n) for c, n
-                                in zip(cat_res, num_res)])
-        else:
-            res = num_res
-        gains = jnp.where(feature_mask[None, :], res.gain, NEG_INF)
-        return res, gains
+        return run_split_finders(
+            hist, sum_grad, sum_hess, count, min_c, max_c, cfg,
+            f_num_bin, f_missing, f_default_bin, f_monotone, f_is_cat,
+            feature_mask, self.has_categorical)
 
     # ------------------------------------------------------------------
     def _refresh(self, st: GrowerState, parents, rights, grad, hess,
@@ -1204,84 +1181,75 @@ class TreeGrower:
             # so XLA emits a single in-place update of the cache buffer
             cache = cache.at[jnp.where(new_slots >= 0, new_slots, L)].set(
                 h_new, mode="drop")
-        safe = jnp.clip(new_slots, 0, L - 1)
-        valid = new_slots >= 0
+        # ---- frontier-bounded candidate refresh (round 7): the finder
+        # and the cache scatter run at the narrowest packed-strip width
+        # covering the valid slots — a lax.cond ladder mirroring
+        # _packed_dispatch, so the (2W, F, B) threshold sweep stops
+        # paying the full frontier cap on the 1-2-leaf early rounds
+        W = parents.shape[0]
+
+        def refresh_at(w):
+            def go(_):
+                if w >= W:
+                    return self._refresh_cand(st, new_slots, h_new,
+                                              feature_mask)
+                slots_w = jnp.concatenate([parents[:w], rights[:w]])
+                h_w = jnp.concatenate([left_hist[:w], right_hist[:w]])
+                return self._refresh_cand(st, slots_w, h_w, feature_mask)
+            return go
+
+        rungs = [s for s in (PACKED_STRIP, 2 * PACKED_STRIP) if s < W]
+        if not self.split_ladder or not rungs:
+            cand, forced_cand = refresh_at(W)(None)
+        else:
+            kv = jnp.sum(rights >= 0)
+            wide = refresh_at(W)
+            if len(rungs) == 1:
+                cand, forced_cand = jax.lax.cond(
+                    kv <= rungs[0], refresh_at(rungs[0]), wide, None)
+            else:
+                cand, forced_cand = jax.lax.cond(
+                    kv <= rungs[0], refresh_at(rungs[0]),
+                    lambda _: jax.lax.cond(kv <= rungs[1],
+                                           refresh_at(rungs[1]), wide,
+                                           None), None)
+        return st._replace(hist_cache=cache, cand=cand,
+                           forced_cand=forced_cand)
+
+    # ------------------------------------------------------------------
+    def _refresh_cand(self, st: GrowerState, slots_w, h_w, feature_mask):
+        """Finder + candidate-cache update at ONE frontier width: every
+        shape is bounded by ``slots_w``'s length (2·w, never L_pad) and
+        the per-leaf cache update is a single packed-block scatter
+        (plus one for forced splits) instead of the former 11+8
+        per-field scatters.  Valid slots occupy a prefix of each half
+        of ``slots_w`` (_round queues them that way); negative entries
+        scatter to the dropped L row."""
+        L = self.num_leaves
+        cfg = self.cfg_scalars
+        safe = jnp.clip(slots_w, 0, L - 1)
         sg = st.leaf_sum_grad[safe]
         sh = st.leaf_sum_hess[safe]
         sc = st.leaf_count[safe]
         mc = st.leaf_min_c[safe]
         xc = st.leaf_max_c[safe]
         totals = jnp.stack([sg, sh, sc], axis=1)
-        feat_hist = expand_feature_histograms(h_new, self.bin_map,
+        feat_hist = expand_feature_histograms(h_w, self.bin_map,
                                               self.fix_bin, totals)
-        res, gains = self._run_finders(
+        block = find_best_split_block(
             feat_hist, sg, sh, sc, mc, xc, cfg, self.f_num_bin,
             self.f_missing, self.f_default_bin, self.f_monotone,
-            self.f_is_cat, feature_mask)
-
-        best_fc = jnp.argmax(gains, axis=1).astype(jnp.int32)   # (2W,)
-        best_gain = jnp.take_along_axis(gains, best_fc[:, None],
-                                        axis=1)[:, 0]
-
-        def at_leaf(arr2d):
-            return jnp.take_along_axis(arr2d, best_fc[:, None],
-                                       axis=1)[:, 0]
-
-        thr = at_leaf(res.threshold)
-        cat_dir = at_leaf(res.cat_dir)
-        if self.has_categorical:
-            hist_chosen = jnp.take_along_axis(
-                feat_hist, best_fc[:, None, None, None], axis=1)[:, 0]
-            cat_mask = build_cat_bitset(
-                hist_chosen, thr, cat_dir, self.f_num_bin[best_fc],
-                self.f_missing[best_fc], cfg)
-        else:
-            cat_mask = jnp.zeros((new_slots.shape[0],
-                                  self.max_feature_bin), bool)
-
-        idx = jnp.where(valid, new_slots, L)
-        c = st.cand
-        cand = SplitCand(
-            gain=c.gain.at[idx].set(best_gain, mode="drop"),
-            feature=c.feature.at[idx].set(best_fc, mode="drop"),
-            threshold=c.threshold.at[idx].set(thr, mode="drop"),
-            default_left=c.default_left.at[idx].set(
-                at_leaf(res.default_left), mode="drop"),
-            lsg=c.lsg.at[idx].set(at_leaf(res.left_sum_grad), mode="drop"),
-            lsh=c.lsh.at[idx].set(at_leaf(res.left_sum_hess), mode="drop"),
-            lsc=c.lsc.at[idx].set(at_leaf(res.left_count), mode="drop"),
-            lout=c.lout.at[idx].set(at_leaf(res.left_output), mode="drop"),
-            rout=c.rout.at[idx].set(at_leaf(res.right_output), mode="drop"),
-            cat_dir=c.cat_dir.at[idx].set(cat_dir, mode="drop"),
-            cat_mask=c.cat_mask.at[idx].set(cat_mask, mode="drop"))
-
+            self.f_is_cat, feature_mask, self.has_categorical)
+        idx = jnp.where(slots_w >= 0, slots_w, L)
+        cand = st.cand.at[idx].set(block, mode="drop")
         forced_cand = st.forced_cand
         if self.forced_count:
-            spec = st.leaf_forced[safe]                          # (2W,)
-            s_node = jnp.clip(spec, 0, self.forced_count - 1)
-            ff = self.forced_feature[s_node]
-            ft = self.forced_thr[s_node]
-            hist_ff = jnp.take_along_axis(
-                feat_hist, ff[:, None, None, None], axis=1)[:, 0]
-            (fgain, flg, flh, flc, flo, fro, fdl) = \
-                gather_split_at_threshold(
-                    hist_ff, ft, sg, sh, sc, self.f_num_bin[ff],
-                    self.f_missing[ff], self.f_default_bin[ff],
-                    self.f_is_cat[ff], cfg)
-            fgain = jnp.where(spec >= 0, fgain, NEG_INF)
-            fc = forced_cand
-            forced_cand = ForcedCand(
-                gain=fc.gain.at[idx].set(fgain, mode="drop"),
-                threshold=fc.threshold.at[idx].set(ft, mode="drop"),
-                default_left=fc.default_left.at[idx].set(fdl, mode="drop"),
-                lsg=fc.lsg.at[idx].set(flg, mode="drop"),
-                lsh=fc.lsh.at[idx].set(flh, mode="drop"),
-                lsc=fc.lsc.at[idx].set(flc, mode="drop"),
-                lout=fc.lout.at[idx].set(flo, mode="drop"),
-                rout=fc.rout.at[idx].set(fro, mode="drop"))
-
-        return st._replace(hist_cache=cache, cand=cand,
-                           forced_cand=forced_cand)
+            fblock = forced_split_block(
+                feat_hist, st.leaf_forced[safe], self.forced_feature,
+                self.forced_thr, sg, sh, sc, self.f_num_bin,
+                self.f_missing, self.f_default_bin, self.f_is_cat, cfg)
+            forced_cand = st.forced_cand.at[idx].set(fblock, mode="drop")
+        return cand, forced_cand
 
     # ------------------------------------------------------------------
     def _apply_selection(self, st: GrowerState, do_split, rank, k,
@@ -1433,31 +1401,35 @@ class TreeGrower:
         st = self._refresh(st, st.pend_parents, st.pend_rights, grad,
                            hess, counts, feature_mask, quant)
 
-        best_gain = st.cand.gain
-        best_f = st.cand.feature
-        thr = st.cand.threshold
-        dleft = st.cand.default_left
-        lsg, lsh, lsc = st.cand.lsg, st.cand.lsh, st.cand.lsc
-        lout, rout = st.cand.lout, st.cand.rout
-        cat_mask = st.cand.cat_mask
+        c = st.cand
+        best_gain = c[:, CAND_GAIN]
+        best_f = c[:, CAND_FEATURE].astype(jnp.int32)
+        thr = c[:, CAND_THRESHOLD].astype(jnp.int32)
+        dleft = c[:, CAND_DEFAULT_LEFT] > 0.5
+        lsg, lsh, lsc = c[:, CAND_LSG], c[:, CAND_LSH], c[:, CAND_LSC]
+        lout, rout = c[:, CAND_LOUT], c[:, CAND_ROUT]
+        cat_mask = c[:, CAND_COLS:] > 0.5
 
         forced_valid = None
         if self.forced_count:
             fc = st.forced_cand
+            fc_gain = fc[:, FORCED_GAIN]
+            fc_thr = fc[:, FORCED_THRESHOLD].astype(jnp.int32)
             s_node = jnp.clip(st.leaf_forced, 0, self.forced_count - 1)
             ff = self.forced_feature[s_node]
-            forced_valid = (st.leaf_forced >= 0) & (fc.gain > NEG_INF)
+            forced_valid = (st.leaf_forced >= 0) & (fc_gain > NEG_INF)
             best_f = jnp.where(forced_valid, ff, best_f)
-            best_gain = jnp.where(forced_valid, fc.gain, best_gain)
-            thr = jnp.where(forced_valid, fc.threshold, thr)
-            dleft = jnp.where(forced_valid, fc.default_left, dleft)
-            lsg = jnp.where(forced_valid, fc.lsg, lsg)
-            lsh = jnp.where(forced_valid, fc.lsh, lsh)
-            lsc = jnp.where(forced_valid, fc.lsc, lsc)
-            lout = jnp.where(forced_valid, fc.lout, lout)
-            rout = jnp.where(forced_valid, fc.rout, rout)
+            best_gain = jnp.where(forced_valid, fc_gain, best_gain)
+            thr = jnp.where(forced_valid, fc_thr, thr)
+            dleft = jnp.where(forced_valid,
+                              fc[:, FORCED_DEFAULT_LEFT] > 0.5, dleft)
+            lsg = jnp.where(forced_valid, fc[:, FORCED_LSG], lsg)
+            lsh = jnp.where(forced_valid, fc[:, FORCED_LSH], lsh)
+            lsc = jnp.where(forced_valid, fc[:, FORCED_LSC], lsc)
+            lout = jnp.where(forced_valid, fc[:, FORCED_LOUT], lout)
+            rout = jnp.where(forced_valid, fc[:, FORCED_ROUT], rout)
             fmask = (jnp.arange(self.max_feature_bin, dtype=jnp.int32)[None]
-                     == fc.threshold[:, None])
+                     == fc_thr[:, None])
             cat_mask = jnp.where(forced_valid[:, None], fmask, cat_mask)
 
         slot = jnp.arange(L, dtype=jnp.int32)
@@ -1472,8 +1444,13 @@ class TreeGrower:
         key = jnp.where(cand_m, best_gain, NEG_INF)
         if forced_valid is not None:
             key = jnp.where(forced_valid, jnp.inf, key)
-        order = jnp.argsort(-key)                   # best first, stable
-        rank = jnp.argsort(order).astype(jnp.int32)  # (L,)
+        # W-bounded selection (round 7): only the top W leaves — the
+        # most a round can split — ever receive a rank, replacing two
+        # full-L argsorts.  lax.top_k keeps the lower index first on
+        # ties, exactly the stable argsort(-key) order it replaces.
+        top_i = jax.lax.top_k(key, W)[1].astype(jnp.int32)
+        rank = jnp.full(L, L, jnp.int32).at[top_i].set(
+            jnp.arange(W, dtype=jnp.int32))
         budget = L - st.num_leaves
         do_split = cand_m & (rank < budget) & (rank < W)
         k = do_split.sum().astype(jnp.int32)
@@ -1483,11 +1460,11 @@ class TreeGrower:
                                     lout, rout, cat_mask, forced_valid)
 
         # queue this round's new leaves for the NEXT round's refresh:
-        # order[w] is the leaf with split-rank w (its slot hosts the
+        # top_i[w] is the leaf with split-rank w (its slot hosts the
         # left child); the matching right child is num_leaves_old + w
         w_iota = jnp.arange(W, dtype=jnp.int32)
         split_ok = w_iota < k
-        parents = jnp.where(split_ok, order[:W].astype(jnp.int32), -1)
+        parents = jnp.where(split_ok, top_i, -1)
         rights = jnp.where(split_ok, st.num_leaves + w_iota, -1)
         return st2._replace(pend_parents=parents, pend_rights=rights)
 

@@ -35,6 +35,36 @@ K_CATEGORICAL_MASK = 1
 K_DEFAULT_LEFT_MASK = 2
 
 
+def unpack_tree_records_device(records: jax.Array, num_leaves: int,
+                               max_feature_bin: int):
+    """Packed tree record(s) -> TreeArrays, on device.
+
+    ``records`` is uint8 with the record bytes in the LAST axis
+    (tree.TreeRecordLayout layout); any leading batch axes are
+    preserved, so a (T, record_size) stack unpacks to a TreeArrays
+    whose leaves carry a leading T — the shape predict scans expect.
+    Static-offset slices + bitcasts only: unpacking a chunk's worth of
+    trees costs no gathers."""
+    from ..tree import TreeRecordLayout
+    from ..learner.grower import TreeArrays
+
+    layout = TreeRecordLayout(num_leaves, max_feature_bin)
+    lead = records.shape[:-1]
+    out = {}
+    for name, (off, nbytes, dt, shape) in layout.fields.items():
+        raw = jax.lax.slice_in_dim(records, off, off + nbytes,
+                                   axis=records.ndim - 1)
+        kind = np.dtype(dt).kind
+        if kind == "u":
+            arr = raw.astype(bool)
+        else:
+            tgt = jnp.int32 if kind == "i" else jnp.float32
+            arr = jax.lax.bitcast_convert_type(
+                raw.reshape(lead + (nbytes // 4, 4)), tgt)
+        out[name] = arr.reshape(lead + shape)
+    return TreeArrays(**out)
+
+
 def predict_binned(tree, bins: jax.Array, f_group: jax.Array,
                    g2f_lut: jax.Array, f_missing: jax.Array,
                    f_default_bin: jax.Array, f_num_bin: jax.Array,
@@ -146,7 +176,13 @@ def stack_host_trees(models: List) -> RawTreeStack:
                 words = np.asarray(t.cat_threshold[lo:hi], dtype=np.uint32)
                 cw[k, i, :len(words)] = words
     hi = thr.astype(np.float32)
-    lo = (thr - hi.astype(np.float64)).astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        lo = (thr - hi.astype(np.float64)).astype(np.float32)
+    # +-inf thresholds (a split keeping the NaN/overflow bin on one
+    # side) must keep lo finite: inf - inf is NaN, and a NaN residual
+    # poisons the two-float compare into always-right, diverging from
+    # the host walk's `fv <= +inf`.
+    lo = np.where(np.isnan(lo), np.float32(0), lo)
     return RawTreeStack(
         num_leaves=jnp.asarray(nl), feature=jnp.asarray(feat),
         thr_hi=jnp.asarray(hi), thr_lo=jnp.asarray(lo),

@@ -24,6 +24,119 @@ K_DEFAULT_LEFT_MASK = 2
 K_ZERO_THRESHOLD = 1e-35
 
 
+# ---------------------------------------------------------------------------
+# Packed tree-record layout (round 7).
+#
+# The fused dispatch scan used to carry EIGHTEEN O(chunk)-sized stacked
+# output buffers — one per TreeArrays field plus the num_leaves series —
+# and the TPU backend's handling of that many loop-carried output stacks
+# is what made per-tree time grow linearly with chunk length
+# (docs/ROOFLINE.md round-6 delta: per-tree ≈ 25.75 + 0.075·chunk ms).
+# This layout packs one grown tree into ONE contiguous byte buffer with
+# FIXED offsets derived from (num_leaves L, max_feature_bin B), so the
+# scan carries a single uint8 output stack (plus the small num_leaves
+# series for the deferred stop check).  The grower emits it with
+# static-offset dynamic-update-slice writes (pack_tree_record); the
+# host unpacks after dispatch (unpack_tree_record) and the device
+# unpacks for in-session prediction (ops/predict.py
+# unpack_tree_records_device).
+#
+# TREE_RECORD_SPEC is the single source of truth: field order MUST
+# equal learner.grower.TreeArrays._fields, dtypes are little-endian
+# (matching both numpy .view and jax.lax.bitcast_convert_type byte
+# enumeration), and shapes are symbolic in the dims {L, M, B} with
+# M = L - 1.  scripts/check_carry_layout.py lints the spec against the
+# grower's emit sites and fails on drift.
+# ---------------------------------------------------------------------------
+TREE_RECORD_SPEC = (
+    ("num_leaves", "<i4", ()),
+    ("leaf_value", "<f4", ("L",)),
+    ("leaf_weight", "<f4", ("L",)),
+    ("leaf_count", "<f4", ("L",)),
+    ("leaf_parent", "<i4", ("L",)),
+    ("leaf_depth", "<i4", ("L",)),
+    ("node_feature", "<i4", ("M",)),
+    ("node_threshold", "<i4", ("M",)),
+    ("node_default_left", "|u1", ("M",)),
+    ("node_is_cat", "|u1", ("M",)),
+    ("node_cat_mask", "|u1", ("M", "B")),
+    ("node_gain", "<f4", ("M",)),
+    ("node_value", "<f4", ("M",)),
+    ("node_weight", "<f4", ("M",)),
+    ("node_count", "<f4", ("M",)),
+    ("node_left", "<i4", ("M",)),
+    ("node_right", "<i4", ("M",)),
+)
+
+
+class TreeRecordLayout:
+    """Fixed byte offsets of one packed tree record for a given
+    (num_leaves, max_feature_bin) shape.  ``fields`` maps field name ->
+    (offset, nbytes, numpy dtype string, concrete shape)."""
+
+    def __init__(self, num_leaves: int, max_feature_bin: int):
+        self.num_leaves = int(num_leaves)
+        self.max_feature_bin = int(max_feature_bin)
+        dims = {"L": self.num_leaves,
+                "M": self.num_leaves - 1,   # matches TreeArrays' node dim
+                "B": self.max_feature_bin}
+        self.fields: Dict[str, tuple] = {}
+        off = 0
+        for name, dt, shape_sym in TREE_RECORD_SPEC:
+            shape = tuple(dims[s] for s in shape_sym)
+            count = 1
+            for s in shape:
+                count *= s
+            nbytes = count * np.dtype(dt).itemsize
+            # every field starts word-aligned and the record is padded
+            # to a 64-byte multiple: sub-word starts/odd-sized carry
+            # buffers are exactly what backends mishandle, and the pad
+            # costs bytes, not buffers
+            off = (off + 3) & ~3
+            self.fields[name] = (off, nbytes, dt, shape)
+            off += nbytes
+        self.record_size = (off + 63) & ~63
+
+    # ------------------------------------------------------------------
+    def pack_tree_record(self, tree):
+        """Device-side: serialize one grown TreeArrays into a (record_
+        size,) uint8 buffer with static-offset dynamic-update-slice
+        writes (lax.dynamic_update_slice, NOT ``.at[...].set`` — jnp's
+        indexed update lowers to a windowed scatter, while an explicit
+        DUS is the in-place form the fused chunk's HLO regression test
+        pins)."""
+        import jax
+        import jax.numpy as jnp
+
+        buf = jnp.zeros((self.record_size,), jnp.uint8)
+        for name, (off, nbytes, dt, shape) in self.fields.items():
+            arr = getattr(tree, name)
+            kind = np.dtype(dt).kind
+            if kind == "u":                       # bools stored as bytes
+                by = arr.astype(jnp.uint8).reshape(-1)
+            else:
+                tgt = jnp.int32 if kind == "i" else jnp.float32
+                by = jax.lax.bitcast_convert_type(
+                    arr.astype(tgt), jnp.uint8).reshape(-1)
+            buf = jax.lax.dynamic_update_slice(buf, by, (off,))
+        return buf
+
+    # ------------------------------------------------------------------
+    def unpack_tree_record(self, buf: np.ndarray) -> Dict[str, np.ndarray]:
+        """Host-side: one packed record (uint8 numpy) back to the
+        TreeArrays field dict Tree.from_grower_arrays consumes."""
+        buf = np.ascontiguousarray(np.asarray(buf, dtype=np.uint8))
+        out: Dict[str, np.ndarray] = {}
+        for name, (off, nbytes, dt, shape) in self.fields.items():
+            raw = buf[off:off + nbytes]
+            if np.dtype(dt).kind == "u":
+                arr = raw.astype(bool)
+            else:
+                arr = raw.view(dt)
+            out[name] = arr.reshape(shape) if shape else arr.reshape(())[()]
+        return out
+
+
 def _make_decision_type(is_cat: bool, default_left: bool,
                         missing_type: int) -> int:
     dt = 0

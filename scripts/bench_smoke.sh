@@ -10,6 +10,10 @@
 # plumbing, not performance.
 set -e
 cd "$(dirname "$0")/.."
+# packed-carry layout lint first: record-offset drift corrupts trees
+# silently, so fail the smoke before spending a training run on it
+# (status to stderr — bench stdout is ONE JSON line by contract)
+python scripts/check_carry_layout.py >&2
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \

@@ -1,10 +1,26 @@
 """C-API-surface tests (the reference's tests/c_api_test/test_.py
-analog: ctypes-level Dataset/Booster lifecycle, :59-255)."""
+analog: ctypes-level Dataset/Booster lifecycle, :59-255).
+
+These tests intermittently die in NATIVE code on some hosts (SIGABRT/
+SIGSEGV mid-suite or at interpreter exit — a pre-existing container
+glitch, not a regression), which used to kill the whole pytest worker
+and take the rest of the suite's results with it.  They are therefore
+gated behind LGBM_CAPI_INPROC=1 and normally executed by
+tests/test_capi_subprocess.py, which runs this module in a CHILD
+pytest and turns any native crash into an ordinary assertion failure
+with the child's output attached."""
+import os
+
 import numpy as np
 import pytest
 
 import lightgbm_tpu.capi as capi
 import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("LGBM_CAPI_INPROC") != "1",
+    reason="runs via tests/test_capi_subprocess.py for native-crash "
+           "isolation; set LGBM_CAPI_INPROC=1 to run in-process")
 
 
 def _mk_data(rng, n=500, f=5):

@@ -141,39 +141,55 @@ import resource
 
 BASE_PEAK_MB = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
 
-def peak_or_rss_mb():
-    # Peak RSS when the starting high-water mark is clean; otherwise
-    # (an inherited/polluted watermark, observed as identical ~2.1 GB
-    # baselines under a loaded suite) fall back to current VmRSS,
-    # which still catches persistent whole-matrix densification.
-    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
-    if BASE_PEAK_MB < 400:
-        return peak
+def vmrss_mb():
     with open("/proc/self/status") as f:
         for line in f:
             if line.startswith("VmRSS:"):
                 return int(line.split()[1]) / 1024.0
-    return peak
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
 path = sys.argv[1]
 rng = np.random.RandomState(0)
-# write ~600 MB of text: 1.5M rows x 25 cols in streamed chunks
+# write ~600 MB of text: 1.5M rows x 25 cols.  One 20000-row chunk is
+# formatted once and written 75 times — the bound under test is the
+# construct's residency, which only sees row count and text size, and
+# %-formatting 39M floats with savetxt would dominate the test's wall
+# clock for no extra coverage.
+import io
+buf = io.StringIO()
+np.savetxt(buf, rng.randn(20000, 26).astype(np.float32),
+           delimiter=",", fmt="%.6g")
+chunk_txt = buf.getvalue()
 with open(path, "w") as f:
     for _ in range(75):
-        chunk = rng.randn(20000, 26).astype(np.float32)
-        np.savetxt(f, chunk, delimiter=",", fmt="%.6g")
+        f.write(chunk_txt)
 write_mb = os.path.getsize(path) / 1e6
 import lightgbm_tpu as lgb
 from lightgbm_tpu.config import Config
 cfg = Config.from_params({"objective": "regression", "verbose": -1,
                           "two_round": True, "max_bin": 63,
                           "bin_construct_sample_cnt": 20000})
+# the bound is on what CONSTRUCT adds over the import baseline —
+# an absolute bound silently re-fails every time the jax/numpy
+# import footprint grows (and the peak watermark is polluted on
+# this container: observed ~1.1-2.1 GB ru_maxrss at interpreter
+# start), while the delta stays discriminating: uint8 bins
+# (37.5 MB) + one parse chunk + sample buffers ~< 150 MB vs the
+# 300 MB float64 matrix / ~600 MB resident text a densifying
+# construct would hold.
+rss_import_mb = vmrss_mb()
 core = lgb.Dataset(path).construct(cfg)
 assert core.num_data == 1_500_000, core.num_data
-rss_mb = peak_or_rss_mb()
-print("csv_mb", write_mb, "rss_mb", rss_mb, "base", BASE_PEAK_MB)
-# full float64 matrix alone would be 300 MB; text in RAM ~600 MB.
-# budget: uint8 bins (37.5 MB) + chunk + samples + interpreter << 600
-assert rss_mb < 600, rss_mb
+rss_mb = vmrss_mb()
+peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+print("csv_mb", write_mb, "rss_mb", rss_mb, "import", rss_import_mb,
+      "peak", peak_mb, "base", BASE_PEAK_MB)
+assert rss_mb - rss_import_mb < 300, (rss_import_mb, rss_mb)
+if BASE_PEAK_MB < 400:
+    # clean high-water mark: the TRANSIENT is visible too — a
+    # construct that densifies then frees before returning (the 300
+    # MB matrix would put the peak delta past the same budget) only
+    # shows up here
+    assert peak_mb - rss_import_mb < 300, (rss_import_mb, peak_mb)
 """
     r = subprocess.run(
         [sys.executable, "-c", code, str(tmp_path / "big.csv")],

@@ -26,12 +26,18 @@ Env knobs: BENCH_ROWS/BENCH_ITERS (primary), BENCH_ROWS_BIG/
 BENCH_ITERS_BIG (big scale; BENCH_BIG=0 disables), BENCH_SKIP_F32=1
 skips the f32 accuracy rerun, BENCH_PARAMS='{...}' overrides params,
 BENCH_LEAVES/BENCH_MAX_BIN shrink the tree shape (smoke runs).
+Serving bench knobs (BENCH_PREDICT=0 disables the predict scale):
+BENCH_PREDICT_TRAIN_ROWS/BENCH_PREDICT_ITERS shape the served model,
+BENCH_PREDICT_ROWS the bulk-throughput batch,
+BENCH_PREDICT_SMALL_BATCH/BENCH_PREDICT_CALLS the p50 micro-batch
+loop, BENCH_PREDICT_ANCHOR_ROWS the reference task=predict anchor.
 Local-reference knobs: BENCH_LOCAL_REF=0 disables all same-machine
-reference runs; BENCH_LOCAL_REF_BIG=0 / BENCH_LOCAL_REF_LTR=0 disable
-just the 10.5M / lambdarank anchors (each costs minutes of 1-core CSV
-write + reference binning wall-clock); BENCH_REF_ITERS /
-BENCH_REF_ITERS_BIG / BENCH_REF_ITERS_LTR set the differenced
-iteration counts (defaults 30/10/10).
+reference runs; BENCH_LOCAL_REF_BIG=0 / BENCH_LOCAL_REF_LTR=0 /
+BENCH_LOCAL_REF_PREDICT=0 disable just the 10.5M / lambdarank /
+task=predict anchors (each costs minutes of 1-core CSV write +
+reference wall-clock); BENCH_REF_ITERS / BENCH_REF_ITERS_BIG /
+BENCH_REF_ITERS_LTR set the differenced iteration counts (defaults
+30/10/10).
 
 Budget discipline (round-5 verdict weak #1/#3: the r5 bench blew the
 driver's wall-clock limit re-measuring fixed-binary anchors and died
@@ -44,6 +50,17 @@ An anchor that must run fresh is time-boxed to the remaining budget
 minus a finishing reserve and skipped WITH A NOTE in the JSON on
 overrun — the bench itself always completes with rc 0.
 BENCH_LOCAL_REF_REFRESH=1 forces re-measurement.
+
+Round-8 extension: the budget now bounds EVERY phase, not just the
+anchors (the r5 rc=124 record — BENCH_r05.json `parsed: null` — came
+from the 10.5M lightgbm_tpu MEASUREMENT run itself blowing the outer
+driver timeout after the anchors were budgeted).  Each optional scale
+is admitted against the measured primary-scale wall: the big scale is
+scaled DOWN to rows that fit the remaining budget (with a
+`scaled_down_from` note) or skipped with a note; the lambdarank and
+predict scales skip with a note when their estimate doesn't fit.  The
+JSON is always emitted and overruns never exit rc != 0 (quality gates
+— AUC drift, NDCG floor, predict parity — still do).
 """
 import gc
 import json
@@ -126,6 +143,10 @@ _EXPECTED_KEY_FIELDS = frozenset(
     ("rows", "iters", "seed", "nl", "mb", "lr", "mdl", "msh",
      "threads", "host"))
 _REQUIRED_RECORD_FIELDS = ("per_tree_ms", "threads", "iters")
+# task=predict anchors time the reference's batch scorer, not
+# training: rows/s replaces per-tree time and no quality metric rides
+# along (the parity gate lives in the lightgbm_tpu predict scale)
+_REQUIRED_PREDICT_FIELDS = ("rows_per_s", "threads", "iters")
 _LOCAL_REF_NOTES: list = []
 _LOCAL_REF_BAD: set = set()
 
@@ -162,11 +183,18 @@ def validate_local_ref():
                 "re-measure with BENCH_LOCAL_REF_REFRESH=1")
             bad.add(key)
             continue
-        schema_ok = (isinstance(rec, dict)
-                     and ("skipped" in rec
-                          or (all(f in rec
-                                  for f in _REQUIRED_RECORD_FIELDS)
-                              and ("auc" in rec or "ndcg10" in rec))))
+        if parts[0] == "predict":
+            schema_ok = (isinstance(rec, dict)
+                         and ("skipped" in rec
+                              or all(f in rec
+                                     for f in _REQUIRED_PREDICT_FIELDS)))
+        else:
+            schema_ok = (isinstance(rec, dict)
+                         and ("skipped" in rec
+                              or (all(f in rec
+                                      for f in _REQUIRED_RECORD_FIELDS)
+                                  and ("auc" in rec
+                                       or "ndcg10" in rec))))
         if not schema_ok:
             notes.append(
                 f"anchor {key!r}: record schema drift (expected "
@@ -673,6 +701,192 @@ def run_local_reference(X, y, Xv, yv, params, iters,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_local_reference_predict(model_str, X, y, params, n_trees,
+                                seed=21):
+    """Measure the reference CPU binary's ``task=predict`` on the SAME
+    model text and data on THIS machine — the serving roofline's
+    anchor.  Methodology: the model is our saved text (interchangeable
+    format), predict wall is differenced between the full matrix and a
+    1/8 prefix so binary-load + model-parse cancel; the per-row CSV
+    parse does NOT cancel and is part of the reference CLI's serving
+    cost (noted in the record).  Cached in LOCAL_REF.json under a
+    ``predict:...`` key (same key fields; ``iters`` = model trees)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    ref_bin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".refbuild", "lightgbm")
+    if os.environ.get("BENCH_LOCAL_REF", "1") == "0" \
+            or os.environ.get("BENCH_LOCAL_REF_PREDICT", "1") == "0":
+        return None
+    threads = os.cpu_count() or 1
+    key = _local_ref_key("predict", X.shape[0], n_trees, seed, params,
+                         threads)
+    if os.environ.get("BENCH_LOCAL_REF_REFRESH") != "1":
+        cached = (None if key in _LOCAL_REF_BAD
+                  else _local_ref_load().get(key))
+        if cached is not None:
+            print(f"local predict anchor reused from LOCAL_REF.json "
+                  f"[{key}]", file=sys.stderr)
+            return dict(cached, cached=True)
+    if not os.path.exists(ref_bin):
+        return {"skipped": "reference binary absent "
+                           "(.refbuild/lightgbm)"}
+    box = budget_left() - ANCHOR_RESERVE_S
+    est_csv_s = (X.size + X.shape[0]) / 2e6
+    if box < 30 + est_csv_s:
+        return {"skipped": f"insufficient budget for a fresh predict "
+                           f"anchor ({box:.0f}s left after reserve, "
+                           f"CSV write alone est. {est_csv_s:.0f}s)"}
+    tmp = tempfile.mkdtemp(prefix="bench_refp_")
+    try:
+        n = X.shape[0]
+        n_small = max(1, n // 8)
+        full_csv = os.path.join(tmp, "full.csv")
+        small_csv = os.path.join(tmp, "small.csv")
+        arr = np.column_stack([y, X])
+        try:
+            import pandas as pd
+            pd.DataFrame(arr).to_csv(full_csv, header=False, index=False,
+                                     float_format="%.8g")
+            pd.DataFrame(arr[:n_small]).to_csv(
+                small_csv, header=False, index=False, float_format="%.8g")
+        except ImportError:
+            np.savetxt(full_csv, arr, fmt="%.8g", delimiter=",")
+            np.savetxt(small_csv, arr[:n_small], fmt="%.8g",
+                       delimiter=",")
+        model_txt = os.path.join(tmp, "model.txt")
+        with open(model_txt, "w") as f:
+            f.write(model_str)
+
+        def run_predict(data_csv):
+            t0 = time.time()
+            subprocess.run(
+                [ref_bin, "task=predict", f"data={data_csv}",
+                 f"input_model={model_txt}",
+                 f"output_result={tmp}/preds.txt",
+                 f"num_threads={threads}", "verbose=-1"],
+                check=True, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, cwd=tmp,
+                timeout=max(10.0, budget_left() - ANCHOR_RESERVE_S))
+            return time.time() - t0
+
+        t_small = run_predict(small_csv)
+        t_full = run_predict(full_csv)
+        if t_full <= t_small:
+            return {"skipped": "predict differencing degenerate "
+                               f"(t_full {t_full:.3f}s <= t_small "
+                               f"{t_small:.3f}s at n={n})"}
+        out = {"rows_per_s": round((n - n_small) / (t_full - t_small)),
+               "threads": threads, "iters": n_trees, "rows": n,
+               "note": "differenced wall includes the reference CLI's "
+                       "per-row CSV parse"}
+        _local_ref_store(key, out)
+        return out
+    except subprocess.TimeoutExpired:
+        return {"skipped": "predict anchor hit the BENCH_BUDGET_S time "
+                           "box"}
+    except Exception as e:
+        print(f"local predict reference failed ({type(e).__name__}: "
+              f"{e})", file=sys.stderr)
+        return {"skipped": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_predict_scale(params):
+    """Serving roofline point: bulk scoring throughput, micro-batch
+    p50 latency and the compile count of the shape-bucketed device
+    predictor, gated on exact parity with the host tree walk and
+    anchored against the reference CPU ``task=predict``.
+
+    Runs with ``device=True`` so the measurement exercises the device
+    predictor on whatever backend JAX selected (``backend`` is
+    recorded; on the CPU seam the numbers are the XLA-CPU analog of
+    the on-chip run, same as the training scales)."""
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ops.predict import (PREDICT_TELEMETRY,
+                                          reset_predict_telemetry)
+
+    train_rows = int(os.environ.get("BENCH_PREDICT_TRAIN_ROWS", 200_000))
+    iters = int(os.environ.get("BENCH_PREDICT_ITERS", 50))
+    bulk_rows = int(os.environ.get("BENCH_PREDICT_ROWS", 2_000_000))
+    small = int(os.environ.get("BENCH_PREDICT_SMALL_BATCH", 32))
+    calls = int(os.environ.get("BENCH_PREDICT_CALLS", 50))
+
+    X, y, w = make_data(train_rows, BENCH_FEATURES, seed=21)
+    bst = lgb.train(dict(params), lgb.Dataset(X, label=y), iters,
+                    verbose_eval=False)
+    n_trees = bst.num_trees()
+    Xb, yb, _ = make_data(bulk_rows, BENCH_FEATURES, seed=22, w=w)
+    del X, y
+    gc.collect()
+
+    reset_predict_telemetry()
+    # warm pass compiles every bucket the measurement will touch
+    t0 = time.time()
+    bst.predict(Xb[:small], device=True)
+    pred = bst.predict(Xb, device=True)
+    warm_s = time.time() - t0
+    t0 = time.time()
+    pred = bst.predict(Xb, device=True)
+    bulk_s = time.time() - t0
+
+    # parity gate: the serving numbers are only evidence if the device
+    # predictor routes every row exactly like the host walk
+    n_check = min(4096, bulk_rows)
+    host = bst.predict(Xb[:n_check], device=False)
+    if not np.allclose(pred[:n_check], host, rtol=2e-5, atol=2e-7):
+        raise SystemExit(
+            "device predict diverged from the host tree walk on the "
+            f"bench draw (max |delta| "
+            f"{np.max(np.abs(pred[:n_check] - host)):g}) — serving "
+            "parity gate failed")
+
+    lat = []
+    off = 0
+    for _ in range(calls):
+        t0 = time.time()
+        bst.predict(Xb[off:off + small], device=True)
+        lat.append(time.time() - t0)
+        off = (off + small) % max(bulk_rows - small, 1)
+    p50_ms = float(np.percentile(np.asarray(lat) * 1e3, 50))
+
+    buckets = sorted(PREDICT_TELEMETRY["buckets"])
+    out = {
+        "task": "predict", "backend": jax.default_backend(),
+        "model_trees": n_trees, "model_leaves": params["num_leaves"],
+        "rows": bulk_rows,
+        "bulk_rows_per_s": round(bulk_rows / bulk_s),
+        "bulk_s": round(bulk_s, 3),
+        "warm_s": round(warm_s, 3),
+        "small_batch": small,
+        "p50_ms": round(p50_ms, 3),
+        "compile_count": PREDICT_TELEMETRY["traces"],
+        "buckets_used": buckets,
+        "dispatches": PREDICT_TELEMETRY["dispatches"],
+        "parity": "pass",
+    }
+    anchor_rows = min(bulk_rows,
+                      int(os.environ.get("BENCH_PREDICT_ANCHOR_ROWS",
+                                         200_000)))
+    ref = run_local_reference_predict(
+        bst.model_to_string(), Xb[:anchor_rows], yb[:anchor_rows],
+        params, n_trees)
+    if ref is None:
+        out["local_ref_skipped"] = "BENCH_LOCAL_REF[_PREDICT]=0"
+    elif "skipped" in ref:
+        out["local_ref_skipped"] = ref["skipped"]
+    else:
+        out["local_ref"] = ref
+        out["vs_local_reference"] = round(
+            out["bulk_rows_per_s"] / ref["rows_per_s"], 3)
+    return out
+
+
 def run_higgs_real(params):
     """Real-HIGGS anchor (round-4 verdict #6): when the UCI HIGGS
     dataset is available — BENCH_HIGGS_PATH pointing at HIGGS.csv[.gz],
@@ -827,29 +1041,87 @@ def main():
         print(f"LOCAL_REF validation: {n}", file=sys.stderr)
 
     check_f32 = os.environ.get("BENCH_SKIP_F32") != "1"
+    t_primary = time.time()
     primary = run_scale(
         BENCH_ROWS, BENCH_ITERS, params, check_f32, local_ref=True,
         slope_probe=os.environ.get("BENCH_SLOPE_PROBE", "1") != "0")
+    primary_wall = max(time.time() - t_primary, 1e-3)
     scales = [primary]
+
+    # ---- per-phase budget admission (round 8): every REMAINING phase
+    # is admitted against an estimate scaled from the measured primary
+    # wall, so a lightgbm_tpu measurement run can no longer blow the
+    # outer driver timeout the way the r5 10.5M run did (rc=124,
+    # BENCH_r05.json parsed: null).  Estimates are deliberately
+    # conservative (1.5x) — a phase that would overrun is scaled down
+    # (big scale) or skipped WITH A NOTE, never started and killed.
+    FINISH_RESERVE_S = float(os.environ.get("BENCH_FINISH_RESERVE_S",
+                                            60))
+
+    def admit(task, est_s):
+        """Remaining-budget admission for one phase; returns the skip
+        note (None = run it)."""
+        left = budget_left() - FINISH_RESERVE_S
+        if est_s <= left:
+            return None
+        return (f"BENCH_BUDGET_S phase bound: est {est_s:.0f}s > "
+                f"{left:.0f}s left")
+
     if os.environ.get("BENCH_BIG", "1") != "0" \
             and BENCH_ROWS_BIG > BENCH_ROWS:
         # HIGGS true scale: the f32 accuracy gate already ran at the
         # primary scale (same kernels, same quantization); rerunning
         # two 10.5M trainings would double the bench wall for no new
-        # information
+        # information.
         # local_ref at true scale too (round-4 verdict #5: the 34.1x
         # 10.5M ratio was prose-only — capture it in the JSON record).
-        # The reference runs ~7.7 s/tree at this host's 1 thread, so
-        # the differenced pair uses few iterations (default 10 → ~80 s,
-        # plus minutes of CSV write + one-time binning; disable with
-        # BENCH_LOCAL_REF_BIG=0)
-        scales.append(run_scale(
-            BENCH_ROWS_BIG, BENCH_ITERS_BIG, params, check_f32=False,
-            local_ref=os.environ.get("BENCH_LOCAL_REF_BIG", "1") != "0",
-            ref_iters=int(os.environ.get("BENCH_REF_ITERS_BIG", 10))))
+        big_wall_unit = primary_wall * 1.5 / BENCH_ROWS  # s per row
+        rows_big = BENCH_ROWS_BIG
+        note = admit("big", big_wall_unit * rows_big)
+        if note is not None:
+            # scale the row count down to what the budget fits (floor
+            # 2x primary — below that the point adds nothing)
+            rows_fit = int((budget_left() - FINISH_RESERVE_S)
+                           / big_wall_unit)
+            rows_big = rows_fit if rows_fit >= 2 * BENCH_ROWS else 0
+        if rows_big:
+            s = run_scale(
+                rows_big, BENCH_ITERS_BIG, params, check_f32=False,
+                local_ref=os.environ.get("BENCH_LOCAL_REF_BIG",
+                                         "1") != "0",
+                ref_iters=int(os.environ.get("BENCH_REF_ITERS_BIG",
+                                             10)))
+            if rows_big != BENCH_ROWS_BIG:
+                s["scaled_down_from"] = BENCH_ROWS_BIG
+                s["budget_note"] = note
+            scales.append(s)
+        else:
+            scales.append({"task": "binary_big", "rows": BENCH_ROWS_BIG,
+                           "skipped": note})
     if os.environ.get("BENCH_LTR", "1") != "0":
-        scales.append(run_ltr_scale())
-    if budget_left() > 60:
+        ltr_rows = int(os.environ.get("BENCH_LTR_QUERIES", 18_900)) * 120
+        ltr_iters = int(os.environ.get("BENCH_LTR_ITERS", 30))
+        # width factor: MS-LTR is 136 features vs the 28-feature
+        # primary; anchors self-box against the remaining budget
+        est = (primary_wall * 1.5 * (136 / 28)
+               * (ltr_rows * ltr_iters) / (BENCH_ROWS * BENCH_ITERS))
+        note = admit("lambdarank", est)
+        if note is None:
+            scales.append(run_ltr_scale())
+        else:
+            scales.append({"task": "lambdarank", "skipped": note})
+    predict_block = None
+    if os.environ.get("BENCH_PREDICT", "1") != "0":
+        p_rows = int(os.environ.get("BENCH_PREDICT_TRAIN_ROWS", 200_000))
+        p_iters = int(os.environ.get("BENCH_PREDICT_ITERS", 50))
+        est = (primary_wall * 1.5
+               * (p_rows * p_iters) / (BENCH_ROWS * BENCH_ITERS)) + 30
+        note = admit("predict", est)
+        if note is None:
+            predict_block = run_predict_scale(params)
+        else:
+            predict_block = {"task": "predict", "skipped": note}
+    if budget_left() > 60 + FINISH_RESERVE_S:
         higgs = run_higgs_real(params)
         if higgs is not None:
             scales.append(higgs)
@@ -879,6 +1151,11 @@ def main():
         "budget": {"budget_s": BENCH_BUDGET_S,
                    "elapsed_s": round(time.time() - _T0, 1)},
     }
+    if predict_block is not None:
+        # the serving roofline block: bulk rows/s, micro-batch p50,
+        # compile count (one per shape bucket) and the task=predict
+        # anchor status (docs/ROOFLINE.md "Serving roofline")
+        result["predict"] = predict_block
     if "chunk_slope" in primary:
         # the round-6/7 per-iteration chunk-slope fit and what
         # dispatch_chunk=auto would pick locally and on an axon-RPC
@@ -924,6 +1201,23 @@ def main():
         print(f"rows={s.get('rows')} per_tree={s.get('per_tree_ms')}ms "
               f"vs_baseline={s.get('vs_baseline')} prep={s.get('prep_s')}s "
               f"compile={s.get('compile_s')}s{extra}", file=sys.stderr)
+    if predict_block is not None:
+        if "skipped" in predict_block:
+            print(f"predict skipped: {predict_block['skipped']}",
+                  file=sys.stderr)
+        else:
+            extra = ""
+            if "vs_local_reference" in predict_block:
+                extra = (f" vs_local_ref="
+                         f"{predict_block['vs_local_reference']} (ref "
+                         f"{predict_block['local_ref']['rows_per_s']} "
+                         "rows/s)")
+            print(f"predict bulk={predict_block['bulk_rows_per_s']} "
+                  f"rows/s p50[{predict_block['small_batch']}]="
+                  f"{predict_block['p50_ms']}ms "
+                  f"compiles={predict_block['compile_count']} "
+                  f"buckets={predict_block['buckets_used']}{extra}",
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -49,6 +49,134 @@ def _acc_fn():
     return _ACC_FN
 
 
+_PREDICT_CHUNK_BUDGET_BYTES = 256 << 20  # transient per-chunk device
+# footprint bound for predict_chunk_rows=auto (two chunks in flight)
+
+
+class _ServingPredictor:
+    """Shape-bucketed, chunk-streamed device predictor over one
+    ensemble slice — the serving subsystem's compiled-program unit.
+
+    Batch sizes round UP to power-of-two row buckets (masked tails:
+    pad rows are scored and discarded), so micro-batch serving traffic
+    compiles once per bucket instead of once per batch size; the
+    module-level jit in ops/predict.py shares those compilations
+    across every Booster in the process and `compile_cache_dir` across
+    processes.  Batches above the chunk cap stream through the device
+    in fixed full-bucket chunks with at most two chunks' results in
+    flight (double buffering: the next chunk's upload/compute overlaps
+    the previous one's D2H), so bulk scoring never densifies the whole
+    matrix on device."""
+
+    def __init__(self, models: List[Tree], num_class: int, config):
+        import jax.numpy as jnp
+
+        from .ops import predict as P
+        from .tree import flatten_ensemble
+
+        flat = flatten_ensemble(models, num_class)
+        self.depth = int(flat.pop("depth"))
+        self.stack = P.LevelEnsemble(
+            **{k: jnp.asarray(v) for k, v in flat.items()})
+        self.num_class = max(num_class, 1)
+        kernel = str(getattr(config, "predict_kernel", "auto")).lower()
+        self.kernel = "level" if kernel in ("auto", "") else kernel
+        self.interpret = bool(getattr(config, "force_pallas_interpret",
+                                      False))
+        tile = max(1, int(getattr(config, "predict_pallas_tile", 512)))
+        # power-of-two floor: the grid requires tile | rows, and both
+        # buckets and chunk caps are powers of two
+        self.tile = 1 << (tile.bit_length() - 1)
+        self.bucketed = str(getattr(config, "predict_bucket", "auto")
+                            ).lower() not in ("off", "false", "0")
+        self.min_bucket = max(1, int(getattr(
+            config, "predict_min_bucket_rows", 16)))
+        self.chunk_rows = int(getattr(config, "predict_chunk_rows", 0))
+
+    # ------------------------------------------------------------------
+    def _chunk_cap(self, two_f: int) -> int:
+        if self.chunk_rows > 0:
+            cap = self.chunk_rows
+        else:
+            t = int(self.stack.root.shape[0])
+            # per-row transients: the (N, 2F) hi/lo matrix + the (N, T)
+            # node state, (N, 2T) gather indices and (N, T) values
+            bytes_per_row = 4 * (two_f + 8 * max(t, 1))
+            cap = _PREDICT_CHUNK_BUDGET_BYTES // max(bytes_per_row, 1)
+            cap = max(4096, min(1 << 20, cap))
+        if self.bucketed:
+            # power-of-two cap => every full chunk is ONE bucket shape
+            cap = 1 << (max(cap, 1).bit_length() - 1)
+        return cap
+
+    def _bucket(self, m: int, cap: int) -> int:
+        if not self.bucketed:
+            return m
+        b = self.min_bucket
+        while b < m:
+            b <<= 1
+        return min(b, cap)
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, x2_dev):
+        from .ops import predict as P
+        if self.kernel == "pallas":
+            # halve until the tile divides the batch (immediate for
+            # power-of-two buckets; odd bucket-off batches degrade to
+            # tile 1 rather than crash the grid)
+            tile = self.tile
+            while x2_dev.shape[0] % tile:
+                tile >>= 1
+            return P.predict_level_ensemble_pallas(
+                self.stack, x2_dev, depth=self.depth, tile=max(tile, 1),
+                interpret=self.interpret)
+        return P.predict_level_ensemble(self.stack, x2_dev,
+                                        depth=self.depth)
+
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        """(n, F) float64 raw features -> (n, K) float64 raw scores
+        (f32 device accumulation, identical routing to the host walk)."""
+        import jax.numpy as jnp
+
+        from .ops import predict as P
+
+        data = np.asarray(data, dtype=np.float64)
+        n = data.shape[0]
+        if n == 0:
+            return np.zeros((0, self.num_class))
+        hi, lo = P.split_hi_lo(data)
+        x2 = np.empty((n, 2 * data.shape[1]), np.float32)
+        x2[:, 0::2] = hi
+        x2[:, 1::2] = lo
+        cap = self._chunk_cap(x2.shape[1])
+        out = np.empty((n, self.num_class), np.float32)
+        pending: list = []
+
+        def drain(slot):
+            dev, s, m = slot
+            out[s:s + m] = np.asarray(dev)[:m]
+
+        for s in range(0, n, cap):
+            part = x2[s:s + cap]
+            m = part.shape[0]
+            b = self._bucket(m, cap)
+            if m < b:
+                part = np.concatenate(
+                    [part, np.zeros((b - m, x2.shape[1]), np.float32)])
+            dev = self._dispatch(jnp.asarray(part))
+            P.PREDICT_TELEMETRY["dispatches"] += 1
+            P.PREDICT_TELEMETRY["rows"] += m
+            P.PREDICT_TELEMETRY["buckets"].add(int(part.shape[0]))
+            pending.append((dev, s, m))
+            if len(pending) >= 2:
+                # double buffer: at most TWO chunks' results in flight
+                # (what _PREDICT_CHUNK_BUDGET_BYTES sizes against)
+                drain(pending.pop(0))
+        for slot in pending:
+            drain(slot)
+        return out.astype(np.float64)
+
+
 class Booster:
     def __init__(self, config: Optional[Config] = None,
                  train_set: Optional[Dataset] = None,
@@ -221,6 +349,7 @@ class Booster:
         # different tree — a length-keyed stack cache would serve the
         # rolled-back ensemble
         self._raw_stack_cache = None
+        self._predictor_cache = None
 
     def _sync_models(self) -> None:
         """Materialize any device-resident trees into self.models
@@ -255,14 +384,19 @@ class Booster:
         gbdt_prediction.cpp:9-100; SHAP via tree.PredictContrib;
         margin-based early stop prediction_early_stop.cpp:13-80).
 
-        ``device``: None (auto) routes large batch predictions of
-        in-session models through the accelerator — the input is binned
-        with the training mappers and the device-resident trees are
-        evaluated in one scanned program (the TPU analog of the
-        reference's OMP batch predict, c_api.cpp:200).  The device path
-        accumulates in float32 (the host walk uses float64), so raw
-        scores may differ at ~1e-6 relative.  True forces it, False
-        forces the host path."""
+        ``device``: None (auto) routes predictions through the
+        accelerator when one is attached — large in-session batches
+        through the binned scan (input binned with the training
+        mappers, device-resident trees evaluated in one scanned
+        program, the TPU analog of the reference's OMP batch predict,
+        c_api.cpp:200), and everything else — any batch size,
+        serving-shaped micro-batches included — through the bucketed
+        level-descent serving predictor (_ServingPredictor: batch
+        sizes round up to power-of-two buckets so small batches reuse
+        one compiled program).  The device paths accumulate in float32
+        (the host walk uses float64), so raw scores may differ at
+        ~1e-6 relative.  True forces the device path, False forces the
+        host walk."""
         from .basic import _is_sparse, _to_matrix
         if _is_sparse(data):
             # CSR prediction without whole-matrix densify (reference
@@ -389,6 +523,7 @@ class Booster:
             bst.models.append(ct)
         bst.max_feature_idx = int(used.size) - 1
         bst._raw_stack_cache = None
+        bst._predictor_cache = None
         bst._device_stale = False
         return bst, used
 
@@ -508,24 +643,87 @@ class Booster:
         if device is True:
             return True
         import jax
+        if jax.default_backend() not in ("tpu", "axon"):
+            return False
+        if self._predict_impl() != "scan" \
+                and str(getattr(self.config, "predict_bucket", "auto")
+                        ).lower() not in ("off", "false", "0"):
+            # bucketed serving predictor: small batches reuse the
+            # bucket's compiled program, so serving-shaped traffic
+            # routes to the accelerator at ANY batch size (the old
+            # n*trees floor existed to amortize per-shape compiles)
+            return True
         n_trees = self._resolve_tree_count(total, num_iteration)
-        return (jax.default_backend() in ("tpu", "axon")
-                and n * n_trees >= 2_000_000)
+        return n * n_trees >= 2_000_000
+
+    def _predict_impl(self) -> str:
+        k = str(getattr(self.config, "predict_kernel", "auto")).lower()
+        return "level" if k in ("auto", "") else k
+
+    def _serving_predictor(self, count: int) -> _ServingPredictor:
+        """Per-(model revision, tree count) serving predictor cache —
+        the ensemble stack uploads once; compiled programs are shared
+        process-wide by the module-level jit underneath."""
+        cache = getattr(self, "_predictor_cache", None)
+        if cache is None or cache[0] != len(self.models):
+            cache = (len(self.models), {})
+            self._predictor_cache = cache
+        by_count = cache[1]
+        if count not in by_count:
+            by_count[count] = _ServingPredictor(
+                self.models[:count],
+                max(self.num_tree_per_iteration, 1), self.config)
+        return by_count[count]
+
+    def warm_predictor(self, batch_sizes=(1,),
+                       num_iteration: int = -1) -> "Booster":
+        """Serving warm-up: compile the bucketed device predictor for
+        the given batch sizes at deploy time instead of on the first
+        request (with compile_cache_dir wired this is a disk hit in
+        later processes).  Drives the serving predictor DIRECTLY —
+        predict() routing would send an in-session booster's call
+        through the binned scan instead, warming the wrong programs.
+        Wired to `predict_warm_buckets` in engine.train()."""
+        self._sync_models()
+        if not self.models:
+            return self
+        count = self._resolve_tree_count(len(self.models), num_iteration)
+        if count == 0 or self._predict_impl() == "scan":
+            return self
+        pred = self._serving_predictor(count)
+        f = self.max_feature_idx + 1
+        for b in batch_sizes:
+            pred(np.zeros((max(int(b), 1), f)))
+        return self
 
     def _device_predict_loaded(self, data: np.ndarray,
                                num_iteration: int):
-        """Raw scores via the stacked raw-feature walk.  Returns
-        ((n, k) float64 raw scores, used tree count).  Accumulation is
-        float32 (documented device-predict precision); decisions match
-        the host walk exactly via the two-float threshold compare."""
+        """Raw scores via the ensemble-vectorized level descent (or the
+        legacy per-tree stacked walk when predict_kernel=scan).
+        Returns ((n, k) float64 raw scores, used tree count).
+        Accumulation is float32 (documented device-predict precision);
+        decisions match the host walk exactly via the two-float
+        threshold compare.  num_iteration resolves through the SAME
+        _resolve_tree_count as the host path, so both paths always
+        slice identical tree counts."""
+        self._sync_models()
+        count = self._resolve_tree_count(len(self.models), num_iteration)
+        k = max(self.num_tree_per_iteration, 1)
+        if count == 0:
+            return np.zeros((data.shape[0], k)), 0
+        if self._predict_impl() == "scan":
+            return self._device_predict_scan(data, count, k), count
+        return self._serving_predictor(count)(data), count
+
+    def _device_predict_scan(self, data: np.ndarray, count: int,
+                             k: int) -> np.ndarray:
+        """Legacy per-tree lax.scan walk (predict_kernel=scan A/B)."""
         import jax
         import jax.numpy as jnp
 
         from .ops.predict import (predict_raw_ensemble, split_hi_lo,
                                   stack_host_trees)
 
-        self._sync_models()
-        count = self._resolve_tree_count(len(self.models), num_iteration)
         cache = getattr(self, "_raw_stack_cache", None)
         if cache is None or cache[0] != len(self.models):
             cache = (len(self.models), stack_host_trees(self.models))
@@ -533,13 +731,12 @@ class Booster:
         stack = cache[1]
         if count < len(self.models):
             stack = jax.tree_util.tree_map(lambda x: x[:count], stack)
-        k = max(self.num_tree_per_iteration, 1)
         cls = jnp.arange(count, dtype=jnp.int32) % k
         Xhi, Xlo = split_hi_lo(data)
         out = predict_raw_ensemble(
             stack, jnp.asarray(Xhi), jnp.asarray(Xlo), cls,
             jnp.zeros((k, data.shape[0]), jnp.float32))
-        return np.asarray(out).T.astype(np.float64), count
+        return np.asarray(out).T.astype(np.float64)
 
     def _used_models(self, num_iteration: int) -> List[Tree]:
         self._sync_models()
@@ -897,10 +1094,13 @@ class Booster:
                 tree.leaf_count[leaf] = int(mask.sum())
             scores[:, cls] += tree.leaf_value[lp]
         # host trees diverged from the in-session device stacks;
-        # invalidate both device paths' caches (the raw-stack path
-        # rebuilds from the refitted host trees on next use)
+        # invalidate every device path's cache (the serving/raw-stack
+        # predictors rebuild from the refitted host trees on next use
+        # — refit mutates leaf values IN PLACE, so the length-keyed
+        # caches would otherwise serve stale ensembles)
         self._device_stale = True
         self._raw_stack_cache = None
+        self._predictor_cache = None
         return self
 
     # ------------------------------------------------------------------

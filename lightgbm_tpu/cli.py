@@ -82,7 +82,10 @@ def _task_train(params, config: Config) -> None:
 def _task_predict(params, config: Config) -> None:
     if not config.input_model:
         Log.fatal("No model file: set input_model=<file>")
-    booster = Booster(model_file=config.input_model)
+    # the parsed config rides along so CLI predict knobs
+    # (predict_kernel, predict_bucket, predict_chunk_rows, ...) reach
+    # the serving predictor
+    booster = Booster(config=config, model_file=config.input_model)
     from .data_loader import load_file
     X, _, _ = load_file(config.data, config)
     pred = booster.predict(

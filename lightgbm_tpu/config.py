@@ -428,6 +428,34 @@ class Config:
     # early rounds of every tree have 1-2 new leaves, and the finder's
     # (2W, F, B) threshold sweep was the last frontier-capped cost
     # (ROOFLINE headroom #2).  False restores the full-width finder
+    predict_kernel: str = "auto"    # device predictor implementation:
+    # "level" (default for auto) is the ensemble-vectorized
+    # level-synchronous descent — all trees advance together over the
+    # row tile, one feature gather per level across the whole ensemble;
+    # "pallas" is its row-tile kernel form keeping the stacked ensemble
+    # resident in VMEM (interpret-seam validated; the queued on-chip
+    # A/B, like hist_leaf_partition r6); "scan" restores the legacy
+    # per-tree lax.scan node walk (two full-matrix gathers per node
+    # step) for A/B
+    predict_bucket: str = "auto"    # shape-bucketed predict compile
+    # cache: batch sizes round UP to power-of-two row buckets with
+    # masked (padded, discarded) tails, so micro-batch serving compiles
+    # once per bucket instead of once per batch size.  auto = on;
+    # "off" compiles per exact batch shape (legacy)
+    predict_min_bucket_rows: int = 16  # smallest row bucket (single-row
+    # serving calls share one compiled program up to this size)
+    predict_chunk_rows: int = 0     # rows per device dispatch for bulk
+    # scoring; batches above it stream in fixed full-bucket chunks with
+    # at most two results in flight (double buffering), so HIGGS-scale
+    # scoring never densifies the whole matrix on device.  0 = auto:
+    # sized from the per-row device footprint against a ~256 MB
+    # transient budget, clamped to [4096, 1M] rows
+    predict_pallas_tile: int = 512  # rows per Pallas predict tile
+    # (predict_kernel=pallas); shrinks to the bucket when smaller
+    predict_warm_buckets: Tuple[int, ...] = ()  # serving warm-up:
+    # batch sizes whose buckets are pre-compiled after train() /
+    # on warm_predictor(), so the first request doesn't pay the
+    # compile (a disk hit across processes via compile_cache_dir)
     compile_cache_dir: str = "~/.cache/lightgbm_tpu/jit"  # persistent
     # XLA compilation cache directory (jax_compilation_cache_dir):
     # repeat processes skip the multi-second cold compile (37 s at the
@@ -495,6 +523,20 @@ class Config:
                 "auto", "on", "off", "true", "false", "1", "0"):
             raise ValueError("packed_tree_carry must be auto/on/off, "
                              f"got {self.packed_tree_carry!r}")
+        if str(self.predict_kernel).lower() not in (
+                "auto", "level", "pallas", "scan"):
+            raise ValueError("predict_kernel must be auto/level/pallas/"
+                             f"scan, got {self.predict_kernel!r}")
+        if str(self.predict_bucket).lower() not in (
+                "auto", "on", "off", "true", "false", "1", "0"):
+            raise ValueError("predict_bucket must be auto/on/off, "
+                             f"got {self.predict_bucket!r}")
+        if self.predict_min_bucket_rows < 1:
+            raise ValueError("predict_min_bucket_rows must be >= 1")
+        if self.predict_chunk_rows < 0:
+            raise ValueError("predict_chunk_rows must be >= 0 (0 = auto)")
+        if self.predict_pallas_tile < 1:
+            raise ValueError("predict_pallas_tile must be >= 1")
         dc = str(self.dispatch_chunk).lower()
         if dc != "auto":
             try:

@@ -242,6 +242,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # score arrays) is released; prediction and use as init_model
         # keep working
         booster.free_dataset()
+    if config.predict_warm_buckets and booster.num_trees() > 0:
+        # serving warm-up: pre-compile the bucketed device predictor
+        # for the declared batch shapes, so the first request after
+        # deploy pays a cache hit instead of a compile
+        booster.warm_predictor(config.predict_warm_buckets)
     return booster
 
 

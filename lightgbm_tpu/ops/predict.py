@@ -20,6 +20,7 @@ midpoint).
 """
 from __future__ import annotations
 
+import functools
 from typing import List, NamedTuple
 
 import jax
@@ -122,6 +123,179 @@ def predict_binned(tree, bins: jax.Array, f_group: jax.Array,
     return tree.leaf_value[jnp.clip(leaf, 0, tree.leaf_value.shape[0] - 1)]
 
 
+# ---------------------------------------------------------------------------
+# Ensemble-vectorized level-synchronous descent (serving predictor).
+#
+# The per-tree scan above this round (predict_raw_ensemble) walked one
+# tree at a time, and each node step gathered from the full (N, F)
+# feature matrix TWICE (hi + lo) — 2·T·depth big gathers per batch, the
+# exact pattern the round-5 profiles measured at ~1.6 GiB/s.  Here ALL
+# T trees advance one level per step over the whole row tile: the node
+# state is one (N, T) array over tree.flatten_ensemble's flat node
+# axis, the per-level feature fetch is ONE take_along_axis of (N, 2T)
+# indices into the interleaved (N, 2F) hi/lo matrix (feat2 is
+# pre-doubled so the hi and lo parts ride the same gather), and the
+# remaining per-level gathers hit only the small flat node tables.
+# The loop is depth-bounded (static max tree depth, no jnp.any exit
+# sync), so the program is one fori_loop + one class-matmul.
+# ---------------------------------------------------------------------------
+
+# serving-predictor telemetry: ``traces`` counts jit retraces (== XLA
+# compilations per process modulo the persistent cache), ``dispatches``
+# device calls, ``buckets`` the padded row-bucket shapes served.  The
+# bench's compile-count line and the cache lint read these.
+PREDICT_TELEMETRY = {"traces": 0, "dispatches": 0, "rows": 0,
+                     "buckets": set()}
+
+
+def reset_predict_telemetry() -> None:
+    PREDICT_TELEMETRY.update(traces=0, dispatches=0, rows=0, buckets=set())
+
+
+class LevelEnsemble(NamedTuple):
+    """Flat SoA node tensors of a whole ensemble (tree.flatten_ensemble
+    layout): node axis = t*M + i, leaf axis = t*L + l, child pointers
+    pre-resolved into those spaces, feat2 pre-doubled for the
+    interleaved hi/lo gather."""
+    feat2: jax.Array        # (T*M,) int32 = 2 * feature
+    thr_hi: jax.Array       # (T*M,) f32
+    thr_lo: jax.Array       # (T*M,) f32 residual (finite, r7 inf guard)
+    dtype_: jax.Array       # (T*M,) int32 decision_type bitfield
+    left: jax.Array         # (T*M,) int32 flat child (negative = leaf)
+    right: jax.Array        # (T*M,) int32
+    leaf_value: jax.Array   # (T*L,) f32
+    cat_words: jax.Array    # (T*M*W,) int32 per-node category bitset
+    root: jax.Array         # (T,) int32 initial node (stumps settled)
+    cls_onehot: jax.Array   # (T, K) f32 tree -> class accumulator
+
+
+def _two_float_left(fhi, flo, thr_hi, thr_lo):
+    """Exact f64 ``fv <= thr`` for f32-representable data, including
+    equal-hi pairs where both parts are +-inf (inf - inf is NaN and
+    would misroute; the host walk's ``inf <= inf`` is True)."""
+    d = jnp.where(fhi == thr_hi, flo - thr_lo,
+                  (fhi - thr_hi) + (flo - thr_lo))
+    return d <= 0.0
+
+
+def _level_step(stack: LevelEnsemble, X2: jax.Array, node: jax.Array,
+                T: int, W: int) -> jax.Array:
+    """Advance every (row, tree) pair one level.  ``node`` is (N, T)
+    flat node ids; negative = settled leaf (kept as-is)."""
+    nid = jnp.maximum(node, 0)
+    f2 = stack.feat2[nid]                               # (N, T)
+    idx = jnp.concatenate([f2, f2 + 1], axis=1)         # (N, 2T)
+    v = jnp.take_along_axis(X2, idx, axis=1)            # ONE X gather
+    vhi, vlo = v[:, :T], v[:, T:]
+    dt = stack.dtype_[nid]
+    is_cat = (dt & K_CATEGORICAL_MASK) > 0
+    dleft = (dt & K_DEFAULT_LEFT_MASK) > 0
+    mtype = (dt >> 2) & 3
+    nan_mask = jnp.isnan(vhi)
+    conv = nan_mask & (mtype != MISSING_NAN)
+    fhi = jnp.where(conv, 0.0, vhi)
+    flo = jnp.where(conv, 0.0, vlo)
+    is_zero = (fhi > -K_ZERO_THRESHOLD) & (fhi <= K_ZERO_THRESHOLD)
+    use_default = ((mtype == MISSING_ZERO) & is_zero) | \
+                  ((mtype == MISSING_NAN) & jnp.isnan(fhi))
+    num_left = jnp.where(use_default, dleft,
+                         _two_float_left(fhi, flo, stack.thr_hi[nid],
+                                         stack.thr_lo[nid]))
+    v_int = jnp.where(nan_mask, -1, fhi.astype(jnp.int32))
+    in_range = (v_int >= 0) & (v_int < W * 32)
+    word = stack.cat_words[nid * W + jnp.clip(v_int // 32, 0, W - 1)]
+    bit = jnp.bitwise_and(
+        jax.lax.shift_right_logical(word, v_int % 32), 1)
+    cat_left = in_range & (bit > 0)
+    go_left = jnp.where(is_cat, cat_left, num_left)
+    nxt = jnp.where(go_left, stack.left[nid], stack.right[nid])
+    return jnp.where(node >= 0, nxt, node)
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "unroll"))
+def predict_level_ensemble(stack: LevelEnsemble, X2: jax.Array, *,
+                           depth: int, unroll: int = 1) -> jax.Array:
+    """All-trees level descent over an interleaved (N, 2F) hi/lo
+    matrix -> (N, K) f32 class-accumulated raw scores (f32 matmul
+    accumulation — the documented device-predict precision).
+
+    ``depth`` (static) is the ensemble's max tree depth: after that
+    many levels every row has settled, so there is no per-level
+    ``jnp.any`` device sync.  Module-level jit: one compilation per
+    (ensemble shape, row bucket) serves every Booster in the process,
+    and the persistent compile cache serves it across processes."""
+    PREDICT_TELEMETRY["traces"] += 1
+    T = stack.root.shape[0]
+    W = stack.cat_words.shape[0] // stack.feat2.shape[0]
+    n = X2.shape[0]
+    node = jnp.broadcast_to(stack.root[None, :], (n, T))
+    if depth > 0:
+        node = jax.lax.fori_loop(
+            0, depth, lambda i, nd: _level_step(stack, X2, nd, T, W),
+            node, unroll=unroll)
+    leaf = jnp.clip(-node - 1, 0, stack.leaf_value.shape[0] - 1)
+    vals = stack.leaf_value[leaf]                       # (N, T)
+    return jnp.dot(vals, stack.cls_onehot)              # (N, K)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("depth", "tile", "interpret"))
+def predict_level_ensemble_pallas(stack: LevelEnsemble, X2: jax.Array,
+                                  *, depth: int, tile: int,
+                                  interpret: bool = False) -> jax.Array:
+    """Row-tile Pallas form of the level descent: the grid walks (tile,
+    2F) row blocks while every ensemble table is a full-array VMEM
+    block — the stacked ensemble stays chip-resident across the whole
+    batch instead of re-streaming from HBM per level.  Validated on the
+    interpret seam (this container has no chip); `predict_kernel=
+    pallas` is the one-flag on-chip A/B, same protocol as
+    hist_leaf_partition r6."""
+    PREDICT_TELEMETRY["traces"] += 1
+    from jax.experimental import pallas as pl
+
+    n, f2_dim = X2.shape
+    T = stack.root.shape[0]
+    K = stack.cls_onehot.shape[1]
+    W = stack.cat_words.shape[0] // stack.feat2.shape[0]
+    if n % tile != 0:
+        raise ValueError(f"row count {n} must be a multiple of the "
+                         f"predict tile {tile} (buckets are powers of "
+                         "two; the serving predictor pads)")
+
+    def kernel(f2_ref, thi_ref, tlo_ref, dt_ref, l_ref, r_ref, lv_ref,
+               cw_ref, root_ref, c1h_ref, x2_ref, out_ref):
+        local = LevelEnsemble(
+            feat2=f2_ref[:], thr_hi=thi_ref[:], thr_lo=tlo_ref[:],
+            dtype_=dt_ref[:], left=l_ref[:], right=r_ref[:],
+            leaf_value=lv_ref[:], cat_words=cw_ref[:], root=root_ref[:],
+            cls_onehot=c1h_ref[:])
+        X2t = x2_ref[:]
+        node = jnp.broadcast_to(local.root[None, :], (tile, T))
+        if depth > 0:
+            node = jax.lax.fori_loop(
+                0, depth,
+                lambda i, nd: _level_step(local, X2t, nd, T, W), node)
+        leaf = jnp.clip(-node - 1, 0, local.leaf_value.shape[0] - 1)
+        vals = local.leaf_value[leaf]
+        out_ref[:] = jnp.dot(vals, local.cls_onehot,
+                             preferred_element_type=jnp.float32)
+
+    def full(a):
+        return pl.BlockSpec(a.shape, lambda i: (0,) * a.ndim)
+
+    fields = [stack.feat2, stack.thr_hi, stack.thr_lo, stack.dtype_,
+              stack.left, stack.right, stack.leaf_value,
+              stack.cat_words, stack.root, stack.cls_onehot]
+    return pl.pallas_call(
+        kernel,
+        grid=(n // tile,),
+        in_specs=[full(a) for a in fields]
+        + [pl.BlockSpec((tile, f2_dim), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, K), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, K), jnp.float32),
+        interpret=interpret)(*fields, X2)
+
+
 class RawTreeStack(NamedTuple):
     """T host trees stacked into fixed-shape device arrays for the
     raw-feature batch predict (padded to the batch max node/leaf/cat
@@ -140,15 +314,12 @@ class RawTreeStack(NamedTuple):
 def stack_host_trees(models: List) -> RawTreeStack:
     """Upload a host Tree list as one RawTreeStack (leaf values carry
     shrinkage/DART renormalization already — host semantics)."""
+    from ..tree import (ensemble_cat_width, split_threshold_parts,
+                        tree_cat_words)
     T = len(models)
     M = max(max(t.num_leaves - 1 for t in models), 1)
     L = M + 1
-    W = 1
-    for t in models:
-        for i in range(t.num_leaves - 1):
-            if t.decision_type[i] & K_CATEGORICAL_MASK:
-                ci = int(t.threshold[i])
-                W = max(W, t.cat_boundaries[ci + 1] - t.cat_boundaries[ci])
+    W = ensemble_cat_width(models)
     nl = np.zeros(T, np.int32)
     feat = np.zeros((T, M), np.int32)
     thr = np.zeros((T, M), np.float64)
@@ -169,20 +340,8 @@ def stack_host_trees(models: List) -> RawTreeStack:
         left[k, :m] = t.left_child[:m]
         right[k, :m] = t.right_child[:m]
         lv[k, :t.num_leaves] = t.leaf_value[:t.num_leaves]
-        for i in range(m):
-            if dt[k, i] & K_CATEGORICAL_MASK:
-                ci = int(t.threshold[i])
-                lo, hi = t.cat_boundaries[ci], t.cat_boundaries[ci + 1]
-                words = np.asarray(t.cat_threshold[lo:hi], dtype=np.uint32)
-                cw[k, i, :len(words)] = words
-    hi = thr.astype(np.float32)
-    with np.errstate(invalid="ignore"):
-        lo = (thr - hi.astype(np.float64)).astype(np.float32)
-    # +-inf thresholds (a split keeping the NaN/overflow bin on one
-    # side) must keep lo finite: inf - inf is NaN, and a NaN residual
-    # poisons the two-float compare into always-right, diverging from
-    # the host walk's `fv <= +inf`.
-    lo = np.where(np.isnan(lo), np.float32(0), lo)
+        cw[k, :m] = tree_cat_words(t, W)
+    hi, lo = split_threshold_parts(thr)
     return RawTreeStack(
         num_leaves=jnp.asarray(nl), feature=jnp.asarray(feat),
         thr_hi=jnp.asarray(hi), thr_lo=jnp.asarray(lo),
@@ -230,8 +389,9 @@ def _walk_raw(tree: RawTreeStack, Xhi: jax.Array, Xlo: jax.Array
                       ((mtype == MISSING_NAN) & jnp.isnan(fhi))
         # two-float comparison: exact f64 `fv <= thr` for
         # f32-representable data (see module docstring)
-        d = (fhi - tree.thr_hi[nid]) + (flo - tree.thr_lo[nid])
-        num_left = jnp.where(use_default, dleft, d <= 0.0)
+        num_left = jnp.where(use_default, dleft,
+                             _two_float_left(fhi, flo, tree.thr_hi[nid],
+                                             tree.thr_lo[nid]))
         # categorical: int truncation of the raw value, then bitset
         v_int = jnp.where(nan_mask, -1, fhi.astype(jnp.int32))
         in_range = (v_int >= 0) & (v_int < W * 32)

@@ -228,12 +228,15 @@ class LGBMModel(_SKBase):
         return y
 
     def predict(self, X, raw_score=False, num_iteration=None,
-                pred_leaf=False, pred_contrib=False):
+                pred_leaf=False, pred_contrib=False, **kwargs):
+        """Extra keyword arguments (e.g. ``device=True`` to force the
+        bucketed device predictor for serving-shaped micro-batches)
+        forward to Booster.predict."""
         if self._Booster is None:
             raise RuntimeError("Estimator not fitted")
         return self._Booster.predict(
             X, num_iteration=num_iteration or -1, raw_score=raw_score,
-            pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib, **kwargs)
 
     # -- attributes -------------------------------------------------------
     @property
@@ -312,18 +315,18 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         return np.asarray([lut[v] for v in y], dtype=np.float64)
 
     def predict(self, X, raw_score=False, num_iteration=None,
-                pred_leaf=False, pred_contrib=False):
+                pred_leaf=False, pred_contrib=False, **kwargs):
         result = self.predict_proba(X, raw_score, num_iteration,
-                                    pred_leaf, pred_contrib)
+                                    pred_leaf, pred_contrib, **kwargs)
         if raw_score or pred_leaf or pred_contrib:
             return result
         idx = np.argmax(result, axis=1)
         return self._classes[idx]
 
     def predict_proba(self, X, raw_score=False, num_iteration=None,
-                      pred_leaf=False, pred_contrib=False):
+                      pred_leaf=False, pred_contrib=False, **kwargs):
         result = super().predict(X, raw_score, num_iteration, pred_leaf,
-                                 pred_contrib)
+                                 pred_contrib, **kwargs)
         if raw_score or pred_leaf or pred_contrib:
             return result
         if result.ndim == 1:   # binary: (n,) prob of positive class

@@ -137,6 +137,120 @@ class TreeRecordLayout:
         return out
 
 
+def ensemble_cat_width(models: List["Tree"]) -> int:
+    """Widest per-node categorical bitset (in uint32 words) across an
+    ensemble — the padded W of every device tree stack."""
+    W = 1
+    for t in models:
+        for i in range(t.num_leaves - 1):
+            if t.decision_type[i] & K_CATEGORICAL_MASK:
+                ci = int(t.threshold[i])
+                W = max(W, t.cat_boundaries[ci + 1] - t.cat_boundaries[ci])
+    return W
+
+
+def tree_cat_words(t: "Tree", width: int) -> np.ndarray:
+    """One tree's per-node categorical bitsets as a dense
+    (num_leaves-1, width) uint32 block (zero-padded)."""
+    m = max(t.num_leaves - 1, 0)
+    cw = np.zeros((m, width), np.uint32)
+    for i in range(m):
+        if t.decision_type[i] & K_CATEGORICAL_MASK:
+            ci = int(t.threshold[i])
+            lo, hi = t.cat_boundaries[ci], t.cat_boundaries[ci + 1]
+            words = np.asarray(t.cat_threshold[lo:hi], dtype=np.uint32)
+            cw[i, :len(words)] = words
+    return cw
+
+
+def split_threshold_parts(thr: np.ndarray):
+    """f64 thresholds -> (hi, lo) f32 pair for the device two-float
+    compare.  +-inf thresholds (a split keeping the NaN/overflow bin on
+    one side) must keep lo finite: inf - inf is NaN, and a NaN residual
+    poisons the compare into always-right, diverging from the host
+    walk's ``fv <= +inf`` (the r7 fix — ONE definition, shared by every
+    device tree stacker)."""
+    hi = thr.astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        lo = (thr - hi.astype(np.float64)).astype(np.float32)
+    return hi, np.where(np.isnan(lo), np.float32(0), lo)
+
+
+def flatten_ensemble(models: List["Tree"], num_class: int = 1
+                     ) -> Dict[str, np.ndarray]:
+    """Ensemble-level SoA node layout for the level-synchronous device
+    descent (ops/predict.py predict_level_ensemble / _pallas).
+
+    The per-tree node arrays of the whole ensemble land in ONE flat
+    node axis — tree ``t``'s node ``i`` at flat slot ``t*M + i`` (M =
+    the batch max node count) — with child pointers PRE-RESOLVED into
+    that flat space (internal child ``c`` -> ``t*M + c``; leaf ``l`` ->
+    ``-(t*L + l) - 1``, indexing the flat leaf-value vector), so the
+    descent never forms ``t*M + node`` on device and one (N, T) gather
+    per small table serves every tree at once.  The split feature is
+    pre-DOUBLED (``2*f``) to index the interleaved (N, 2F) hi/lo
+    matrix: a single take_along_axis per level fetches BOTH float
+    parts of the two-float threshold compare for every (row, tree)
+    pair — the whole-ensemble replacement for the per-tree scan's two
+    full-matrix gathers per node step.
+
+    Returns the LevelEnsemble field dict (numpy; feat2/thr_hi/thr_lo/
+    dtype_/left/right/leaf_value/cat_words/root/cls_onehot) plus the
+    static ``depth`` bound (max tree depth — the unrolled level count
+    that settles every row).
+    """
+    T = len(models)
+    if T == 0:
+        raise ValueError("flatten_ensemble needs at least one tree")
+    M = max(max(t.num_leaves - 1 for t in models), 1)
+    L = M + 1
+    W = ensemble_cat_width(models)
+    feat2 = np.zeros((T, M), np.int32)
+    thr = np.zeros((T, M), np.float64)
+    dt = np.zeros((T, M), np.int32)
+    left = np.zeros((T, M), np.int64)
+    right = np.zeros((T, M), np.int64)
+    lv = np.zeros((T, L), np.float32)
+    cw = np.zeros((T, M, W), np.uint32)
+    root = np.zeros(T, np.int32)
+    depth = 0
+    for k, t in enumerate(models):
+        m = t.num_leaves - 1
+        if m <= 0:
+            # stump: the root IS leaf 0 — encode it settled
+            lv[k, 0] = t.leaf_value[0] if len(t.leaf_value) else 0.0
+            root[k] = -(k * L) - 1
+            continue
+        root[k] = k * M
+        depth = max(depth, t.max_depth())
+        feat2[k, :m] = 2 * t.split_feature[:m]
+        thr[k, :m] = t.threshold[:m]
+        dt[k, :m] = t.decision_type[:m]
+        # child pointers resolved into the flat node/leaf spaces
+        for arr, out in ((t.left_child, left), (t.right_child, right)):
+            c = np.asarray(arr[:m], np.int64)
+            out[k, :m] = np.where(c >= 0, k * M + c, -(k * L + (-c - 1)) - 1)
+        lv[k, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        cw[k, :m] = tree_cat_words(t, W)
+    hi, lo = split_threshold_parts(thr)
+    k_cls = max(num_class, 1)
+    cls_onehot = np.zeros((T, k_cls), np.float32)
+    cls_onehot[np.arange(T), np.arange(T) % k_cls] = 1.0
+    return {
+        "feat2": feat2.reshape(-1),
+        "thr_hi": hi.reshape(-1),
+        "thr_lo": lo.reshape(-1),
+        "dtype_": dt.reshape(-1),
+        "left": left.reshape(-1).astype(np.int32),
+        "right": right.reshape(-1).astype(np.int32),
+        "leaf_value": lv.reshape(-1),
+        "cat_words": cw.reshape(-1).view(np.int32),
+        "root": root,
+        "cls_onehot": cls_onehot,
+        "depth": depth,
+    }
+
+
 def _make_decision_type(is_cat: bool, default_left: bool,
                         missing_type: int) -> int:
     dt = 0

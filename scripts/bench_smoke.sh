@@ -21,6 +21,10 @@ BENCH_LEAVES=${BENCH_LEAVES:-31} \
 BENCH_BIG=0 \
 BENCH_LTR_QUERIES=${BENCH_LTR_QUERIES:-40} \
 BENCH_LTR_ITERS=${BENCH_LTR_ITERS:-2} \
+BENCH_PREDICT_TRAIN_ROWS=${BENCH_PREDICT_TRAIN_ROWS:-2048} \
+BENCH_PREDICT_ITERS=${BENCH_PREDICT_ITERS:-3} \
+BENCH_PREDICT_ROWS=${BENCH_PREDICT_ROWS:-4096} \
+BENCH_PREDICT_CALLS=${BENCH_PREDICT_CALLS:-10} \
 BENCH_LOCAL_REF=0 \
 BENCH_SKIP_F32=1 \
 BENCH_BUDGET_S=${BENCH_BUDGET_S:-600} \

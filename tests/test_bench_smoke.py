@@ -37,6 +37,22 @@ def test_bench_smoke_json_contract():
     ltr = next(s for s in out["scales"] if s.get("task") == "lambdarank")
     # the same-data NDCG gate must EXECUTE or say why it didn't
     assert "ndcg_gate" in ltr
+    # serving roofline block (round 8): bulk throughput, micro-batch
+    # p50, compile telemetry and the parity gate result
+    assert "predict" in out, "predict scale must run in the smoke"
+    p = out["predict"]
+    for field in ("bulk_rows_per_s", "p50_ms", "small_batch",
+                  "compile_count", "buckets_used", "parity"):
+        assert field in p, f"predict block missing {field}"
+    assert p["parity"] == "pass"
+    # compile-count lint: ONE compilation per shape bucket — every
+    # batch size inside a bucket reuses the bucket's program
+    assert p["compile_count"] == len(p["buckets_used"]), (
+        f"{p['compile_count']} compiles for buckets "
+        f"{p['buckets_used']} — bucketed predict must compile once "
+        "per bucket")
+    assert p["dispatches"] > p["compile_count"], \
+        "smoke issued no cache-hit dispatches"
 
 
 if __name__ == "__main__":

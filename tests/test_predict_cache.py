@@ -1,0 +1,141 @@
+"""Compile-count lint for the shape-bucketed serving predictor
+(round-8 tentpole), pinned at the compiler seam in the style of
+tests/test_carry_hlo.py.
+
+The serving contract: batch sizes round up to power-of-two row buckets,
+so ONE jit trace (== one XLA compilation per process) serves every
+batch size inside a bucket, the module-level jit shares those programs
+across Boosters, and bulk batches stream in fixed full-bucket chunks.
+The jaxpr check pins the tentpole's op-count claim — the level descent
+issues a fixed number of gathers per LEVEL, independent of the tree
+count (the per-tree scan it replaced issued two full-matrix gathers
+per node step per tree).
+
+Shapes here are deliberately unique to this file (7/9 features, 6/13
+trees) so another test's jit cache entries can't mask a miscount.
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.predict import (PREDICT_TELEMETRY,
+                                      reset_predict_telemetry)
+
+
+def _train(f=9, leaves=13, iters=6, n=220, seed=0, **params):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] - 0.4 * X[:, 1]
+    p = {"objective": "regression", "verbose": -1, "num_leaves": leaves,
+         "min_data_in_leaf": 5, **params}
+    return lgb.train(p, lgb.Dataset(X, label=y), iters,
+                     verbose_eval=False), X
+
+
+def test_one_compile_serves_a_bucket():
+    bst, X = _train()
+    reset_predict_telemetry()
+    for n in (3, 5, 9, 13, 16):
+        bst.predict(X[:n], device=True)
+    assert PREDICT_TELEMETRY["traces"] == 1, (
+        f"{PREDICT_TELEMETRY['traces']} compilations for 5 batch sizes "
+        "inside one bucket — the bucketed cache must compile ONCE")
+    assert PREDICT_TELEMETRY["buckets"] == {16}
+    bst.predict(X[:17], device=True)        # next bucket: one more
+    assert PREDICT_TELEMETRY["traces"] == 2
+    assert PREDICT_TELEMETRY["buckets"] == {16, 32}
+    bst.predict(X[:13], device=True)        # back inside: cache hit
+    assert PREDICT_TELEMETRY["traces"] == 2
+    assert PREDICT_TELEMETRY["dispatches"] == 7
+
+
+def test_compiled_programs_shared_across_boosters():
+    """The jit cache is module-level: a second booster with the same
+    ensemble/bucket shapes must trace NOTHING new (one deployed model
+    revision == one program set, however many handles serve it)."""
+    bst, X = _train(seed=1)
+    bst.predict(X[:10], device=True)        # ensure the shape is traced
+    clone = lgb.Booster(model_str=bst.model_to_string())
+    reset_predict_telemetry()
+    out = clone.predict(X[:10], device=True)
+    assert PREDICT_TELEMETRY["traces"] == 0, (
+        "a same-shaped booster retraced the serving predictor — the "
+        "compiled-program cache must be process-wide")
+    np.testing.assert_allclose(out, bst.predict(X[:10], device=False),
+                               rtol=2e-5, atol=2e-7)
+
+
+def test_chunk_streaming_matches_single_dispatch():
+    """Bulk batches above predict_chunk_rows stream in full-bucket
+    chunks (double-buffered) and must score identically to the host
+    walk; every full chunk reuses ONE bucket shape."""
+    from lightgbm_tpu.config import Config
+    bst, X = _train(f=7, leaves=9, iters=4, n=100, seed=2)
+    cfg = Config.from_params({"predict_chunk_rows": 32, "verbose": -1})
+    chunked = lgb.Booster(config=cfg, model_str=bst.model_to_string())
+    reset_predict_telemetry()
+    dev = chunked.predict(X, device=True)
+    np.testing.assert_allclose(dev, bst.predict(X, device=False),
+                               rtol=2e-5, atol=2e-7)
+    assert PREDICT_TELEMETRY["dispatches"] == 4          # 32*3 + 4
+    assert PREDICT_TELEMETRY["buckets"] == {32, 16}      # tail bucket
+    assert PREDICT_TELEMETRY["traces"] == 2
+
+
+def test_warm_buckets_precompile():
+    """predict_warm_buckets compiles the serving program at train()
+    time — the first real request must be a pure cache hit."""
+    bst, X = _train(f=7, leaves=11, iters=5, n=200, seed=3,
+                    predict_warm_buckets=(4,))
+    reset_predict_telemetry()
+    bst.predict(X[:10], device=True)        # inside the warmed bucket
+    assert PREDICT_TELEMETRY["traces"] == 0, (
+        "predict after predict_warm_buckets warm-up still compiled")
+
+
+def _count_gathers(jaxpr, out=None):
+    out = [0] if out is None else out
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "gather":
+            out[0] += 1
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                _count_gathers(v.jaxpr, out)
+            elif isinstance(v, (list, tuple)):
+                for b in v:
+                    if hasattr(b, "jaxpr"):
+                        _count_gathers(b.jaxpr, out)
+    return out[0]
+
+
+def test_level_descent_gathers_independent_of_tree_count():
+    """The tentpole's op-count claim, read off the jaxpr: the level
+    descent's gather count is a constant per level — NOT proportional
+    to the tree count the way the per-tree scan's inner walk was."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.predict import (LevelEnsemble,
+                                          predict_level_ensemble)
+    from lightgbm_tpu.tree import flatten_ensemble
+
+    bst, X = _train(iters=12, seed=4)
+    bst._sync_models()
+    depth = 6
+    counts = {}
+    for t_count in (4, 12):
+        flat = flatten_ensemble(bst.models[:t_count], 1)
+        flat.pop("depth")
+        stack = LevelEnsemble(**{k: jnp.asarray(v)
+                                 for k, v in flat.items()})
+        x2 = jnp.zeros((16, 2 * X.shape[1]), jnp.float32)
+        jaxpr = jax.make_jaxpr(
+            lambda s, x: predict_level_ensemble(s, x, depth=depth))(
+                stack, x2)
+        counts[t_count] = _count_gathers(jaxpr.jaxpr)
+    assert counts[4] == counts[12], (
+        f"gather count grew with tree count ({counts}) — the descent "
+        "regressed to per-tree gathers")
+    # 8 table/feature gathers per level + the final leaf-value gather
+    assert counts[12] <= depth * 8 + 2, (
+        f"{counts[12]} gathers for depth {depth} — more than the "
+        "level-synchronous budget (8/level + leaf fetch)")

@@ -1,0 +1,247 @@
+"""Device-vs-host predict parity across the serving predictor's full
+semantic surface (round-8 tentpole: the ensemble-vectorized level
+descent replaces the per-tree scan walk).
+
+Every device implementation — the level descent (default), its Pallas
+row-tile form (interpret seam; the container has no chip) and the
+legacy per-tree scan kept as the A/B — must route every row exactly
+like the host float64 tree walk: categorical splits, all three
+missing-value modes (MISSING_NAN / MISSING_ZERO / the zero-threshold
+band), +-inf thresholds (regression pin for the r7 `thr_lo = inf - inf`
+NaN fix, extended round 8 to +-inf DATA against +-inf thresholds),
+`num_leaves == 1` stumps, batch sizes straddling the power-of-two
+bucket boundaries, and identical `num_iteration`/`raw_score`
+resolution on both paths.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+
+IMPLS = ("level", "pallas", "scan")
+
+
+def _clone(bst, impl):
+    """Reload a trained model as a serving-shaped (loaded) booster
+    pinned to one device predictor implementation."""
+    cfg = Config.from_params({
+        "predict_kernel": impl, "verbose": -1,
+        # the Pallas variant runs on the interpret seam in this
+        # container (no chip); tile < min bucket exercises the grid
+        "force_pallas_interpret": impl == "pallas",
+        "predict_pallas_tile": 8,
+    })
+    return lgb.Booster(config=cfg, model_str=bst.model_to_string())
+
+
+def _assert_parity(bst, impl, X, **kw):
+    dev = _clone(bst, impl).predict(X, device=True, **kw)
+    host = bst.predict(X, device=False, **kw)
+    np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-7)
+
+
+def _train(X, y, extra=None, iters=8, **dskw):
+    params = {"objective": "regression", "verbose": -1,
+              "num_leaves": 15, "min_data_in_leaf": 5}
+    params.update(extra or {})
+    return lgb.train(params, lgb.Dataset(X, label=y, **dskw), iters,
+                     verbose_eval=False)
+
+
+@functools.lru_cache(maxsize=None)
+def _missing_case(mode):
+    """One trained model per missing mode, shared by every impl param
+    (training dominates these tests; prediction is the subject)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(400, 5)
+    if mode == "nan":
+        X[rng.rand(400, 5) < 0.1] = np.nan
+    if mode == "zero":
+        X[rng.rand(400, 5) < 0.2] = 0.0
+    y = np.nan_to_num(X[:, 0]) - 0.5 * np.nan_to_num(X[:, 1])
+    extra = {"zero_as_missing": mode == "zero",
+             "use_missing": mode != "none"}
+    bst = _train(X, y, extra)
+    # probe rows the training draw may not cover: NaN everywhere,
+    # exact zeros, and sub-threshold values inside the zero band
+    probe = np.vstack([X, np.full((2, 5), np.nan),
+                       np.zeros((2, 5)), np.full((2, 5), 1e-40),
+                       np.full((2, 5), -1e-40)])
+    return bst, probe
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("mode", ["nan", "zero", "none"])
+def test_missing_mode_parity(impl, mode):
+    bst, probe = _missing_case(mode)
+    _assert_parity(bst, impl, probe)
+
+
+@functools.lru_cache(maxsize=None)
+def _categorical_case():
+    rng = np.random.RandomState(5)
+    X = rng.randn(500, 4)
+    X[:, -1] = rng.randint(0, 12, 500)
+    y = (X[:, -1] % 3 == 0).astype(float) + 0.2 * X[:, 0]
+    bst = _train(X, y, {"max_cat_to_onehot": 2}, iters=10,
+                 categorical_feature=[3])
+    probe = np.vstack([X, [[0.0, 0.0, 0.0, 25.0]],   # unseen category
+                       [[0.0, 0.0, 0.0, -3.0]],      # negative
+                       [[0.0, 0.0, 0.0, np.nan]]])   # NaN category
+    return bst, probe
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_categorical_parity(impl):
+    bst, probe = _categorical_case()
+    _assert_parity(bst, impl, probe)
+
+
+def _model_text(tree_blocks, max_feature_idx=1):
+    names = " ".join(f"f{i}" for i in range(max_feature_idx + 1))
+    infos = " ".join("[-1e+30:1e+30]"
+                     for _ in range(max_feature_idx + 1))
+    head = "\n".join([
+        "tree", "version=v2", "num_class=1",
+        "num_tree_per_iteration=1", "label_index=0",
+        f"max_feature_idx={max_feature_idx}", "objective=regression",
+        f"feature_names={names}", f"feature_infos={infos}",
+        "tree_sizes=" + " ".join(str(len(b)) for b in tree_blocks),
+        "", ""])
+    return head + "".join(f"Tree={i}\n{b}\n"
+                          for i, b in enumerate(tree_blocks))
+
+
+_INF_TREE = """num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=1 1
+threshold=inf -inf
+decision_type={dt} {dt}
+left_child=1 -1
+right_child=-1 -2
+leaf_value=0.5 -1.25 2.75
+leaf_count=2 2 2
+internal_value=0 0
+internal_count=6 4
+shrinkage=1
+"""
+
+_STUMP_TREE = """num_leaves=1
+num_cat=0
+leaf_value=0.625
+leaf_count=7
+shrinkage=1
+"""
+
+_PLAIN_TREE = """num_leaves=2
+num_cat=0
+split_feature=0
+split_gain=1
+threshold=0.25
+decision_type=2
+left_child=-1
+right_child=-2
+leaf_value=1.5 -0.75
+leaf_count=3 4
+internal_value=0
+internal_count=7
+shrinkage=1
+"""
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("dt", [0, 8])  # missing none / missing NaN
+def test_inf_threshold_parity(impl, dt):
+    """+-inf saved thresholds (a split isolating the overflow bin) must
+    route identically on device — including +-inf DATA values, where a
+    naive two-float compare computes inf - inf = NaN and misroutes
+    (host: `inf <= inf` is True)."""
+    text = _model_text([_INF_TREE.format(dt=dt)])
+    host_b = lgb.Booster(model_str=text)
+    vals = [-np.inf, -5.0, 0.0, 5.0, np.inf, np.nan]
+    probe = np.array([[a, b] for a in vals for b in vals])
+    dev = _clone(host_b, impl).predict(probe, device=True)
+    host = host_b.predict(probe, device=False)
+    np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_stump_ensemble_parity(impl):
+    """num_leaves == 1 trees (no split cleared the gain bar) settle at
+    their single leaf in zero levels — mixed with real trees, the
+    flat-node encoding must still land every tree's contribution."""
+    text = _model_text([_STUMP_TREE, _PLAIN_TREE, _STUMP_TREE])
+    host_b = lgb.Booster(model_str=text)
+    probe = np.array([[-1.0, 0.0], [0.25, 1.0], [0.2500001, -1.0],
+                      [np.nan, np.nan], [3.0, 2.0]])
+    dev = _clone(host_b, impl).predict(probe, device=True)
+    host = host_b.predict(probe, device=False)
+    np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-7)
+    # all-stump ensemble: depth 0, nothing to descend
+    text1 = _model_text([_STUMP_TREE, _STUMP_TREE])
+    b1 = lgb.Booster(model_str=text1)
+    dev1 = _clone(b1, impl).predict(probe, device=True)
+    np.testing.assert_allclose(dev1, b1.predict(probe, device=False),
+                               rtol=1e-6, atol=1e-7)
+
+
+@functools.lru_cache(maxsize=None)
+def _boundary_case():
+    rng = np.random.RandomState(11)
+    X = rng.randn(70, 5)
+    y = X[:, 0] - 0.3 * X[:, 2]
+    return _train(X, y), X
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_bucket_boundary_batch_sizes(impl):
+    """Batch sizes straddling the power-of-two buckets (15/16/17 around
+    the default min bucket 16) must score identically — the padded tail
+    rows are discarded, never leaked."""
+    bst, X = _boundary_case()
+    dev_b = _clone(bst, impl)
+    for n in (1, 15, 16, 17, 31, 32, 33, 70):
+        dev = dev_b.predict(X[:n], device=True)
+        host = bst.predict(X[:n], device=False)
+        np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-7,
+                                   err_msg=f"batch size {n}")
+
+
+@functools.lru_cache(maxsize=None)
+def _binary_case():
+    rng = np.random.RandomState(13)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0).astype(float)
+    return _train(X, y, {"objective": "binary"}, iters=9), X
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_num_iteration_and_raw_score_identical(impl):
+    """num_iteration truncation (incl. best_iteration resolution) and
+    raw_score conversion must resolve identically on both paths."""
+    bst, X = _binary_case()
+    dev_b = _clone(bst, impl)
+    for ni in (-1, 1, 4, 9, 50):
+        for raw in (False, True):
+            dev = dev_b.predict(X, device=True, num_iteration=ni,
+                                raw_score=raw)
+            host = bst.predict(X, device=False, num_iteration=ni,
+                               raw_score=raw)
+            np.testing.assert_allclose(
+                dev, host, rtol=2e-5, atol=2e-7,
+                err_msg=f"num_iteration={ni} raw_score={raw}")
+    # best_iteration resolution: both paths must slice the same count
+    # (restore afterwards — the trained booster is shared across the
+    # impl parametrization)
+    try:
+        bst.best_iteration = 3
+        dev_b.best_iteration = 3
+        np.testing.assert_allclose(
+            dev_b.predict(X, device=True),
+            bst.predict(X, device=False), rtol=2e-5, atol=2e-7)
+    finally:
+        bst.best_iteration = -1

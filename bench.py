@@ -256,14 +256,42 @@ def auc_score(y, s):
     return float((ranks[pos].sum() - np_ * (np_ + 1) / 2) / (np_ * nn))
 
 
+def _bench_telemetry():
+    """The bench consumes the RUNTIME telemetry counters instead of
+    private timers (round-9 tentpole): ``train_chunk`` itself records
+    host_dispatch_ms (time-to-return of the async enqueue) and — with
+    the fence enabled — device_wait_ms, so the numbers printed here
+    and the numbers a production run exports via ``telemetry=spans``
+    come from ONE code path (docs/OBSERVABILITY.md, bench-vs-runtime
+    equivalence).  Mode is only ever raised, never lowered, so a
+    BENCH_PARAMS telemetry override survives."""
+    from lightgbm_tpu.telemetry import TELEMETRY
+    if not TELEMETRY.on:
+        TELEMETRY.configure("counters")
+    TELEMETRY.set_fence(True)
+    return TELEMETRY
+
+
 def timed_chunks(gbdt, iters, chunk):
     """Run the warm training loop in ``chunk``-sized fused dispatches
     with the wall clock SPLIT into host/dispatch time (how long each
     train_chunk call takes to RETURN — the async enqueue, which on a
     remote-attached chip carries the dispatch RPC) and device wait
-    (the remainder up to the drain).  The split is what tracks
-    ROOFLINE headroom #3 (the ≈1-2 ms/tree host gap) as a series.
-    Returns the timing dict shared by every bench scale."""
+    (the per-chunk fence up to the drain), both read from the
+    telemetry counters train_chunk maintains.  The split is what
+    tracks ROOFLINE headroom #3 (the ≈1-2 ms/tree host gap) as a
+    series.  Returns the timing dict shared by every bench scale."""
+    tm = _bench_telemetry()
+
+    def counters():
+        c = tm.counters()
+        # iteration (not tree) count: per_tree/trees_total keep the
+        # pre-r9 per-ITERATION denominator — trees_dispatched scales
+        # by num_class and would shift the series on a multiclass scale
+        return (c.get("host_dispatch_ms", 0.0),
+                c.get("device_wait_ms", 0.0),
+                c.get("iterations", 0))
+
     def drain():
         np.asarray(gbdt.scores[:, :8])
 
@@ -272,24 +300,25 @@ def timed_chunks(gbdt, iters, chunk):
     drain()
     compile_s = time.time() - t0
     n_chunks = max(1, (iters - chunk) // chunk)
-    host_s = 0.0
+    h0, d0, n0 = counters()
     t0 = time.time()
     for _ in range(n_chunks):
-        tc = time.time()
         gbdt.train_chunk(chunk)
-        host_s += time.time() - tc
     drain()
     steady_s = time.time() - t0
-    trees = n_chunks * chunk
+    h1, d1, n1 = counters()
+    host_s = (h1 - h0) / 1e3
+    device_s = (d1 - d0) / 1e3
+    trees = (n1 - n0) or n_chunks * chunk
     return {
         "compile_s": compile_s,
         "steady_s": steady_s,
         "per_tree": steady_s / trees,
         "trees_total": trees + chunk,
         "host_dispatch_s": host_s,
-        "device_wait_s": steady_s - host_s,
+        "device_wait_s": device_s,
         "host_ms_per_tree": host_s / trees * 1e3,
-        "device_ms_per_tree": (steady_s - host_s) / trees * 1e3,
+        "device_ms_per_tree": device_s / trees * 1e3,
     }
 
 
@@ -349,11 +378,20 @@ def train_timed(cfg_params, X, y, iters):
 def attach_timing(out: dict, timing: dict) -> dict:
     """Copy the host/device wall split (and the chunk-slope fit when
     the probe ran) from a timed_chunks dict into a scale record — the
-    series ROOFLINE headroom #3 tracks."""
+    series ROOFLINE headroom #3 tracks.
+
+    ``timing_source`` marks the round-9 semantics change for series
+    continuity: the split now comes from the telemetry counters with a
+    per-chunk device fence, so the steady wall is host + device with
+    NO chunk overlap (the pre-r9 loop enqueued all chunks back-to-back
+    and drained once, hiding host dispatch under device execution on a
+    pipelined backend) — compare r9+ per_tree against r8 anchors with
+    that in mind."""
     out["host_dispatch_ms_per_tree"] = round(
         timing["host_ms_per_tree"], 3)
     out["device_wait_ms_per_tree"] = round(
         timing["device_ms_per_tree"], 3)
+    out["timing_source"] = "telemetry_fenced"
     if "chunk_slope" in timing:
         out["chunk_slope"] = timing["chunk_slope"]
     return out

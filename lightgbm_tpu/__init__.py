@@ -11,7 +11,9 @@ from .config import Config
 from .engine import train, cv, CVBooster
 from .utils.log import Log, LightGBMError
 from .callback import (early_stopping, print_evaluation, record_evaluation,
-                       reset_parameter)
+                       reset_parameter, telemetry_snapshot)
+from . import telemetry
+from .telemetry import TELEMETRY
 from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
 from . import plotting
 from .plotting import (plot_importance, plot_metric, plot_tree,
@@ -21,7 +23,8 @@ __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster", "Log",
            "LightGBMError", "early_stopping", "print_evaluation",
-           "record_evaluation", "reset_parameter", "LGBMModel",
+           "record_evaluation", "reset_parameter", "telemetry_snapshot",
+           "telemetry", "TELEMETRY", "LGBMModel",
            "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "plot_importance", "plot_metric", "plot_tree",
            "create_tree_digraph", "__version__"]

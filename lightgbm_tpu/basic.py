@@ -122,11 +122,15 @@ class Dataset:
         data = _to_matrix(data, train_cats)
         feature_names, cat_indices = self._resolve_columns(data)
 
-        self._core = CoreDataset.from_matrix(
-            data, label=label, weight=self.weight, group=self.group,
-            init_score=self.init_score, config=config,
-            categorical_features=cat_indices,
-            feature_names=feature_names, reference=ref_core)
+        from .telemetry import TELEMETRY
+        with TELEMETRY.span("binning", rows=int(data.shape[0])):
+            # host-side bin-mapper fit + matrix binning — the one
+            # pre-device phase of training (docs/OBSERVABILITY.md)
+            self._core = CoreDataset.from_matrix(
+                data, label=label, weight=self.weight, group=self.group,
+                init_score=self.init_score, config=config,
+                categorical_features=cat_indices,
+                feature_names=feature_names, reference=ref_core)
         self._core._raw_data = None if self.free_raw_data else data
         self._core._categorical_features = cat_indices
         self._core.pandas_categorical = pandas_cats

@@ -23,6 +23,22 @@ MODEL_VERSION = "v2"
 
 _ACC_FN = None
 
+# serializes the (trace-counter read, dispatch, compare) window that
+# classifies a serving dispatch as bucket hit vs miss — without it a
+# concurrent thread's compile lands inside another thread's window and
+# a cached-program hit is misattributed as a miss.  Only taken when
+# telemetry is on; the enqueue itself is sub-ms so serving threads
+# contend only on the dispatch call, never on device execution.
+_SERVING_CLASSIFY_LOCK = None
+
+
+def _serving_lock():
+    global _SERVING_CLASSIFY_LOCK
+    if _SERVING_CLASSIFY_LOCK is None:
+        import threading
+        _SERVING_CLASSIFY_LOCK = threading.Lock()
+    return _SERVING_CLASSIFY_LOCK
+
 
 def _acc_fn():
     """Module-level jitted tree-stack accumulator for the device
@@ -37,6 +53,10 @@ def _acc_fn():
         @functools.partial(jax.jit, static_argnames=("max_steps",))
         def acc(total, stack, shrink_arr, vbins, f_group, g2f_lut,
                 f_missing, f_default_bin, f_num_bin, *, max_steps):
+            from .telemetry import TELEMETRY
+            TELEMETRY.note_trace("predict.binned_scan",
+                                 (vbins.shape, max_steps))
+
             def body(carry, xs):
                 tr, sh = xs
                 pv = predict_binned(tr, vbins, f_group, g2f_lut,
@@ -135,15 +155,26 @@ class _ServingPredictor:
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
         """(n, F) float64 raw features -> (n, K) float64 raw scores
-        (f32 device accumulation, identical routing to the host walk)."""
+        (f32 device accumulation, identical routing to the host walk).
+
+        Telemetry (docs/OBSERVABILITY.md): a ``predict`` span per call
+        with a ``predict_dispatch``/``predict_drain`` child per chunk;
+        counters count requests, scored vs masked-tail pad rows, and
+        bucket hit/miss — a MISS is a dispatch that triggered a new jit
+        trace (== an XLA compilation, the ``test_predict_cache`` ground
+        truth), everything else is a compiled-program hit."""
         import jax.numpy as jnp
 
         from .ops import predict as P
+        from .telemetry import TELEMETRY as tm
 
         data = np.asarray(data, dtype=np.float64)
         n = data.shape[0]
         if n == 0:
             return np.zeros((0, self.num_class))
+        span = tm.start_span("predict", rows=n)
+        if tm.on:
+            tm.add("predict_requests", 1)
         hi, lo = P.split_hi_lo(data)
         x2 = np.empty((n, 2 * data.shape[1]), np.float32)
         x2[:, 0::2] = hi
@@ -154,7 +185,8 @@ class _ServingPredictor:
 
         def drain(slot):
             dev, s, m = slot
-            out[s:s + m] = np.asarray(dev)[:m]
+            with tm.span("predict_drain"):
+                out[s:s + m] = np.asarray(dev)[:m]
 
         for s in range(0, n, cap):
             part = x2[s:s + cap]
@@ -163,17 +195,35 @@ class _ServingPredictor:
             if m < b:
                 part = np.concatenate(
                     [part, np.zeros((b - m, x2.shape[1]), np.float32)])
-            dev = self._dispatch(jnp.asarray(part))
+            if tm.on:
+                with _serving_lock():
+                    traces0 = P.PREDICT_TELEMETRY["traces"]
+                    with tm.span("predict_dispatch",
+                                 bucket=int(part.shape[0])):
+                        dev = self._dispatch(jnp.asarray(part))
+                    miss = P.PREDICT_TELEMETRY["traces"] > traces0
+                tm.add("predict_dispatches", 1)
+                tm.add("predict_rows", m)
+                tm.add("predict_pad_rows", int(part.shape[0]) - m)
+                tm.add("predict_bucket_miss" if miss
+                       else "predict_bucket_hit", 1)
+            else:
+                dev = self._dispatch(jnp.asarray(part))
             P.PREDICT_TELEMETRY["dispatches"] += 1
             P.PREDICT_TELEMETRY["rows"] += m
             P.PREDICT_TELEMETRY["buckets"].add(int(part.shape[0]))
             pending.append((dev, s, m))
+            if tm.on:
+                tm.gauge_max("predict_stream_depth", len(pending))
             if len(pending) >= 2:
                 # double buffer: at most TWO chunks' results in flight
                 # (what _PREDICT_CHUNK_BUDGET_BYTES sizes against)
                 drain(pending.pop(0))
         for slot in pending:
             drain(slot)
+        if tm.on:
+            tm.sample_memory()
+        tm.end_span(span)
         return out.astype(np.float64)
 
 

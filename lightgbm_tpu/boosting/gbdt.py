@@ -15,6 +15,7 @@ on one.
 """
 from __future__ import annotations
 
+import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -29,6 +30,7 @@ from ..metrics import Metric, create_metrics
 from ..objectives import Objective, create_objective
 from ..ops.histogram import leaf_value_broadcast
 from ..ops.predict import predict_binned
+from ..telemetry import TELEMETRY
 from ..tree import Tree
 from ..utils.log import Log, PhaseTimer
 
@@ -400,6 +402,10 @@ class GBDT:
             # sample_active is a static cache key mirroring
             # self._sample_active(), which _boost_one reads at trace time
             del sample_active
+            # trace-time only (retrace sentinel + compile counter):
+            # runs once per compilation, never on the dispatch path
+            TELEMETRY.note_trace("gbdt.fused_step",
+                                 (scores.shape, len(vscores)))
             vb = vbins if cap is None else cap["vbins"]
             with self._bound_captives(cap):
                 return self._boost_one(scores, vscores, bag_mask, key,
@@ -521,6 +527,8 @@ class GBDT:
 
         def chunk(scores, vscores, bag_mask, keys, fmasks, fresh_flags,
                   ohb=None, cap=None):
+            TELEMETRY.note_trace("gbdt.fused_chunk",
+                                 (keys.shape[0], scores.shape))
             vb = vbins if cap is None else cap["vbins"]
 
             def one_iter(carry, xs):
@@ -558,6 +566,12 @@ class GBDT:
     def train_chunk(self, n_iters: int) -> bool:
         """Run n_iters boosting iterations in one device program.
         Returns True when the deferred no-split check stopped training."""
+        tm = TELEMETRY
+        # host cost is timed from METHOD ENTRY: the per-chunk python
+        # prep (key/fmask/flag assembly, pending bookkeeping) is host
+        # wall too, and the pre-r9 bench timed the whole call — the
+        # counter must cover the same window for series continuity
+        t0 = time.perf_counter() if tm.on else 0.0
         cfg = self.config
         chunk_key = (n_iters, len(self.valid_sets), self.shrinkage_rate,
                      self._sample_active())
@@ -611,11 +625,28 @@ class GBDT:
                 self._chunk_fresh = cache
             fresh = cache
         self.timer.start("tree")
-        scores, vscores, bag, trees, nls = self._fused_chunk(
-            self.scores, tuple(vs.scores for vs in self.valid_sets),
-            self._bag_state, keys, fmasks,
-            fresh if isinstance(fresh, jax.Array) else jnp.asarray(fresh),
-            self.grower.ohb, self._build_captives())
+        span = tm.start_span("train_chunk", iters=n_iters)
+        with tm.span("host_dispatch"):
+            scores, vscores, bag, trees, nls = self._fused_chunk(
+                self.scores, tuple(vs.scores for vs in self.valid_sets),
+                self._bag_state, keys, fmasks,
+                fresh if isinstance(fresh, jax.Array)
+                else jnp.asarray(fresh),
+                self.grower.ohb, self._build_captives())
+        if tm.on:
+            # the r7 bench split, now first-class counters: time-to-
+            # return is the host/dispatch cost (the async enqueue, an
+            # RPC on a remote-attached chip); the optional fence
+            # attributes the remainder to device execution
+            tm.add("host_dispatch_ms",
+                   (time.perf_counter() - t0) * 1e3)
+            tm.fence_ready(scores)
+            tm.add("trees_dispatched", n_iters * self.num_class)
+            tm.add("iterations", n_iters)
+            tm.add("chunks_dispatched", 1)
+            tm.gauge("dispatch_chunk_size", n_iters)
+            tm.sample_memory(device=tm.spans_on)
+        tm.end_span(span)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
             vs.scores = s
@@ -676,22 +707,28 @@ class GBDT:
         disp = []
         iters_used = 0
         stopped = False
-        for c in probes:
-            for timed in (False, True):
-                t0 = _time.perf_counter()
-                stop = self.train_chunk(c)
-                t_return = _time.perf_counter() - t0
-                jax.block_until_ready(self.scores)
-                t_total = _time.perf_counter() - t0
-                iters_used += c
-                if timed:
-                    times[c] = (t_total - t_return) / c
-                    disp.append(t_return)
-                if stop:
-                    stopped = True
+        # the probe measures the RAW async enqueue (time-to-return) —
+        # a telemetry device fence inside train_chunk would fold the
+        # device wall into it and poison the slope fit
+        span = TELEMETRY.start_span("tune_dispatch_chunk")
+        with TELEMETRY.suspend_fence():
+            for c in probes:
+                for timed in (False, True):
+                    t0 = _time.perf_counter()
+                    stop = self.train_chunk(c)
+                    t_return = _time.perf_counter() - t0
+                    jax.block_until_ready(self.scores)
+                    t_total = _time.perf_counter() - t0
+                    iters_used += c
+                    if timed:
+                        times[c] = (t_total - t_return) / c
+                        disp.append(t_return)
+                    if stop:
+                        stopped = True
+                        break
+                if stopped:
                     break
-            if stopped:
-                break
+        TELEMETRY.end_span(span)
         if stopped or len(times) < 2:
             return cmin, {"iters_used": iters_used, "stopped": stopped,
                           "probe_per_tree_s": times}
@@ -717,6 +754,9 @@ class GBDT:
             return self._train_one_iter_custom(grad, hess)
         if self.objective is None:
             Log.fatal("No objective and no custom gradients")
+        tm = TELEMETRY
+        t0 = time.perf_counter() if tm.on else 0.0  # host wall from
+        # method entry (same window discipline as train_chunk)
         self._before_boosting()
         self.timer.start("tree")
         if self._fused_step is None:
@@ -729,12 +769,20 @@ class GBDT:
             self._bag_state = self._full_counts > 0
         key = jax.random.PRNGKey(
             int(self._iter_key_rng.randint(0, 2**31 - 1)))
-        scores, vscores, bag, trees, nl = self._fused_step(
-            self.scores, tuple(vs.scores for vs in self.valid_sets),
-            self._bag_state, key, self._feature_masks(),
-            jnp.asarray(self.shrinkage_rate, jnp.float32),
-            self.grower.ohb, self._build_captives(),
-            fresh_bag=fresh_bag, sample_active=self._sample_active())
+        span = tm.start_span("boost_iter", iteration=self.iter_)
+        with tm.span("host_dispatch"):
+            scores, vscores, bag, trees, nl = self._fused_step(
+                self.scores, tuple(vs.scores for vs in self.valid_sets),
+                self._bag_state, key, self._feature_masks(),
+                jnp.asarray(self.shrinkage_rate, jnp.float32),
+                self.grower.ohb, self._build_captives(),
+                fresh_bag=fresh_bag, sample_active=self._sample_active())
+        if tm.on:
+            tm.add("host_dispatch_ms", (time.perf_counter() - t0) * 1e3)
+            tm.fence_ready(scores)
+            tm.add("trees_dispatched", self.num_class)
+            tm.add("iterations", 1)
+        tm.end_span(span)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
             vs.scores = s
@@ -807,6 +855,9 @@ class GBDT:
             self._tree_shrink.append(self.shrinkage_rate)
             nl = jnp.maximum(nl, tree_arrays.num_leaves)
         self.timer.stop("tree")
+        if TELEMETRY.on:
+            TELEMETRY.add("trees_dispatched", self.num_class)
+            TELEMETRY.add("iterations", 1)
         self._nl_window.append(nl)
         self._after_iteration()
         self.iter_ += 1
@@ -857,6 +908,7 @@ class GBDT:
         if not self._pending:
             return
         pending, self._pending = self._pending, []
+        span = TELEMETRY.start_span("model_flush", entries=len(pending))
         # ONE device->host transfer for everything queued: per-tree
         # entries are stacked, chunk entries already are stacks (packed
         # record stacks travel as their single uint8 buffer)
@@ -888,6 +940,7 @@ class GBDT:
         i_plain = 0
         i_chunk = 0
         i_rec = 0
+        n_before = len(self.models)
         layout = self.grower.record_layout
         for p in pending:
             if p[0] == "tree":
@@ -915,6 +968,8 @@ class GBDT:
                                 for f in stack._fields}
                         append_tree(arrs, shrinkage,
                                     bias0 if j == 0 else 0.0)
+        TELEMETRY.add("trees_flushed", len(self.models) - n_before)
+        TELEMETRY.end_span(span)
 
     # ------------------------------------------------------------------
     def _mask_gradients(self, g, h, counts):
@@ -973,7 +1028,8 @@ class GBDT:
         eval_valid don't pay for metrics they discard."""
         self.timer.start("metric")
         try:
-            return self._eval_metrics_impl(which)
+            with TELEMETRY.span("eval_metrics"):
+                return self._eval_metrics_impl(which)
         finally:
             self.timer.stop("metric")
 

@@ -63,6 +63,30 @@ def reset_parameter(**kwargs) -> Callable:
     return _callback
 
 
+def telemetry_snapshot(dest: dict) -> Callable:
+    """Expose the runtime telemetry registry to user code per
+    iteration, ``record_evaluation``-style: after each iteration a
+    ``lightgbm_tpu.telemetry.TELEMETRY.snapshot()`` dict (counters,
+    gauges, retrace map, derived per-tree host/device split) is
+    appended to ``dest["snapshots"]`` with the matching 1-based
+    iteration in ``dest["iterations"]``.
+
+    Needs telemetry enabled (``telemetry=counters`` or higher) to
+    carry data, and — like every per-iteration callback — opts the run
+    out of multi-iteration fused dispatch chunks, so counters advance
+    once per iteration (docs/OBSERVABILITY.md)."""
+    if not isinstance(dest, dict):
+        raise TypeError("dest should be a dict")
+    dest.clear()
+
+    def _callback(env):
+        from .telemetry import TELEMETRY
+        dest.setdefault("iterations", []).append(env.iteration + 1)
+        dest.setdefault("snapshots", []).append(TELEMETRY.snapshot())
+    _callback.order = 25
+    return _callback
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True) -> Callable:
     """Early-stopping callback (reference callback.py:148-215)."""

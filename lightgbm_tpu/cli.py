@@ -56,6 +56,13 @@ def run(argv: List[str]) -> int:
         _task_refit(params, config)
     else:
         Log.fatal(f"Unknown task {task}")
+    from .telemetry import TELEMETRY
+    if TELEMETRY.on and config.telemetry_out:
+        # explicit export at task end (the atexit hook is only the
+        # safety net): telemetry=trace telemetry_out=/tmp/run writes
+        # /tmp/run.jsonl + /tmp/run.perfetto.json (ui.perfetto.dev)
+        paths = TELEMETRY.export(config.telemetry_out)
+        Log.info("telemetry written: " + ", ".join(paths))
     return 0
 
 

@@ -469,6 +469,29 @@ class Config:
     force_pallas_interpret: bool = False  # test seam: run the Pallas
     # kernel paths (incl. the fused-route grower wiring) in interpret
     # mode on CPU — slow, for CI coverage of the TPU-only code paths
+    telemetry: str = "off"          # runtime telemetry subsystem
+    # (docs/OBSERVABILITY.md): "off" records nothing and is pinned to
+    # change NO compiled program; "counters" keeps named counters and
+    # gauges (trees dispatched, compiles observed, serving bucket
+    # hit/miss, RSS watermark) with zero device interference; "spans"
+    # adds nested timing spans plus a per-dispatch device fence that
+    # splits wall time into host_dispatch_ms vs device_wait_ms (the
+    # r7 bench split, now first-class); "trace" additionally annotates
+    # the grower's trace-time phases (histogram, split finder,
+    # partition) with jax.named_scope so profiler xplanes attribute
+    # device ops to them — metadata-only HLO change
+    telemetry_out: str = ""         # export path prefix: on process
+    # exit (and after each CLI task) telemetry writes <prefix>.jsonl
+    # (newline-JSON events + a final counter snapshot) and
+    # <prefix>.perfetto.json (Chrome trace_event, loadable in
+    # ui.perfetto.dev); "" disables export (counters stay readable
+    # in-process via lightgbm_tpu.telemetry.TELEMETRY.snapshot())
+    telemetry_retrace_warn: int = 8  # retrace sentinel: warn (once
+    # per function) when a jitted entry point has traced more than
+    # this many DISTINCT shapes — each retrace is an XLA compilation,
+    # so shape churn past the serving bucket ladder is a production
+    # latency bug.  Counts are exported either way; the guard itself
+    # is active even at telemetry=off (trace-time cost only)
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
     deterministic: bool = False
@@ -483,8 +506,11 @@ class Config:
                                                       self.tree_learner)
         if self.device == "gpu":
             self.device = "tpu"
+        self.telemetry = str(self.telemetry).lower()
         self.check()
         _setup_compile_cache(self.compile_cache_dir)
+        from .telemetry import apply_config as _telemetry_apply
+        _telemetry_apply(self)
 
     # ------------------------------------------------------------------
     def check(self):
@@ -537,6 +563,12 @@ class Config:
             raise ValueError("predict_chunk_rows must be >= 0 (0 = auto)")
         if self.predict_pallas_tile < 1:
             raise ValueError("predict_pallas_tile must be >= 1")
+        if str(self.telemetry).lower() not in ("off", "counters",
+                                               "spans", "trace"):
+            raise ValueError("telemetry must be off/counters/spans/"
+                             f"trace, got {self.telemetry!r}")
+        if self.telemetry_retrace_warn < 1:
+            raise ValueError("telemetry_retrace_warn must be >= 1")
         dc = str(self.dispatch_chunk).lower()
         if dc != "auto":
             try:

@@ -14,6 +14,7 @@ import numpy as np
 from .booster import Booster
 from .config import Config
 from .dataset import Dataset
+from .telemetry import TELEMETRY
 from .utils.log import Log
 
 
@@ -66,6 +67,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         params["num_iterations"] = num_boost_round
     config = Config.from_params(params)
     num_boost_round = config.num_iterations
+    # config.verbosity routes to the process-global Log level on the
+    # python API too, not only in CLI runs (the reference's Config
+    # verbosity is global the same way); Log.fatal ignores the level
+    Log.set_level(config.verbose)
 
     if hasattr(train_set, "construct"):
         core_train = train_set.construct(config)
@@ -149,6 +154,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     stopped_early = False
     iteration = 0
+    train_span = TELEMETRY.start_span("train",
+                                      num_boost_round=num_boost_round)
     if chunkable and chunk_cfg in ("auto", "") and num_boost_round >= 60:
         import jax
         if jax.default_backend() in ("tpu", "axon"):
@@ -157,6 +164,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             if info.get("stopped"):
                 num_boost_round = iteration
             else:
+                TELEMETRY.gauge("dispatch_chunk_auto", chunk_size)
                 Log.info(
                     f"dispatch_chunk=auto: fitted slope "
                     f"{info['slope_s_per_iter'] * 1e3:.4f} ms/iter·chunk,"
@@ -233,6 +241,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_iteration = -1
     if booster.gbdt is not None:
         booster.gbdt.flush_models(final=True)
+    TELEMETRY.end_span(train_span)
     if booster.gbdt is not None and booster.gbdt.timer.acc:
         Log.debug("training phase timings: "
                   + booster.gbdt.timer.report())

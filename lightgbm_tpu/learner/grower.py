@@ -70,6 +70,7 @@ from ..ops.split import (CAND_CAT_DIR, CAND_COLS, CAND_DEFAULT_LEFT,
                          FORCED_ROUT, FORCED_THRESHOLD,
                          build_cat_bitset, find_best_split_block,
                          forced_split_block, run_split_finders)
+from ..telemetry import TELEMETRY
 from ..tree import TreeRecordLayout
 
 NEG_INF = -jnp.inf
@@ -496,6 +497,32 @@ class TreeGrower:
             and getattr(self.policy, "bins_spec", None) is not None
             and self.num_groups % self.policy.mesh.size == 0)
         self._train_tree = jax.jit(self._train_tree_impl)
+        if TELEMETRY.on:
+            # the grower's resolved kernel plan as gauges: the fused
+            # device phases cannot be host-timed per iteration (one
+            # compiled program), so telemetry records WHAT was selected
+            # — device-time attribution per phase comes from
+            # telemetry=trace + scripts/profile_train.py xplanes
+            if self.leaf_part:
+                hk = "seg_tiled(leaf_partition)"
+            elif self.use_tiled:
+                hk = "fused_tiled" if self.use_fused else "q_tiled"
+            elif self.use_fused:
+                hk = "fused_streamed"
+            elif self.use_quant_otf:
+                hk = "q_onthefly"
+            elif self.use_pre_ohb:
+                hk = "pre_onehot"
+            elif self.use_pallas:
+                hk = "pallas_paired" if self.pallas_paired else "pallas"
+            else:
+                hk = "xla"
+            TELEMETRY.gauge("grower.hist_kernel", hk)
+            TELEMETRY.gauge("grower.quantized", int(self.use_quant))
+            TELEMETRY.gauge("grower.split_finder_ladder",
+                            int(self.split_ladder))
+            TELEMETRY.gauge("grower.frontier_width", int(self.frontier))
+            TELEMETRY.gauge("grower.rows_padded", int(self.n_padded))
 
     # ------------------------------------------------------------------
     def _load_forced_splits(self, dataset: Dataset, config: Config) -> None:
@@ -636,7 +663,16 @@ class TreeGrower:
     def _hist_kernel(self, grad, hess, counts, leaf_id, slots=None,
                      num_leaves=None, quant=None):
         """Frontier histogram dispatch: Pallas on a real single chip,
-        XLA one-hot contraction under meshes / CPU simulation."""
+        XLA one-hot contraction under meshes / CPU simulation.
+        ``telemetry=trace`` annotates the phase (named_scope metadata)
+        so xplane device events attribute to ``histogram``; any other
+        telemetry mode leaves the lowered program untouched."""
+        with TELEMETRY.phase("histogram"):
+            return self._hist_kernel_impl(grad, hess, counts, leaf_id,
+                                          slots, num_leaves, quant)
+
+    def _hist_kernel_impl(self, grad, hess, counts, leaf_id, slots=None,
+                          num_leaves=None, quant=None):
         L = self.num_leaves if num_leaves is None else num_leaves
         if quant is not None and self.use_tiled:
             return self._hist_kernel_q_tiled(leaf_id, slots, quant)
@@ -758,6 +794,12 @@ class TreeGrower:
         right children's histograms, at the narrowest strip packing
         covering the frontier.  Returns (hist (W, G, B, 3), new
         leaf_id)."""
+        with TELEMETRY.phase("histogram"):
+            return self._hist_kernel_fused_impl(st, rights, grad, hess,
+                                                counts, quant)
+
+    def _hist_kernel_fused_impl(self, st, rights, grad, hess, counts,
+                                quant):
         B = self.max_group_bin
         W = rights.shape[0]
         ohb = self._ohb_arg if self._ohb_arg is not None else self.ohb
@@ -838,13 +880,14 @@ class TreeGrower:
         The two row gathers here are the formulation's dominant cost —
         see the cost note on ops/partition.py build_leaf_partition."""
         from ..ops.partition import apply_partition, build_leaf_partition
-        wT, scales = quant                               # (3, N) int32
-        perm, blk_leaf, _ = build_leaf_partition(
-            leaf_id, num_slots=self.num_leaves,
-            block=self.leaf_part_block)
-        binsT_p = apply_partition(self.binsT, perm, axis=1)
-        wT_p = apply_partition(wT, perm, axis=1)
-        return binsT_p, wT_p, blk_leaf, scales
+        with TELEMETRY.phase("partition"):
+            wT, scales = quant                           # (3, N) int32
+            perm, blk_leaf, _ = build_leaf_partition(
+                leaf_id, num_slots=self.num_leaves,
+                block=self.leaf_part_block)
+            binsT_p = apply_partition(self.binsT, perm, axis=1)
+            wT_p = apply_partition(wT, perm, axis=1)
+            return binsT_p, wT_p, blk_leaf, scales
 
     # ------------------------------------------------------------------
     def _hist_kernel_seg(self, part, slots):
@@ -947,7 +990,8 @@ class TreeGrower:
         slice writes into one (record_size,) uint8 buffer.  The fused
         dispatch chunk stacks THIS as its only O(chunk) tree output
         (gbdt._build_fused_chunk) instead of 18 per-field stacks."""
-        return self.record_layout.pack_tree_record(tree)
+        with TELEMETRY.phase("tree_record"):
+            return self.record_layout.pack_tree_record(tree)
 
     # ------------------------------------------------------------------
     def _init_state(self, grad, hess, counts) -> GrowerState:
@@ -1225,6 +1269,11 @@ class TreeGrower:
         per-field scatters.  Valid slots occupy a prefix of each half
         of ``slots_w`` (_round queues them that way); negative entries
         scatter to the dropped L row."""
+        with TELEMETRY.phase("split_finder"):
+            return self._refresh_cand_impl(st, slots_w, h_w,
+                                           feature_mask)
+
+    def _refresh_cand_impl(self, st, slots_w, h_w, feature_mask):
         L = self.num_leaves
         cfg = self.cfg_scalars
         safe = jnp.clip(slots_w, 0, L - 1)
@@ -1261,6 +1310,14 @@ class TreeGrower:
         (shared by the cached and voting rounds; the reference's
         SerialTreeLearner::Split, serial_tree_learner.cpp:700-774).
         All per-leaf args are (L,) chosen-split values."""
+        with TELEMETRY.phase("apply_split"):
+            return self._apply_selection_impl(
+                st, do_split, rank, k, best_gain, best_f, thr, dleft,
+                lsg, lsh, lsc, lout, rout, cat_mask, forced_valid)
+
+    def _apply_selection_impl(self, st, do_split, rank, k, best_gain,
+                              best_f, thr, dleft, lsg, lsh, lsc, lout,
+                              rout, cat_mask, forced_valid=None):
         L = self.num_leaves
         M = L - 1
         slot = jnp.arange(L, dtype=jnp.int32)

@@ -225,6 +225,9 @@ def predict_level_ensemble(stack: LevelEnsemble, X2: jax.Array, *,
     (ensemble shape, row bucket) serves every Booster in the process,
     and the persistent compile cache serves it across processes."""
     PREDICT_TELEMETRY["traces"] += 1
+    from ..telemetry import TELEMETRY
+    TELEMETRY.note_trace("predict.level_ensemble",
+                         (X2.shape, stack.root.shape[0]))
     T = stack.root.shape[0]
     W = stack.cat_words.shape[0] // stack.feat2.shape[0]
     n = X2.shape[0]
@@ -251,6 +254,9 @@ def predict_level_ensemble_pallas(stack: LevelEnsemble, X2: jax.Array,
     pallas` is the one-flag on-chip A/B, same protocol as
     hist_leaf_partition r6."""
     PREDICT_TELEMETRY["traces"] += 1
+    from ..telemetry import TELEMETRY
+    TELEMETRY.note_trace("predict.level_ensemble_pallas",
+                         (X2.shape, stack.root.shape[0]))
     from jax.experimental import pallas as pl
 
     n, f2_dim = X2.shape

@@ -1,0 +1,486 @@
+"""Runtime telemetry: structured spans, counters, device-time split,
+retrace watch, Perfetto + newline-JSON export.
+
+Every roofline decision so far (the r7 chunk-slope fit, the r8 serving
+bucket policy, the r6 leaf-partition rejection) was made from one-off
+instrumentation private to ``bench.py`` — invisible to a real training
+or serving run.  This module is the one code path both worlds share:
+``bench.py`` reads its counters for the host-dispatch / device-wait
+split, and a production process gets the same attribution in-process
+via ``telemetry=counters|spans|trace``.
+
+Design constraints (pinned by ``tests/test_telemetry.py``):
+
+- **Compiled-out by default.**  ``telemetry=off`` (the default) adds
+  ZERO changes to any jitted program — all instrumentation lives at
+  host seams (dispatch boundaries, trace-time Python), and the
+  off/counters/spans modes lower byte-identical StableHLO
+  (``test_off_mode_hlo_identity``).  Only ``trace`` mode adds
+  ``jax.named_scope`` METADATA inside traced functions so profiler
+  xplanes attribute device ops to grower phases.
+- **Zero dependencies.**  Stdlib only; jax is imported lazily and only
+  for the optional device fence / named-scope / live-array features.
+- **Thread-safe.**  Span stacks are thread-local; counters, gauges and
+  the event log are guarded by one lock.  Serving handlers may call
+  ``predict`` from many threads into one global registry.
+
+Modes (``Config.telemetry``):
+
+- ``off``       — nothing recorded (the retrace sentinel still counts:
+                  it is a runtime guard, not telemetry — see
+                  ``note_trace``).
+- ``counters``  — named counters/gauges only; no fencing, so the
+                  device pipeline is untouched (``device_wait_ms``
+                  stays empty unless a fence is explicitly enabled,
+                  as ``bench.py`` does).
+- ``spans``     — counters + nested timing spans + a per-dispatch
+                  ``jax.block_until_ready`` fence attributing wall
+                  time to host dispatch vs device wait.  The fence is
+                  host-side only (no program change) but serializes
+                  chunk overlap — a documented observer effect.
+- ``trace``     — spans + ``jax.named_scope`` phase annotation at
+                  trace time (metadata-only HLO change) for device-op
+                  attribution in ``scripts/profile_train.py``.
+
+Export (``Config.telemetry_out`` = path prefix): ``<prefix>.jsonl``
+(newline-JSON span events + one final snapshot line) and
+``<prefix>.perfetto.json`` (Chrome ``trace_event`` format — load in
+``ui.perfetto.dev``).  See docs/OBSERVABILITY.md for the span map and
+counter glossary.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .utils.log import Log
+
+MODES = ("off", "counters", "spans", "trace")
+_OFF, _COUNTERS, _SPANS, _TRACE = range(4)
+
+# hard bound on retained span events: a week-long serving process must
+# not grow its heap linearly in requests.  Overflow increments the
+# ``events_dropped`` counter instead of silently truncating.
+MAX_EVENTS = 500_000
+
+
+class _NullCtx:
+    """Shared no-op context for disabled spans/phases."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _Span:
+    __slots__ = ("_tm", "name", "attrs", "t0", "_depth")
+
+    def __init__(self, tm: "Telemetry", name: str, attrs):
+        self._tm = tm
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = self._tm._stack()
+        self._depth = len(stack)
+        stack.append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self.t0
+        stack = self._tm._stack()
+        # reentrancy guard: pop OUR frame even if an inner span leaked
+        while stack and stack[-1] is not self:
+            stack.pop()
+        if stack:
+            stack.pop()
+        self._tm._record(self.name, self.t0, dur, self._depth, self.attrs)
+        return False
+
+
+class Telemetry:
+    """Process-global telemetry registry (module singleton
+    ``TELEMETRY``).  All methods are cheap no-ops at ``off``."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._tls = threading.local()
+        self._t0 = time.perf_counter()
+        self.mode = _OFF
+        self.out = ""
+        self.retrace_warn = 8
+        self._fence = False
+        self._fence_suspended = 0
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Any] = {}
+        self._events: list = []          # (name, t0, dur, tid, depth, attrs)
+        self._traces: Dict[str, set] = {}
+        self._retrace_warned: set = set()
+        self._atexit_armed = False
+
+    # -- configuration -------------------------------------------------
+    def configure(self, mode: str = "counters", out: str = "",
+                  fence: Optional[bool] = None,
+                  retrace_warn: Optional[int] = None) -> "Telemetry":
+        """Set the global mode.  ``fence=None`` resolves to the mode
+        default (on for spans/trace, off for counters).  ``out`` arms
+        an atexit export to ``<out>.jsonl`` / ``<out>.perfetto.json``."""
+        if mode not in MODES:
+            raise ValueError(f"telemetry mode must be one of {MODES}, "
+                             f"got {mode!r}")
+        with self._lock:
+            self.mode = MODES.index(mode)
+            self._fence = (self.mode >= _SPANS) if fence is None \
+                else bool(fence)
+            if retrace_warn is not None:
+                self.retrace_warn = max(1, int(retrace_warn))
+            if out:
+                self.out = out
+                if not self._atexit_armed:
+                    self._atexit_armed = True
+                    atexit.register(self._export_atexit)
+        return self
+
+    def reset(self) -> None:
+        """Clear recorded state (events, counters, gauges, retrace
+        watch); the configured mode/out/fence survive."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._events = []
+            self._traces.clear()
+            self._retrace_warned.clear()
+            self._t0 = time.perf_counter()
+
+    @property
+    def on(self) -> bool:
+        return self.mode >= _COUNTERS
+
+    @property
+    def spans_on(self) -> bool:
+        return self.mode >= _SPANS
+
+    @property
+    def level(self) -> str:
+        return MODES[self.mode]
+
+    # -- spans ---------------------------------------------------------
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def span(self, name: str, **attrs):
+        """Nested timing span (context manager).  No-op below
+        ``spans`` mode — safe on any hot path."""
+        if self.mode < _SPANS:
+            return _NULL
+        return _Span(self, name, attrs or None)
+
+    def start_span(self, name: str, **attrs):
+        """Explicit begin/end form for spans that cannot wrap a lexical
+        block (pair with ``end_span(token)``).  Deliberately does NOT
+        touch the thread-local nesting stack, so an exception between
+        start and end cannot corrupt later spans' depths; the event is
+        recorded at depth 0 (Perfetto nests by time overlap anyway)."""
+        if self.mode < _SPANS:
+            return None
+        return (name, time.perf_counter(), attrs or None)
+
+    def end_span(self, token) -> None:
+        if token is None:
+            return
+        name, t0, attrs = token
+        self._record(name, t0, time.perf_counter() - t0, 0, attrs)
+
+    def _record(self, name, t0, dur, depth, attrs):
+        with self._lock:
+            if len(self._events) >= MAX_EVENTS:
+                self._counters["events_dropped"] = \
+                    self._counters.get("events_dropped", 0) + 1
+                return
+            self._events.append((name, t0 - self._t0, dur,
+                                 threading.get_ident(), depth, attrs))
+
+    def instant(self, name: str, **attrs) -> None:
+        """Zero-duration marker event (spans mode and above)."""
+        if self.mode >= _SPANS:
+            self._record(name, time.perf_counter(), 0.0,
+                         len(self._stack()), attrs or None)
+
+    # -- counters / gauges ---------------------------------------------
+    def add(self, name: str, value: float = 1) -> None:
+        if self.mode < _COUNTERS:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def gauge(self, name: str, value) -> None:
+        if self.mode < _COUNTERS:
+            return
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge_max(self, name: str, value: float) -> None:
+        if self.mode < _COUNTERS:
+            return
+        with self._lock:
+            cur = self._gauges.get(name)
+            if cur is None or value > cur:
+                self._gauges[name] = value
+
+    def counters(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    # -- device fence --------------------------------------------------
+    @property
+    def fence_active(self) -> bool:
+        """Whether dispatch sites should block_until_ready to split
+        host-dispatch from device-wait wall time."""
+        return (self.mode >= _COUNTERS and self._fence
+                and self._fence_suspended == 0)
+
+    def set_fence(self, on: bool) -> None:
+        self._fence = bool(on)
+
+    def suspend_fence(self):
+        """Context manager: temporarily disable the device fence —
+        ``tune_dispatch_chunk`` times the raw async enqueue and a
+        fenced ``train_chunk`` would fold device wall into it."""
+        tm = self
+
+        class _Suspend:
+            def __enter__(self):
+                with tm._lock:
+                    tm._fence_suspended += 1
+
+            def __exit__(self, *exc):
+                with tm._lock:
+                    tm._fence_suspended -= 1
+                return False
+
+        return _Suspend()
+
+    def fence_ready(self, x, counter: str = "device_wait_ms") -> float:
+        """``jax.block_until_ready(x)`` inside a ``device_wait`` span,
+        accumulating the wait into ``counter``.  Returns seconds
+        waited (0.0 when the fence is inactive)."""
+        if not self.fence_active:
+            return 0.0
+        import jax
+        t0 = time.perf_counter()
+        with self.span("device_wait"):
+            jax.block_until_ready(x)
+        dt = time.perf_counter() - t0
+        self.add(counter, dt * 1e3)
+        return dt
+
+    # -- trace-mode phase annotation ------------------------------------
+    def phase(self, name: str):
+        """``jax.named_scope`` wrapper for code inside jitted bodies:
+        at ``trace`` mode the phase name lands in the HLO op metadata
+        (so xplane device events attribute to it); below ``trace`` it
+        is the shared no-op context, leaving lowered programs
+        byte-identical.  Effective only if telemetry is configured
+        before the function's first trace (jit caches the program)."""
+        if self.mode < _TRACE:
+            return _NULL
+        import jax
+        return jax.named_scope(f"tel.{name}")
+
+    # -- retrace sentinel ----------------------------------------------
+    def note_trace(self, fn: str, shape) -> None:
+        """Record one jit trace of entry point ``fn`` with ``shape``
+        (any hashable shape key).  ALWAYS counts — trace-time Python
+        only, never on the dispatch path — and warns once per fn when
+        the distinct-shape count exceeds ``retrace_warn`` (the runtime
+        promotion of the ``test_predict_cache`` compile-count lint;
+        ``Config.telemetry_retrace_warn``)."""
+        key = repr(shape)
+        with self._lock:
+            shapes = self._traces.setdefault(fn, set())
+            shapes.add(key)
+            n = len(shapes)
+            if self.mode >= _COUNTERS:
+                self._counters["compiles_observed"] = \
+                    self._counters.get("compiles_observed", 0) + 1
+            warn = n > self.retrace_warn and fn not in self._retrace_warned
+            if warn:
+                self._retrace_warned.add(fn)
+        if warn:
+            Log.warning(
+                f"jitted entry point {fn} has now traced {n} distinct "
+                f"shapes (telemetry_retrace_warn={self.retrace_warn}) — "
+                "each retrace is an XLA compilation; bucket or pad the "
+                "offending shape (docs/OBSERVABILITY.md, retrace "
+                "sentinel)")
+
+    def retraces(self) -> Dict[str, int]:
+        with self._lock:
+            return {fn: len(s) for fn, s in self._traces.items()}
+
+    # -- memory watch ---------------------------------------------------
+    def sample_memory(self, device: bool = False) -> None:
+        """Record RSS (and optionally device-buffer) watermarks.
+        Called at chunk/predict boundaries — a /proc read per call."""
+        if self.mode < _COUNTERS:
+            return
+        try:
+            with open("/proc/self/status") as f:
+                for ln in f:
+                    if ln.startswith("VmRSS:"):
+                        self.gauge_max("rss_mb_peak",
+                                       round(int(ln.split()[1]) / 1024, 1))
+                        break
+        except (OSError, ValueError, IndexError):
+            pass
+        if device:
+            try:
+                import jax
+                nbytes = sum(getattr(a, "nbytes", 0)
+                             for a in jax.live_arrays())
+                self.gauge_max("device_buffer_mb_peak",
+                               round(nbytes / (1 << 20), 1))
+            except Exception:  # pragma: no cover - backend-dependent
+                pass
+
+    # -- snapshot / export ----------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Counters + gauges + retrace map + derived per-tree /
+        serving ratios — the dict the ``telemetry_snapshot`` callback
+        hands to user code and the JSONL export's final line."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "mode": MODES[self.mode],
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "retraces": {fn: len(s) for fn, s in self._traces.items()},
+            }
+        c = out["counters"]
+        derived: Dict[str, float] = {}
+        trees = c.get("trees_dispatched", 0)
+        if trees:
+            derived["host_dispatch_ms_per_tree"] = round(
+                c.get("host_dispatch_ms", 0.0) / trees, 4)
+            derived["device_wait_ms_per_tree"] = round(
+                c.get("device_wait_ms", 0.0) / trees, 4)
+        scored = c.get("predict_rows", 0) + c.get("predict_pad_rows", 0)
+        if scored:
+            derived["predict_tail_waste"] = round(
+                c.get("predict_pad_rows", 0) / scored, 4)
+        if derived:
+            out["derived"] = derived
+        return out
+
+    def events_snapshot(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    def export(self, prefix: Optional[str] = None) -> list:
+        """Write ``<prefix>.jsonl`` (events + snapshot) and
+        ``<prefix>.perfetto.json`` (Chrome trace_event, loadable in
+        ui.perfetto.dev).  Returns the written paths."""
+        prefix = prefix or self.out
+        if not prefix:
+            raise ValueError("telemetry export needs a path prefix "
+                             "(Config.telemetry_out)")
+        d = os.path.dirname(os.path.abspath(prefix))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        events = self.events_snapshot()
+        snap = self.snapshot()
+        jsonl = f"{prefix}.jsonl"
+        with open(jsonl, "w") as f:
+            for name, ts, dur, tid, depth, attrs in events:
+                ev = {"type": "span", "name": name,
+                      "ts_us": round(ts * 1e6, 1),
+                      "dur_us": round(dur * 1e6, 1),
+                      "tid": tid, "depth": depth}
+                if attrs:
+                    ev["attrs"] = attrs
+                f.write(json.dumps(ev) + "\n")
+            f.write(json.dumps({"type": "snapshot", **snap}) + "\n")
+        perfetto = f"{prefix}.perfetto.json"
+        with open(perfetto, "w") as f:
+            json.dump(self._perfetto(events, snap), f)
+        return [jsonl, perfetto]
+
+    def _perfetto(self, events, snap) -> Dict[str, Any]:
+        pid = os.getpid()
+        tids = {}
+        trace = []
+        for name, ts, dur, tid, depth, attrs in events:
+            short = tids.setdefault(tid, len(tids) + 1)
+            ev = {"name": name, "cat": "host", "ph": "X",
+                  "ts": round(ts * 1e6, 1),
+                  "dur": round(dur * 1e6, 1),
+                  "pid": pid, "tid": short}
+            if attrs:
+                ev["args"] = {k: (v if isinstance(v, (int, float, str,
+                                                      bool))
+                                  else repr(v)) for k, v in attrs.items()}
+            trace.append(ev)
+        now = round((time.perf_counter() - self._t0) * 1e6, 1)
+        for k, v in sorted(snap["counters"].items()):
+            trace.append({"name": k, "cat": "counter", "ph": "C",
+                          "ts": now, "pid": pid,
+                          "args": {"value": round(float(v), 3)}})
+        for k, v in sorted(snap["gauges"].items()):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                trace.append({"name": k, "cat": "gauge", "ph": "C",
+                              "ts": now, "pid": pid,
+                              "args": {"value": v}})
+            else:
+                trace.append({"name": f"{k}={v}", "cat": "gauge",
+                              "ph": "i", "ts": now, "pid": pid,
+                              "tid": 0, "s": "g"})
+        for tid, short in tids.items():
+            trace.append({"name": "thread_name", "ph": "M", "pid": pid,
+                          "tid": short,
+                          "args": {"name": f"thread-{short}"}})
+        return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+    def _export_atexit(self) -> None:  # pragma: no cover - process exit
+        try:
+            if self.out and (self._events or self._counters):
+                self.export(self.out)
+        except Exception:
+            pass
+
+
+TELEMETRY = Telemetry()
+
+
+_RETRACE_WARN_DEFAULT = 8
+
+
+def apply_config(cfg) -> None:
+    """Wire a Config's telemetry knobs into the process-global
+    registry.  A fully default-valued Config (``telemetry=off``, the
+    universal default) leaves the global state COMPLETELY alone — the
+    library builds internal Configs (Booster(), dataset construction)
+    and those must not stomp a threshold or mode an earlier enabling
+    Config set.  Disable explicitly via ``TELEMETRY.configure("off")``."""
+    warn = max(1, int(getattr(cfg, "telemetry_retrace_warn",
+                              _RETRACE_WARN_DEFAULT)))
+    mode = str(getattr(cfg, "telemetry", "off")).lower()
+    out = str(getattr(cfg, "telemetry_out", ""))
+    if mode != "off" or warn != _RETRACE_WARN_DEFAULT:
+        TELEMETRY.retrace_warn = warn
+    if mode != "off":
+        TELEMETRY.configure(mode, out=out)
+    elif out and TELEMETRY.on:
+        TELEMETRY.configure(TELEMETRY.level, out=out)

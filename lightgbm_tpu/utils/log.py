@@ -62,7 +62,13 @@ class PhaseTimer:
     def stop(self, phase: str) -> None:
         t0 = self._start.pop(phase, None)
         if t0 is not None:
-            self.acc[phase] = self.acc.get(phase, 0.0) + time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.acc[phase] = self.acc.get(phase, 0.0) + dt
+            # mirror each phase into the telemetry counters so the
+            # TIMETAG accounting rides the same export as everything
+            # else (lazy import: telemetry imports this module)
+            from ..telemetry import TELEMETRY
+            TELEMETRY.add(f"phase_{phase}_ms", dt * 1e3)
 
     def report(self) -> str:
         return ", ".join(f"{k}={v:.3f}s" for k, v in sorted(self.acc.items()))

@@ -14,6 +14,16 @@ cd "$(dirname "$0")/.."
 # silently, so fail the smoke before spending a training run on it
 # (status to stderr — bench stdout is ONE JSON line by contract)
 python scripts/check_carry_layout.py >&2
+# telemetry span-glossary lint (round 9): an undocumented span is a
+# mystery slice in the Perfetto UI — same fail-before-training policy
+python scripts/check_telemetry_coverage.py >&2
+# profile_train smoke (round 9: rewritten on the telemetry spans):
+# tiny shape, asserts the Perfetto + JSONL files actually get written
+# (stdout redirected — the bench stdout contract is ONE JSON line)
+BENCH_PARAMS='{"num_leaves":15,"max_bin":31}' \
+python scripts/profile_train.py 2048 2 /tmp/lgbtpu_smoke/telemetry >&2
+test -s /tmp/lgbtpu_smoke/telemetry.perfetto.json
+test -s /tmp/lgbtpu_smoke/telemetry.jsonl
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \

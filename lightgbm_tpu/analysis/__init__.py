@@ -1,0 +1,26 @@
+"""lightgbm_tpu.analysis — compiled-program lint framework.
+
+Two engines, one CLI:
+
+- **Program-invariant checker** (``hlo_rules``): declarative rules
+  HLO001-HLO008 over the lowered jaxpr / StableHLO / compiled HLO of
+  the registered hot entry points (``programs``), converting the
+  r6-r9 incident learnings (carry stacks, scatter regressions, buffer
+  donation, retrace churn) into machine-enforced invariants.
+- **Trace-safety AST pass** (``ast_rules``): host-library calls and
+  data-dependent Python branching inside jit-reachable functions,
+  plus the Config documentation/consumption contract.
+
+Plus the re-homed artifact lints (``CARRY001``, ``TEL001``) and the
+suppression engine (``# lint: disable=RULE(reason)``, stale
+suppressions flagged as ``SUP001``).
+
+CLI: ``python -m lightgbm_tpu.analysis [--json] [--rules ID,ID]``
+(exit 0 = clean; docs/STATIC_ANALYSIS.md is the rule glossary).
+"""
+from .core import (Context, Finding, Rule, RULES, render_json,
+                   render_text, run_rules, unsuppressed)
+from . import walker
+
+__all__ = ["Context", "Finding", "Rule", "RULES", "render_json",
+           "render_text", "run_rules", "unsuppressed", "walker"]

@@ -1,0 +1,368 @@
+"""Trace-safety AST pass + config-consistency rules.
+
+TRC001/TRC002 walk the package source (never the lowered programs):
+a ``np.*`` / ``math.*`` / ``time.*`` / Python-RNG call inside a
+jit-reachable function executes at TRACE time — it silently bakes a
+constant into the compiled program (the value the host happened to
+produce at trace time), or worse, forces a host sync.  Data-dependent
+Python branching on a ``jnp`` expression is the classic
+ConcretizationTypeError-or-silent-specialization hazard.  The
+call graph is seeded from the registered hot entry points
+(``programs.py``) and expanded conservatively: over-approximating
+reachability is safe (a spurious finding gets a reviewed suppression),
+under-approximating is not.
+
+CFG001/CFG002 pin the Config contract: every knob documented in
+``docs/Parameters.md`` (CFG001) and actually read somewhere in the
+package (CFG002) — an accepted-but-never-read knob is a user-facing
+lie (the r-series reviews found four).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, rule
+
+# host modules whose calls are trace-time hazards inside jit-reachable
+# code (numpy collapses traced arrays to constants; time/random make
+# the trace nondeterministic)
+HOST_MODULES = {"numpy", "math", "time", "random"}
+
+# host-module calls that are legitimate at trace time: dtype/metadata
+# constructors and scalar casts of static Python values.  Everything
+# else needs a fix or a reviewed `# lint: disable=TRC001(...)`.
+SAFE_HOST_CALLS = {
+    "numpy.dtype", "numpy.iinfo", "numpy.finfo",
+    "numpy.float32", "numpy.float64", "numpy.int32", "numpy.int64",
+    "numpy.uint8", "numpy.uint32", "numpy.int8", "numpy.bool_",
+    "math.ceil", "math.floor", "math.log2", "math.sqrt", "math.inf",
+    "math.isinf", "math.isnan", "math.prod",
+}
+
+# jit-reachable roots: the functions the registered entry points trace
+# into.  (file suffix, function name) — names resolve against the AST
+# index, so a rename here fails loudly in tests.
+JIT_SEEDS: List[Tuple[str, str]] = [
+    ("boosting/gbdt.py", "_boost_one"),
+    ("learner/grower.py", "_train_tree_impl"),
+    ("learner/grower.py", "emit_tree_record"),
+    ("ops/predict.py", "predict_level_ensemble"),
+    ("ops/predict.py", "predict_level_ensemble_pallas"),
+    ("ops/predict.py", "_level_step"),
+    ("ops/predict.py", "predict_raw_ensemble"),
+    ("ops/predict.py", "_walk_raw"),
+    ("ops/predict.py", "predict_binned"),
+    ("ops/predict.py", "unpack_tree_records_device"),
+]
+
+
+class _FnInfo:
+    __slots__ = ("path", "name", "node", "module")
+
+    def __init__(self, path: str, name: str, node: ast.AST,
+                 module: str):
+        self.path = path
+        self.name = name
+        self.node = node
+        self.module = module
+
+
+class SourceIndex:
+    """Package-wide AST index: functions, per-module import aliases,
+    internal-module imports — everything the call-graph expansion and
+    the hazard scans read."""
+
+    def __init__(self, sources: Dict[str, str]):
+        self.trees: Dict[str, ast.Module] = {}
+        self.functions: Dict[str, List[_FnInfo]] = {}   # name -> defs
+        self.by_module: Dict[str, Dict[str, List[_FnInfo]]] = {}
+        self.host_aliases: Dict[str, Dict[str, str]] = {}
+        self.jnp_aliases: Dict[str, Set[str]] = {}
+        self.internal_imports: Dict[str, Set[str]] = {}
+        for path, text in sources.items():
+            try:
+                tree = ast.parse(text)
+            except SyntaxError:
+                continue
+            self.trees[path] = tree
+            self._index_module(path, tree)
+
+    def _index_module(self, path: str, tree: ast.Module) -> None:
+        host: Dict[str, str] = {}
+        jnp: Set[str] = set()
+        internal: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    top = a.name.split(".")[0]
+                    alias = a.asname or top
+                    if top in HOST_MODULES:
+                        host[alias] = a.name
+                    if a.name == "jax.numpy":
+                        jnp.add(alias)
+                    if top == "lightgbm_tpu":
+                        internal.add(alias)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                top = mod.split(".")[0]
+                for a in node.names:
+                    alias = a.asname or a.name
+                    if top in HOST_MODULES:
+                        host[alias] = f"{mod}.{a.name}"
+                    if mod == "jax" and a.name == "numpy":
+                        jnp.add(alias)
+                    if node.level or top == "lightgbm_tpu":
+                        internal.add(alias)
+        self.host_aliases[path] = host
+        self.jnp_aliases[path] = jnp
+        self.internal_imports[path] = internal
+
+        mod_fns: Dict[str, List[_FnInfo]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _FnInfo(path, node.name, node, path)
+                self.functions.setdefault(node.name, []).append(info)
+                mod_fns.setdefault(node.name, []).append(info)
+        self.by_module[path] = mod_fns
+
+    # -- call-graph expansion -----------------------------------------
+    def resolve_call(self, path: str, call: ast.Call) -> List[_FnInfo]:
+        """Conservative callee resolution (documented in the module
+        docstring): same-module first, then package-wide for private
+        (``_``-prefixed) or package-unique names."""
+        fn = call.func
+        name: Optional[str] = None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+        elif isinstance(fn, ast.Attribute):
+            name = fn.attr
+        if not name:
+            return []
+        local = self.by_module.get(path, {}).get(name, [])
+        if local:
+            return local
+        cands = self.functions.get(name, [])
+        if name.startswith("_") or len(cands) == 1:
+            return cands
+        return []
+
+    def reachable(self, seeds: List[Tuple[str, str]]) -> List[_FnInfo]:
+        """BFS the call graph from (file-suffix, fn-name) seeds."""
+        work: List[_FnInfo] = []
+        for suffix, name in seeds:
+            found = [f for f in self.functions.get(name, [])
+                     if f.path.endswith(suffix)]
+            work.extend(found)
+        seen: Set[int] = set()
+        out: List[_FnInfo] = []
+        while work:
+            info = work.pop()
+            key = id(info.node)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(info)
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    work.extend(self.resolve_call(info.path, node))
+        return out
+
+
+def _attr_chain(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """``np.random.uniform`` -> ("np", "random.uniform")."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, ".".join(reversed(parts))
+    return None
+
+
+def scan_host_calls(index: SourceIndex, fns: List[_FnInfo]
+                    ) -> List[Finding]:
+    """TRC001 over a set of reachable functions."""
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for info in fns:
+        aliases = index.host_aliases.get(info.path, {})
+        if not aliases:
+            continue
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            root, path_ = chain
+            mod = aliases.get(root)
+            if not mod:
+                continue
+            full = f"{mod}.{path_}" if path_ else mod
+            # normalize to the canonical module head for the allowlist
+            head = mod.split(".")[0]
+            canon = f"{head}." + full.split(".", 1)[1] \
+                if "." in full else full
+            if canon in SAFE_HOST_CALLS:
+                continue
+            key = (info.path, node.lineno, canon)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                rule="TRC001", file=info.path, line=node.lineno,
+                message=f"`{root}.{path_}(...)` inside jit-reachable "
+                        f"`{info.name}` — a {head} call at trace time "
+                        "bakes a host constant into the compiled "
+                        "program (or forces a host sync); use jnp/"
+                        "jax.random, or hoist to the dispatch side"))
+    return out
+
+
+def scan_python_branching(index: SourceIndex, fns: List[_FnInfo]
+                          ) -> List[Finding]:
+    """TRC002: Python ``if``/``while`` on a jnp expression inside a
+    jit-reachable function."""
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for info in fns:
+        jnp = index.jnp_aliases.get(info.path, set())
+        if not jnp:
+            continue
+        for node in ast.walk(info.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for sub in ast.walk(node.test):
+                chain = _attr_chain(sub) if isinstance(
+                    sub, ast.Attribute) else None
+                if chain and chain[0] in jnp:
+                    key = (info.path, node.lineno)
+                    if key in seen:
+                        break
+                    seen.add(key)
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(Finding(
+                        rule="TRC002", file=info.path,
+                        line=node.lineno,
+                        message=f"Python `{kind}` on a jnp expression "
+                                f"inside jit-reachable `{info.name}` — "
+                                "data-dependent Python control flow "
+                                "either fails to trace or silently "
+                                "specializes on the trace-time value; "
+                                "use lax.cond/jnp.where"))
+                    break
+    return out
+
+
+# -- Config consistency -----------------------------------------------------
+
+def config_field_lines(config_src: str) -> Dict[str, int]:
+    """{field name: definition line} from the Config dataclass body."""
+    tree = ast.parse(config_src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return {stmt.target.id: stmt.lineno
+                    for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)}
+    return {}
+
+
+def documented_params(doc_text: str) -> Set[str]:
+    """First-column backticked names of the Parameters.md tables."""
+    return set(re.findall(r"^\|\s*`(\w+)`\s*\|", doc_text, re.M))
+
+
+def config_reads(sources: Dict[str, str]) -> Set[str]:
+    """Every attribute name read (Load context) or getattr'd by string
+    anywhere in the package — the CFG002 notion of a knob being
+    consumed."""
+    reads: Set[str] = set()
+    for path, text in sources.items():
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                reads.add(node.attr)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in ("getattr", "hasattr") \
+                    and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                reads.add(node.args[1].value)
+    return reads
+
+
+# -- registered rules -------------------------------------------------------
+
+def _reachable_fns(ctx) -> Tuple[SourceIndex, List[_FnInfo]]:
+    # index + BFS are pure functions of ctx.sources — cached on the
+    # Context so TRC001/TRC002 (and anything else) share one build
+    return ctx.source_index, ctx.jit_reachable()
+
+
+@rule("TRC001", "no host-library calls in jit-reachable functions",
+      incident="trace-time constants / host syncs in device code")
+def _trc001(ctx) -> List[Finding]:
+    index, fns = _reachable_fns(ctx)
+    return scan_host_calls(index, fns)
+
+
+@rule("TRC002", "no Python branching on jnp values in jit-reachable "
+                "functions",
+      incident="trace-time specialization / ConcretizationTypeError")
+def _trc002(ctx) -> List[Finding]:
+    index, fns = _reachable_fns(ctx)
+    return scan_python_branching(index, fns)
+
+
+@rule("CFG001", "every Config knob documented in docs/Parameters.md",
+      incident="accepted-but-undocumented knobs")
+def _cfg001(ctx) -> List[Finding]:
+    cfg_rel = "lightgbm_tpu/config.py"
+    cfg_src = ctx.sources.get(cfg_rel)
+    if cfg_src is None:                       # fixture source set
+        return []
+    doc_path = os.path.join(ctx.repo, "docs", "Parameters.md")
+    try:
+        with open(doc_path) as fh:
+            documented = documented_params(fh.read())
+    except FileNotFoundError:
+        return [Finding(rule="CFG001", file="docs/Parameters.md",
+                        message="docs/Parameters.md missing — run "
+                                "scripts/gen_parameter_docs.py")]
+    out: List[Finding] = []
+    for name, line in sorted(config_field_lines(cfg_src).items()):
+        if name not in documented:
+            out.append(Finding(
+                rule="CFG001", file=cfg_rel, line=line,
+                message=f"Config knob `{name}` is not documented in "
+                        "docs/Parameters.md — run scripts/"
+                        "gen_parameter_docs.py"))
+    return out
+
+
+@rule("CFG002", "every Config knob read at least once in the package",
+      incident="accepted-but-never-read knobs (user-facing no-ops)")
+def _cfg002(ctx) -> List[Finding]:
+    cfg_rel = "lightgbm_tpu/config.py"
+    cfg_src = ctx.sources.get(cfg_rel)
+    if cfg_src is None:
+        return []
+    reads = config_reads(ctx.sources)
+    out: List[Finding] = []
+    for name, line in sorted(config_field_lines(cfg_src).items()):
+        if name not in reads:
+            out.append(Finding(
+                rule="CFG002", file=cfg_rel, line=line,
+                message=f"Config knob `{name}` is never read anywhere "
+                        "in the package — an accepted parameter that "
+                        "does nothing; wire it or remove it"))
+    return out

@@ -1,0 +1,272 @@
+"""Rule engine for the compiled-program lint framework.
+
+A :class:`Rule` is a stable-ID'd check (``HLO004``, ``TRC001``, ...)
+over one of three surfaces — lowered programs of the registered hot
+entry points, the package's Python AST, or cross-artifact consistency
+(spec vs emit site, span map vs glossary).  Rules emit
+:class:`Finding` records; the engine applies source-comment
+suppressions, reports unused suppressions, and renders one text or
+JSON report.  ``python -m lightgbm_tpu.analysis`` is the CLI;
+``scripts/bench_smoke.sh`` fails CI on any unsuppressed finding.
+
+Suppression syntax (checked for staleness — a suppression that matches
+no finding is itself a finding, rule ``SUP001``)::
+
+    x = np.empty(n)  # lint: disable=TRC001(host buffer, dispatch side)
+
+Trailing form suppresses that rule on that line; a standalone
+``# lint: disable=...`` comment line suppresses the rule for the whole
+file (program-level findings are attributed to the entry point's
+defining file at line 0, so file scope is how they are waived).
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+JSON_SCHEMA_VERSION = 1
+
+SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=((?:[A-Z]+\d+\([^)]*\)(?:\s*,\s*)?)+)")
+SUPPRESS_ITEM_RE = re.compile(r"([A-Z]+\d+)\(([^)]*)\)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation (or suppressed would-be violation)."""
+    rule: str
+    message: str
+    file: str = ""          # repo-relative source path
+    line: int = 0           # 1-based; 0 = whole-file / program-level
+    suppressed: bool = False
+    reason: str = ""        # suppression reason when suppressed
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def location(self) -> str:
+        if self.file and self.line:
+            return f"{self.file}:{self.line}"
+        return self.file or "<repo>"
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    title: str
+    incident: str           # which hard-won learning the rule encodes
+    check: Callable         # (Context) -> List[Finding]
+    needs_programs: bool = False
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(id: str, title: str, incident: str = "",
+         needs_programs: bool = False):
+    """Register a rule check function under a stable ID."""
+    def deco(fn):
+        RULES[id] = Rule(id=id, title=title, incident=incident,
+                         check=fn, needs_programs=needs_programs)
+        return fn
+    return deco
+
+
+@dataclasses.dataclass
+class Suppression:
+    file: str
+    line: int               # line the comment sits on
+    rule: str
+    reason: str
+    file_scope: bool        # standalone comment = whole-file scope
+    used: bool = False
+
+
+def parse_suppressions(path: str, text: str) -> List[Suppression]:
+    """All ``# lint: disable=RULE(reason)`` comments in one file."""
+    out: List[Suppression] = []
+    for i, raw in enumerate(text.splitlines(), start=1):
+        m = SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        standalone = raw.strip().startswith("#")
+        for rid, reason in SUPPRESS_ITEM_RE.findall(m.group(1)):
+            out.append(Suppression(file=path, line=i, rule=rid,
+                                   reason=reason.strip(),
+                                   file_scope=standalone))
+    return out
+
+
+class Context:
+    """Shared state handed to every rule: repo sources, lazily-built
+    entry-point programs, and the selected-rule set."""
+
+    def __init__(self, repo: str = REPO,
+                 sources: Optional[Dict[str, str]] = None,
+                 programs=None):
+        self.repo = repo
+        self._sources = sources
+        self._programs = programs
+        self._source_index = None
+        self._reachable = None
+
+    # -- sources ------------------------------------------------------
+    @property
+    def sources(self) -> Dict[str, str]:
+        """{repo-relative path: text} for every package source file
+        (the analysis package itself is excluded: it is host-only
+        tooling, never jit-reachable, and must not self-lint its rule
+        fixtures)."""
+        if self._sources is None:
+            srcs: Dict[str, str] = {}
+            pkg = os.path.join(self.repo, "lightgbm_tpu")
+            for root, _dirs, files in os.walk(pkg):
+                if os.sep + "analysis" in root:
+                    continue
+                for f in sorted(files):
+                    if not f.endswith(".py"):
+                        continue
+                    path = os.path.join(root, f)
+                    rel = os.path.relpath(path, self.repo)
+                    with open(path) as fh:
+                        srcs[rel] = fh.read()
+            self._sources = srcs
+        return self._sources
+
+    def suppression_sources(self) -> Dict[str, str]:
+        """Sources scanned for ``# lint: disable`` comments: the
+        package files plus the out-of-package files TEL001 lints
+        (bench.py, scripts/profile_train.py) — a finding attributed
+        to those files must be waivable like any other."""
+        from .teldoc_rule import EXTRA_SOURCES
+        out = dict(self.sources)
+        for rel in EXTRA_SOURCES:
+            path = os.path.join(self.repo, rel)
+            if rel not in out and os.path.exists(path):
+                with open(path) as fh:
+                    out[rel] = fh.read()
+        return out
+
+    # -- programs -----------------------------------------------------
+    @property
+    def programs(self):
+        if self._programs is None:
+            from .programs import ProgramSet
+            self._programs = ProgramSet()
+        return self._programs
+
+    # -- AST index (shared by TRC001/TRC002/CFG002) -------------------
+    @property
+    def source_index(self):
+        if self._source_index is None:
+            from .ast_rules import SourceIndex
+            self._source_index = SourceIndex(self.sources)
+        return self._source_index
+
+    def jit_reachable(self):
+        if self._reachable is None:
+            from .ast_rules import JIT_SEEDS
+            self._reachable = self.source_index.reachable(JIT_SEEDS)
+        return self._reachable
+
+
+def _apply_suppressions(findings: List[Finding],
+                        sups: List[Suppression]) -> None:
+    """Mark findings covered by a suppression; mark suppressions used.
+    Trailing comments cover their own line; standalone comments cover
+    the file."""
+    by_file: Dict[str, List[Suppression]] = {}
+    for s in sups:
+        by_file.setdefault(s.file, []).append(s)
+    for f in findings:
+        for s in by_file.get(f.file, ()):
+            if s.rule != f.rule:
+                continue
+            if s.file_scope or (f.line and s.line == f.line):
+                f.suppressed = True
+                f.reason = s.reason
+                s.used = True
+                break
+
+
+def run_rules(rule_ids: Optional[List[str]] = None,
+              ctx: Optional[Context] = None,
+              check_suppressions: bool = True) -> List[Finding]:
+    """Run the selected rules (default: all registered) and apply
+    suppressions.  Returns every finding, suppressed ones included —
+    callers gate on the unsuppressed subset."""
+    # rule modules self-register on import
+    from . import ast_rules, hlo_rules, layout_rule, teldoc_rule  # noqa: F401
+
+    ctx = ctx or Context()
+    ids = list(rule_ids) if rule_ids else sorted(RULES)
+    unknown = [i for i in ids if i not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {unknown}; "
+                         f"known: {sorted(RULES)}")
+    findings: List[Finding] = []
+    for rid in ids:
+        findings.extend(RULES[rid].check(ctx))
+
+    sups: List[Suppression] = []
+    for rel, text in ctx.suppression_sources().items():
+        sups.extend(parse_suppressions(rel, text))
+    _apply_suppressions(findings, sups)
+    if check_suppressions:
+        for s in sups:
+            if not s.used and (s.rule in ids or s.rule not in RULES):
+                findings.append(Finding(
+                    rule="SUP001",
+                    message=(f"unused suppression for {s.rule}"
+                             + (f" ({s.reason})" if s.reason else "")
+                             + " — the finding it waived no longer "
+                               "fires; delete the comment"),
+                    file=s.file, line=s.line))
+    return findings
+
+
+def unsuppressed(findings: List[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]
+
+
+def render_text(findings: List[Finding],
+                rule_ids: Optional[List[str]] = None) -> str:
+    out = io.StringIO()
+    live = unsuppressed(findings)
+    for f in sorted(live, key=lambda f: (f.rule, f.file, f.line)):
+        out.write(f"{f.rule} {f.location()}: {f.message}\n")
+    n_sup = len(findings) - len(live)
+    ids = rule_ids or sorted(RULES)
+    if live:
+        out.write(f"lightgbm_tpu.analysis: {len(live)} finding(s) "
+                  f"({n_sup} suppressed) across {len(ids)} rule(s)\n")
+    else:
+        out.write(f"lightgbm_tpu.analysis: clean — {len(ids)} rule(s), "
+                  f"0 findings ({n_sup} suppressed)\n")
+    return out.getvalue()
+
+
+def render_json(findings: List[Finding],
+                rule_ids: Optional[List[str]] = None) -> str:
+    live = unsuppressed(findings)
+    ids = rule_ids or sorted(RULES)
+    doc = {
+        "version": JSON_SCHEMA_VERSION,
+        "rules_run": ids,
+        "findings": [f.to_dict() for f in sorted(
+            findings, key=lambda f: (f.rule, f.file, f.line))],
+        "counts": {
+            "total": len(findings),
+            "suppressed": len(findings) - len(live),
+            "unsuppressed": len(live),
+        },
+        "clean": not live,
+    }
+    return json.dumps(doc, sort_keys=True)

@@ -1,0 +1,188 @@
+"""CARRY001 — packed tree-record layout consistency (re-homed from
+``scripts/check_carry_layout.py``, which is now a thin wrapper over
+this rule).
+
+The packed single-buffer tree carry (round 7) serializes a grown
+TreeArrays into one uint8 record at FIXED offsets
+(tree.TreeRecordLayout).  Three places must agree on that layout — the
+spec (tree.TREE_RECORD_SPEC), the dtypes the grower materializes in
+``_init_state`` (parsed from SOURCE, so a dtype edit trips the rule
+even if nothing imports), and the host/device unpack sites — and a
+field added to TreeArrays without a matching spec row would silently
+drop or corrupt tree state only on the packed path.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from .core import Finding, rule
+
+SRC = "lightgbm_tpu/tree.py"
+
+# dtype token the grower writes at the emit site -> spec dtype string
+GROWER_DTYPE_TO_SPEC = {
+    "jnp.int32": "<i4",
+    "jnp.float32": "<f4",
+    "bool": "|u1",
+}
+
+
+def _f(msg: str) -> Finding:
+    return Finding(rule="CARRY001", file=SRC, message=msg)
+
+
+def check_field_order(spec, tree_arrays_cls) -> List[Finding]:
+    spec_names = [name for name, _, _ in spec]
+    fields = list(tree_arrays_cls._fields)
+    if spec_names != fields:
+        return [_f(f"TREE_RECORD_SPEC field order {spec_names} != "
+                   f"TreeArrays._fields {fields}")]
+    return []
+
+
+def check_grower_emit_dtypes(spec, grower_src: str) -> List[Finding]:
+    """Parse ``_init_state``'s TreeArrays(...) literal for each field's
+    dtype token and compare against the spec."""
+    out: List[Finding] = []
+    m = re.search(r"tree = TreeArrays\((.*?)\n\s*\)", grower_src, re.S)
+    if not m:
+        return [_f("could not find the `tree = TreeArrays(...)` emit "
+                   "site in learner/grower.py _init_state")]
+    body = m.group(1)
+    # split the literal's kwargs on top-level commas (nested parens in
+    # shape tuples rule out a flat regex)
+    parts, depth, cur = [], 0, []
+    for ch in body:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    emitted = {}
+    for part in parts:
+        if "=" not in part:
+            continue
+        name, expr = part.split("=", 1)
+        name, expr = name.strip(), expr.strip()
+        if not re.fullmatch(r"\w+", name):
+            continue
+        if name == "num_leaves":
+            # scalar: jnp.int32(1)
+            emitted[name] = "<i4" if "jnp.int32" in expr else "?"
+            continue
+        toks = [t for t in GROWER_DTYPE_TO_SPEC
+                if re.search(rf"[,(]\s*{re.escape(t)}\s*[,)]", expr)]
+        emitted[name] = GROWER_DTYPE_TO_SPEC[toks[0]] if len(toks) == 1 \
+            else "?"
+    for name, dt, _ in spec:
+        if name not in emitted:
+            out.append(_f(f"spec field {name!r} has no emit site in "
+                          "grower._init_state"))
+        elif emitted[name] == "?":
+            out.append(_f("could not determine the dtype "
+                          "grower._init_state materializes for "
+                          f"{name!r}"))
+        elif emitted[name] != dt:
+            out.append(_f(f"{name!r}: grower emits {emitted[name]}, "
+                          f"spec says {dt}"))
+    for name in emitted:
+        if name not in {n for n, _, _ in spec}:
+            out.append(_f(f"grower emits field {name!r} with no spec "
+                          "row — it would be DROPPED by the packed "
+                          "carry"))
+    return out
+
+
+def check_offsets(layout) -> List[Finding]:
+    out: List[Finding] = []
+    prev_end = 0
+    for name, (off, nbytes, dt, shape) in layout.fields.items():
+        if off % 4:
+            out.append(_f(f"{name!r}: offset {off} not word-aligned"))
+        if off < prev_end:
+            out.append(_f(f"{name!r}: offset {off} overlaps previous "
+                          f"field (ends at {prev_end})"))
+        prev_end = off + nbytes
+    if layout.record_size % 64:
+        out.append(_f(f"record_size {layout.record_size} not 64-byte "
+                      "padded"))
+    if prev_end > layout.record_size:
+        out.append(_f(f"fields end at {prev_end} past record_size "
+                      f"{layout.record_size}"))
+    return out
+
+
+def check_roundtrip(layout, tree_arrays_cls, spec) -> List[Finding]:
+    """Functional round-trip: pack a randomized TreeArrays on the CPU
+    backend, unpack host-side AND device-side, require exact equality
+    field by field."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lightgbm_tpu.ops.predict import unpack_tree_records_device
+
+    out: List[Finding] = []
+    rng = np.random.RandomState(7)
+    vals = {}
+    for name, (off, nbytes, dt, shape) in layout.fields.items():
+        kind = np.dtype(dt).kind
+        if name == "num_leaves":
+            vals[name] = jnp.int32(5)
+        elif kind == "u":
+            vals[name] = jnp.asarray(rng.rand(*shape) > 0.5)
+        elif kind == "i":
+            vals[name] = jnp.asarray(
+                rng.randint(-100, 100, size=shape), jnp.int32)
+        else:
+            vals[name] = jnp.asarray(
+                rng.randn(*shape).astype(np.float32))
+    tree = tree_arrays_cls(**vals)
+    rec = np.asarray(jax.jit(layout.pack_tree_record)(tree))
+
+    host = layout.unpack_tree_record(rec)
+    for name, _, _ in spec:
+        want = np.asarray(vals[name])
+        got = np.asarray(host[name])
+        if got.shape != want.shape or not np.array_equal(got, want):
+            out.append(_f(f"host round-trip mismatch on {name!r}"))
+
+    dev = unpack_tree_records_device(
+        jnp.asarray(rec), layout.num_leaves, layout.max_feature_bin)
+    for name, _, _ in spec:
+        got = np.asarray(getattr(dev, name))
+        want = np.asarray(vals[name])
+        if got.shape != want.shape or not np.array_equal(got, want):
+            out.append(_f(f"device round-trip mismatch on {name!r}"))
+    return out
+
+
+@rule("CARRY001", "packed tree-record spec, grower emit sites and "
+                  "pack/unpack round-trip agree",
+      incident="r7 packed single-buffer tree carry")
+def _carry001(ctx) -> List[Finding]:
+    from lightgbm_tpu.learner.grower import TreeArrays
+    from lightgbm_tpu.tree import TREE_RECORD_SPEC, TreeRecordLayout
+
+    grower_src = ctx.sources.get(
+        "lightgbm_tpu/learner/grower.py")
+    if grower_src is None:
+        with open(os.path.join(ctx.repo, "lightgbm_tpu", "learner",
+                               "grower.py")) as fh:
+            grower_src = fh.read()
+
+    out: List[Finding] = []
+    out.extend(check_field_order(TREE_RECORD_SPEC, TreeArrays))
+    out.extend(check_grower_emit_dtypes(TREE_RECORD_SPEC, grower_src))
+    for L, B in ((31, 64), (8, 16)):
+        out.extend(check_offsets(TreeRecordLayout(L, B)))
+    out.extend(check_roundtrip(TreeRecordLayout(8, 16), TreeArrays,
+                               TREE_RECORD_SPEC))
+    return out

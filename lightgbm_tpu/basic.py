@@ -106,6 +106,12 @@ class Dataset:
                 self.weight = extras["weight"]
             if self.group is None and extras.get("group") is not None:
                 self.group = extras["group"]
+            if self.categorical_feature == "auto" \
+                    and extras.get("categorical_feature"):
+                # CLI categorical_column= spec, resolved by the loader
+                # into post-drop feature indices (reference
+                # dataset_loader.cpp categorical_feature handling)
+                self.categorical_feature = extras["categorical_feature"]
         ref_core = None
         if self.reference is not None:
             # the reference may be a lazy handle or an already
@@ -120,6 +126,11 @@ class Dataset:
         pandas_cats = (train_cats if train_cats is not None
                        else _pandas_categories(data))
         data = _to_matrix(data, train_cats)
+        if _is_sparse(data) and not config.is_enable_sparse:
+            # reference is_enable_sparse=false: bypass the sparse-aware
+            # construction and bin the dense matrix
+            data = np.ascontiguousarray(
+                np.asarray(data.todense(), dtype=np.float64))
         feature_names, cat_indices = self._resolve_columns(data)
 
         from .telemetry import TELEMETRY

@@ -69,6 +69,23 @@ def run(argv: List[str]) -> int:
 def _task_train(params, config: Config) -> None:
     if not config.data:
         Log.fatal("No training data: set data=<file>")
+    if config.num_machines > 1:
+        # socket rendezvous config (reference application.cpp:87-105):
+        # machines= inline list wins, machine_list_file= is the file
+        # form; forwarded to the call-compat network surface
+        machines = config.machines
+        if not machines and config.machine_list_file:
+            import os
+            if not os.path.exists(config.machine_list_file):
+                Log.fatal("machine_list_file not found: "
+                          f"{config.machine_list_file}")
+            with open(config.machine_list_file) as f:
+                machines = ",".join(ln.strip() for ln in f
+                                    if ln.strip())
+        if machines:
+            from .capi import LGBM_NetworkInit
+            LGBM_NetworkInit(machines, config.local_listen_port,
+                             config.time_out, config.num_machines)
     # input_model (continued training) seeds scores from raw data —
     # retain it in that case (reference CLI keeps data in memory too)
     train_set = Dataset(config.data, params=params,
@@ -82,6 +99,11 @@ def _task_train(params, config: Config) -> None:
     booster = _train(params, train_set, config.num_iterations,
                      valid_sets=valid_sets, valid_names=valid_names,
                      init_model=config.input_model or None)
+    if config.is_save_binary_file:
+        # reference DatasetLoader::SaveBinaryFile: the binned dataset
+        # lands next to the text file and short-circuits future loads
+        train_set.save_binary(config.data + ".bin")
+        Log.info(f"Saved binned dataset to {config.data}.bin")
     booster.save_model(config.output_model)
     Log.info(f"Finished training; model saved to {config.output_model}")
 
@@ -100,7 +122,10 @@ def _task_predict(params, config: Config) -> None:
         num_iteration=config.num_iteration_predict,
         raw_score=config.is_predict_raw_score,
         pred_leaf=config.is_predict_leaf_index,
-        pred_contrib=config.is_predict_contrib)
+        pred_contrib=config.is_predict_contrib,
+        pred_early_stop=config.pred_early_stop,
+        pred_early_stop_freq=config.pred_early_stop_freq,
+        pred_early_stop_margin=config.pred_early_stop_margin)
     out = np.atleast_2d(np.asarray(pred))
     if out.shape[0] == 1 and X.shape[0] != 1:
         out = out.T

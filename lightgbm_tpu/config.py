@@ -218,7 +218,7 @@ class Config:
     boosting_type: str = "gbdt"
     device: str = "tpu"
     tree_learner: str = "serial"
-    num_threads: int = 0
+    num_threads: int = 0  # lint: disable=CFG002(compat-only: host work is numpy/native-threaded, device work is the TPU program)
     seed: int = 0
     num_machines: int = 1
     verbose: int = 1
@@ -297,7 +297,7 @@ class Config:
     group_column: str = ""
     ignore_column: str = ""
     categorical_column: str = ""
-    is_pre_partition: bool = False
+    is_pre_partition: bool = False  # lint: disable=CFG002(distributed loaders always treat per-host shards as pre-partitioned; accepted for reference CLI parity)
     use_two_round_loading: bool = False
     streaming_chunk_rows: int = 65536  # rows per two-round/PushRows
     # text chunk (bounds peak float-row memory during streaming load)
@@ -324,11 +324,12 @@ class Config:
     machines: str = ""
 
     # -- tpu-specific (new; no reference analog) --
-    hist_dtype: str = "float32"     # accumulation dtype for histogram matmuls
     hist_compute_dtype: str = "float32"  # one-hot matmul input dtype
     # (bfloat16 roughly doubles MXU throughput at ~0.4% grad rounding;
-    # opt in for benchmarks, keep float32 for reference parity)
-    row_chunk: int = 65536          # rows per histogram-scan chunk
+    # opt in for benchmarks, keep float32 for reference parity.  The
+    # ACCUMULATION dtype is deliberately not a knob: every histogram
+    # matmul pins preferred_element_type=float32, and analysis rule
+    # HLO001 pins the no-f64 side)
     frontier_width: int = 0         # max splits applied per frontier round
     # (0 = auto: min(126, num_leaves-1) — three 42-leaf strips of the
     # channel-packed histogram kernel.  84 is ~3% faster at the 1M
@@ -494,10 +495,11 @@ class Config:
     # is active even at telemetry=off (trace-time cost only)
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
-    deterministic: bool = False
 
-    # free-form passthrough of unrecognized params (warned, kept for echo)
-    extra: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # free-form passthrough of unrecognized params (warned, kept for
+    # echo; consumed wholesale through to_dict/model-file echo, never
+    # by attribute)
+    extra: Dict[str, str] = dataclasses.field(default_factory=dict)  # lint: disable=CFG002(passthrough container, consumed wholesale via to_dict)
 
     # ------------------------------------------------------------------
     def __post_init__(self):

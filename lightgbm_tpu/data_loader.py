@@ -82,8 +82,10 @@ def qid_to_group_sizes(qid: np.ndarray) -> np.ndarray:
 
 def _resolve_file_columns(config: Config, names: Optional[List[str]],
                           ncol: int):
-    """Shared label/weight/group/ignore column-role resolution
-    (reference dataset_loader.cpp:23-158)."""
+    """Shared label/weight/group/ignore/categorical column-role
+    resolution (reference dataset_loader.cpp:23-158).  Returns
+    categorical indices REMAPPED into the post-drop feature space so
+    they line up with the returned matrix's columns."""
     label_col = _resolve_single(config.label_column, names, default=0)
     weight_cols = _parse_column_spec(config.weight_column, names)
     group_cols = _parse_column_spec(config.group_column, names)
@@ -91,7 +93,9 @@ def _resolve_file_columns(config: Config, names: Optional[List[str]],
     used = [i for i in range(ncol)
             if i != label_col and i not in weight_cols
             and i not in group_cols and i not in ignore_cols]
-    return label_col, weight_cols, group_cols, used
+    raw_cat = set(_parse_column_spec(config.categorical_column, names))
+    cat_feats = [f for f, i in enumerate(used) if i in raw_cat]
+    return label_col, weight_cols, group_cols, used, cat_feats
 
 
 def _load_side_files(path: str, extras: Dict) -> Dict:
@@ -163,11 +167,13 @@ def load_file(path: str, config: Config
                              skiprows=1 if has_header else 0,
                              ndmin=2, dtype=np.float64,
                              converters=None, encoding=None)
-        label_col, weight_cols, group_cols, used = _resolve_file_columns(
-            config, names, raw.shape[1])
+        label_col, weight_cols, group_cols, used, cat_feats = \
+            _resolve_file_columns(config, names, raw.shape[1])
         X = raw[:, used]
         label = raw[:, label_col] if label_col is not None else None
         extras: Dict = {}
+        if cat_feats:
+            extras["categorical_feature"] = cat_feats
         if weight_cols:
             extras["weight"] = raw[:, weight_cols[0]].astype(np.float32)
         if group_cols:
@@ -231,15 +237,16 @@ def load_file_streaming(path: str, config: Config):
                     reservoir[j] = line
             n_rows += 1
     sample_raw = parse_lines(reservoir)
-    label_col, weight_cols, group_cols, used = _resolve_file_columns(
-        config, names, sample_raw.shape[1])
+    label_col, weight_cols, group_cols, used, cat_feats = \
+        _resolve_file_columns(config, names, sample_raw.shape[1])
     sample_X = sample_raw[:, used]
     sample_vals, sample_rows = split_sample_columns(sample_X)
 
     ds = CoreDataset.from_sampled_columns(
         sample_vals, sample_rows, sample_X.shape[0], n_rows,
         config=config,
-        feature_names=[names[i] for i in used] if names else None)
+        feature_names=[names[i] for i in used] if names else None,
+        categorical_features=cat_feats or None)
 
     # ---- round 2: stream chunks into the bin matrix ----
     chunk_rows = max(1, int(config.streaming_chunk_rows))

@@ -413,10 +413,10 @@ def _expansion_consts(num_groups: int, max_group_bin: int,
     bin index.  kind selects the dtype pair: "bf16" (emat bf16 / bcol
     f32), "i8" (int8 / int32), "bf16_i32" (bf16 / int32)."""
     g, b = num_groups, max_group_bin
-    emat = np.zeros((g, g * b), dtype=np.float32)
+    emat = np.zeros((g, g * b), dtype=np.float32)  # lint: disable=TRC001(static-shape constant table, never touches traced values)
     for gg in range(g):
         emat[gg, gg * b:(gg + 1) * b] = 1.0
-    bcol = np.tile(np.arange(b, dtype=np.float32), g)[None, :]
+    bcol = np.tile(np.arange(b, dtype=np.float32), g)[None, :]  # lint: disable=TRC001(static-shape constant table, never touches traced values)
     if kind == "i8":
         return emat.astype(np.int8), bcol.astype(np.int32)
     if kind == "bf16_i32":

@@ -10,13 +10,13 @@
 # plumbing, not performance.
 set -e
 cd "$(dirname "$0")/.."
-# packed-carry layout lint first: record-offset drift corrupts trees
-# silently, so fail the smoke before spending a training run on it
-# (status to stderr — bench stdout is ONE JSON line by contract)
-python scripts/check_carry_layout.py >&2
-# telemetry span-glossary lint (round 9): an undocumented span is a
-# mystery slice in the Perfetto UI — same fail-before-training policy
-python scripts/check_telemetry_coverage.py >&2
+# static-analysis suite first: the compiled-program invariant rules
+# (HLO001-HLO008), the trace-safety AST pass, the Config contract and
+# the re-homed carry-layout/telemetry-glossary lints all run as one
+# engine (docs/STATIC_ANALYSIS.md).  Any unsuppressed finding fails
+# the smoke before a training run is spent on it.  (JSON to stderr —
+# bench stdout is ONE JSON line by contract.)
+python -m lightgbm_tpu.analysis --json >&2
 # profile_train smoke (round 9: rewritten on the telemetry spans):
 # tiny shape, asserts the Perfetto + JSONL files actually get written
 # (stdout redirected — the bench stdout contract is ONE JSON line)

@@ -1,37 +1,15 @@
 #!/usr/bin/env python
 """Carry-layout lint: tree.TREE_RECORD_SPEC vs the grower's emit sites.
 
-The packed single-buffer tree carry (round 7) serializes a grown
-TreeArrays into one uint8 record at FIXED offsets
-(tree.TreeRecordLayout).  Three places must agree on that layout —
-
-- the spec itself (lightgbm_tpu/tree.py TREE_RECORD_SPEC),
-- the grower's TreeArrays fields and the dtypes it materializes in
-  `_init_state` (lightgbm_tpu/learner/grower.py), and
-- the unpack sites (host `unpack_tree_record`, device
-  `ops/predict.py unpack_tree_records_device`)
-
-— and a field added to TreeArrays without a matching spec row (or with
-a different dtype) would silently drop or corrupt tree state only on
-the packed path.  This lint fails on any drift; scripts/bench_smoke.sh
-runs it before the bench so CI catches it without a training run.
-
-Checks:
-  1. spec field names/order == TreeArrays._fields (exact),
-  2. every dtype the grower materializes in `_init_state` maps to the
-     spec dtype (jnp.int32 -> <i4, jnp.float32 -> <f4, bool -> |u1),
-     parsed from the grower SOURCE so a dtype edit at the emit site
-     trips the lint even if nothing imports,
-  3. offsets are word-aligned, non-overlapping, monotonic; record is
-     64-byte padded,
-  4. functional round-trip: pack a randomized TreeArrays on the CPU
-     backend, unpack host-side AND device-side, require exact equality
-     field by field.
-
-Usage: python scripts/check_carry_layout.py   (rc 0 clean, rc 1 drift)
+Thin wrapper over analysis rule ``CARRY001``
+(lightgbm_tpu/analysis/layout_rule.py) — the check logic was re-homed
+into the `python -m lightgbm_tpu.analysis` engine in the
+static-analysis round; this entry point keeps the historical CLI
+contract (rc 0 clean, rc 1 drift, findings on stderr) for tooling that
+calls it directly.  ``scripts/bench_smoke.sh`` now runs the full
+analysis suite instead.
 """
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -39,158 +17,15 @@ sys.path.insert(0, REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-import numpy as np  # noqa: E402
-
-ERRORS = []
-
-
-def err(msg):
-    ERRORS.append(msg)
-    print(f"DRIFT: {msg}", file=sys.stderr)
-
-
-# dtype token the grower writes at the emit site -> spec dtype string
-GROWER_DTYPE_TO_SPEC = {
-    "jnp.int32": "<i4",
-    "jnp.float32": "<f4",
-    "bool": "|u1",
-}
-
-
-def check_field_order(spec, tree_arrays_cls):
-    spec_names = [name for name, _, _ in spec]
-    fields = list(tree_arrays_cls._fields)
-    if spec_names != fields:
-        err(f"TREE_RECORD_SPEC field order {spec_names} != "
-            f"TreeArrays._fields {fields}")
-
-
-def check_grower_emit_dtypes(spec):
-    """Parse `_init_state`'s TreeArrays(...) literal for each field's
-    dtype token and compare against the spec."""
-    src_path = os.path.join(REPO, "lightgbm_tpu", "learner", "grower.py")
-    with open(src_path) as f:
-        src = f.read()
-    m = re.search(r"tree = TreeArrays\((.*?)\n\s*\)", src, re.S)
-    if not m:
-        err("could not find the `tree = TreeArrays(...)` emit site in "
-            "learner/grower.py _init_state")
-        return
-    body = m.group(1)
-    # split the literal's kwargs on top-level commas (nested parens in
-    # shape tuples rule out a flat regex)
-    parts, depth, cur = [], 0, []
-    for ch in body:
-        if ch == "(":
-            depth += 1
-        elif ch == ")":
-            depth -= 1
-        if ch == "," and depth == 0:
-            parts.append("".join(cur))
-            cur = []
-        else:
-            cur.append(ch)
-    parts.append("".join(cur))
-    emitted = {}
-    for part in parts:
-        if "=" not in part:
-            continue
-        name, expr = part.split("=", 1)
-        name, expr = name.strip(), expr.strip()
-        if not re.fullmatch(r"\w+", name):
-            continue
-        if name == "num_leaves":
-            # scalar: jnp.int32(1)
-            emitted[name] = "<i4" if "jnp.int32" in expr else "?"
-            continue
-        toks = [t for t in GROWER_DTYPE_TO_SPEC
-                if re.search(rf"[,(]\s*{re.escape(t)}\s*[,)]", expr)]
-        emitted[name] = GROWER_DTYPE_TO_SPEC[toks[0]] if len(toks) == 1 \
-            else "?"
-    for name, dt, _ in spec:
-        if name not in emitted:
-            err(f"spec field {name!r} has no emit site in "
-                f"grower._init_state")
-        elif emitted[name] == "?":
-            err(f"could not determine the dtype grower._init_state "
-                f"materializes for {name!r}")
-        elif emitted[name] != dt:
-            err(f"{name!r}: grower emits {emitted[name]}, spec says "
-                f"{dt}")
-    for name in emitted:
-        if name not in {n for n, _, _ in spec}:
-            err(f"grower emits field {name!r} with no spec row — it "
-                f"would be DROPPED by the packed carry")
-
-
-def check_offsets(layout):
-    prev_end = 0
-    for name, (off, nbytes, dt, shape) in layout.fields.items():
-        if off % 4:
-            err(f"{name!r}: offset {off} not word-aligned")
-        if off < prev_end:
-            err(f"{name!r}: offset {off} overlaps previous field "
-                f"(ends at {prev_end})")
-        prev_end = off + nbytes
-    if layout.record_size % 64:
-        err(f"record_size {layout.record_size} not 64-byte padded")
-    if prev_end > layout.record_size:
-        err(f"fields end at {prev_end} past record_size "
-            f"{layout.record_size}")
-
-
-def check_roundtrip(layout, tree_arrays_cls, spec):
-    import jax
-    import jax.numpy as jnp
-    from lightgbm_tpu.ops.predict import unpack_tree_records_device
-
-    rng = np.random.RandomState(7)
-    vals = {}
-    for name, (off, nbytes, dt, shape) in layout.fields.items():
-        kind = np.dtype(dt).kind
-        if name == "num_leaves":
-            vals[name] = jnp.int32(5)
-        elif kind == "u":
-            vals[name] = jnp.asarray(rng.rand(*shape) > 0.5)
-        elif kind == "i":
-            vals[name] = jnp.asarray(
-                rng.randint(-100, 100, size=shape), jnp.int32)
-        else:
-            vals[name] = jnp.asarray(
-                rng.randn(*shape).astype(np.float32))
-    tree = tree_arrays_cls(**vals)
-    rec = np.asarray(jax.jit(layout.pack_tree_record)(tree))
-
-    host = layout.unpack_tree_record(rec)
-    for name, _, _ in spec:
-        want = np.asarray(vals[name])
-        got = np.asarray(host[name])
-        if got.shape != want.shape or not np.array_equal(got, want):
-            err(f"host round-trip mismatch on {name!r}")
-
-    dev = unpack_tree_records_device(
-        jnp.asarray(rec), layout.num_leaves, layout.max_feature_bin)
-    for name, _, _ in spec:
-        got = np.asarray(getattr(dev, name))
-        want = np.asarray(vals[name])
-        if got.shape != want.shape or not np.array_equal(got, want):
-            err(f"device round-trip mismatch on {name!r}")
-
 
 def main():
-    from lightgbm_tpu.tree import TREE_RECORD_SPEC, TreeRecordLayout
-    from lightgbm_tpu.learner.grower import TreeArrays
-
-    check_field_order(TREE_RECORD_SPEC, TreeArrays)
-    check_grower_emit_dtypes(TREE_RECORD_SPEC)
-    for L, B in ((31, 64), (8, 16)):
-        layout = TreeRecordLayout(L, B)
-        check_offsets(layout)
-    check_roundtrip(TreeRecordLayout(8, 16), TreeArrays,
-                    TREE_RECORD_SPEC)
-
-    if ERRORS:
-        print(f"check_carry_layout: {len(ERRORS)} drift error(s)",
+    from lightgbm_tpu.analysis import run_rules, unsuppressed
+    findings = run_rules(["CARRY001"], check_suppressions=False)
+    live = unsuppressed(findings)
+    for f in live:
+        print(f"DRIFT: {f.message}", file=sys.stderr)
+    if live:
+        print(f"check_carry_layout: {len(live)} drift error(s)",
               file=sys.stderr)
         return 1
     print("check_carry_layout: spec, grower emit sites, offsets and "

@@ -2,110 +2,35 @@
 """Telemetry coverage lint: every span/phase name in the code must be
 documented in docs/OBSERVABILITY.md, and vice versa.
 
-The span map is the contract between the instrumentation and anyone
-reading a Perfetto trace — an undocumented span is a mystery slice in
-the UI, and a documented-but-deleted span means the doc (and any
-dashboard built on it) silently rotted.  Same discipline as
-scripts/check_carry_layout.py: fail the smoke before spending a
-training run.
-
-Scans ``lightgbm_tpu/**/*.py``, ``scripts/profile_train.py`` and
-``bench.py`` for
-
-    .span("name"...)   .start_span("name"...)   .phase("name"...)
-
-(string-literal first arguments only — dynamic names are a lint error
-by construction: they cannot be in the glossary) and compares the set
-against the first-column backticked names of the "Span map" and
-"Trace-mode phase annotations" tables in docs/OBSERVABILITY.md.
-
-Usage: python scripts/check_telemetry_coverage.py  (rc 0 clean, rc 1 drift)
+Thin wrapper over analysis rule ``TEL001``
+(lightgbm_tpu/analysis/teldoc_rule.py) — the check logic was re-homed
+into the `python -m lightgbm_tpu.analysis` engine in the
+static-analysis round; this entry point keeps the historical CLI
+contract (rc 0 clean, rc 1 drift, findings on stderr) for tooling that
+calls it directly.  ``scripts/bench_smoke.sh`` now runs the full
+analysis suite instead.
 """
-import glob
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-CALL_RE = re.compile(
-    r"\.(?:span|start_span|phase)\(\s*(?:f?)([\"'])([^\"']+)\1")
-DYNAMIC_RE = re.compile(r"\.(?:span|start_span|phase)\(\s*[^\"')]")
-# telemetry.py itself defines the API (its internal span("device_wait")
-# helper IS a real span and is scanned too)
-SOURCES = (
-    sorted(glob.glob(os.path.join(REPO, "lightgbm_tpu", "**", "*.py"),
-                     recursive=True))
-    + [os.path.join(REPO, "scripts", "profile_train.py"),
-       os.path.join(REPO, "bench.py")]
-)
-DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
-
-ERRORS = []
-
-
-def err(msg):
-    ERRORS.append(msg)
-    print(f"DRIFT: {msg}", file=sys.stderr)
-
-
-def code_spans():
-    names = {}
-    for path in SOURCES:
-        with open(path) as f:
-            src = f.read()
-        rel = os.path.relpath(path, REPO)
-        for m in CALL_RE.finditer(src):
-            names.setdefault(m.group(2), set()).add(rel)
-        for m in DYNAMIC_RE.finditer(src):
-            frag = src[m.start():m.start() + 60].splitlines()[0]
-            # allow the API definition sites in telemetry.py and
-            # variable-forwarding helpers that pass a `name` parameter
-            if "telemetry.py" in rel or re.match(
-                    r"\.(?:span|start_span|phase)\(\s*(?:self|name|f?\")",
-                    frag):
-                continue
-            err(f"{rel}: dynamic span/phase name cannot be linted "
-                f"against the glossary: {frag!r}")
-    return names
-
-
-def doc_spans():
-    with open(DOC) as f:
-        text = f.read()
-    names = set()
-    in_table = False
-    for line in text.splitlines():
-        if line.startswith("| Span |") or line.startswith("| Phase |"):
-            in_table = True
-            continue
-        if in_table:
-            m = re.match(r"\|\s*`([^`]+)`\s*\|", line)
-            if m:
-                names.add(m.group(1))
-            elif not line.startswith("|"):
-                in_table = False
-    return names
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def main():
-    code = code_spans()
-    doc = doc_spans()
-    if not doc:
-        err("no span map tables parsed from docs/OBSERVABILITY.md")
-    for name, sites in sorted(code.items()):
-        if name not in doc:
-            err(f"span {name!r} (used in {', '.join(sorted(sites))}) "
-                "is missing from the docs/OBSERVABILITY.md span map")
-    for name in sorted(doc - set(code)):
-        err(f"docs/OBSERVABILITY.md documents span {name!r} but no "
-            "span(/phase( call with that name exists in the code")
-    if ERRORS:
-        print(f"check_telemetry_coverage: {len(ERRORS)} drift error(s)",
+    from lightgbm_tpu.analysis import run_rules, unsuppressed
+    findings = run_rules(["TEL001"], check_suppressions=False)
+    live = unsuppressed(findings)
+    for f in live:
+        print(f"DRIFT: {f.message}", file=sys.stderr)
+    if live:
+        print(f"check_telemetry_coverage: {len(live)} drift error(s)",
               file=sys.stderr)
         return 1
-    print(f"check_telemetry_coverage: {len(code)} span/phase names "
-          "consistent with docs/OBSERVABILITY.md")
+    print("check_telemetry_coverage: span/phase names consistent with "
+          "docs/OBSERVABILITY.md")
     return 0
 
 

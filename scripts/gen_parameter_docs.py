@@ -135,7 +135,6 @@ DESC = {
     "machines": "comma-separated ip:port list",
     "mesh_shape": "device mesh shape for sharded training (e.g. `8` or `4,2`)",
     "mesh_axes": "mesh axis names matching mesh_shape",
-    "deterministic": "bit-deterministic mode (fixed reduction orders)",
     "extra": "unrecognized key=value params: warned, kept, echoed into the model file",
 }
 

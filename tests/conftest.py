@@ -88,6 +88,16 @@ def rng():
     return np.random.RandomState(42)
 
 
+@pytest.fixture(scope="session")
+def analysis_programs():
+    """One ProgramSet per suite: the static-analysis probe builds
+    (carry-probe GBDT, predict-probe booster, lowered entry points)
+    are shared by tests/test_analysis.py and tests/test_carry_hlo.py
+    instead of each file re-training its own."""
+    from lightgbm_tpu.analysis.programs import ProgramSet
+    return ProgramSet()
+
+
 # ---------------------------------------------------------------------------
 # `fast` smoke tier: one representative test per subsystem (marker
 # applied here so the test files stay uncluttered).  pytest -m fast -q

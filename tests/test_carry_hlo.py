@@ -1,4 +1,6 @@
-"""HLO regression gate for the packed tree carry (round 7).
+"""HLO regression gate for the packed tree carry (round 7), asserted
+through the shared `lightgbm_tpu.analysis` engine since the
+static-analysis round.
 
 ROOFLINE round-6 traced the dispatch-chunk degradation (per-tree ≈
 25.75 + 0.075·chunk ms on v5e) to the TPU backend's handling of the
@@ -12,139 +14,105 @@ These tests pin that structure at the compiler seam, for chunk 4 AND
 16 (the auto-policy probe sizes), so a refactor that quietly
 reintroduces per-field output stacks — or turns the static-offset
 record writes back into scattered updates — fails the suite instead of
-silently re-opening the chunk slope.
+silently re-opening the chunk slope.  The jaxpr walking and the
+bound itself live in ``lightgbm_tpu/analysis`` (rules HLO003/HLO004 +
+``walker``): CI's `python -m lightgbm_tpu.analysis` and this file
+assert the SAME guarantee through the SAME code.
 """
 import re
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 import pytest
 
-import lightgbm_tpu as lgb
-from lightgbm_tpu.boosting.gbdt import GBDT
-from lightgbm_tpu.config import Config
-from lightgbm_tpu.tree import TREE_RECORD_SPEC
+from lightgbm_tpu.analysis import walker
+from lightgbm_tpu.analysis.hlo_rules import (MAX_CARRY_OUTPUT_BUFFERS,
+                                             check_carry_bound,
+                                             check_dus_not_scatter,
+                                             check_no_donation)
+from lightgbm_tpu.analysis.programs import build_probe_gbdt, chunk_args
 
-# the acceptance bound: carry tuple holds at most this many O(chunk)
-# output stacks (the packed path uses 2: records + num_leaves)
-MAX_CARRY_OUTPUT_BUFFERS = 4
 # the legacy per-field carry this refactor retired: 17 TreeArrays
 # fields + the num_leaves series
 LEGACY_CARRY_OUTPUT_BUFFERS = 18
 
 
-def _build_gbdt(**params):
-    rng = np.random.RandomState(7)
-    X = rng.randn(512, 6)
-    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(float)
-    cfg = Config.from_params({"objective": "binary", "num_leaves": 7,
-                              "verbose": -1, "min_data_in_leaf": 5,
-                              **params})
-    core = lgb.Dataset(X, label=y).construct(cfg)
-    return GBDT(cfg, core)
-
-
-def _chunk_args(g, chunk):
-    keys = jnp.zeros((chunk, 2), jnp.uint32)
-    fmasks = jnp.ones((chunk, g.num_class, g.grower.num_features), bool)
-    fresh = jnp.zeros(chunk, bool)
-    return (g.scores, tuple(), g._full_counts > 0, keys, fmasks, fresh)
-
-
 def _scan_output_stacks(g, chunk):
     """Number of O(chunk) output buffers (ys) the fused chunk's
-    boosting scan stacks — read off the jaxpr's scan primitive, the
-    exact quantity the backend turns into loop-carried output stores."""
+    boosting scan stacks — read off the jaxpr's scan primitive through
+    the shared walker, the exact quantity the backend turns into
+    loop-carried output stores."""
     fn = g._build_fused_chunk(chunk)
-    jaxpr = jax.make_jaxpr(fn)(*_chunk_args(g, chunk))
-
-    def find(jx, out):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "scan":
-                out.append(eqn)
-            for v in eqn.params.values():
-                if hasattr(v, "jaxpr"):
-                    find(v.jaxpr, out)
-        return out
-
-    scans = find(jaxpr.jaxpr, [])
-    assert scans, "fused chunk no longer lowers through lax.scan"
+    jaxpr = jax.make_jaxpr(fn)(*chunk_args(g, chunk)).jaxpr
+    assert walker.find_scans(jaxpr), \
+        "fused chunk no longer lowers through lax.scan"
     # the boosting scan is the one of length == chunk (inner kernels
     # may scan too, but over other extents)
-    boost = [s for s in scans if s.params.get("length") == chunk]
+    boost = walker.find_scans(jaxpr, length=chunk)
     assert boost, f"no scan of length {chunk} in the fused chunk"
-    top = boost[0]
-    return len(top.outvars) - top.params["num_carry"]
+    return walker.scan_output_stacks(boost[0])
 
 
 @pytest.mark.parametrize("chunk", [4, 16])
-def test_packed_carry_bounds_output_buffers(chunk):
-    g = _build_gbdt()
-    assert g._packed_carry, "packed_tree_carry must default on"
-    ys = _scan_output_stacks(g, chunk)
-    assert ys <= MAX_CARRY_OUTPUT_BUFFERS, (
-        f"fused chunk stacks {ys} loop-carried output buffers at chunk "
-        f"{chunk}; the packed-carry bound is {MAX_CARRY_OUTPUT_BUFFERS}"
-        " (round-6 diagnosis: per-field stacks are what made per-tree "
-        "cost grow with chunk length)")
+def test_packed_carry_bounds_output_buffers(analysis_programs, chunk):
+    """Rule HLO003 on the registered fused-chunk programs: the carry
+    tuple holds at most MAX_CARRY_OUTPUT_BUFFERS O(chunk) output
+    stacks (the packed path uses 2: records + num_leaves)."""
+    assert analysis_programs.gbdt._packed_carry, \
+        "packed_tree_carry must default on"
+    prog = analysis_programs.fused_chunk(chunk)
+    findings = check_carry_bound(prog)
+    assert not findings, "\n".join(f.message for f in findings)
 
 
-def test_legacy_carry_counter_discriminates():
+def test_legacy_carry_counter_discriminates(analysis_programs):
     """The same counter must report the 18-buffer legacy carry — if it
-    stopped discriminating, the bound above would be vacuous."""
-    g = _build_gbdt(packed_tree_carry="off")
+    stopped discriminating, the HLO003 bound would be vacuous."""
+    g = build_probe_gbdt(packed_tree_carry="off")
     assert not g._packed_carry
     assert _scan_output_stacks(g, 4) == LEGACY_CARRY_OUTPUT_BUFFERS
+    # sanity: the packed default stays within the rule bound (probe
+    # model reused from the session fixture — no extra training run)
+    assert _scan_output_stacks(analysis_programs.gbdt, 4) \
+        <= MAX_CARRY_OUTPUT_BUFFERS
 
 
-@pytest.fixture(scope="module")
-def lowered_chunk4():
-    """One shared lower()+compile() of the chunk-4 program — every
-    compiled-HLO assertion below reads the same artifact."""
-    g = _build_gbdt()
-    fn = g._build_fused_chunk(4)
-    low = fn.lower(*_chunk_args(g, 4))
-    return g, low, low.compile().as_text()
+def test_record_writes_lower_to_dynamic_update_slice(analysis_programs):
+    """Rule HLO004: every tree-record field write lowers to a
+    static-offset dynamic-update-slice (the in-place form), never a
+    uint8 scatter, and the compiled module keeps DUS instructions
+    attributed to tree.py (XLA's simplifier did not rewrite them into
+    copies)."""
+    prog = analysis_programs.fused_chunk(4)
+    findings = check_dus_not_scatter(prog)
+    assert not findings, "\n".join(f.message for f in findings)
+    # the positive side the rule asserts must not be vacuous here:
+    # the program really does carry one DUS per record field
+    assert walker.count_op(prog.stablehlo,
+                           "stablehlo.dynamic_update_slice") \
+        >= prog.meta["record_spec_len"]
 
 
-def test_record_writes_lower_to_dynamic_update_slice(lowered_chunk4):
-    """Every tree-record field write must lower to a static-offset
-    dynamic-update-slice (the in-place form), never a windowed scatter:
-    one DUS per TREE_RECORD_SPEC field in the StableHLO, and the
-    compiled module keeps DUS instructions attributed to tree.py
-    (XLA's simplifier did not rewrite them into copies)."""
-    g, low, hlo = lowered_chunk4
-
-    txt = low.as_text()
-    n_dus = txt.count("stablehlo.dynamic_update_slice")
-    # 17 field writes + the scan's 2 output-stack updates
-    assert n_dus >= len(TREE_RECORD_SPEC), (
-        f"only {n_dus} dynamic_update_slice ops in the lowered chunk — "
-        f"expected one per record field ({len(TREE_RECORD_SPEC)}); "
-        "record emission regressed to scatter")
-    # no scatter may write a uint8 operand (the record buffer is the
-    # only u8 tensor in the program)
-    for m in re.finditer(r'"stablehlo\.scatter"\(([^)]*)\)', txt):
-        assert "ui8" not in m.group(1), (
-            "a tree-record write lowered to stablehlo.scatter: "
-            f"{m.group(0)[:160]}")
-
-    dus_tree = [ln for ln in hlo.splitlines()
-                if "dynamic-update-slice" in ln and "tree.py" in ln]
-    assert dus_tree, ("compiled HLO carries no dynamic-update-slice "
-                      "attributed to tree.py — record writes were "
-                      "rewritten out of in-place form")
+def test_donation_stays_off_fused_programs(analysis_programs):
+    """Rule HLO006 on both probe chunks + the per-iteration step: the
+    r7 heap-corruption bisect pinned donation OFF these multi-shape
+    programs."""
+    for prog in (analysis_programs.fused_chunk(4),
+                 analysis_programs.fused_chunk(16),
+                 analysis_programs.fused_step()):
+        findings = check_no_donation(prog)
+        assert not findings, "\n".join(f.message for f in findings)
+        assert prog.donated_args, \
+            f"{prog.name}: no args_info — the donation check went blind"
 
 
-def test_compiled_while_carries_packed_record_stack(lowered_chunk4):
+def test_compiled_while_carries_packed_record_stack(analysis_programs):
     """The compiled chunk's outer while-loop tuple must hold the uint8
     record stack (chunk, K, record_size) — the single packed output
     buffer the dispatch scan carries."""
-    g, _low, hlo = lowered_chunk4
-    chunk = 4
-    rec = g.grower.record_layout.record_size
-    pat = re.compile(r"while\(.*u8\[%d,1,%d\]" % (chunk, rec))
-    assert any(pat.search(ln) for ln in hlo.splitlines()), (
-        f"no while loop carries the packed u8[{chunk},1,{rec}] record "
+    prog = analysis_programs.fused_chunk(4)
+    rec = prog.meta["record_size"]
+    pat = re.compile(r"while\(.*u8\[%d,1,%d\]" % (4, rec))
+    assert any(pat.search(ln)
+               for ln in prog.compiled_text.splitlines()), (
+        f"no while loop carries the packed u8[4,1,{rec}] record "
         "stack in the compiled chunk")

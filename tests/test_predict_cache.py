@@ -92,28 +92,19 @@ def test_warm_buckets_precompile():
         "predict after predict_warm_buckets warm-up still compiled")
 
 
-def _count_gathers(jaxpr, out=None):
-    out = [0] if out is None else out
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "gather":
-            out[0] += 1
-        for v in eqn.params.values():
-            if hasattr(v, "jaxpr"):
-                _count_gathers(v.jaxpr, out)
-            elif isinstance(v, (list, tuple)):
-                for b in v:
-                    if hasattr(b, "jaxpr"):
-                        _count_gathers(b.jaxpr, out)
-    return out[0]
-
-
 def test_level_descent_gathers_independent_of_tree_count():
-    """The tentpole's op-count claim, read off the jaxpr: the level
-    descent's gather count is a constant per level — NOT proportional
-    to the tree count the way the per-tree scan's inner walk was."""
+    """The r8 tentpole's op-count claim, asserted through the shared
+    analysis engine (rule HLO005 + the walker's primitive counter —
+    the private gather-counting copy this file used to carry now
+    lives in lightgbm_tpu/analysis/walker.py): the level descent's
+    gather count is a constant per level — NOT proportional to the
+    tree count the way the per-tree scan's inner walk was."""
     import jax
     import jax.numpy as jnp
 
+    from lightgbm_tpu.analysis import walker
+    from lightgbm_tpu.analysis.hlo_rules import check_gather_t_invariance
+    from lightgbm_tpu.analysis.programs import Program
     from lightgbm_tpu.ops.predict import (LevelEnsemble,
                                           predict_level_ensemble)
     from lightgbm_tpu.tree import flatten_ensemble
@@ -121,7 +112,7 @@ def test_level_descent_gathers_independent_of_tree_count():
     bst, X = _train(iters=12, seed=4)
     bst._sync_models()
     depth = 6
-    counts = {}
+    progs = {}
     for t_count in (4, 12):
         flat = flatten_ensemble(bst.models[:t_count], 1)
         flat.pop("depth")
@@ -130,12 +121,13 @@ def test_level_descent_gathers_independent_of_tree_count():
         x2 = jnp.zeros((16, 2 * X.shape[1]), jnp.float32)
         jaxpr = jax.make_jaxpr(
             lambda s, x: predict_level_ensemble(s, x, depth=depth))(
-                stack, x2)
-        counts[t_count] = _count_gathers(jaxpr.jaxpr)
-    assert counts[4] == counts[12], (
-        f"gather count grew with tree count ({counts}) — the descent "
-        "regressed to per-tree gathers")
-    # 8 table/feature gathers per level + the final leaf-value gather
-    assert counts[12] <= depth * 8 + 2, (
-        f"{counts[12]} gathers for depth {depth} — more than the "
-        "level-synchronous budget (8/level + leaf fetch)")
+                stack, x2).jaxpr
+        progs[t_count] = Program(
+            f"fixture_level@T{t_count}", "lightgbm_tpu/ops/predict.py",
+            jaxpr=jaxpr, meta={"gather_probe_t": t_count,
+                               "depth": depth})
+    findings = check_gather_t_invariance(progs[4], progs[12])
+    assert not findings, "\n".join(f.message for f in findings)
+    # the rule must not be vacuously green: the probe programs really
+    # do gather (8 table/feature gathers per level + the leaf fetch)
+    assert walker.count_primitive(progs[12].jaxpr, "gather") > 0

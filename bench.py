@@ -31,6 +31,10 @@ BENCH_PREDICT_TRAIN_ROWS/BENCH_PREDICT_ITERS shape the served model,
 BENCH_PREDICT_ROWS the bulk-throughput batch,
 BENCH_PREDICT_SMALL_BATCH/BENCH_PREDICT_CALLS the p50 micro-batch
 loop, BENCH_PREDICT_ANCHOR_ROWS the reference task=predict anchor.
+Construction bench knobs (round 11; BENCH_CONSTRUCT=0 disables):
+BENCH_CONSTRUCT_ROWS sizes the cold-construct point (default
+min(BENCH_ROWS, 1M)); BENCH_LOCAL_REF_CONSTRUCT=0 skips just the
+reference CSV-load anchor.
 Local-reference knobs: BENCH_LOCAL_REF=0 disables all same-machine
 reference runs; BENCH_LOCAL_REF_BIG=0 / BENCH_LOCAL_REF_LTR=0 /
 BENCH_LOCAL_REF_PREDICT=0 disable just the 10.5M / lambdarank /
@@ -147,6 +151,11 @@ _REQUIRED_RECORD_FIELDS = ("per_tree_ms", "threads", "iters")
 # training: rows/s replaces per-tree time and no quality metric rides
 # along (the parity gate lives in the lightgbm_tpu predict scale)
 _REQUIRED_PREDICT_FIELDS = ("rows_per_s", "threads", "iters")
+# task=construct anchors time the reference binary's load+bin of the
+# same CSV (a num_iterations=1 run — dataset construction dominates);
+# no quality metric rides along, parity is gated on the lightgbm_tpu
+# side by byte-equality between its own construction paths
+_REQUIRED_CONSTRUCT_FIELDS = ("construct_s", "threads", "iters")
 _LOCAL_REF_NOTES: list = []
 _LOCAL_REF_BAD: set = set()
 
@@ -188,6 +197,12 @@ def validate_local_ref():
                          and ("skipped" in rec
                               or all(f in rec
                                      for f in _REQUIRED_PREDICT_FIELDS)))
+        elif parts[0] == "construct":
+            schema_ok = (isinstance(rec, dict)
+                         and ("skipped" in rec
+                              or all(f in rec
+                                     for f in
+                                     _REQUIRED_CONSTRUCT_FIELDS)))
         else:
             schema_ok = (isinstance(rec, dict)
                          and ("skipped" in rec
@@ -833,6 +848,178 @@ def run_local_reference_predict(model_str, X, y, params, n_trees,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_local_reference_construct(X, y, params, seed=31):
+    """Time the reference CPU binary's dataset construction (text parse
+    + bin-mapper fit + binning + binary-cache save) of the SAME CSV on
+    THIS machine — the anchor for the round-11 ``construct`` block.  A
+    ``num_iterations=1`` training run is construction-dominated (one
+    31-leaf tree on an already-binned matrix is milliseconds); the one
+    tree rides along in the record's note.  Cached in LOCAL_REF.json
+    under a ``construct:...`` key (``iters`` = 1)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    ref_bin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".refbuild", "lightgbm")
+    if os.environ.get("BENCH_LOCAL_REF", "1") == "0" \
+            or os.environ.get("BENCH_LOCAL_REF_CONSTRUCT", "1") == "0":
+        return None
+    threads = os.cpu_count() or 1
+    key = _local_ref_key("construct", X.shape[0], 1, seed, params,
+                         threads)
+    if os.environ.get("BENCH_LOCAL_REF_REFRESH") != "1":
+        cached = (None if key in _LOCAL_REF_BAD
+                  else _local_ref_load().get(key))
+        if cached is not None:
+            print(f"local construct anchor reused from LOCAL_REF.json "
+                  f"[{key}]", file=sys.stderr)
+            return dict(cached, cached=True)
+    if not os.path.exists(ref_bin):
+        return {"skipped": "reference binary absent "
+                           "(.refbuild/lightgbm)"}
+    box = budget_left() - ANCHOR_RESERVE_S
+    est_csv_s = (X.size + X.shape[0]) / 2e6
+    if box < 30 + est_csv_s:
+        return {"skipped": f"insufficient budget for a fresh construct "
+                           f"anchor ({box:.0f}s left after reserve, "
+                           f"CSV write alone est. {est_csv_s:.0f}s)"}
+    tmp = tempfile.mkdtemp(prefix="bench_refc_")
+    try:
+        train_csv = os.path.join(tmp, "train.csv")
+        arr = np.column_stack([y, X])
+        try:
+            import pandas as pd
+            pd.DataFrame(arr).to_csv(train_csv, header=False,
+                                     index=False, float_format="%.8g")
+        except ImportError:
+            np.savetxt(train_csv, arr, fmt="%.8g", delimiter=",")
+        t0 = time.time()
+        subprocess.run(
+            [ref_bin, "task=train", f"data={train_csv}",
+             f"objective={params['objective']}",
+             f"num_leaves={params['num_leaves']}",
+             f"max_bin={params['max_bin']}",
+             "num_iterations=1", "save_binary=true",
+             f"num_threads={threads}",
+             f"output_model={tmp}/warm.txt", "verbose=-1"],
+            check=True, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, cwd=tmp,
+            timeout=max(10.0, budget_left() - ANCHOR_RESERVE_S))
+        out = {"construct_s": round(time.time() - t0, 3),
+               "threads": threads, "iters": 1, "rows": int(X.shape[0]),
+               "note": "reference task=train num_iterations=1 "
+                       "save_binary=true wall — CSV parse + bin fit + "
+                       "binning + cache write (+ one tree)"}
+        _local_ref_store(key, out)
+        return out
+    except subprocess.TimeoutExpired:
+        return {"skipped": "construct anchor hit the BENCH_BUDGET_S "
+                           "time box"}
+    except Exception as e:
+        print(f"local construct reference failed ({type(e).__name__}: "
+              f"{e})", file=sys.stderr)
+        return {"skipped": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_construct_scale(params):
+    """Dataset-construction roofline point (round 11): cold-construct
+    rows/s of the parallel pipeline (threaded mapper fit + native
+    numerical/categorical/EFB binning) against the serial pure-Python
+    baseline measured IN THE SAME RUN, thread scaling 1 vs auto, and
+    the binary-cache v2 save/reload — gated on the packed matrix being
+    byte-identical across every path.  On a 1-core host the thread
+    scaling row reads ~1.0x by construction; the headline speedup is
+    the compiled pipeline vs the Python loop either way."""
+    import shutil
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.binning import resolve_construct_threads
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset_io import load_binary, save_binary
+
+    rows = int(os.environ.get("BENCH_CONSTRUCT_ROWS",
+                              min(BENCH_ROWS, 1_000_000)))
+    X, y, _ = make_data(rows, BENCH_FEATURES, seed=31)
+    base = {"objective": "binary", "num_leaves": params["num_leaves"],
+            "max_bin": params["max_bin"], "learning_rate": 0.1,
+            "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
+            "verbose": -1}
+
+    def construct(**overrides):
+        cfg = Config.from_params(dict(base, **overrides))
+        t0 = time.time()
+        core = lgb.Dataset(X, label=y).construct(cfg)
+        return core, time.time() - t0
+
+    # serial baseline FIRST (same run, same data): pure-Python mapper
+    # fit + searchsorted binning, one thread — the pre-r6 pipeline
+    core_serial, serial_s = construct(construct_threads=1,
+                                      native_binning=False)
+    core_cold, cold_s = construct()
+    if not np.array_equal(np.asarray(core_serial.group_bins),
+                          np.asarray(core_cold.group_bins)):
+        raise SystemExit(
+            "construct parity gate failed: the parallel/native "
+            "pipeline's group_bins differ from the serial Python "
+            "path's on the bench draw")
+    del core_serial
+    gc.collect()
+    _, t1_s = construct(construct_threads=1)
+
+    tmp = tempfile.mkdtemp(prefix="bench_construct_")
+    try:
+        bp = os.path.join(tmp, "train.bin")
+        t0 = time.time()
+        save_binary(core_cold, bp)
+        save_s = time.time() - t0
+        t0 = time.time()
+        core_re = load_binary(bp)
+        # touch the matrix so lazily-paged memmap IO is inside the
+        # measurement, not deferred to the consumer
+        checksum = int(np.asarray(core_re.group_bins[::
+                                  max(1, rows // 4096)]).sum())
+        reload_s = time.time() - t0
+        if not np.array_equal(np.asarray(core_re.group_bins),
+                              np.asarray(core_cold.group_bins)):
+            raise SystemExit("binary-cache v2 reload parity gate "
+                             "failed: reloaded group_bins differ")
+        del core_re
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    del checksum
+
+    out = {
+        "task": "construct", "rows": rows, "features": BENCH_FEATURES,
+        "cold_construct_s": round(cold_s, 3),
+        "cold_rows_per_s": round(rows / max(cold_s, 1e-9)),
+        "serial_construct_s": round(serial_s, 3),
+        "serial_rows_per_s": round(rows / max(serial_s, 1e-9)),
+        "speedup_vs_serial": round(serial_s / max(cold_s, 1e-9), 2),
+        "threads_auto": resolve_construct_threads(None),
+        "thread_scaling": {"1": round(t1_s, 3),
+                           "auto": round(cold_s, 3),
+                           "x": round(t1_s / max(cold_s, 1e-9), 2)},
+        "cache_save_s": round(save_s, 3),
+        "cache_reload_s": round(reload_s, 3),
+        "reload_x_cold": round(cold_s / max(reload_s, 1e-9), 1),
+        "parity": "pass",
+    }
+    ref = run_local_reference_construct(X, y, base)
+    if ref is None:
+        out["local_ref_skipped"] = "BENCH_LOCAL_REF[_CONSTRUCT]=0"
+    elif "skipped" in ref:
+        out["local_ref_skipped"] = ref["skipped"]
+    else:
+        out["local_ref"] = ref
+        out["vs_local_reference"] = round(
+            ref["construct_s"] / max(cold_s, 1e-9), 3)
+    return out
+
+
 def run_predict_scale(params):
     """Serving roofline point: bulk scoring throughput, micro-batch
     p50 latency and the compile count of the shape-bucketed device
@@ -1159,6 +1346,20 @@ def main():
             predict_block = run_predict_scale(params)
         else:
             predict_block = {"task": "predict", "skipped": note}
+    construct_block = None
+    if os.environ.get("BENCH_CONSTRUCT", "1") != "0":
+        c_rows = int(os.environ.get("BENCH_CONSTRUCT_ROWS",
+                                    min(BENCH_ROWS, 1_000_000)))
+        # three constructions (serial python, parallel, threads=1) + a
+        # cache round trip; the serial Python pass dominates at
+        # ~3-5 s/M rows on one core — 20 s/M is a safe ceiling
+        est = max(10.0, 20.0 * c_rows / 1e6)
+        note = admit("construct", est)
+        if note is None:
+            construct_block = run_construct_scale(params)
+        else:
+            construct_block = {"task": "construct", "rows": c_rows,
+                               "skipped": note}
     if budget_left() > 60 + FINISH_RESERVE_S:
         higgs = run_higgs_real(params)
         if higgs is not None:
@@ -1194,6 +1395,12 @@ def main():
         # compile count (one per shape bucket) and the task=predict
         # anchor status (docs/ROOFLINE.md "Serving roofline")
         result["predict"] = predict_block
+    if construct_block is not None:
+        # the construction roofline block (round 11): cold-construct
+        # rows/s parallel vs serial (same run), thread scaling, binary-
+        # cache v2 reload ratio and the reference-CSV-load anchor
+        # (docs/ROOFLINE.md round-11 delta)
+        result["construct"] = construct_block
     if "chunk_slope" in primary:
         # the round-6/7 per-iteration chunk-slope fit and what
         # dispatch_chunk=auto would pick locally and on an axon-RPC
@@ -1239,6 +1446,26 @@ def main():
         print(f"rows={s.get('rows')} per_tree={s.get('per_tree_ms')}ms "
               f"vs_baseline={s.get('vs_baseline')} prep={s.get('prep_s')}s "
               f"compile={s.get('compile_s')}s{extra}", file=sys.stderr)
+    if construct_block is not None:
+        if "skipped" in construct_block:
+            print(f"construct skipped: {construct_block['skipped']}",
+                  file=sys.stderr)
+        else:
+            extra = ""
+            if "vs_local_reference" in construct_block:
+                extra = (f" vs_local_ref="
+                         f"{construct_block['vs_local_reference']} (ref "
+                         f"{construct_block['local_ref']['construct_s']}"
+                         "s)")
+            c = construct_block
+            print(f"construct rows={c['rows']} "
+                  f"cold={c['cold_construct_s']}s "
+                  f"({c['cold_rows_per_s']} rows/s) "
+                  f"serial={c['serial_construct_s']}s "
+                  f"speedup={c['speedup_vs_serial']}x "
+                  f"reload={c['cache_reload_s']}s "
+                  f"({c['reload_x_cold']}x cold){extra}",
+                  file=sys.stderr)
     if predict_block is not None:
         if "skipped" in predict_block:
             print(f"predict skipped: {predict_block['skipped']}",

@@ -83,8 +83,17 @@ class Dataset:
                         "falling back to in-RAM loading")
         if streaming_ok:
             # two-round streaming: the float matrix never exists
+            import time as _time
+
             from .data_loader import load_file_streaming
-            self._core = load_file_streaming(data, config)
+            from .telemetry import TELEMETRY
+            t0 = _time.perf_counter()
+            with TELEMETRY.span("binning"):
+                self._core = load_file_streaming(data, config)
+            wall = _time.perf_counter() - t0
+            if wall > 0:
+                TELEMETRY.gauge("construct_rows_per_s",
+                                round(self._core.num_data / wall))
             if isinstance(self.feature_name, (list, tuple)):
                 self._core.feature_names = list(self.feature_name)
             if self.label is not None:
@@ -133,15 +142,23 @@ class Dataset:
                 np.asarray(data.todense(), dtype=np.float64))
         feature_names, cat_indices = self._resolve_columns(data)
 
+        import time as _time
+
         from .telemetry import TELEMETRY
+        t0 = _time.perf_counter()
         with TELEMETRY.span("binning", rows=int(data.shape[0])):
             # host-side bin-mapper fit + matrix binning — the one
-            # pre-device phase of training (docs/OBSERVABILITY.md)
+            # pre-device phase of training, decomposed into the
+            # fit_mappers/bin/pack sub-spans (docs/OBSERVABILITY.md)
             self._core = CoreDataset.from_matrix(
                 data, label=label, weight=self.weight, group=self.group,
                 init_score=self.init_score, config=config,
                 categorical_features=cat_indices,
                 feature_names=feature_names, reference=ref_core)
+        wall = _time.perf_counter() - t0
+        if wall > 0:
+            TELEMETRY.gauge("construct_rows_per_s",
+                            round(int(data.shape[0]) / wall))
         self._core._raw_data = None if self.free_raw_data else data
         self._core._categorical_features = cat_indices
         self._core.pandas_categorical = pandas_cats

@@ -13,6 +13,8 @@ device matrix rather than per-feature Bin objects.
 from __future__ import annotations
 
 import math
+import os
+from bisect import bisect_left
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -91,15 +93,19 @@ def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
     last = num_distinct - 1                                # exclusive walk end
     while i < last and len(uppers) < max_bin - 1:
         base = cum[i - 1] if i > 0 else 0
+        # bisect_left == np.searchsorted(..., side="left") exactly (both
+        # return the first index whose element >= the needle, comparing
+        # the int64 counts against the float target as float64) but
+        # skips numpy's ~40 us per-call dispatch — this walk issues
+        # O(features x bins) probes and dominated mapper fitting
         # (a) next big value at/after i
-        bi = np.searchsorted(big_pos, i)
+        bi = bisect_left(big_pos, i)
         j1 = int(big_pos[bi]) if bi < len(big_pos) else num_distinct
         # (b) first j with cum[j] - base >= mean_bin_size
-        j2 = int(np.searchsorted(cum, base + mean_bin_size))
+        j2 = bisect_left(cum, base + mean_bin_size)
         # (c) first big-successor position p-1 >= the half-mean point
-        half_at = int(np.searchsorted(cum, base + max(1.0,
-                                                      mean_bin_size * 0.5)))
-        bj = np.searchsorted(big_pos, max(i, half_at) + 1)
+        half_at = bisect_left(cum, base + max(1.0, mean_bin_size * 0.5))
+        bj = bisect_left(big_pos, max(i, half_at) + 1)
         j3 = int(big_pos[bj]) - 1 if bj < len(big_pos) else num_distinct
         # clamp to the walk position: when mean_bin_size hits 0 (all
         # non-big samples exhausted) the scalar loop makes every
@@ -195,7 +201,8 @@ class BinMapper:
 
     __slots__ = ("num_bin", "missing_type", "is_trivial", "sparse_rate",
                  "bin_type", "bin_upper_bound", "bin_2_categorical",
-                 "categorical_2_bin", "min_val", "max_val", "default_bin")
+                 "categorical_2_bin", "min_val", "max_val", "default_bin",
+                 "_cat_lut")
 
     def __init__(self):
         self.num_bin = 1
@@ -209,6 +216,10 @@ class BinMapper:
         self.min_val = 0.0
         self.max_val = 0.0
         self.default_bin = 0
+        # category -> bin lookup table, materialized once at fit time
+        # (and rebuilt on binary-cache load): per-chunk streaming
+        # binning used to re-np.fromiter the dict on EVERY call
+        self._cat_lut: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------
     def find_bin(self, values: np.ndarray, total_sample_cnt: int,
@@ -385,7 +396,29 @@ class BinMapper:
         else:
             self.missing_type = MISSING_NAN
         cnt_in_bin[-1] += int(total_sample_cnt - used_cnt)
+        self._build_cat_cache()
         return np.asarray(cnt_in_bin, dtype=np.int64)
+
+    # ------------------------------------------------------------------
+    def _build_cat_cache(self) -> None:
+        """Materialize the category->bin dense lookup table.
+
+        ``lut[k]`` holds category ``k``'s bin for 0 <= k <= max_key and
+        the unseen bin (``num_bin - 1``) everywhere else; one trailing
+        slot keeps the ``iv <= max_key`` range test a plain length
+        compare.  Built at fit time and on binary-cache load (mappers
+        pickled by an older version lack the slot and rebuild lazily in
+        :meth:`value_to_bin`)."""
+        if not self.categorical_2_bin:
+            self._cat_lut = None
+            return
+        keys = np.fromiter(self.categorical_2_bin.keys(), dtype=np.int64)
+        vals = np.fromiter(self.categorical_2_bin.values(), dtype=np.int32)
+        max_key = int(keys.max())
+        lut = np.full(max_key + 2, self.num_bin - 1, dtype=np.int32)
+        pos_keys = keys >= 0
+        lut[keys[pos_keys]] = vals[pos_keys]
+        self._cat_lut = lut
 
     # ------------------------------------------------------------------
     def value_to_bin(self, values: np.ndarray) -> np.ndarray:
@@ -402,16 +435,17 @@ class BinMapper:
             if self.missing_type == MISSING_NAN:
                 bins = np.where(nan_mask, self.num_bin - 1, bins)
             return bins
-        iv = values.astype(np.int64)
+        with np.errstate(invalid="ignore"):   # NaN cast is overwritten
+            iv = values.astype(np.int64)
         iv = np.where(np.isnan(values), -1, iv)
         out = np.full(len(values), self.num_bin - 1, dtype=np.int32)
-        if self.categorical_2_bin:
-            keys = np.fromiter(self.categorical_2_bin.keys(), dtype=np.int64)
-            vals = np.fromiter(self.categorical_2_bin.values(), dtype=np.int32)
-            max_key = int(keys.max())
-            lut = np.full(max_key + 2, self.num_bin - 1, dtype=np.int32)
-            pos_keys = keys >= 0
-            lut[keys[pos_keys]] = vals[pos_keys]
+        lut = getattr(self, "_cat_lut", None)
+        if lut is None and self.categorical_2_bin:
+            # mapper deserialized from an older pickle: rebuild once
+            self._build_cat_cache()
+            lut = self._cat_lut
+        if lut is not None:
+            max_key = len(lut) - 2
             in_range = (iv >= 0) & (iv <= max_key)
             out[in_range] = lut[iv[in_range]]
         return out
@@ -442,19 +476,50 @@ class BinMapper:
                 f"trivial={self.is_trivial}, default_bin={self.default_bin})")
 
 
+def resolve_construct_threads(config) -> int:
+    """Resolve ``Config.construct_threads`` ("auto" or a positive
+    integer) to a concrete thread count.  auto = the host core count —
+    dataset construction is per-feature host work (numpy
+    sort/searchsorted and the native binner release the GIL), so it
+    scales with cores, not feature count."""
+    spec = "auto" if config is None else getattr(config,
+                                                 "construct_threads", "auto")
+    s = str(spec).lower()
+    if s == "auto":
+        return max(1, os.cpu_count() or 1)
+    n = int(float(s))
+    if n <= 0:            # 0 = auto in any spelling ("0", "0.0", "00")
+        return max(1, os.cpu_count() or 1)
+    return n
+
+
 def find_bin_mappers(sample_values: List[np.ndarray], total_sample_cnt: int,
                      max_bin: int, min_data_in_bin: int, min_split_data: int,
                      categorical_features: Optional[set] = None,
                      use_missing: bool = True,
-                     zero_as_missing: bool = False) -> List[BinMapper]:
+                     zero_as_missing: bool = False,
+                     num_threads: int = 1) -> List[BinMapper]:
     """Fit one BinMapper per feature from per-feature sampled non-zero
-    values (reference dataset_loader.cpp:523-605 serial path)."""
+    values (reference dataset_loader.cpp:523-605; the reference fans
+    this loop over OpenMP threads, dataset_loader.cpp:569 —
+    ``num_threads > 1`` is the analog here).  Each feature's fit is a
+    pure function of its own sample column, so the result is
+    byte-identical at every thread count; ``ThreadPoolExecutor.map``
+    preserves feature order."""
     categorical_features = categorical_features or set()
-    mappers = []
-    for fidx, vals in enumerate(sample_values):
+
+    def fit_one(fidx: int) -> BinMapper:
         m = BinMapper()
-        bt = BIN_CATEGORICAL if fidx in categorical_features else BIN_NUMERICAL
-        m.find_bin(vals, total_sample_cnt, max_bin, min_data_in_bin,
-                   min_split_data, bt, use_missing, zero_as_missing)
-        mappers.append(m)
-    return mappers
+        bt = BIN_CATEGORICAL if fidx in categorical_features \
+            else BIN_NUMERICAL
+        m.find_bin(sample_values[fidx], total_sample_cnt, max_bin,
+                   min_data_in_bin, min_split_data, bt, use_missing,
+                   zero_as_missing)
+        return m
+
+    n = len(sample_values)
+    if num_threads <= 1 or n <= 1:
+        return [fit_one(i) for i in range(n)]
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(num_threads, n)) as ex:
+        return list(ex.map(fit_one, range(n)))

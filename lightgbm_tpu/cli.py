@@ -90,6 +90,14 @@ def _task_train(params, config: Config) -> None:
     # retain it in that case (reference CLI keeps data in memory too)
     train_set = Dataset(config.data, params=params,
                         free_raw_data=not config.input_model)
+    if config.is_save_binary_file:
+        # reference DatasetLoader::SaveBinaryFile writes the cache at
+        # LOAD time, not after training: constructing once here reuses
+        # the core for the training run below AND persists the
+        # (memmap-able v2) cache even if a long run is interrupted —
+        # the next invocation short-circuits straight to load_binary
+        train_set.save_binary(config.data + ".bin")
+        Log.info(f"Saved binned dataset to {config.data}.bin")
     valid_sets = []
     valid_names = []
     for i, vf in enumerate(config.valid_data):
@@ -99,11 +107,6 @@ def _task_train(params, config: Config) -> None:
     booster = _train(params, train_set, config.num_iterations,
                      valid_sets=valid_sets, valid_names=valid_names,
                      init_model=config.input_model or None)
-    if config.is_save_binary_file:
-        # reference DatasetLoader::SaveBinaryFile: the binned dataset
-        # lands next to the text file and short-circuits future loads
-        train_set.save_binary(config.data + ".bin")
-        Log.info(f"Saved binned dataset to {config.data}.bin")
     booster.save_model(config.output_model)
     Log.info(f"Finished training; model saved to {config.output_model}")
 

@@ -300,7 +300,24 @@ class Config:
     is_pre_partition: bool = False  # lint: disable=CFG002(distributed loaders always treat per-host shards as pre-partitioned; accepted for reference CLI parity)
     use_two_round_loading: bool = False
     streaming_chunk_rows: int = 65536  # rows per two-round/PushRows
-    # text chunk (bounds peak float-row memory during streaming load)
+    # text chunk (bounds peak float-row memory during streaming load;
+    # two-round parsing overlaps binning via a bounded two-chunk
+    # queue, so at most FOUR parsed chunks coexist — two queued, one
+    # in the producer's hand, one being binned)
+    construct_threads: str = "auto"  # host threads for dataset
+    # construction: per-feature bin-mapper fitting, the native dense
+    # binner's row blocks, and the CSC column loop all fan across a
+    # thread pool (numpy sort/searchsorted and the native binner
+    # release the GIL).  "auto" = host core count; an integer pins it;
+    # 1 reproduces the serial path exactly — results are
+    # byte-identical at EVERY setting (parallelism is across
+    # features/row-blocks, never inside one reduction)
+    binary_cache_v2: bool = True  # save_binary writes the v2 container
+    # (magic + schema version + pickled mapper/metadata header + a raw
+    # np.memmap-able group_bins section): load_binary maps the bin
+    # matrix zero-copy instead of unpickling a full in-RSS copy.
+    # false restores the v1 pickle payload; v1 files always load, with
+    # a deprecation warning
     is_save_binary_file: bool = False
     is_enable_sparse: bool = True
     enable_bundle: bool = True    # EFB
@@ -571,6 +588,16 @@ class Config:
                              f"trace, got {self.telemetry!r}")
         if self.telemetry_retrace_warn < 1:
             raise ValueError("telemetry_retrace_warn must be >= 1")
+        ct = str(self.construct_threads).lower()
+        if ct != "auto":
+            try:
+                f = float(ct)
+                if not f.is_integer() or f < 0:
+                    raise ValueError
+            except ValueError:
+                raise ValueError("construct_threads must be 'auto' or a "
+                                 "non-negative integer (0 = auto), got "
+                                 f"{self.construct_threads!r}") from None
         dc = str(self.dispatch_chunk).lower()
         if dc != "auto":
             try:

@@ -10,6 +10,7 @@ native loader (lightgbm_tpu/native) accelerates large files.
 from __future__ import annotations
 
 import os
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -159,14 +160,17 @@ def load_file(path: str, config: Config
     fmt, sep, names = _sniff_text_file(path, config)
 
     if fmt in ("csv", "tsv"):
-        try:
-            from .native import text_loader
-            raw = text_loader.load_csv(path, sep, 1 if has_header else 0)
-        except Exception:
-            raw = np.loadtxt(path, delimiter=sep,
-                             skiprows=1 if has_header else 0,
-                             ndmin=2, dtype=np.float64,
-                             converters=None, encoding=None)
+        from .telemetry import TELEMETRY
+        with TELEMETRY.span("parse"):
+            try:
+                from .native import text_loader
+                raw = text_loader.load_csv(path, sep,
+                                           1 if has_header else 0)
+            except Exception:
+                raw = np.loadtxt(path, delimiter=sep,
+                                 skiprows=1 if has_header else 0,
+                                 ndmin=2, dtype=np.float64,
+                                 converters=None, encoding=None)
         label_col, weight_cols, group_cols, used, cat_feats = \
             _resolve_file_columns(config, names, raw.shape[1])
         X = raw[:, used]
@@ -194,13 +198,22 @@ def load_file_streaming(path: str, config: Config):
     Round 1 reservoir-samples up to ``bin_construct_sample_cnt`` parsed
     rows while counting lines; bin mappers and EFB bundles are fitted
     from the samples.  Round 2 re-reads the file in chunks, pushing
-    binned rows straight into the packed (N, G) uint8 matrix.  Peak
-    host memory = samples + one chunk + the uint8 matrix.
+    binned rows straight into the packed (N, G) uint8 matrix — parse
+    and bin OVERLAPPED: a producer thread parses ahead while the main
+    thread bins, a bounded two-chunk queue in between (the native
+    binner and numpy both release the GIL, so the stages genuinely run
+    concurrently).  Peak host memory = samples + at most FOUR parsed
+    chunks (two queued, one in the producer's hand, one being binned)
+    + the uint8 matrix.
 
     Returns a constructed CoreDataset (metadata from label/weight/group
     columns and side files already applied).
     """
+    import queue
+    import threading
+
     from .dataset import Dataset as CoreDataset
+    from .telemetry import TELEMETRY
 
     has_header = config.has_header
     fmt, sep, names = _sniff_text_file(path, config)
@@ -236,7 +249,8 @@ def load_file_streaming(path: str, config: Config):
                 if j < sample_cnt:
                     reservoir[j] = line
             n_rows += 1
-    sample_raw = parse_lines(reservoir)
+    with TELEMETRY.span("parse", rows=len(reservoir)):
+        sample_raw = parse_lines(reservoir)
     label_col, weight_cols, group_cols, used, cat_feats = \
         _resolve_file_columns(config, names, sample_raw.shape[1])
     sample_X = sample_raw[:, used]
@@ -248,29 +262,83 @@ def load_file_streaming(path: str, config: Config):
         feature_names=[names[i] for i in used] if names else None,
         categorical_features=cat_feats or None)
 
-    # ---- round 2: stream chunks into the bin matrix ----
+    # ---- round 2: stream chunks into the bin matrix, parse || bin ----
+    # A bounded two-chunk queue: the producer thread reads + parses
+    # ahead while the consumer bins the current chunk.  Chunk
+    # boundaries and parse order are identical to the old serial loop,
+    # so the packed matrix is byte-identical.  Worst-case resident
+    # parsed chunks: two queued + one in the producer's hand + one
+    # being binned (see streaming_chunk_rows in Parameters.md).  The
+    # `stop` event keeps a consumer-side failure from stranding the
+    # producer in a blocking put() forever (thread + chunk leak).
     chunk_rows = max(1, int(config.streaming_chunk_rows))
     label = np.zeros(n_rows, dtype=np.float64)
     weight = np.zeros(n_rows, dtype=np.float32) if weight_cols else None
     qid = np.zeros(n_rows, dtype=np.int64) if group_cols else None
-    row = 0
-    with open(path) as f:
-        if has_header:
-            f.readline()
-        buf: List[str] = []
-        for line in f:
-            if not line.strip():
+    chunk_q: "queue.Queue" = queue.Queue(maxsize=2)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up once the consumer has aborted."""
+        while not stop.is_set():
+            try:
+                chunk_q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
                 continue
-            buf.append(line)
-            if len(buf) >= chunk_rows:
-                row = _push_text_chunk(ds, parse_lines(buf), used,
-                                       label_col, weight_cols, group_cols,
-                                       label, weight, qid, row)
-                buf = []
-        if buf:
-            row = _push_text_chunk(ds, parse_lines(buf), used, label_col,
-                                   weight_cols, group_cols, label, weight,
-                                   qid, row)
+        return False
+
+    def _produce():
+        try:
+            with open(path) as f:
+                if has_header:
+                    f.readline()
+                buf: List[str] = []
+                for line in f:
+                    if not line.strip():
+                        continue
+                    buf.append(line)
+                    if len(buf) >= chunk_rows:
+                        with TELEMETRY.span("parse", rows=len(buf)):
+                            arr = parse_lines(buf)
+                        if not _put(("chunk", arr)):
+                            return
+                        buf = []
+                if buf:
+                    with TELEMETRY.span("parse", rows=len(buf)):
+                        arr = parse_lines(buf)
+                    if not _put(("chunk", arr)):
+                        return
+            _put(("done", None))
+        except BaseException as e:  # re-raised on the consumer side
+            _put(("error", e))
+
+    t0 = _time.perf_counter()
+    producer = threading.Thread(target=_produce, name="ltpu-parse",
+                                daemon=True)
+    producer.start()
+    row = 0
+    try:
+        while True:
+            kind, payload = chunk_q.get()
+            if kind == "done":
+                break
+            if kind == "error":
+                raise payload
+            row = _push_text_chunk(ds, payload, used, label_col,
+                                   weight_cols, group_cols, label,
+                                   weight, qid, row)
+    finally:
+        stop.set()
+        while True:  # unblock a producer waiting on a full queue
+            try:
+                chunk_q.get_nowait()
+            except queue.Empty:
+                break
+        producer.join()
+    wall = _time.perf_counter() - t0
+    if wall > 0:
+        TELEMETRY.gauge("construct_stream_rows_per_s", round(row / wall))
     ds.finish_load()
     ds.metadata.set_label(label)
     extras = _load_side_files(path, {

@@ -68,6 +68,51 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
             ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8)]
+        # construction-pipeline entry points (hasattr-guarded: a stale
+        # prebuilt libltpu.so without them must still serve the
+        # loaders while the callers fall back to the Python path).
+        # ONE home for every binner signature — dataset.py must not
+        # carry its own copies that could drift from the C side.
+        if hasattr(lib, "ltpu_bin_dense"):
+            lib.ltpu_bin_dense.restype = None
+            lib.ltpu_bin_dense.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+                ctypes.c_long, ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long, ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_ubyte)]
+        if hasattr(lib, "ltpu_scatter_cols"):
+            lib.ltpu_scatter_cols.restype = None
+            lib.ltpu_scatter_cols.argtypes = [
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
+                ctypes.c_long, ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+        if hasattr(lib, "ltpu_bin_dense_mt"):
+            lib.ltpu_bin_dense_mt.restype = None
+            lib.ltpu_bin_dense_mt.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+                ctypes.c_long, ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long, ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+        if hasattr(lib, "ltpu_bin_cat"):
+            lib.ltpu_bin_cat.restype = None
+            lib.ltpu_bin_cat.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+                ctypes.c_long, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_long,
+                ctypes.c_long, ctypes.POINTER(ctypes.c_ubyte),
+                ctypes.c_long]
+        if hasattr(lib, "ltpu_bin_bundle"):
+            lib.ltpu_bin_bundle.restype = None
+            lib.ltpu_bin_bundle.argtypes = [
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
+                ctypes.c_long, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
         _lib = lib
         return _lib
 

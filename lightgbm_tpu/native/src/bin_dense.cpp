@@ -7,23 +7,36 @@
 // per-feature bound arrays runs ~10x that, which is what keeps the
 // 10.5M-row HIGGS prep from being dominated by binning on a 1-core
 // host (round-3 verdict weak #4).
+//
+// Round 11 extends the library over the whole construction pipeline:
+// ltpu_bin_dense_mt fans the row blocks over std::threads (disjoint
+// output rows, so the result is byte-identical at every thread count),
+// ltpu_bin_cat runs the categorical LUT lookup, and ltpu_bin_bundle
+// applies the EFB offset/default-collapse write (feature_group.h:
+// 128-136) — the last per-feature Python fallbacks in _bin_rows_dense.
 #include <algorithm>
 #include <cmath>
+#include <thread>
+#include <vector>
 
-extern "C" void ltpu_bin_dense(
-    const double* X, long n, long f_total,
+namespace {
+
+constexpr long BKMAX = 512;
+
+// Bin rows [i0_lo, i0_hi) of a row-major (n, f_total) matrix into the
+// feature-major (n_used, n) output.  Loop order: row blocks OUTER,
+// features INNER.  A row-major X column gather strides f_total*8
+// bytes, so feature-outer order misses DRAM on every value once the
+// matrix is wide (136-feature MS-LTR prep ran 2x slower per value than
+// 28-feature HIGGS).  With the row block held in cache, only the first
+// feature's gather touches DRAM; the rest hit L2.  BK shrinks for very
+// wide rows so the block (BK * f_total * 8B) stays cache-resident.
+void bin_dense_range(
+    const double* X, long i0_lo, long i0_hi, long n, long f_total,
     const long* feat_idx, long n_used,
     const double* bounds_flat, const long* bounds_off,
     const unsigned char* use_nan, const long* nan_bin,
     unsigned char* out /* (n_used, n) feature-major */) {
-  // Loop order: row blocks OUTER, features INNER.  A row-major X
-  // column gather strides f_total*8 bytes, so feature-outer order
-  // misses DRAM on every value once the matrix is wide (136-feature
-  // MS-LTR prep ran 2x slower per value than 28-feature HIGGS).  With
-  // the row block held in cache, only the first feature's gather
-  // touches DRAM; the rest hit L2.  BK shrinks for very wide rows so
-  // the block (BK * f_total * 8B) stays cache-resident.
-  constexpr long BKMAX = 512;
   long bk = BKMAX;
   if (f_total > 0) {
     const long fit = (2L << 20) / (8 * f_total);  // ~2 MB of block
@@ -32,8 +45,8 @@ extern "C" void ltpu_bin_dense(
   double buf[BKMAX];
   unsigned short cnt[BKMAX];
   unsigned char nanv[BKMAX];
-  for (long i0 = 0; i0 < n; i0 += bk) {
-    const long m = (n - i0 < bk) ? (n - i0) : bk;
+  for (long i0 = i0_lo; i0 < i0_hi; i0 += bk) {
+    const long m = (i0_hi - i0 < bk) ? (i0_hi - i0) : bk;
     const double* xb = X + i0 * f_total;
     for (long j = 0; j < n_used; ++j) {
       const double* ub = bounds_flat + bounds_off[j];
@@ -60,6 +73,89 @@ extern "C" void ltpu_bin_dense(
       for (long i = 0; i < m; ++i)
         o[i] = (nanv[i] && un) ? nb : (unsigned char)cnt[i];
     }
+  }
+}
+
+}  // namespace
+
+extern "C" void ltpu_bin_dense(
+    const double* X, long n, long f_total,
+    const long* feat_idx, long n_used,
+    const double* bounds_flat, const long* bounds_off,
+    const unsigned char* use_nan, const long* nan_bin,
+    unsigned char* out /* (n_used, n) feature-major */) {
+  bin_dense_range(X, 0, n, n, f_total, feat_idx, n_used, bounds_flat,
+                  bounds_off, use_nan, nan_bin, out);
+}
+
+// Threaded form: contiguous block-aligned row ranges per thread.  Each
+// range writes a disjoint slice of every output row, so the packed
+// result is byte-identical at any thread count.
+extern "C" void ltpu_bin_dense_mt(
+    const double* X, long n, long f_total,
+    const long* feat_idx, long n_used,
+    const double* bounds_flat, const long* bounds_off,
+    const unsigned char* use_nan, const long* nan_bin,
+    unsigned char* out, long n_threads) {
+  if (n_threads <= 1 || n < 2 * BKMAX) {
+    bin_dense_range(X, 0, n, n, f_total, feat_idx, n_used, bounds_flat,
+                    bounds_off, use_nan, nan_bin, out);
+    return;
+  }
+  const long max_t = (n + BKMAX - 1) / BKMAX;
+  if (n_threads > max_t) n_threads = max_t;
+  // block-aligned split so every thread's internal blocking matches
+  // the serial walk's block boundaries
+  const long per = ((n / n_threads + BKMAX - 1) / BKMAX) * BKMAX;
+  std::vector<std::thread> ts;
+  for (long t = 0; t < n_threads; ++t) {
+    const long lo = t * per;
+    if (lo >= n) break;
+    const long hi = std::min(n, lo + per);
+    ts.emplace_back(bin_dense_range, X, lo, hi, n, f_total, feat_idx,
+                    n_used, bounds_flat, bounds_off, use_nan, nan_bin,
+                    out);
+  }
+  for (auto& th : ts) th.join();
+}
+
+// Categorical value->bin: the compiled form of BinMapper.value_to_bin's
+// LUT path (bin.h:450-486 CategoricalBin::ValueToBin).  lut[k] is
+// category k's bin (pre-filled with the unseen bin for unmapped keys);
+// NaN and negative values route to the unseen bin like the Python
+// path's iv = -1.  out_stride lets the caller write a packed-matrix
+// column in place (stride = num_groups) or a contiguous scratch row
+// (stride = 1, feeding ltpu_bin_bundle).
+extern "C" void ltpu_bin_cat(
+    const double* X, long n, long f_total, long col,
+    const int* lut, long lut_len, long unseen_bin,
+    unsigned char* out, long out_stride) {
+  const double* c = X + col;
+  for (long i = 0; i < n; ++i) {
+    const double v = c[i * f_total];
+    // (long)v truncates toward zero exactly like numpy's
+    // astype(int64); out-of-range doubles land outside [0, lut_len)
+    // on both paths and take the unseen bin
+    const long iv = std::isnan(v) ? -1 : (long)v;
+    const long b = (iv >= 0 && iv < lut_len) ? lut[iv] : unseen_bin;
+    out[i * out_stride] = (unsigned char)b;
+  }
+}
+
+// EFB bundle column write (reference feature_group.h:128-136): a
+// feature inside a multi-feature bundle stores non-default bins at
+// [offset, offset+num_bin) — minus the default-at-0 slot removal —
+// and leaves default rows alone (they share the group's bin-0 default
+// slot, prefilled by the caller).  col_bins is the feature's own
+// value->bin result (from ltpu_bin_dense/_cat or the Python mapper).
+extern "C" void ltpu_bin_bundle(
+    const unsigned char* col_bins, long n, long offset, long default_bin,
+    unsigned char* out, long out_stride) {
+  const long shift = offset - (default_bin == 0 ? 1 : 0);
+  for (long i = 0; i < n; ++i) {
+    const unsigned char c = col_bins[i];
+    if ((long)c != default_bin)
+      out[i * out_stride] = (unsigned char)((long)c + shift);
   }
 }
 

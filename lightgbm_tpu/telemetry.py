@@ -46,7 +46,13 @@ Export (``Config.telemetry_out`` = path prefix): ``<prefix>.jsonl``
 (newline-JSON span events + one final snapshot line) and
 ``<prefix>.perfetto.json`` (Chrome ``trace_event`` format — load in
 ``ui.perfetto.dev``).  See docs/OBSERVABILITY.md for the span map and
-counter glossary.
+counter glossary.  Since round 11 the ``binning`` span decomposes into
+``parse``/``fit_mappers``/``bin``/``pack`` sub-spans (with
+``construct_rows_per_s`` / ``construct_stream_rows_per_s`` gauges) —
+in a streaming load the ``parse`` spans live on the producer thread
+and visibly overlap the consumer's ``bin`` spans in the Perfetto
+view, which is exactly the pipelining the round-11 construct bench
+series tracks.
 """
 from __future__ import annotations
 

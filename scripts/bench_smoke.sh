@@ -24,6 +24,27 @@ BENCH_PARAMS='{"num_leaves":15,"max_bin":31}' \
 python scripts/profile_train.py 2048 2 /tmp/lgbtpu_smoke/telemetry >&2
 test -s /tmp/lgbtpu_smoke/telemetry.perfetto.json
 test -s /tmp/lgbtpu_smoke/telemetry.jsonl
+# construct pipeline + binary-cache v2 plumbing (round 11): build a
+# tiny dataset through the parallel pipeline, save the v2 cache,
+# reload it (memmap path) and assert byte equality — catches cache
+# format regressions before the bench's construct block reports them
+python - >&2 <<'EOF'
+import os, tempfile
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset_io import load_binary, save_binary
+rng = np.random.RandomState(0)
+X = rng.randn(512, 6)
+X[rng.rand(512, 6) < 0.2] = 0.0
+core = lgb.Dataset(X, label=(X[:, 0] > 0)).construct(
+    Config.from_params({"verbose": -1, "max_bin": 31}))
+p = os.path.join(tempfile.mkdtemp(prefix="lgbtpu_smoke_"), "t.bin")
+save_binary(core, p)
+assert np.array_equal(np.asarray(load_binary(p).group_bins),
+                      np.asarray(core.group_bins))
+print("construct cache-v2 smoke ok")
+EOF
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \

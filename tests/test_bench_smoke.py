@@ -53,6 +53,20 @@ def test_bench_smoke_json_contract():
         "per bucket")
     assert p["dispatches"] > p["compile_count"], \
         "smoke issued no cache-hit dispatches"
+    # construction roofline block (round 11): cold vs serial rows/s,
+    # thread scaling, cache-v2 reload — parity gated inside the bench
+    assert "construct" in out, "construct scale must run in the smoke"
+    c = out["construct"]
+    for field in ("rows", "features", "cold_construct_s",
+                  "cold_rows_per_s", "serial_construct_s",
+                  "serial_rows_per_s", "speedup_vs_serial",
+                  "threads_auto", "thread_scaling", "cache_save_s",
+                  "cache_reload_s", "reload_x_cold", "parity"):
+        assert field in c, f"construct block missing {field}"
+    assert c["parity"] == "pass"
+    assert set(c["thread_scaling"]) == {"1", "auto", "x"}
+    # the anchor must be present or carry an explicit skip reason
+    assert "local_ref" in c or "local_ref_skipped" in c
 
 
 if __name__ == "__main__":

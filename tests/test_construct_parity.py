@@ -1,0 +1,327 @@
+"""Round-11 construction-pipeline parity gate.
+
+The parallel dataset-construction pipeline (threaded bin-mapper fit,
+native categorical/EFB binning, overlapped two-round streaming, binary
+cache v2) carries a byte-identity guarantee against the serial Python
+path: ``group_bins`` must be EXACTLY equal — and therefore trained
+trees byte-identical — for every construction route and every
+``construct_threads`` setting, across dense/CSC/categorical/EFB
+shapes including the ``collapsed_default`` bundle and NaN /
+zero-as-missing corners.  ``construct_threads=1`` +
+``native_binning=false`` reproduces the pre-r11 serial behavior by
+construction; everything else is checked against it here.
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset_io import (BINARY_TOKEN, MAGIC_V2, load_binary,
+                                     save_binary)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _mixed_matrix(n=2500, seed=3):
+    """Dense matrix exercising every feature class at once: numerical
+    with NaN + zeros, two categorical columns (incl. an all-small one),
+    and eight mutually-exclusive sparse columns that EFB packs into
+    multi-feature bundles with collapsed defaults."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 14))
+    for j in range(8):                      # EFB bundle candidates
+        rows = np.arange(j, n, 8)
+        X[rows, j] = rng.randn(len(rows))
+    X[np.arange(3, n, 16), 2] = np.nan      # NaN inside a bundled col
+    X[:, 8] = rng.randn(n)                  # dense numerical
+    X[:, 9] = rng.randn(n)
+    X[rng.rand(n) < 0.05, 9] = np.nan       # MISSING_NAN numerical
+    X[:, 10] = rng.randn(n)
+    X[rng.rand(n) < 0.4, 10] = 0.0          # heavy zero bin
+    cat = rng.randint(0, 9, n).astype(float)
+    cat[rng.rand(n) < 0.03] = np.nan        # NaN categorical
+    cat[rng.rand(n) < 0.02] = -2.0          # negative -> NaN bin
+    X[:, 11] = cat
+    X[:, 12] = rng.randint(0, 3, n).astype(float)   # small cardinality
+    X[:, 13] = np.where(rng.rand(n) < 0.1,
+                        rng.randint(1, 5, n), 0.0)  # sparse categorical
+    y = (rng.rand(n) > 0.5).astype(float)
+    return X, y, [11, 12, 13]
+
+
+BASE = {"verbose": -1, "max_bin": 63, "min_data_in_bin": 1}
+SERIAL = {"construct_threads": 1, "native_binning": False}
+
+
+def _construct(X, y, cats, **overrides):
+    params = dict(BASE, **overrides)
+    return lgb.Dataset(X.copy(), label=y,
+                       categorical_feature=list(cats)).construct(
+        Config.from_params(params))
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return _mixed_matrix()
+
+
+@pytest.fixture(scope="module")
+def serial_core(mixed):
+    X, y, cats = mixed
+    return _construct(X, y, cats, **SERIAL)
+
+
+@pytest.fixture(scope="module")
+def parallel_core(mixed):
+    X, y, cats = mixed
+    return _construct(X, y, cats)          # defaults: native + auto
+
+
+def _bins(core):
+    return np.asarray(core.group_bins)
+
+
+def test_mixed_shape_covers_every_feature_class(parallel_core):
+    """The fixture must actually exercise bundles (incl. collapsed
+    defaults), categoricals and NaN corners, or the parity tests below
+    prove nothing."""
+    assert any(parallel_core.group_is_multi)
+    assert any(f.collapsed_default for f in parallel_core.features)
+    assert any(f.is_categorical for f in parallel_core.features)
+    from lightgbm_tpu.binning import MISSING_NAN
+    assert any(m.missing_type == MISSING_NAN
+               for m in parallel_core.mappers if not m.is_trivial)
+
+
+def test_parallel_native_byte_identical_to_serial(serial_core,
+                                                  parallel_core):
+    np.testing.assert_array_equal(_bins(serial_core),
+                                  _bins(parallel_core))
+    assert serial_core.feature_infos() == parallel_core.feature_infos()
+
+
+@pytest.mark.parametrize("threads", [2, 3])
+def test_thread_count_never_changes_bins(mixed, serial_core, threads):
+    X, y, cats = mixed
+    core = _construct(X, y, cats, construct_threads=threads)
+    np.testing.assert_array_equal(_bins(serial_core), _bins(core))
+
+
+def test_native_only_and_threads_only_match(mixed, serial_core):
+    X, y, cats = mixed
+    native_only = _construct(X, y, cats, construct_threads=1)
+    threads_only = _construct(X, y, cats, construct_threads=4,
+                              native_binning=False)
+    np.testing.assert_array_equal(_bins(serial_core), _bins(native_only))
+    np.testing.assert_array_equal(_bins(serial_core),
+                                  _bins(threads_only))
+
+
+def test_zero_as_missing_parity(mixed):
+    X, y, cats = mixed
+    a = _construct(X, y, cats, zero_as_missing=True, **SERIAL)
+    b = _construct(X, y, cats, zero_as_missing=True)
+    np.testing.assert_array_equal(_bins(a), _bins(b))
+
+
+def test_small_chunk_native_path_parity():
+    """The 4096-row native cutoff is gone: tiny matrices (and therefore
+    small streaming chunks) must take the native path and still match
+    the Python mapper byte for byte."""
+    rng = np.random.RandomState(11)
+    X = rng.randn(257, 5)
+    X[rng.rand(257, 5) < 0.1] = np.nan
+    y = rng.rand(257)
+    a = lgb.Dataset(X, label=y).construct(Config.from_params(BASE))
+    b = lgb.Dataset(X, label=y).construct(
+        Config.from_params(dict(BASE, **SERIAL)))
+    np.testing.assert_array_equal(_bins(a), _bins(b))
+
+
+def test_sparse_csc_threaded_parity(mixed):
+    sp = pytest.importorskip("scipy.sparse")
+    X, y, cats = mixed
+    Xs = sp.csr_matrix(np.nan_to_num(X, nan=0.0))
+    a = lgb.Dataset(Xs, label=y, categorical_feature=cats).construct(
+        Config.from_params(dict(BASE, construct_threads=4)))
+    b = lgb.Dataset(Xs.copy(), label=y,
+                    categorical_feature=cats).construct(
+        Config.from_params(dict(BASE, construct_threads=1)))
+    np.testing.assert_array_equal(_bins(a), _bins(b))
+
+
+# ---------------------------------------------------------------------------
+# streaming (overlapped parse/bin) parity
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def stream_csv(tmp_path_factory):
+    rng = np.random.RandomState(5)
+    X = rng.randn(3000, 8)
+    X[rng.rand(3000, 8) < 0.3] = 0.0
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    p = tmp_path_factory.mktemp("cstream") / "train.csv"
+    np.savetxt(p, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    return str(p)
+
+
+def test_overlapped_streaming_matches_in_ram(stream_csv):
+    params = {"verbose": -1, "max_bin": 63,
+              "bin_construct_sample_cnt": 5000}
+    ram = lgb.Dataset(stream_csv).construct(Config.from_params(params))
+    stream = lgb.Dataset(stream_csv).construct(Config.from_params(
+        dict(params, two_round=True, streaming_chunk_rows=256)))
+    np.testing.assert_array_equal(_bins(ram), _bins(stream))
+    np.testing.assert_array_equal(ram.metadata.label,
+                                  stream.metadata.label)
+
+
+def test_streaming_chunk_size_invariant(stream_csv):
+    params = {"verbose": -1, "max_bin": 63, "two_round": True,
+              "bin_construct_sample_cnt": 5000}
+    a = lgb.Dataset(stream_csv).construct(Config.from_params(
+        dict(params, streaming_chunk_rows=173)))
+    b = lgb.Dataset(stream_csv).construct(Config.from_params(
+        dict(params, streaming_chunk_rows=2048)))
+    np.testing.assert_array_equal(_bins(a), _bins(b))
+
+
+# ---------------------------------------------------------------------------
+# binary cache v2 / v1
+# ---------------------------------------------------------------------------
+def test_cache_v2_roundtrip_byte_identical(parallel_core, tmp_path):
+    bp = str(tmp_path / "mixed.bin")
+    save_binary(parallel_core, bp)
+    re = load_binary(bp)
+    assert isinstance(re.group_bins, np.memmap), \
+        "v2 reload must memmap the bin section (near-zero-copy)"
+    np.testing.assert_array_equal(_bins(parallel_core), _bins(re))
+    np.testing.assert_array_equal(parallel_core.metadata.label,
+                                  re.metadata.label)
+    assert parallel_core.feature_infos() == re.feature_infos()
+    assert parallel_core.group_num_bin == re.group_num_bin
+    assert [f.offset for f in parallel_core.features] == \
+        [f.offset for f in re.features]
+
+
+def test_cache_v1_backward_load(parallel_core, tmp_path):
+    bp = str(tmp_path / "mixed_v1.bin")
+    save_binary(parallel_core, bp, version=1)
+    re = load_binary(bp)            # deprecation warning, not an error
+    np.testing.assert_array_equal(_bins(parallel_core), _bins(re))
+    assert parallel_core.feature_infos() == re.feature_infos()
+
+
+def test_cache_v1_knob(parallel_core, mixed, tmp_path):
+    """binary_cache_v2=false writes the legacy pickle payload."""
+    X, y, cats = mixed
+    core = _construct(X, y, cats, binary_cache_v2=False)
+    bp = str(tmp_path / "knob_v1.bin")
+    save_binary(core, bp)
+    with open(bp, "rb") as f:
+        f.read(len(BINARY_TOKEN))
+        assert f.read(len(MAGIC_V2)) != MAGIC_V2
+    np.testing.assert_array_equal(_bins(parallel_core),
+                                  _bins(load_binary(bp)))
+
+
+def test_corrupted_header_rejected(tmp_path):
+    bad_len = tmp_path / "bad_len.bin"
+    bad_len.write_bytes(BINARY_TOKEN + MAGIC_V2
+                        + struct.pack("<Q", 1 << 40) + b"x" * 64)
+    with pytest.raises(LightGBMError):
+        load_binary(str(bad_len))
+    bad_blob = tmp_path / "bad_blob.bin"
+    bad_blob.write_bytes(BINARY_TOKEN + MAGIC_V2
+                         + struct.pack("<Q", 16) + b"not a pickle!!!!")
+    with pytest.raises(LightGBMError):
+        load_binary(str(bad_blob))
+
+
+def test_truncated_bin_section_rejected(parallel_core, tmp_path):
+    bp = tmp_path / "trunc.bin"
+    save_binary(parallel_core, str(bp))
+    whole = bp.read_bytes()
+    bp.write_bytes(whole[:-1024])
+    with pytest.raises(LightGBMError):
+        load_binary(str(bp))
+
+
+def test_not_a_binary_file_rejected(tmp_path):
+    p = tmp_path / "noise.bin"
+    p.write_bytes(b"definitely not a dataset")
+    with pytest.raises(LightGBMError):
+        load_binary(str(p))
+
+
+# ---------------------------------------------------------------------------
+# trained-tree byte identity across construction routes
+# ---------------------------------------------------------------------------
+TRAIN_PARAMS = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+                "max_bin": 63, "min_data_in_bin": 1,
+                "min_data_in_leaf": 5}
+
+
+def _train_model(core):
+    booster = lgb.Booster(config=Config.from_params(TRAIN_PARAMS),
+                          train_set=core)
+    for _ in range(5):
+        booster.update()
+    return booster.model_to_string()
+
+
+def test_trained_trees_byte_identical_across_routes(
+        serial_core, parallel_core, tmp_path):
+    bp = str(tmp_path / "route.bin")
+    save_binary(parallel_core, bp)
+    reloaded = load_binary(bp)      # memmap-backed bins -> device path
+    m_serial = _train_model(serial_core)
+    m_parallel = _train_model(parallel_core)
+    m_reload = _train_model(reloaded)
+    assert m_serial == m_parallel, \
+        "parallel construction changed the trained trees"
+    assert m_serial == m_reload, \
+        "binary-cache v2 reload changed the trained trees"
+
+
+# ---------------------------------------------------------------------------
+# knobs + mapper cache
+# ---------------------------------------------------------------------------
+def test_construct_threads_validation():
+    with pytest.raises(ValueError):
+        Config.from_params({"construct_threads": "many"})
+    with pytest.raises(ValueError):
+        Config.from_params({"construct_threads": "2.5"})
+    assert Config.from_params({"construct_threads": "auto"})
+    assert Config.from_params({"construct_threads": 3})
+    from lightgbm_tpu.binning import resolve_construct_threads
+    assert resolve_construct_threads(
+        Config.from_params({"construct_threads": 3})) == 3
+    assert resolve_construct_threads(None) >= 1
+    assert resolve_construct_threads(
+        Config.from_params({"construct_threads": 0})) >= 1
+
+
+def test_categorical_lut_cached_at_fit_time(parallel_core):
+    """value_to_bin must not re-materialize the dict arrays per call:
+    the LUT is built once at fit time, and a mapper arriving WITHOUT
+    the cache (older pickle) rebuilds it lazily with identical
+    results."""
+    from lightgbm_tpu.binning import BIN_CATEGORICAL
+    m = next(mm for mm in parallel_core.mappers
+             if mm.bin_type == BIN_CATEGORICAL and not mm.is_trivial)
+    assert m._cat_lut is not None
+    probe = np.array([-3.0, 0.0, 1.0, 2.0, 7.0, 99.0, np.nan])
+    cached = m.value_to_bin(probe)
+    m._cat_lut = None               # simulate an old-pickle mapper
+    lazy = m.value_to_bin(probe)
+    assert m._cat_lut is not None   # rebuilt
+    np.testing.assert_array_equal(cached, lazy)
+
+
+if __name__ == "__main__":
+    import sys
+
+    import pytest as _pytest
+    sys.exit(_pytest.main([__file__, "-v"]))

@@ -112,6 +112,14 @@ class _ServingPredictor:
         self.min_bucket = max(1, int(getattr(
             config, "predict_min_bucket_rows", 16)))
         self.chunk_rows = int(getattr(config, "predict_chunk_rows", 0))
+        # OOM degradation ladder (docs/RELIABILITY.md): on
+        # RESOURCE_EXHAUSTED the dispatch bucket halves and the
+        # request retries at the smaller shape instead of failing;
+        # the learned cap persists so later requests start degraded
+        self.oom_downshift = bool(getattr(config, "oom_downshift",
+                                          True))
+        self._oom_cap: Optional[int] = None
+        self._oom_warned = False
 
     # ------------------------------------------------------------------
     def _chunk_cap(self, two_f: int) -> int:
@@ -140,6 +148,8 @@ class _ServingPredictor:
     # ------------------------------------------------------------------
     def _dispatch(self, x2_dev):
         from .ops import predict as P
+        from .reliability.faults import FAULTS
+        FAULTS.fault_point("predict.dispatch")
         if self.kernel == "pallas":
             # halve until the tile divides the batch (immediate for
             # power-of-two buckets; odd bucket-off batches degrade to
@@ -152,6 +162,36 @@ class _ServingPredictor:
                 interpret=self.interpret)
         return P.predict_level_ensemble(self.stack, x2_dev,
                                         depth=self.depth)
+
+    def _recover_oom(self, e: BaseException, bucket_rows: int, pending,
+                     tm, s: int) -> int:
+        """Classify a failed dispatch OR a failed drain (on async
+        backends a device OOM materializes at the result copy, not the
+        enqueue): RESOURCE_EXHAUSTED halves the serving ladder (warn
+        once, count the event) and returns the row index to restart
+        from; anything else — or OOM at a single-row bucket, where
+        there is nothing left to halve — re-raises.
+
+        In-flight results are DISCARDED, not drained: draining a
+        poisoned buffer would re-raise the same OOM from inside the
+        handler, and dropping the references lets the backend free the
+        buffers (the other half of the memory pressure).  Their slices
+        rewind into the restart index and are re-dispatched at the
+        smaller bucket."""
+        from .reliability.retry import is_oom
+        if not (self.oom_downshift and is_oom(e)) or bucket_rows <= 1:
+            raise
+        restart = min((slot[1] for slot in pending), default=s)
+        pending.clear()
+        self._oom_cap = max(1, bucket_rows // 2)
+        tm.add("oom_downshifts", 1)
+        if not self._oom_warned:
+            self._oom_warned = True
+            Log.warning(
+                "RESOURCE_EXHAUSTED during serving dispatch at bucket "
+                f"{bucket_rows} ({e}); downshifting to bucket "
+                f"{self._oom_cap} and retrying the slice")
+        return restart
 
     def __call__(self, data: np.ndarray) -> np.ndarray:
         """(n, F) float64 raw features -> (n, K) float64 raw scores
@@ -173,6 +213,14 @@ class _ServingPredictor:
         if n == 0:
             return np.zeros((0, self.num_class))
         span = tm.start_span("predict", rows=n)
+        try:
+            return self._call_impl(data, n, jnp, P, tm)
+        finally:
+            # the ladder's re-raise paths (non-OOM errors, OOM at
+            # bucket 1) must not leave the request span unrecorded
+            tm.end_span(span)
+
+    def _call_impl(self, data, n, jnp, P, tm) -> np.ndarray:
         if tm.on:
             tm.add("predict_requests", 1)
         hi, lo = P.split_hi_lo(data)
@@ -180,6 +228,8 @@ class _ServingPredictor:
         x2[:, 0::2] = hi
         x2[:, 1::2] = lo
         cap = self._chunk_cap(x2.shape[1])
+        if self._oom_cap is not None:
+            cap = max(1, min(cap, self._oom_cap))
         out = np.empty((n, self.num_class), np.float32)
         pending: list = []
 
@@ -188,42 +238,64 @@ class _ServingPredictor:
             with tm.span("predict_drain"):
                 out[s:s + m] = np.asarray(dev)[:m]
 
-        for s in range(0, n, cap):
+        s = 0
+        while s < n or pending:
+            if pending and (s >= n or len(pending) >= 2):
+                # double buffer: at most TWO chunks' results in flight
+                # (what _PREDICT_CHUNK_BUDGET_BYTES sizes against).
+                # The drain is inside the ladder too: on an async
+                # backend a device OOM materializes HERE, at the
+                # result copy, not at the enqueue.
+                slot = pending[0]
+                try:
+                    drain(slot)
+                except Exception as e:
+                    s = self._recover_oom(e, int(slot[0].shape[0]),
+                                          pending, tm, s)
+                    cap = max(1, min(cap, self._oom_cap))
+                    continue
+                pending.pop(0)
+                continue
             part = x2[s:s + cap]
             m = part.shape[0]
             b = self._bucket(m, cap)
             if m < b:
                 part = np.concatenate(
                     [part, np.zeros((b - m, x2.shape[1]), np.float32)])
-            if tm.on:
-                with _serving_lock():
-                    traces0 = P.PREDICT_TELEMETRY["traces"]
-                    with tm.span("predict_dispatch",
-                                 bucket=int(part.shape[0])):
-                        dev = self._dispatch(jnp.asarray(part))
-                    miss = P.PREDICT_TELEMETRY["traces"] > traces0
-                tm.add("predict_dispatches", 1)
-                tm.add("predict_rows", m)
-                tm.add("predict_pad_rows", int(part.shape[0]) - m)
-                tm.add("predict_bucket_miss" if miss
-                       else "predict_bucket_hit", 1)
-            else:
-                dev = self._dispatch(jnp.asarray(part))
+            try:
+                if tm.on:
+                    with _serving_lock():
+                        traces0 = P.PREDICT_TELEMETRY["traces"]
+                        with tm.span("predict_dispatch",
+                                     bucket=int(part.shape[0])):
+                            dev = self._dispatch(jnp.asarray(part))
+                        miss = P.PREDICT_TELEMETRY["traces"] > traces0
+                    tm.add("predict_dispatches", 1)
+                    tm.add("predict_rows", m)
+                    tm.add("predict_pad_rows", int(part.shape[0]) - m)
+                    tm.add("predict_bucket_miss" if miss
+                           else "predict_bucket_hit", 1)
+                else:
+                    dev = self._dispatch(jnp.asarray(part))
+            except Exception as e:
+                # RESOURCE_EXHAUSTED degradation ladder: halve the
+                # dispatch bucket and retry from the earliest
+                # un-drained slice at the smaller shape instead of
+                # failing the request; the learned cap sticks so
+                # later requests start degraded
+                s = self._recover_oom(e, int(part.shape[0]), pending,
+                                      tm, s)
+                cap = max(1, min(cap, self._oom_cap))
+                continue
             P.PREDICT_TELEMETRY["dispatches"] += 1
             P.PREDICT_TELEMETRY["rows"] += m
             P.PREDICT_TELEMETRY["buckets"].add(int(part.shape[0]))
             pending.append((dev, s, m))
             if tm.on:
                 tm.gauge_max("predict_stream_depth", len(pending))
-            if len(pending) >= 2:
-                # double buffer: at most TWO chunks' results in flight
-                # (what _PREDICT_CHUNK_BUDGET_BYTES sizes against)
-                drain(pending.pop(0))
-        for slot in pending:
-            drain(slot)
+            s += m
         if tm.on:
             tm.sample_memory()
-        tm.end_span(span)
         return out.astype(np.float64)
 
 

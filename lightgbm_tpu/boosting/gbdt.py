@@ -30,6 +30,9 @@ from ..metrics import Metric, create_metrics
 from ..objectives import Objective, create_objective
 from ..ops.histogram import leaf_value_broadcast
 from ..ops.predict import predict_binned
+from ..reliability.checkpoint import CheckpointError
+from ..reliability.faults import FAULTS
+from ..reliability.retry import RetryPolicy, retry_call
 from ..telemetry import TELEMETRY
 from ..tree import Tree
 from ..utils.log import Log, PhaseTimer
@@ -581,6 +584,14 @@ class GBDT:
         use_bag = self._use_bagging_fused()
         if self._bag_state is None:
             self._bag_state = self._full_counts > 0
+        # the per-iteration seed and feature-mask draws below consume
+        # host RNG state BEFORE the dispatch can fail — snapshot the
+        # streams so a failed dispatch restores them and a retry or
+        # engine-level chunk downshift re-draws the IDENTICAL
+        # sequence (the byte-identity guarantee under failure,
+        # docs/RELIABILITY.md)
+        _rng_snap = (self._iter_key_rng.get_state(),
+                     self._feat_rng.get_state())
         seeds = np.asarray([self._iter_key_rng.randint(0, 2**31 - 1)
                             for _ in range(n_iters)], np.uint32)
         if self._np_keys_ok and not use_bag \
@@ -626,26 +637,53 @@ class GBDT:
             fresh = cache
         self.timer.start("tree")
         span = tm.start_span("train_chunk", iters=n_iters)
-        with tm.span("host_dispatch"):
-            scores, vscores, bag, trees, nls = self._fused_chunk(
+
+        def _enqueue():
+            # fault seam BEFORE the dispatch: an injected failure (or
+            # SIGKILL) leaves training state as if the chunk was never
+            # dispatched, so a retry — or a checkpoint resume — is
+            # exact.  Transient-classified errors (connection/timeout/
+            # UNAVAILABLE RPC statuses) retry under the config policy;
+            # anything else (OOM included) propagates to the caller's
+            # degradation ladder.
+            FAULTS.fault_point("gbdt.train_chunk")
+            return self._fused_chunk(
                 self.scores, tuple(vs.scores for vs in self.valid_sets),
                 self._bag_state, keys, fmasks,
                 fresh if isinstance(fresh, jax.Array)
                 else jnp.asarray(fresh),
                 self.grower.ohb, self._build_captives())
-        if tm.on:
-            # the r7 bench split, now first-class counters: time-to-
-            # return is the host/dispatch cost (the async enqueue, an
-            # RPC on a remote-attached chip); the optional fence
-            # attributes the remainder to device execution
-            tm.add("host_dispatch_ms",
-                   (time.perf_counter() - t0) * 1e3)
-            tm.fence_ready(scores)
-            tm.add("trees_dispatched", n_iters * self.num_class)
-            tm.add("iterations", n_iters)
-            tm.add("chunks_dispatched", 1)
-            tm.gauge("dispatch_chunk_size", n_iters)
-            tm.sample_memory(device=tm.spans_on)
+
+        try:
+            with tm.span("host_dispatch"):
+                scores, vscores, bag, trees, nls = retry_call(
+                    _enqueue, policy=self._retry_policy(),
+                    seam="gbdt.train_chunk")
+            if tm.on:
+                # the r7 bench split, now first-class counters: time-
+                # to-return is the host/dispatch cost (the async
+                # enqueue, an RPC on a remote-attached chip); the
+                # optional fence attributes the remainder to device
+                # execution
+                tm.add("host_dispatch_ms",
+                       (time.perf_counter() - t0) * 1e3)
+                tm.fence_ready(scores)
+                tm.add("trees_dispatched", n_iters * self.num_class)
+                tm.add("iterations", n_iters)
+                tm.add("chunks_dispatched", 1)
+                tm.gauge("dispatch_chunk_size", n_iters)
+                tm.sample_memory(device=tm.spans_on)
+        except BaseException:
+            # one guard covers the enqueue AND the telemetry fence
+            # (an async device OOM materializes at the fence, still
+            # before any state commits): restore the RNG streams so a
+            # retry or downshifted re-dispatch draws the IDENTICAL
+            # seed/feature-mask sequence
+            self._iter_key_rng.set_state(_rng_snap[0])
+            self._feat_rng.set_state(_rng_snap[1])
+            self.timer.stop("tree")
+            tm.end_span(span)
+            raise
         tm.end_span(span)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
@@ -767,21 +805,45 @@ class GBDT:
                                       self.iter_ % cfg.bagging_freq == 0))
         if self._bag_state is None:
             self._bag_state = self._full_counts > 0
+        # RNG snapshot: the key/feature-mask draws precede the
+        # dispatch; a failed dispatch restores the streams so a retry
+        # trains the identical iteration (the masks are drawn ONCE,
+        # outside the retried closure, for the same reason)
+        _rng_snap = (self._iter_key_rng.get_state(),
+                     self._feat_rng.get_state())
         key = jax.random.PRNGKey(
             int(self._iter_key_rng.randint(0, 2**31 - 1)))
+        fmasks = self._feature_masks()
         span = tm.start_span("boost_iter", iteration=self.iter_)
-        with tm.span("host_dispatch"):
-            scores, vscores, bag, trees, nl = self._fused_step(
+
+        def _enqueue():
+            FAULTS.fault_point("gbdt.train_one_iter")
+            return self._fused_step(
                 self.scores, tuple(vs.scores for vs in self.valid_sets),
-                self._bag_state, key, self._feature_masks(),
+                self._bag_state, key, fmasks,
                 jnp.asarray(self.shrinkage_rate, jnp.float32),
                 self.grower.ohb, self._build_captives(),
                 fresh_bag=fresh_bag, sample_active=self._sample_active())
-        if tm.on:
-            tm.add("host_dispatch_ms", (time.perf_counter() - t0) * 1e3)
-            tm.fence_ready(scores)
-            tm.add("trees_dispatched", self.num_class)
-            tm.add("iterations", 1)
+
+        try:
+            with tm.span("host_dispatch"):
+                scores, vscores, bag, trees, nl = retry_call(
+                    _enqueue, policy=self._retry_policy(),
+                    seam="gbdt.train_one_iter")
+            if tm.on:
+                tm.add("host_dispatch_ms",
+                       (time.perf_counter() - t0) * 1e3)
+                tm.fence_ready(scores)
+                tm.add("trees_dispatched", self.num_class)
+                tm.add("iterations", 1)
+        except BaseException:
+            # covers the enqueue and the fence (async OOM surfaces at
+            # the fence): restore RNG streams for an exact retry
+            self._iter_key_rng.set_state(_rng_snap[0])
+            self._feat_rng.set_state(_rng_snap[1])
+            self.timer.stop("tree")
+            tm.end_span(span)
+            raise
         tm.end_span(span)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
@@ -970,6 +1032,123 @@ class GBDT:
                                     bias0 if j == 0 else 0.0)
         TELEMETRY.add("trees_flushed", len(self.models) - n_before)
         TELEMETRY.end_span(span)
+
+    # ------------------------------------------------------------------
+    # crash-safe checkpointing (docs/RELIABILITY.md) ------------------
+    def _retry_policy(self) -> RetryPolicy:
+        p = getattr(self, "_retry_policy_cache", None)
+        if p is None:
+            p = RetryPolicy.from_config(self.config)
+            self._retry_policy_cache = p
+        return p
+
+    def can_checkpoint(self) -> bool:
+        """Whether full-state checkpointing covers this booster: plain
+        GBDT and GOSS (their entire RNG state lives in the captured
+        streams).  DART re-scales finished trees from host-side drop
+        state and RF mutates averaged leaf outputs between iterations
+        — neither round-trips through capture_state yet."""
+        return type(self).__name__ in ("GBDT", "GOSS") and not self._mh
+
+    def capture_state(self) -> Tuple[dict, bool]:
+        """Snapshot FULL training state for a crash-safe checkpoint:
+        host models, score caches, bagging/key RNG streams, and
+        early-stopping bookkeeping — everything a resumed run needs to
+        produce byte-identical trees to an uninterrupted one.  The
+        deferred no-split window is consumed first (it is the one
+        piece of state that references device-resident tree stacks);
+        returns (state, stopped) where stopped means the window
+        detected end-of-training."""
+        stopped = self._check_stop_window() if self._nl_window else False
+        self.flush_models()
+        state = {
+            "iter_": self.iter_,
+            "models": list(self.models),
+            "tree_scale": list(self._tree_scale),
+            "applied_scale": list(self._applied_scale),
+            "tree_shrink": list(self._tree_shrink),
+            # informational only: restore_state deliberately sets
+            # scale_offset to len(models) instead (restored trees are
+            # host-only and route like init_model foreign trees)
+            "scale_offset": self._scale_offset,
+            "shrinkage_rate": self.shrinkage_rate,
+            "init_score": self.init_score,
+            "scores": np.asarray(self.scores),
+            "valid_scores": [np.asarray(vs.scores)
+                             for vs in self.valid_sets],
+            "bag_state": (None if self._bag_state is None
+                          else np.asarray(self._bag_state)),
+            "bag_mask": (None if self._bag_mask is None
+                         else np.asarray(self._bag_mask)),
+            "bag_rng": np.asarray(self._bag_rng),
+            "iter_key_rng": self._iter_key_rng.get_state(),
+            "feat_rng": self._feat_rng.get_state(),
+            "py_rng": self._rng.get_state(),
+            "best_score": dict(self._best_score),
+            "best_iter": dict(self._best_iter),
+            "best_iteration": self.best_iteration,
+            "num_class": self.num_class,
+            "num_data": self.num_data,
+            "n_padded": self.grower.n_padded,
+            "num_valid": len(self.valid_sets),
+        }
+        if hasattr(self, "_goss_key"):          # GOSS host-path stream
+            state["goss_key"] = np.asarray(self._goss_key)
+        return state, stopped
+
+    def restore_state(self, state: dict) -> None:
+        """Adopt a capture_state snapshot: the inverse restore, run on
+        a freshly-constructed GBDT over the SAME dataset (the caller
+        verified the checkpoint fingerprint).  Raises CheckpointError
+        on any shape/identity mismatch rather than training garbage."""
+        if state.get("num_class") != self.num_class or \
+                state.get("num_data") != self.num_data or \
+                state.get("n_padded") != self.grower.n_padded or \
+                state.get("num_valid") != len(self.valid_sets):
+            raise CheckpointError(
+                "checkpoint state does not match this training setup "
+                f"(saved num_data={state.get('num_data')}/"
+                f"num_class={state.get('num_class')}/padded="
+                f"{state.get('n_padded')}/valid={state.get('num_valid')}"
+                f" vs {self.num_data}/{self.num_class}/"
+                f"{self.grower.n_padded}/{len(self.valid_sets)})")
+        import jax.numpy as jnp
+        self.iter_ = int(state["iter_"])
+        # in-place: Booster.models aliases this list
+        self.models[:] = state["models"]
+        self._tree_scale[:] = state["tree_scale"]
+        self._applied_scale[:] = state["applied_scale"]
+        self._tree_shrink[:] = state["tree_shrink"]
+        # restored trees live only on host — register them like
+        # init_model foreign trees so the in-session binned device
+        # predict (which only knows post-resume device stacks) stands
+        # down in favor of the host/stacked path
+        self._scale_offset = len(self.models)
+        self.shrinkage_rate = float(state["shrinkage_rate"])
+        self.init_score = float(state["init_score"])
+        self.scores = self.grower.policy.place_score_rows(
+            np.asarray(state["scores"], np.float32))
+        for vs, arr in zip(self.valid_sets, state["valid_scores"]):
+            vs.scores = jnp.asarray(np.asarray(arr, np.float32))
+        self._bag_state = (None if state["bag_state"] is None
+                           else jnp.asarray(state["bag_state"]))
+        mask = state.get("bag_mask")
+        self._bag_mask = None if mask is None else jnp.asarray(mask)
+        self._bag_rng = jnp.asarray(
+            np.asarray(state["bag_rng"], np.uint32))
+        self._iter_key_rng.set_state(state["iter_key_rng"])
+        self._feat_rng.set_state(state["feat_rng"])
+        self._rng.set_state(state["py_rng"])
+        self._best_score = dict(state["best_score"])
+        self._best_iter = dict(state["best_iter"])
+        self.best_iteration = int(state["best_iteration"])
+        if "goss_key" in state and hasattr(self, "_goss_key"):
+            self._goss_key = jnp.asarray(
+                np.asarray(state["goss_key"], np.uint32))
+        self.device_trees = []
+        self._pending = []
+        self._nl_window = []
+        self._nl_count = 0
 
     # ------------------------------------------------------------------
     def _mask_gradients(self, g, h, counts):

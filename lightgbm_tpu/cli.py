@@ -84,8 +84,24 @@ def _task_train(params, config: Config) -> None:
                                     if ln.strip())
         if machines:
             from .capi import LGBM_NetworkInit
-            LGBM_NetworkInit(machines, config.local_listen_port,
-                             config.time_out, config.num_machines)
+            from .reliability.faults import FAULTS
+            from .reliability.retry import RetryPolicy, retry_call
+
+            def _net_init():
+                FAULTS.fault_point("distributed.init")
+                return LGBM_NetworkInit(machines,
+                                        config.local_listen_port,
+                                        config.time_out,
+                                        config.num_machines)
+            # transient rendezvous failures (peers still starting,
+            # port in TIME_WAIT) retry with growing backoff for the
+            # reference's time_out budget (minutes, the reference's
+            # socket-timeout semantic) — the TIME budget governs the
+            # rendezvous patience, not the dispatch retry count
+            policy = RetryPolicy.from_config(config)
+            policy.budget_s = config.time_out * 60.0
+            retry_call(_net_init, seam="distributed.init",
+                       policy=policy)
     # input_model (continued training) seeds scores from raw data —
     # retain it in that case (reference CLI keeps data in memory too)
     train_set = Dataset(config.data, params=params,

@@ -231,6 +231,10 @@ class Config:
     output_freq: int = 1
     is_training_metric: bool = False
     snapshot_freq: int = -1
+    snapshot_keep: int = 2    # rolling retention for the
+    # <output_model>.snapshot_iter_N model snapshots: keep the newest
+    # N and delete older ones after each write (long runs used to
+    # accumulate unbounded snapshot files); 0 keeps everything
     sigmoid: float = 1.0
     boost_from_average: bool = True
     alpha: float = 0.9            # huber/quantile
@@ -513,6 +517,45 @@ class Config:
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
 
+    # -- reliability (new; no reference analog) --
+    checkpoint_freq: int = -1   # save a crash-safe FULL-training-state
+    # checkpoint every this many iterations (model + score cache +
+    # bagging/GOSS RNG streams + eval history + early-stopping state —
+    # docs/RELIABILITY.md): a run killed mid-train resumes from the
+    # newest valid checkpoint and produces byte-identical trees to an
+    # uninterrupted run.  -1 disables (the default); checkpoints are
+    # written atomically (tmp + fsync + rename) with a rolling
+    # retention of checkpoint_keep files
+    checkpoint_path: str = ""   # checkpoint file prefix (files are
+    # <prefix>_iter_N); "" derives <output_model>.ckpt
+    checkpoint_keep: int = 2    # rolling checkpoint retention: the
+    # newest N checkpoint files are kept, older ones deleted only
+    # AFTER the new one is durable — a crash mid-save always leaves a
+    # valid checkpoint behind
+    resume: str = "auto"        # resume policy when checkpointing is
+    # active: "auto" scans <checkpoint_path>_iter_* for the newest
+    # VALID checkpoint whose config/dataset fingerprint matches and
+    # continues from it (corrupt/truncated files are rejected loudly,
+    # falling back to the previous valid one); "off" always starts
+    # cold; an explicit file path resumes from exactly that checkpoint
+    # (and errors loudly if it is invalid)
+    dispatch_retries: int = 2   # bounded retries of TRANSIENT-
+    # classified errors (connection/timeout/UNAVAILABLE — never OOM,
+    # never real bugs) at the device-dispatch and distributed-init
+    # seams, with exponential backoff + jitter from retry_backoff_s
+    retry_backoff_s: float = 0.5  # base backoff delay; attempt k
+    # sleeps min(30, retry_backoff_s * 2^k) * uniform(1, 1.25)
+    oom_downshift: bool = True  # graceful degradation under
+    # RESOURCE_EXHAUSTED: the serving predictor halves its row
+    # bucket/chunk ladder and training halves the fused-chunk length
+    # instead of crashing the request or the job (warned once,
+    # counted in the oom_downshifts telemetry counter)
+    fault_plan: str = ""        # deterministic fault-injection plan
+    # (config-file form of the LTPU_FAULT_PLAN env var):
+    # "seam:nth:action[:xCount];..." raises/kills on the Nth call at a
+    # registered seam — the mechanism every recovery test drives its
+    # failures through (docs/RELIABILITY.md, fault-plan grammar)
+
     # free-form passthrough of unrecognized params (warned, kept for
     # echo; consumed wholesale through to_dict/model-file echo, never
     # by attribute)
@@ -530,6 +573,8 @@ class Config:
         _setup_compile_cache(self.compile_cache_dir)
         from .telemetry import apply_config as _telemetry_apply
         _telemetry_apply(self)
+        from .reliability.faults import apply_config as _faults_apply
+        _faults_apply(self)
 
     # ------------------------------------------------------------------
     def check(self):
@@ -588,6 +633,19 @@ class Config:
                              f"trace, got {self.telemetry!r}")
         if self.telemetry_retrace_warn < 1:
             raise ValueError("telemetry_retrace_warn must be >= 1")
+        if self.snapshot_keep < 0:
+            raise ValueError("snapshot_keep must be >= 0 (0 = keep all)")
+        if self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1")
+        if self.dispatch_retries < 0:
+            raise ValueError("dispatch_retries must be >= 0")
+        if self.retry_backoff_s < 0:
+            raise ValueError("retry_backoff_s must be >= 0")
+        if self.fault_plan:
+            # parse NOW so a typo'd plan fails the run instead of
+            # silently never injecting (a vacuous recovery test)
+            from .reliability.faults import parse_plan
+            parse_plan(self.fault_plan)
         ct = str(self.construct_threads).lower()
         if ct != "auto":
             try:

@@ -53,6 +53,11 @@ def register_file_scheme(scheme: str, opener) -> None:
 
 
 def _open(filename: str, mode: str):
+    # fault seam: every binary-cache read/write opens through here —
+    # injected IO errors exercise the loud-rejection paths without a
+    # real disk failure (docs/RELIABILITY.md, seam registry)
+    from .reliability.faults import FAULTS
+    FAULTS.fault_point("dataset.cache_io")
     if "://" in filename:
         scheme = filename.split("://", 1)[0].lower()
         op = _SCHEME_OPENERS.get(scheme)

@@ -7,6 +7,7 @@ reference's callback/early-stopping protocol.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -14,6 +15,8 @@ import numpy as np
 from .booster import Booster
 from .config import Config
 from .dataset import Dataset
+from .reliability import checkpoint as _ckpt
+from .reliability.retry import is_oom
 from .telemetry import TELEMETRY
 from .utils.log import Log
 
@@ -33,7 +36,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
           learning_rates: Optional[Union[Sequence[float],
                                          Callable]] = None,
           keep_training_booster: bool = False,
-          callbacks: Optional[Sequence[Callable]] = None) -> Booster:
+          callbacks: Optional[Sequence[Callable]] = None,
+          resume: Optional[Union[bool, str]] = None) -> Booster:
     """Train a gradient-boosted model (reference engine.py:18-229;
     parameter order follows the reference signature engine.py:18-24).
 
@@ -44,7 +48,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
     ``keep_training_booster=False`` (the reference default,
     engine.py:224-226) releases the training state after the final
     flush — the returned booster predicts and serves as ``init_model``
-    for continued training, but update() on it errors."""
+    for continued training, but update() on it errors.
+
+    ``resume`` (docs/RELIABILITY.md): ``None`` defers to
+    ``config.resume`` (default "auto" — scan for the newest valid
+    checkpoint when ``checkpoint_freq`` is active); ``False``/"off"
+    always starts cold; a string path resumes from exactly that
+    checkpoint file.  A resumed run continues FULL training state
+    (model, score cache, RNG streams, early-stopping bookkeeping) and
+    produces byte-identical trees to an uninterrupted run."""
     params = dict(params or {})
     if feature_name != "auto" and hasattr(train_set, "set_feature_name"):
         train_set.set_feature_name(feature_name)
@@ -115,18 +127,92 @@ def train(params: Dict[str, Any], train_set: Dataset,
                  else config.output_freq)
     show_eval = bool(verbose_eval)
 
-    # periodic model snapshots (reference gbdt.cpp:330-334 writes
-    # <output_model>.snapshot_iter_N every snapshot_freq iterations)
-    if config.snapshot_freq > 0 and config.output_model:
-        def _snapshot_cb(env):
-            it = env.iteration + 1
-            if it % config.snapshot_freq == 0:
-                env.model.save_model(
-                    f"{config.output_model}.snapshot_iter_{it}")
-        callbacks = list(callbacks or []) + [_snapshot_cb]
-
     if evals_result is not None:
         evals_result.clear()
+
+    # --- reliability wiring (docs/RELIABILITY.md) --------------------
+    # Periodic model snapshots (reference gbdt.cpp:330-334 writes
+    # <output_model>.snapshot_iter_N every snapshot_freq iterations)
+    # are handled INLINE in the loop, not as a callback: the callback
+    # form silently forced every snapshotting run to per-iteration
+    # dispatch (chunkable checks `not callbacks`), and wrote through a
+    # bare save_model a kill mid-write would tear.  Snapshots now go
+    # through the atomic writer with rolling retention, and fused
+    # chunks are CUT at snapshot/checkpoint boundaries instead of
+    # being disabled.
+    snap_on = config.snapshot_freq > 0 and bool(config.output_model)
+    ckpt_on = config.checkpoint_freq > 0
+    ckpt_prefix = config.checkpoint_path or \
+        (config.output_model or "LightGBM_model.txt") + ".ckpt"
+    if ckpt_on and not booster.gbdt.can_checkpoint():
+        Log.warning(
+            f"checkpoint_freq is set but boosting_type="
+            f"{config.boosting_type} training state does not "
+            "round-trip through checkpoints yet (gbdt/goss only); "
+            "continuing without checkpoints")
+        ckpt_on = False
+    if ckpt_on and (fobj is not None or feval is not None):
+        # a python callable has no stable identity to fingerprint: a
+        # rerun with an EDITED fobj/feval would silently adopt the old
+        # run's checkpoint and train a hybrid of two objectives
+        Log.warning(
+            "checkpoint_freq is set but custom fobj/feval callables "
+            "cannot be fingerprinted for safe resume; continuing "
+            "without checkpoints")
+        ckpt_on = False
+    # init_model identity rides the fingerprint: a continued-training
+    # run (seeded scores + foreign trees) and a fresh run must never
+    # adopt each other's checkpoints
+    init_key = (init_model if isinstance(init_model, str)
+                else "<booster>" if init_model is not None else "")
+    fingerprint = (_ckpt.training_fingerprint(config, train_set,
+                                              len(valid_sets), init_key)
+                   if ckpt_on else None)
+
+    def _save_checkpoint(it: int) -> bool:
+        """Full-state checkpoint at iteration ``it``; True when the
+        consumed no-split window says training is over."""
+        t0 = time.perf_counter()
+        span = TELEMETRY.start_span("checkpoint_save", iteration=it)
+        state, stopped = booster.gbdt.capture_state()
+        payload = {"iteration": it, "gbdt": state, "stopped": stopped,
+                   "evals_result": evals_result or {}}
+        path = _ckpt.save_rolling(ckpt_prefix, it, payload, fingerprint,
+                                  keep=config.checkpoint_keep)
+        TELEMETRY.end_span(span)
+        TELEMETRY.add("checkpoint_saves", 1)
+        TELEMETRY.add("checkpoint_save_ms",
+                      (time.perf_counter() - t0) * 1e3)
+        Log.debug(f"checkpoint saved: {path}")
+        return stopped
+
+    def _after_iterations(it: int, force: bool = False) -> bool:
+        """Snapshot/checkpoint work due once iteration count ``it`` is
+        reached (``force`` fires both regardless of the schedule —
+        the catch-up after an unaligned stretch); True when training
+        must stop."""
+        if snap_on and (force or it % config.snapshot_freq == 0):
+            booster.gbdt.flush_models()
+            _ckpt.atomic_write_text(
+                f"{config.output_model}.snapshot_iter_{it}",
+                booster.model_to_string())
+            _ckpt.prune_snapshots(config.output_model,
+                                  config.snapshot_keep)
+        if ckpt_on and (force or it % config.checkpoint_freq == 0):
+            return _save_checkpoint(it)
+        return False
+
+    def _boundary(it: int) -> Optional[int]:
+        """Iterations until the next periodic snapshot/checkpoint —
+        fused chunks are cut here so their boundaries LAND on the
+        snapshot/checkpoint schedule."""
+        nxt = None
+        for freq, on in ((config.snapshot_freq, snap_on),
+                         (config.checkpoint_freq, ckpt_on)):
+            if on:
+                b = freq - (it % freq)
+                nxt = b if nxt is None else min(nxt, b)
+        return nxt
 
     # headless stretches (no per-iteration callbacks/eval/early-stop
     # consumers) run as multi-iteration fused chunks: on a
@@ -154,9 +240,97 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     stopped_early = False
     iteration = 0
+
+    # --- resume (docs/RELIABILITY.md): continue from the newest valid
+    # checkpoint (auto) or an explicit checkpoint file ---------------
+    resume_arg = config.resume if resume is None else resume
+    if isinstance(resume_arg, bool):
+        resume_arg = "auto" if resume_arg else "off"
+    resume_arg = str(resume_arg or "off")
+    loaded = None
+    if resume_arg.lower() not in ("off", "false", "0", "none", ""):
+        if resume_arg.lower() == "auto":
+            if ckpt_on:
+                loaded = _ckpt.find_resume(ckpt_prefix, fingerprint,
+                                           max_iteration=num_boost_round)
+        else:
+            # explicit checkpoint path: invalid files error LOUDLY —
+            # the user named this exact file, silence would train a
+            # different model than they asked for
+            fp = fingerprint if fingerprint is not None else \
+                _ckpt.training_fingerprint(config, train_set,
+                                           len(valid_sets), init_key)
+            _fp, payload = _ckpt.read_checkpoint(resume_arg, fp)
+            loaded = (int(payload["iteration"]), payload, resume_arg)
+    if loaded is not None:
+        it0, payload, ck_path = loaded
+        span = TELEMETRY.start_span("checkpoint_resume", iteration=it0)
+        try:
+            booster.gbdt.restore_state(payload["gbdt"])
+        except _ckpt.CheckpointError as e:
+            TELEMETRY.end_span(span)
+            if resume_arg.lower() != "auto":
+                raise
+            Log.warning(f"cannot resume from {ck_path}: {e}; "
+                        "starting cold")
+        else:
+            TELEMETRY.end_span(span)
+            iteration = it0
+            if evals_result is not None:
+                evals_result.update(payload.get("evals_result") or {})
+            Log.info(f"Resumed training from checkpoint {ck_path} at "
+                     f"iteration {it0}")
+            if payload.get("stopped"):
+                # the checkpointed run had already detected end of
+                # training (no-split stop window): training further
+                # would grow no-gain trees past the detected end
+                Log.warning(
+                    "checkpoint marks the end of training (no leaves "
+                    "met the split requirements); not training "
+                    "further")
+                num_boost_round = min(num_boost_round, it0)
+
+    oom_warned = False
+
+    def _train_chunk_guarded(c: int):
+        """Dispatch one fused chunk with the OOM degradation ladder:
+        RESOURCE_EXHAUSTED halves the chunk length (down to 1) and
+        re-dispatches — trained trees are byte-identical at every
+        chunk length (test_packed_carry), so the downshift degrades
+        only dispatch amortization, never the model.  Returns
+        (stop, iterations_actually_dispatched)."""
+        nonlocal chunk_size, oom_warned
+        while True:
+            it0 = booster.gbdt.iter_
+            try:
+                return booster.gbdt.train_chunk(c), c
+            except Exception as e:
+                if not (config.oom_downshift and is_oom(e)) or c <= 1:
+                    raise
+                if booster.gbdt.iter_ != it0:
+                    # the OOM surfaced AFTER the chunk committed state
+                    # (async backend, late materialization at a fence
+                    # or the stop-window pull): scores/iter_ already
+                    # absorbed the poisoned chunk, so re-dispatching
+                    # would train on garbage — fail cleanly instead;
+                    # checkpoint resume is the recovery path for this
+                    raise
+                c = max(1, c // 2)
+                chunk_size = max(1, min(chunk_size, c))
+                TELEMETRY.add("oom_downshifts", 1)
+                if not oom_warned:
+                    oom_warned = True
+                    Log.warning(
+                        "RESOURCE_EXHAUSTED during fused-chunk "
+                        f"dispatch ({e}); downshifting dispatch_chunk "
+                        f"to {chunk_size} and continuing")
+
     train_span = TELEMETRY.start_span("train",
                                       num_boost_round=num_boost_round)
-    if chunkable and chunk_cfg in ("auto", "") and num_boost_round >= 60:
+    # tuner gate counts REMAINING iterations: a resumed run near its
+    # target must not spend (or overshoot with) probe chunks
+    if chunkable and chunk_cfg in ("auto", "") \
+            and num_boost_round - iteration >= 60:
         import jax
         if jax.default_backend() in ("tpu", "axon"):
             chunk_size, info = booster.gbdt.tune_dispatch_chunk()
@@ -170,24 +344,33 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     f"{info['slope_s_per_iter'] * 1e3:.4f} ms/iter·chunk,"
                     f" dispatch {info['dispatch_s'] * 1e3:.1f} ms -> "
                     f"chunk {chunk_size}")
+            if iteration > 0 and (snap_on or ckpt_on):
+                # the probe chunks trained real iterations without
+                # boundary alignment: write a catch-up snapshot/
+                # checkpoint so a preemption right after the probe
+                # window has something to resume from
+                _after_iterations(iteration, force=True)
     while iteration < num_boost_round:
         remaining = num_boost_round - iteration
-        if chunkable and remaining >= chunk_size:
-            stop = booster.gbdt.train_chunk(chunk_size)
-            iteration += chunk_size
-            if stop:
-                break
-            continue
-        if chunkable and 10 <= remaining < chunk_size:
-            # tail after a large (auto-picked) chunk: one odd-length
-            # chunk — a single extra compile — instead of up to
-            # chunk_size-1 per-iteration dispatches, each paying the
-            # RPC the chunking exists to amortize
-            stop = booster.gbdt.train_chunk(remaining)
-            iteration += remaining
-            if stop:
-                break
-            continue
+        if chunkable:
+            # chunk length: the configured size, capped by what's left
+            # and CUT at snapshot/checkpoint boundaries (a cut chunk
+            # repeats the same length every period, so it costs one
+            # extra compile total, not one per snapshot).  Tails of
+            # 10+ run as one odd-length chunk — a single extra compile
+            # instead of per-iteration dispatches, each paying the RPC
+            # the chunking exists to amortize.
+            c = min(chunk_size, remaining)
+            bound = _boundary(iteration)
+            cut = bound is not None and bound <= c
+            if cut:
+                c = bound
+            if c == chunk_size or cut or c >= 10:
+                stop, done = _train_chunk_guarded(c)
+                iteration += done
+                if stop or _after_iterations(iteration):
+                    break
+                continue
         if callbacks:
             for cb in callbacks:
                 if getattr(cb, "before_iteration", False):
@@ -237,6 +420,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             stopped_early = True
             break
         iteration += 1
+        if _after_iterations(iteration):
+            break
     if not stopped_early:
         booster.best_iteration = -1
     if booster.gbdt is not None:

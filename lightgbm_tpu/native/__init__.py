@@ -46,6 +46,11 @@ def _build() -> Optional[str]:
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
+    # fault seam: every native-lib entry resolves the handle through
+    # here, so an injected failure models a broken/unloadable .so at
+    # exactly one call site (docs/RELIABILITY.md, seam registry)
+    from ..reliability.faults import FAULTS
+    FAULTS.fault_point("native.entry")
     global _lib, _build_failed
     with _lock:
         if _lib is not None:

@@ -29,14 +29,32 @@ from ..utils.log import Log
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None,
+               config: Optional[Config] = None) -> None:
     """Join the multi-host rendezvous (reference Network::Init +
     Linkers ctor).  With no arguments, jax auto-detects the cluster
-    environment (TPU pod metadata / SLURM / env vars)."""
-    import jax
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    environment (TPU pod metadata / SLURM / env vars).
+
+    Transient rendezvous failures (coordinator still starting, DNS
+    races) retry with bounded backoff under the config's retry policy
+    and the reference ``time_out`` budget — the ``distributed.init``
+    seam in the fault harness (docs/RELIABILITY.md)."""
+    from ..reliability.faults import FAULTS
+    from ..reliability.retry import RetryPolicy, retry_call
+
+    def _init():
+        FAULTS.fault_point("distributed.init")
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+    if config is None:
+        policy = RetryPolicy()
+    else:
+        policy = RetryPolicy.from_config(config)
+        policy.budget_s = config.time_out * 60.0
+    retry_call(_init, seam="distributed.init", policy=policy)
 
 
 def sample_local_rows(local_data: np.ndarray, sample_cnt: int,
@@ -62,13 +80,28 @@ def sample_local_rows(local_data: np.ndarray, sample_cnt: int,
     return out
 
 
+def _allgather(arr: np.ndarray) -> np.ndarray:
+    """Host collective backend call — the ``collectives.allgather``
+    fault seam every gather in this module routes through (a preempted
+    peer surfaces here as an UNAVAILABLE RPC error).
+
+    Deliberately NO per-host retry: collectives are entered in
+    lockstep by every process, so one host re-entering alone would
+    either hang (no peer joins its retry) or pair with a peer's NEXT
+    collective and gather mismatched data.  A failed collective fails
+    the job loudly; recovery is job restart + checkpoint resume
+    (docs/RELIABILITY.md)."""
+    from ..reliability.faults import FAULTS
+    FAULTS.fault_point("collectives.allgather")
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
 def allgather_samples(local_sample: np.ndarray) -> np.ndarray:
     """(S, F+1) per-host padded sample -> (sum valid, F) combined
     sample, identical on every host (the redesign of the reference's
     per-feature serialized-mapper allgather)."""
-    from jax.experimental import multihost_utils
-    gathered = np.asarray(
-        multihost_utils.process_allgather(local_sample))
+    gathered = _allgather(local_sample)
     flat = gathered.reshape(-1, local_sample.shape[1])
     valid = flat[:, -1] > 0.5
     return flat[valid, :-1]
@@ -126,15 +159,13 @@ def finalize_global(ds):
     machine trains on its shard and histograms are reduce-scattered).
     """
     import jax
-    from jax.experimental import multihost_utils
 
     from ..dataset import Metadata
     nproc = jax.process_count()
     if nproc <= 1:
         return ds
     n_local = ds.num_data
-    counts = np.asarray(multihost_utils.process_allgather(
-        np.array([n_local], dtype=np.int64))).ravel()
+    counts = _allgather(np.array([n_local], dtype=np.int64)).ravel()
     if not (counts == counts[0]).all():
         Log.fatal("multi-host training requires equal row shards per "
                   f"host, got {counts.tolist()} — pad the tail shard")
@@ -143,20 +174,19 @@ def finalize_global(ds):
                   "yet — queries must not span hosts")
     n_global = int(counts.sum())
     md = Metadata(n_global)
-    md.label = np.asarray(multihost_utils.process_allgather(
-        np.ascontiguousarray(ds.metadata.label))).reshape(-1) \
+    md.label = _allgather(
+        np.ascontiguousarray(ds.metadata.label)).reshape(-1) \
         .astype(np.float32)
     if ds.metadata.weight is not None:
-        md.weight = np.asarray(multihost_utils.process_allgather(
-            np.ascontiguousarray(ds.metadata.weight))).reshape(-1) \
+        md.weight = _allgather(
+            np.ascontiguousarray(ds.metadata.weight)).reshape(-1) \
             .astype(np.float32)
     if ds.metadata.init_score is not None:
         # init_score is class-major per host ((K, n_local) flattened);
         # a naive concat would interleave hosts inside classes
         init_l = np.ascontiguousarray(ds.metadata.init_score)
         k = max(1, len(init_l) // n_local)
-        gathered = np.asarray(multihost_utils.process_allgather(
-            init_l)).reshape(nproc, k, n_local)
+        gathered = _allgather(init_l).reshape(nproc, k, n_local)
         md.init_score = np.transpose(gathered, (1, 0, 2)).reshape(-1)
     ds.metadata = md
     ds._mh_local_rows = n_local

@@ -1,0 +1,22 @@
+"""Fault-tolerance subsystem (docs/RELIABILITY.md).
+
+Four pieces, one package:
+
+- ``checkpoint``  — crash-safe full-training-state checkpoints
+  (versioned container, atomic writes, rolling retention,
+  fingerprinted resume; ``engine.train(resume=...)``).
+- ``faults``      — deterministic fault-injection harness: registered
+  seams + the ``LTPU_FAULT_PLAN`` plan grammar; every recovery test
+  drives its failure through this, never through sleeps or races.
+- ``retry``       — bounded exponential backoff + jitter around
+  transient-classified errors (dispatch + distributed-init seams).
+- OOM degradation lives at the call sites (``booster.py`` serving
+  ladder, ``engine.py`` chunk downshift) keyed on ``retry.is_oom``.
+"""
+from .checkpoint import (CheckpointError, atomic_write_text,  # noqa: F401
+                         find_resume, list_checkpoints, prune_snapshots,
+                         read_checkpoint, save_checkpoint, save_rolling,
+                         training_fingerprint)
+from .faults import FAULTS, FaultInjected, parse_plan  # noqa: F401
+from .retry import (RetryPolicy, is_oom, is_transient,  # noqa: F401
+                    retry_call)

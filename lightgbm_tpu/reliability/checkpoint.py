@@ -1,0 +1,318 @@
+"""Crash-safe training checkpoints: versioned container, atomic
+writes, rolling retention, fingerprinted resume.
+
+A SIGKILL mid-train used to lose everything except the lossy
+``snapshot_iter_N`` model text — model weights without the score
+cache, RNG streams or early-stopping bookkeeping, so a "resume" from
+one silently trains a DIFFERENT model.  A checkpoint here captures
+FULL training state (``GBDT.capture_state``): interrupted-then-resumed
+training produces byte-identical trees to an uninterrupted run, and
+``tests/test_reliability.py`` pins that equivalence with a real
+SIGKILL injected through the fault harness.
+
+Container layout (``docs/RELIABILITY.md``)::
+
+    offset  size  field
+    0       10    magic  b"LTPUCKPT1\\n"
+    10      4     schema version (u32 LE)
+    14      4     fingerprint length F (u32 LE)
+    18      F     fingerprint (ascii sha256 hexdigest of the config +
+                  dataset identity — resume refuses state from a
+                  different run setup)
+    18+F    8     payload length P (u64 LE)
+    26+F    P     payload (pickled state dict)
+    26+F+P  32    sha256 over bytes [0, 26+F+P)
+
+Every read validates magic, schema, both length fields and the
+trailing digest before unpickling; ANY violation raises
+``CheckpointError`` — a torn, truncated or bit-flipped file is
+rejected loudly and the resume scan falls back to the previous valid
+checkpoint.  Writes are atomic: tmp file in the same directory,
+flush + fsync, ``os.replace``, best-effort directory fsync — a crash
+at any instant leaves either the old file or the new one, never a
+hybrid.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+import re
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.log import Log
+from .faults import FAULTS
+
+MAGIC = b"LTPUCKPT1\n"
+SCHEMA_VERSION = 1
+# hard sanity bound on the pickled-state length field: a value past
+# this is a corrupted (or hostile) file, not a real training state
+_MAX_PAYLOAD_BYTES = 1 << 40
+
+
+class CheckpointError(Exception):
+    """A checkpoint file failed validation (magic/schema/length/
+    checksum/fingerprint) — the caller falls back or starts cold."""
+
+
+# ---------------------------------------------------------------------------
+# atomic writes (shared by checkpoints AND model snapshots)
+# ---------------------------------------------------------------------------
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # pragma: no cover - fs-dependent (e.g. NFS)
+        pass
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp-write -> flush -> fsync -> rename: a crash leaves either
+    the old file or the new file, never a torn hybrid."""
+    FAULTS.fault_point("checkpoint.io")
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """The atomic writer for model text (snapshots, final saves that
+    opt in) — ``save_model`` used to bare-``open`` and a kill mid-write
+    left a torn, unparseable model file."""
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# container read/write
+# ---------------------------------------------------------------------------
+def save_checkpoint(path: str, state: dict, fingerprint: str) -> int:
+    """Serialize ``state`` into the versioned container at ``path``
+    (atomically).  Returns bytes written."""
+    fp = fingerprint.encode("ascii")
+    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    blob = b"".join([
+        MAGIC,
+        struct.pack("<I", SCHEMA_VERSION),
+        struct.pack("<I", len(fp)), fp,
+        struct.pack("<Q", len(payload)), payload,
+    ])
+    blob += hashlib.sha256(blob).digest()
+    atomic_write_bytes(path, blob)
+    return len(blob)
+
+
+def read_checkpoint(path: str,
+                    expect_fingerprint: Optional[str] = None
+                    ) -> Tuple[str, dict]:
+    """Validate and load one checkpoint file.  Raises
+    ``CheckpointError`` naming the first violated invariant."""
+    FAULTS.fault_point("checkpoint.io")
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise CheckpointError(f"cannot read checkpoint {path}: {e}") \
+            from e
+    if len(blob) < len(MAGIC) + 4 + 4 + 8 + 32:
+        raise CheckpointError(f"{path}: truncated (only {len(blob)} "
+                              "bytes)")
+    if not blob.startswith(MAGIC):
+        raise CheckpointError(f"{path}: bad magic (not a checkpoint "
+                              "file)")
+    body, digest = blob[:-32], blob[-32:]
+    if hashlib.sha256(body).digest() != digest:
+        raise CheckpointError(f"{path}: checksum mismatch (torn or "
+                              "bit-flipped file)")
+    off = len(MAGIC)
+    (schema,) = struct.unpack_from("<I", body, off)
+    off += 4
+    if schema != SCHEMA_VERSION:
+        raise CheckpointError(f"{path}: schema version {schema} "
+                              f"(this build reads {SCHEMA_VERSION})")
+    (fp_len,) = struct.unpack_from("<I", body, off)
+    off += 4
+    if off + fp_len > len(body):
+        raise CheckpointError(f"{path}: fingerprint length {fp_len} "
+                              "exceeds file")
+    fingerprint = body[off:off + fp_len].decode("ascii", "replace")
+    off += fp_len
+    (p_len,) = struct.unpack_from("<Q", body, off)
+    off += 8
+    if p_len > _MAX_PAYLOAD_BYTES or off + p_len != len(body):
+        raise CheckpointError(f"{path}: payload length {p_len} does "
+                              "not match file size")
+    if expect_fingerprint is not None and \
+            fingerprint != expect_fingerprint:
+        raise CheckpointError(
+            f"{path}: fingerprint mismatch — checkpoint was written "
+            "by a different config/dataset (expected "
+            f"{expect_fingerprint[:12]}..., found "
+            f"{fingerprint[:12]}...)")
+    try:
+        state = pickle.loads(body[off:off + p_len])
+    except Exception as e:
+        raise CheckpointError(f"{path}: payload unpickle failed "
+                              f"({type(e).__name__}: {e})") from e
+    return fingerprint, state
+
+
+# ---------------------------------------------------------------------------
+# rolling files + resume scan
+# ---------------------------------------------------------------------------
+def checkpoint_file(prefix: str, iteration: int) -> str:
+    return f"{prefix}_iter_{int(iteration)}"
+
+
+def _iter_files(base: str, sep: str) -> List[Tuple[int, str]]:
+    """[(iteration, path)] newest-first for ``<base><sep><N>`` files
+    (ignores tmp files) — the one file-listing used by checkpoint
+    retention, snapshot retention and the resume scan."""
+    out = []
+    pat = re.compile(re.escape(os.path.basename(base))
+                     + re.escape(sep) + r"(\d+)$")
+    for path in glob.glob(glob.escape(base) + sep + "*"):
+        m = pat.match(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    out.sort(reverse=True)
+    return out
+
+
+def _prune(files: List[Tuple[int, str]], keep: int) -> None:
+    """Delete everything past the newest ``keep`` (keep<=0 keeps
+    all)."""
+    if keep <= 0:
+        return
+    for _it, old in files[keep:]:
+        try:
+            os.unlink(old)
+        except OSError:
+            pass
+
+
+def list_checkpoints(prefix: str) -> List[Tuple[int, str]]:
+    """[(iteration, path)] newest-first; ignores tmp files."""
+    return _iter_files(prefix, "_iter_")
+
+
+def save_rolling(prefix: str, iteration: int, state: dict,
+                 fingerprint: str, keep: int = 2) -> str:
+    """Write the iteration's checkpoint, then prune to the newest
+    ``keep`` files.  The new file is fully durable (fsync'd) BEFORE
+    any old one is deleted, so a crash inside this function always
+    leaves at least one valid checkpoint behind."""
+    path = checkpoint_file(prefix, iteration)
+    save_checkpoint(path, state, fingerprint)
+    _prune(list_checkpoints(prefix), keep)
+    return path
+
+
+def find_resume(prefix: str, fingerprint: str,
+                max_iteration: Optional[int] = None
+                ) -> Optional[Tuple[int, dict, str]]:
+    """Scan ``<prefix>_iter_*`` newest-first for the first VALID
+    checkpoint matching ``fingerprint``.  Corrupt/truncated/mismatched
+    files are rejected loudly (Log.warning) and the scan falls back to
+    the next-older candidate; returns None when nothing valid exists
+    (the caller starts cold).  ``max_iteration`` skips checkpoints
+    PAST the requested training target (a previous longer run) —
+    auto-resuming one would return more trees than asked for."""
+    for iteration, path in list_checkpoints(prefix):
+        if max_iteration is not None and iteration > max_iteration:
+            Log.warning(
+                f"skipping checkpoint {path}: iteration {iteration} is "
+                f"past the requested target {max_iteration} (resume "
+                "from it explicitly to keep the longer model)")
+            continue
+        try:
+            _fp, state = read_checkpoint(path, fingerprint)
+        except CheckpointError as e:
+            Log.warning(f"rejecting checkpoint: {e}; falling back to "
+                        "an older one")
+            continue
+        return iteration, state, path
+    return None
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + snapshot retention
+# ---------------------------------------------------------------------------
+# config fields that do NOT change what gets trained: IO paths, task
+# routing, serving/telemetry/reliability knobs, and the dispatch
+# chunking (chunk length is byte-parity pinned by test_packed_carry).
+# num_iterations is excluded deliberately so a run can be RESUMED WITH
+# A LARGER TARGET (extend training) from an existing checkpoint.
+_FP_EXCLUDE_EXACT = frozenset({
+    "task", "data", "valid_data", "input_model", "output_model",
+    "output_result", "convert_model", "convert_model_language",
+    "num_iterations", "verbose", "output_freq", "extra",
+    "machines", "machine_list_file", "local_listen_port", "time_out",
+    "compile_cache_dir", "dispatch_chunk", "force_pallas_interpret",
+    "num_iteration_predict", "num_threads", "construct_threads",
+    "is_save_binary_file", "binary_cache_v2", "native_binning",
+})
+_FP_EXCLUDE_PREFIX = ("telemetry", "predict_", "is_predict_",
+                      "pred_early_stop", "snapshot_", "checkpoint_",
+                      "resume", "fault_plan", "dispatch_retries",
+                      "retry_backoff", "oom_downshift")
+
+
+def training_fingerprint(config, dataset, num_valid: int = 0,
+                         init_model: str = "") -> str:
+    """sha256 identity of (training-relevant config) + (dataset
+    shape/binning/labels) + valid-set count + init-model identity.
+    Two runs with equal fingerprints train the same trees at every
+    iteration, so a checkpoint from one is resumable by the other.
+    ``init_model`` is the engine-level continued-training seed (path
+    string, or a marker for an in-memory booster): a run continued
+    FROM a previous model must never adopt a fresh run's checkpoint,
+    or vice versa — its scores and tree list start differently."""
+    import dataclasses as _dc
+    import zlib
+
+    import numpy as np
+    parts = []
+    for f in sorted(_dc.fields(config), key=lambda f: f.name):
+        name = f.name
+        if name in _FP_EXCLUDE_EXACT or \
+                any(name.startswith(p) for p in _FP_EXCLUDE_PREFIX):
+            continue
+        parts.append(f"{name}={getattr(config, name)!r}")
+    parts.append(f"num_data={dataset.num_data}")
+    parts.append(f"num_features={dataset.num_total_features}")
+    parts.append("feature_infos=" + " ".join(dataset.feature_infos()))
+    md = dataset.metadata
+    for field in ("label", "weight", "init_score"):
+        arr = getattr(md, field, None)
+        crc = 0 if arr is None else zlib.crc32(
+            np.ascontiguousarray(arr).tobytes())
+        parts.append(f"{field}_crc={crc:#x}")
+    qb = getattr(md, "query_boundaries", None)
+    parts.append("group_crc=%#x" % (0 if qb is None else zlib.crc32(
+        np.ascontiguousarray(qb).tobytes())))
+    parts.append(f"num_valid={num_valid}")
+    parts.append(f"init_model={init_model!r}")
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()
+
+
+def prune_snapshots(output_model: str, keep: int) -> None:
+    """Rolling retention for ``<output_model>.snapshot_iter_N`` model
+    snapshots (``snapshot_keep``; 0 keeps everything)."""
+    _prune(_iter_files(output_model, ".snapshot_iter_"), keep)

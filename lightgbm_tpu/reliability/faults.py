@@ -1,0 +1,226 @@
+"""Deterministic fault-injection harness.
+
+A production jax_graft stack must survive preempted workers, killed
+jobs, transient dispatch/RPC errors and memory pressure — and none of
+that machinery is trustworthy unless every recovery path can be
+EXERCISED on demand.  This module is the one mechanism all recovery
+tests share: named *seams* wrap the process's failure-prone
+boundaries (device dispatch, collectives, cache file IO, native-lib
+entry), and a declarative *fault plan* says exactly which call at
+which seam fails and how.  No sleeps, no signal races, no flaky
+timing — the Nth call at seam S fails, every time.
+
+Plan grammar (``LTPU_FAULT_PLAN`` env var or ``Config.fault_plan``)::
+
+    plan   := entry (';' entry)*
+    entry  := seam ':' nth ':' action [':x' count]
+    seam   := registered seam name (see SEAMS below)
+    nth    := 1-based call index at that seam
+    action := 'kill'            -- SIGKILL the process (no cleanup,
+                                   no atexit: the crash-consistency
+                                   ground truth for checkpoint tests)
+            | 'oom'             -- raise FaultInjected with a
+                                   RESOURCE_EXHAUSTED message (what
+                                   the OOM-degradation ladders key on)
+            | ExceptionName     -- a builtin exception class, e.g.
+                                   ConnectionError, TimeoutError,
+                                   OSError, RuntimeError
+    count  := consecutive calls that fire, starting at nth (default 1;
+              'x3' at nth=2 fails calls 2, 3 and 4 — how
+              retry-exhaustion tests outlast the retry budget)
+
+Example: ``gbdt.train_chunk:3:kill`` SIGKILLs the process the third
+time a fused training chunk is about to be dispatched;
+``predict.dispatch:1:oom;dataset.cache_io:2:OSError`` injects an OOM
+into the first serving dispatch and an OSError into the second
+binary-cache file open.
+
+Call counting starts when a plan is configured and is per-process;
+``FAULTS.reset()`` clears both plan and counters (tests).  With no
+plan configured every ``fault_point`` is a single attribute check —
+the production cost of the harness is one ``if``.
+"""
+from __future__ import annotations
+
+import builtins
+import os
+import signal
+import threading
+from typing import Dict, List, Optional
+
+from ..utils.log import Log
+
+# the STATIC seam registry: every fault_point call site names one of
+# these.  parse_plan hard-errors on any other name — the registry is
+# fixed at import, so an unknown seam is always a typo, and a typo'd
+# seam never fires (turning the recovery test it was written for into
+# a vacuous pass).  Adding a seam means adding it here AND at its
+# fault_point call site.
+SEAMS = (
+    "gbdt.train_chunk",      # fused multi-iteration dispatch enqueue
+    "gbdt.train_one_iter",   # per-iteration fused dispatch enqueue
+    "predict.dispatch",      # serving predictor device dispatch
+    "distributed.init",      # multi-machine rendezvous / network init
+    "collectives.allgather", # host-side collective backend calls
+    "dataset.cache_io",      # binary dataset cache file open (r/w)
+    "native.entry",          # native libltpu.so entry (load/build)
+    "checkpoint.io",         # checkpoint file open (r/w)
+)
+
+
+class FaultInjected(Exception):
+    """Raised by an injected fault whose action is not a builtin
+    exception name ('oom' and future synthetic actions)."""
+
+
+class _Entry:
+    __slots__ = ("seam", "nth", "action", "count", "exc_type")
+
+    def __init__(self, seam: str, nth: int, action: str, count: int):
+        self.seam = seam
+        self.nth = nth
+        self.action = action
+        self.count = count
+        self.exc_type = None
+        if action not in ("kill", "oom"):
+            exc = getattr(builtins, action, None)
+            if not (isinstance(exc, type)
+                    and issubclass(exc, BaseException)):
+                raise ValueError(
+                    f"fault plan action {action!r} is not 'kill', 'oom' "
+                    "or a builtin exception name")
+            self.exc_type = exc
+
+    def matches(self, n: int) -> bool:
+        return self.nth <= n < self.nth + self.count
+
+
+def parse_plan(spec: str) -> List[_Entry]:
+    """Parse the plan grammar; raises ValueError on malformed specs
+    (a silently-dropped fault plan would turn an injection test into
+    a vacuous pass)."""
+    entries: List[_Entry] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"fault plan entry {raw!r} must be "
+                "seam:nth:action[:xCount]")
+        seam, nth_s, action = parts[0].strip(), parts[1].strip(), \
+            parts[2].strip()
+        count = 1
+        if len(parts) == 4:
+            rep = parts[3].strip().lower()
+            if not rep.startswith("x") or not rep[1:].isdigit():
+                raise ValueError(
+                    f"fault plan repeat {parts[3]!r} must be xN")
+            count = int(rep[1:])
+        if not nth_s.isdigit() or int(nth_s) < 1:
+            raise ValueError(
+                f"fault plan call index {nth_s!r} must be a 1-based "
+                "integer")
+        if seam not in SEAMS:
+            # hard error, not a warning: the seam registry is static,
+            # so an unknown name is always a typo — and a typo'd seam
+            # never fires, turning the recovery test it was written
+            # for into a vacuous pass
+            raise ValueError(
+                f"fault plan names unknown seam {seam!r} (registered: "
+                f"{', '.join(SEAMS)})")
+        entries.append(_Entry(seam, int(nth_s), action, max(1, count)))
+    return entries
+
+
+class FaultInjector:
+    """Process-global injector (module singleton ``FAULTS``).  With no
+    plan configured, ``fault_point`` is one attribute check."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plan: List[_Entry] = []
+        self._counts: Dict[str, int] = {}
+        self.spec = ""
+        self.fired: List[dict] = []
+
+    @property
+    def active(self) -> bool:
+        return bool(self._plan)
+
+    def configure(self, spec: str) -> "FaultInjector":
+        """Arm ``spec``, restarting the per-seam call counters."""
+        with self._lock:
+            self._plan = parse_plan(spec)
+            self._counts = {}
+            self.spec = spec
+            self.fired = []
+        if self._plan:
+            Log.debug(f"fault plan armed: {spec}")
+        return self
+
+    def reset(self) -> None:
+        with self._lock:
+            self._plan = []
+            self._counts = {}
+            self.spec = ""
+            self.fired = []
+
+    def call_count(self, seam: str) -> int:
+        with self._lock:
+            return self._counts.get(seam, 0)
+
+    def fault_point(self, seam: str) -> None:
+        """Mark one call at ``seam``; acts if the armed plan says this
+        call fails.  Call BEFORE the seam's side effects so an injected
+        failure (or kill) leaves the state as if the call never
+        happened — that is what makes injected-crash tests a faithful
+        model of a real mid-call crash."""
+        if not self._plan:
+            return
+        with self._lock:
+            n = self._counts.get(seam, 0) + 1
+            self._counts[seam] = n
+            entry: Optional[_Entry] = None
+            for e in self._plan:
+                if e.seam == seam and e.matches(n):
+                    entry = e
+                    break
+            if entry is not None:
+                self.fired.append({"seam": seam, "call": n,
+                                   "action": entry.action})
+        if entry is None:
+            return
+        from ..telemetry import TELEMETRY
+        TELEMETRY.add("faults_injected", 1)
+        if entry.action == "kill":
+            Log.debug(f"fault plan: SIGKILL at seam {seam} call {n}")
+            os.kill(os.getpid(), signal.SIGKILL)
+        if entry.action == "oom":
+            raise FaultInjected(
+                f"RESOURCE_EXHAUSTED: out of memory (injected at seam "
+                f"{seam}, call {n})")
+        raise entry.exc_type(
+            f"injected at seam {seam}, call {n} (fault plan)")
+
+
+FAULTS = FaultInjector()
+
+_env_plan = os.environ.get("LTPU_FAULT_PLAN", "")
+if _env_plan:
+    FAULTS.configure(_env_plan)
+
+
+def apply_config(cfg) -> None:
+    """Arm ``Config.fault_plan`` (the config-file form of
+    LTPU_FAULT_PLAN).  An empty value leaves the env-armed plan alone
+    — internally-built default Configs must not disarm a test's
+    injection mid-run — and an UNCHANGED value is a no-op: the library
+    builds several Configs from one params dict (train + lazy dataset
+    construction), and re-arming would zero the per-seam call counters
+    mid-run, shifting the plan's Nth-call targeting.  Re-arm the same
+    spec freshly via ``FAULTS.configure`` directly."""
+    plan = str(getattr(cfg, "fault_plan", "") or "")
+    if plan and plan != FAULTS.spec:
+        FAULTS.configure(plan)

@@ -45,6 +45,12 @@ assert np.array_equal(np.asarray(load_binary(p).group_bins),
                       np.asarray(core.group_bins))
 print("construct cache-v2 smoke ok")
 EOF
+# reliability probe (round 12): checkpoint save overhead + one smoke
+# fault-plan recovery — a child run SIGKILLed mid-train through the
+# fault harness, auto-resumed, asserted byte-identical vs the cold
+# run; writes /tmp/lgbtpu_smoke/reliability.json for test_bench_smoke
+python scripts/reliability_probe.py /tmp/lgbtpu_smoke/reliability.json >&2
+test -s /tmp/lgbtpu_smoke/reliability.json
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \

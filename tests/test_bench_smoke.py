@@ -67,6 +67,22 @@ def test_bench_smoke_json_contract():
     assert set(c["thread_scaling"]) == {"1", "auto", "x"}
     # the anchor must be present or carry an explicit skip reason
     assert "local_ref" in c or "local_ref_skipped" in c
+    # reliability probe (round 12): checkpoint save overhead measured
+    # and the smoke fault-plan recovery (SIGKILL mid-train -> resume)
+    # byte-identical — scripts/reliability_probe.py, run in-line by
+    # bench_smoke.sh
+    with open("/tmp/lgbtpu_smoke/reliability.json") as f:
+        r = json.load(f)
+    for field in ("save_ms_per_snapshot", "checkpoint_saves",
+                  "cold_wall_s", "resume_wall_s",
+                  "resume_vs_cold_delta_s", "kill_returncode",
+                  "byte_identical", "kill_recovery"):
+        assert field in r, f"reliability probe missing {field}"
+    assert r["kill_recovery"] == "pass"
+    assert r["kill_returncode"] == -9, "harness must really SIGKILL"
+    assert r["byte_identical"] is True
+    assert r["checkpoint_saves"] >= 2
+    assert r["save_ms_per_snapshot"] > 0
 
 
 if __name__ == "__main__":

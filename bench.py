@@ -89,6 +89,10 @@ BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 900))
 # fresh anchor run (the finishing reserve a time-boxed anchor must
 # not eat into)
 ANCHOR_RESERVE_S = float(os.environ.get("BENCH_ANCHOR_RESERVE_S", 120))
+# wall-clock reserved for emitting the JSON + diagnostics after the
+# last admitted phase (round 8; hoisted to module scope in round 13
+# so the primary admission can read it too)
+FINISH_RESERVE_S = float(os.environ.get("BENCH_FINISH_RESERVE_S", 60))
 _T0 = time.time()
 
 LOCAL_REF_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -175,6 +179,20 @@ def validate_local_ref():
         if key == "_schema":          # documentation entry, not a record
             continue
         parts = str(key).split(":")
+        if parts[0] == "bench_wall":
+            # round-13 primary-admission record (this bench's OWN
+            # measured wall on this host, not a reference anchor):
+            # its key is bench_wall:host=<tag> and its payload the
+            # per-(row*iter) unit — own schema, own validation
+            fields = dict(p.split("=", 1) for p in parts[1:]
+                          if "=" in p)
+            if set(fields) != {"host", "nl", "mb"} \
+                    or not isinstance(rec, dict) \
+                    or "unit_s_per_row_iter" not in rec:
+                notes.append(f"bench_wall record {key!r}: schema "
+                             "drift — record ignored")
+                bad.add(key)
+            continue
         fields = {}
         ok_parse = len(parts) >= 2
         for p in parts[1:]:
@@ -1232,7 +1250,138 @@ def run_scale(rows, iters, params, check_f32, local_ref=False,
     return out
 
 
+def _bench_wall_key() -> str:
+    # keyed by workload shape like every other anchor: a unit measured
+    # at leaves=15/max_bin=31 (CI config) is off by the per-tree cost
+    # ratio for a 255/63 perf run — admission would then re-admit the
+    # exact overrun it exists to prevent
+    return (f"bench_wall:host={_host_tag()}:nl={NUM_LEAVES}"
+            f":mb={MAX_BIN}")
+
+
+def admit_primary(rows, iters):
+    """Round-13: the PRIMARY scale itself is budget-admitted (the r5
+    rc=124 record — BENCH_r05.json ``parsed: null`` — was a
+    measurement run escaping admission and blowing the outer driver
+    timeout; r8 budgeted every phase EXCEPT the first one).  The
+    estimate comes from this bench's own measured wall on this host,
+    persisted under the ``bench_wall:`` key in LOCAL_REF.json — the
+    first run on a host has no estimate and runs as configured, every
+    later run scales the primary rows DOWN to what the budget fits
+    (with a ``scaled_down_from`` note) instead of starting a run that
+    cannot finish.  Returns (admitted_rows, note-or-None)."""
+    rec = _local_ref_load().get(_bench_wall_key())
+    if _bench_wall_key() in _LOCAL_REF_BAD or not isinstance(rec, dict):
+        return rows, None
+    try:
+        unit = float(rec.get("unit_s_per_row_iter", 0) or 0)
+        fixed = float(rec.get("fixed_s", 0) or 0)
+    except (TypeError, ValueError):
+        return rows, None
+    if unit <= 0:
+        return rows, None
+    left = budget_left() - FINISH_RESERVE_S
+    est = fixed + 1.3 * unit * rows * iters
+    if est <= left:
+        return rows, None
+    rows_fit = int(max(0.0, left - fixed) / (1.3 * unit * max(iters, 1)))
+    # floor INSIDE the configured rows: max-then-min would scale a
+    # 2048-row primary UP to 4096 and mislabel it scaled_down_from
+    rows_fit = min(rows, max(4096, rows_fit))
+    note = (f"BENCH_BUDGET_S primary admission: est {est:.0f}s > "
+            f"{left:.0f}s left (unit {unit:.3g} s/(row*iter) measured "
+            f"on this host last run); rows {rows} -> {rows_fit}")
+    return rows_fit, note
+
+
+def _store_bench_wall(rows, iters, wall_s, compile_s) -> None:
+    """Persist the measured primary wall as the next run's admission
+    estimate (same-host only — the key carries the CPU model)."""
+    fixed = max(0.0, float(compile_s))
+    unit = max(wall_s - fixed, 1e-9) / max(rows * iters, 1)
+    _local_ref_store(_bench_wall_key(), {
+        "unit_s_per_row_iter": unit, "fixed_s": round(fixed, 3),
+        "rows": int(rows), "iters": int(iters),
+        "wall_s": round(wall_s, 3)})
+
+
+def run_scale_boxed(rows, iters, params, check_f32, local_ref,
+                    ref_iters, box_s, task):
+    """Run one scale point in a TIME-BOXED subprocess (round 13): once
+    admitted, a big measurement run used to be unkillable — if the
+    admission estimate was optimistic (10.5M-row construction is
+    superlinear under memory pressure) it blew the OUTER driver
+    timeout and the whole bench died rc=124 with ``parsed: null``
+    (BENCH_r05.json).  The box turns that worst case into a
+    skip-with-note record: the child is killed at the box, the parent
+    still emits its one-line JSON with rc 0.  ``BENCH_BIG_BOX_S``
+    overrides the box (ops/test hook)."""
+    import signal
+    import subprocess
+    box_s = max(3.0, float(os.environ.get("BENCH_BIG_BOX_S", box_s)))
+    env = dict(os.environ)
+    env["BENCH_CHILD_SCALE"] = json.dumps(
+        {"rows": int(rows), "iters": int(iters),
+         "check_f32": bool(check_f32), "local_ref": bool(local_ref),
+         "ref_iters": ref_iters})
+    env["BENCH_CHILD_PARAMS"] = json.dumps(params)
+    # own session/process GROUP: on box expiry the kill must reach the
+    # child's own subprocesses too (a fresh local_ref anchor spawns the
+    # reference binary — orphaning it would leave minutes of training
+    # burning CPU under every remaining bench phase, the exact
+    # contention the box exists to prevent)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=box_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        out, err = proc.communicate()
+        if err:
+            print(err, file=sys.stderr, end="")
+        return {"task": task, "rows": int(rows),
+                "skipped": f"scale run hit its {box_s:.0f}s time box "
+                           "(admission estimate too optimistic); the "
+                           "r5 rc=124 escape is contained to this "
+                           "skip note"}
+    if err:
+        print(err, file=sys.stderr, end="")
+    lines = [ln for ln in (out or "").strip().splitlines()
+             if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        return {"task": task, "rows": int(rows),
+                "skipped": f"scale child exited rc {proc.returncode}: "
+                           f"{(err or '')[-300:]}"}
+    try:
+        return json.loads(lines[-1])
+    except ValueError:
+        return {"task": task, "rows": int(rows),
+                "skipped": "scale child emitted unparseable output: "
+                           f"{lines[-1][:200]}"}
+
+
 def main():
+    # time-boxed child mode (run_scale_boxed): run ONE scale point and
+    # print its record as the single stdout JSON line
+    child = os.environ.get("BENCH_CHILD_SCALE")
+    if child:
+        spec = json.loads(child)
+        params = json.loads(os.environ["BENCH_CHILD_PARAMS"])
+        notes, bad = validate_local_ref()
+        _LOCAL_REF_NOTES.extend(notes)
+        _LOCAL_REF_BAD.update(bad)
+        out = run_scale(spec["rows"], spec["iters"], params,
+                        spec["check_f32"],
+                        local_ref=spec["local_ref"],
+                        ref_iters=spec.get("ref_iters"))
+        print(json.dumps(out))
+        return
+
     # the persistent compilation cache is wired by the library itself
     # (config.compile_cache_dir, default ~/.cache/lightgbm_tpu/jit) —
     # the first Config created below applies it and logs hit/miss
@@ -1266,11 +1415,26 @@ def main():
         print(f"LOCAL_REF validation: {n}", file=sys.stderr)
 
     check_f32 = os.environ.get("BENCH_SKIP_F32") != "1"
+    # round 13: the primary scale is budget-admitted too — scaled down
+    # against the bench_wall unit measured on this host last run
+    rows_primary, primary_note = admit_primary(BENCH_ROWS, BENCH_ITERS)
+    if primary_note:
+        print(f"primary admission: {primary_note}", file=sys.stderr)
     t_primary = time.time()
     primary = run_scale(
-        BENCH_ROWS, BENCH_ITERS, params, check_f32, local_ref=True,
+        rows_primary, BENCH_ITERS, params, check_f32, local_ref=True,
         slope_probe=os.environ.get("BENCH_SLOPE_PROBE", "1") != "0")
     primary_wall = max(time.time() - t_primary, 1e-3)
+    if primary_note:
+        primary["scaled_down_from"] = BENCH_ROWS
+        primary["budget_note"] = primary_note
+    if os.environ.get("BENCH_LOCAL_REF", "1") != "0":
+        # persist the measured wall as the next run's admission
+        # estimate — but never from the tiny-N smoke driver
+        # (BENCH_LOCAL_REF=0): its compile-dominated unit would make
+        # the next perf run scale down a primary that actually fits
+        _store_bench_wall(rows_primary, BENCH_ITERS, primary_wall,
+                          primary.get("compile_s", 0.0))
     scales = [primary]
 
     # ---- per-phase budget admission (round 8): every REMAINING phase
@@ -1280,8 +1444,9 @@ def main():
     # BENCH_r05.json parsed: null).  Estimates are deliberately
     # conservative (1.5x) — a phase that would overrun is scaled down
     # (big scale) or skipped WITH A NOTE, never started and killed.
-    FINISH_RESERVE_S = float(os.environ.get("BENCH_FINISH_RESERVE_S",
-                                            60))
+    # Round 13 closes the remaining escape: an ADMITTED big run is
+    # additionally time-boxed in a subprocess (run_scale_boxed), so an
+    # optimistic estimate degrades to a skip note instead of rc=124.
 
     def admit(task, est_s):
         """Remaining-budget admission for one phase; returns the skip
@@ -1293,30 +1458,36 @@ def main():
                 f"{left:.0f}s left")
 
     if os.environ.get("BENCH_BIG", "1") != "0" \
-            and BENCH_ROWS_BIG > BENCH_ROWS:
+            and BENCH_ROWS_BIG > rows_primary:
         # HIGGS true scale: the f32 accuracy gate already ran at the
         # primary scale (same kernels, same quantization); rerunning
         # two 10.5M trainings would double the bench wall for no new
         # information.
         # local_ref at true scale too (round-4 verdict #5: the 34.1x
         # 10.5M ratio was prose-only — capture it in the JSON record).
-        big_wall_unit = primary_wall * 1.5 / BENCH_ROWS  # s per row
+        # Unit is per (row * iter) — the r8 estimate silently assumed
+        # BENCH_ITERS_BIG == BENCH_ITERS
+        big_wall_unit = primary_wall * 1.5 \
+            / (rows_primary * max(BENCH_ITERS, 1))
         rows_big = BENCH_ROWS_BIG
-        note = admit("big", big_wall_unit * rows_big)
+        est = big_wall_unit * rows_big * max(BENCH_ITERS_BIG, 1)
+        note = admit("big", est)
         if note is not None:
             # scale the row count down to what the budget fits (floor
             # 2x primary — below that the point adds nothing)
             rows_fit = int((budget_left() - FINISH_RESERVE_S)
-                           / big_wall_unit)
-            rows_big = rows_fit if rows_fit >= 2 * BENCH_ROWS else 0
+                           / (big_wall_unit * max(BENCH_ITERS_BIG, 1)))
+            rows_big = rows_fit if rows_fit >= 2 * rows_primary else 0
         if rows_big:
-            s = run_scale(
+            box = max(10.0, budget_left() - FINISH_RESERVE_S)
+            s = run_scale_boxed(
                 rows_big, BENCH_ITERS_BIG, params, check_f32=False,
                 local_ref=os.environ.get("BENCH_LOCAL_REF_BIG",
                                          "1") != "0",
                 ref_iters=int(os.environ.get("BENCH_REF_ITERS_BIG",
-                                             10)))
-            if rows_big != BENCH_ROWS_BIG:
+                                             10)),
+                box_s=box, task="binary_big")
+            if rows_big != BENCH_ROWS_BIG and "skipped" not in s:
                 s["scaled_down_from"] = BENCH_ROWS_BIG
                 s["budget_note"] = note
             scales.append(s)
@@ -1329,7 +1500,7 @@ def main():
         # width factor: MS-LTR is 136 features vs the 28-feature
         # primary; anchors self-box against the remaining budget
         est = (primary_wall * 1.5 * (136 / 28)
-               * (ltr_rows * ltr_iters) / (BENCH_ROWS * BENCH_ITERS))
+               * (ltr_rows * ltr_iters) / (rows_primary * BENCH_ITERS))
         note = admit("lambdarank", est)
         if note is None:
             scales.append(run_ltr_scale())
@@ -1340,7 +1511,7 @@ def main():
         p_rows = int(os.environ.get("BENCH_PREDICT_TRAIN_ROWS", 200_000))
         p_iters = int(os.environ.get("BENCH_PREDICT_ITERS", 50))
         est = (primary_wall * 1.5
-               * (p_rows * p_iters) / (BENCH_ROWS * BENCH_ITERS)) + 30
+               * (p_rows * p_iters) / (rows_primary * BENCH_ITERS)) + 30
         note = admit("predict", est)
         if note is None:
             predict_block = run_predict_scale(params)
@@ -1372,7 +1543,7 @@ def main():
                        "skipped": "BENCH_BUDGET_S exhausted"})
 
     result = {
-        "metric": f"higgs_synth_{BENCH_ROWS//1000}k_{BENCH_ITERS}trees_s",
+        "metric": f"higgs_synth_{rows_primary//1000}k_{BENCH_ITERS}trees_s",
         "value": primary["value"],
         "unit": "s",
         "vs_baseline": primary["vs_baseline"],

@@ -185,6 +185,8 @@ class _ServingPredictor:
         pending.clear()
         self._oom_cap = max(1, bucket_rows // 2)
         tm.add("oom_downshifts", 1)
+        tm.flight.dump("oom_downshift", seam="predict.dispatch",
+                       bucket=bucket_rows, new_cap=self._oom_cap)
         if not self._oom_warned:
             self._oom_warned = True
             Log.warning(
@@ -202,7 +204,14 @@ class _ServingPredictor:
         counters count requests, scored vs masked-tail pad rows, and
         bucket hit/miss — a MISS is a dispatch that triggered a new jit
         trace (== an XLA compilation, the ``test_predict_cache`` ground
-        truth), everything else is a compiled-program hit."""
+        truth), everything else is a compiled-program hit.  Latency
+        lands in the fixed log-bucket histograms any scraper derives
+        p50/p95/p99 from: ``predict_latency_ms`` (whole request),
+        ``predict_drain_ms`` (per-chunk result wait — the double-buffer
+        "bucket wait") and ``predict_queue_depth`` (chunks in flight at
+        each dispatch)."""
+        import time
+
         import jax.numpy as jnp
 
         from .ops import predict as P
@@ -212,6 +221,7 @@ class _ServingPredictor:
         n = data.shape[0]
         if n == 0:
             return np.zeros((0, self.num_class))
+        t0 = time.perf_counter() if tm.on else 0.0
         span = tm.start_span("predict", rows=n)
         try:
             return self._call_impl(data, n, jnp, P, tm)
@@ -219,6 +229,9 @@ class _ServingPredictor:
             # the ladder's re-raise paths (non-OOM errors, OOM at
             # bucket 1) must not leave the request span unrecorded
             tm.end_span(span)
+            if tm.on:
+                tm.observe("predict_latency_ms",
+                           (time.perf_counter() - t0) * 1e3)
 
     def _call_impl(self, data, n, jnp, P, tm) -> np.ndarray:
         if tm.on:
@@ -234,9 +247,16 @@ class _ServingPredictor:
         pending: list = []
 
         def drain(slot):
+            import time
             dev, s, m = slot
+            t0 = time.perf_counter() if tm.on else 0.0
             with tm.span("predict_drain"):
                 out[s:s + m] = np.asarray(dev)[:m]
+            if tm.on:
+                # the double-buffer wait: on an async backend this is
+                # where the request actually waits on the device
+                tm.observe("predict_drain_ms",
+                           (time.perf_counter() - t0) * 1e3)
 
         s = 0
         while s < n or pending:
@@ -293,6 +313,9 @@ class _ServingPredictor:
             pending.append((dev, s, m))
             if tm.on:
                 tm.gauge_max("predict_stream_depth", len(pending))
+                from .telemetry import DEPTH_BOUNDS
+                tm.observe("predict_queue_depth", len(pending),
+                           bounds=DEPTH_BOUNDS)
             s += m
         if tm.on:
             tm.sample_memory()

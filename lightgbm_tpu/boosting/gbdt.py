@@ -685,6 +685,12 @@ class GBDT:
             tm.end_span(span)
             raise
         tm.end_span(span)
+        if tm.on and self.grower.policy.nproc > 1:
+            # per-host step wall -> fleet max/min/mean + straggler
+            # ratio via a tiny allgather (all hosts run this SPMD
+            # loop in lockstep, so the collective is safe here)
+            from ..parallel.monitor import record_step_wall
+            record_step_wall(time.perf_counter() - t0)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
             vs.scores = s
@@ -845,6 +851,9 @@ class GBDT:
             tm.end_span(span)
             raise
         tm.end_span(span)
+        if tm.on and self.grower.policy.nproc > 1:
+            from ..parallel.monitor import record_step_wall
+            record_step_wall(time.perf_counter() - t0)
         self.scores = scores
         for vs, s in zip(self.valid_sets, vscores):
             vs.scores = s

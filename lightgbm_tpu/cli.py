@@ -60,9 +60,17 @@ def run(argv: List[str]) -> int:
     if TELEMETRY.on and config.telemetry_out:
         # explicit export at task end (the atexit hook is only the
         # safety net): telemetry=trace telemetry_out=/tmp/run writes
-        # /tmp/run.jsonl + /tmp/run.perfetto.json (ui.perfetto.dev)
+        # /tmp/run.jsonl + /tmp/run.perfetto.json (ui.perfetto.dev);
+        # multi-host runs write per-host .host<i> shards — merge with
+        # `python -m lightgbm_tpu.telemetry merge`
         paths = TELEMETRY.export(config.telemetry_out)
         Log.info("telemetry written: " + ", ".join(paths))
+    if TELEMETRY.on and config.telemetry_prom_out:
+        # Prometheus textfile (node-exporter textfile-collector
+        # pattern): serving latency histograms + counters/gauges in
+        # scrape format (docs/OBSERVABILITY.md, Prometheus export)
+        Log.info("prometheus metrics written: "
+                 + TELEMETRY.write_prom(config.telemetry_prom_out))
     return 0
 
 

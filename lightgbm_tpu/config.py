@@ -514,6 +514,24 @@ class Config:
     # so shape churn past the serving bucket ladder is a production
     # latency bug.  Counts are exported either way; the guard itself
     # is active even at telemetry=off (trace-time cost only)
+    telemetry_prom_out: str = ""    # Prometheus text-format export
+    # path (the node-exporter textfile-collector pattern): counters,
+    # numeric gauges and the serving latency histograms are written
+    # atomically at CLI task end / process exit so any scraper can
+    # derive p50/p95/p99 from the cumulative buckets — no new
+    # dependencies, stdlib only (docs/OBSERVABILITY.md, Prometheus
+    # export).  "" disables
+    telemetry_http_port: int = 0    # stdlib HTTP scrape endpoint on
+    # 127.0.0.1: GET /metrics returns the Prometheus text format, GET
+    # /healthz a JSON liveness body — the serving path becomes
+    # scrapeable without a sidecar.  0 disables (the default); the
+    # server is a daemon thread started by the first enabling Config
+    flight_recorder_out: str = ""   # crash flight recorder
+    # (docs/OBSERVABILITY.md): arm a bounded ring of recent
+    # span/counter/log events that the reliability layer dumps to
+    # <prefix>-<ns>.flight.json on injected faults, retry exhaustion,
+    # OOM downshift or unhandled exception — the last-N telemetry
+    # events correlated with the fault seam that fired.  "" disables
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
 
@@ -633,6 +651,9 @@ class Config:
                              f"trace, got {self.telemetry!r}")
         if self.telemetry_retrace_warn < 1:
             raise ValueError("telemetry_retrace_warn must be >= 1")
+        if not (0 <= self.telemetry_http_port <= 65535):
+            raise ValueError("telemetry_http_port must be in [0, "
+                             "65535] (0 = disabled)")
         if self.snapshot_keep < 0:
             raise ValueError("snapshot_keep must be >= 0 (0 = keep all)")
         if self.checkpoint_keep < 1:

@@ -55,6 +55,11 @@ def initialize(coordinator_address: Optional[str] = None,
         policy = RetryPolicy.from_config(config)
         policy.budget_s = config.time_out * 60.0
     retry_call(_init, seam="distributed.init", policy=policy)
+    # the rendezvous barrier exits near-simultaneously on every host:
+    # mark it as the clock-sync anchor the cross-host trace merge
+    # aligns shards on (docs/OBSERVABILITY.md, trace merge)
+    from ..telemetry import TELEMETRY
+    TELEMETRY.mark_sync("rendezvous")
 
 
 def sample_local_rows(local_data: np.ndarray, sample_cnt: int,
@@ -90,11 +95,31 @@ def _allgather(arr: np.ndarray) -> np.ndarray:
     either hang (no peer joins its retry) or pair with a peer's NEXT
     collective and gather mismatched data.  A failed collective fails
     the job loudly; recovery is job restart + checkpoint resume
-    (docs/RELIABILITY.md)."""
+    (docs/RELIABILITY.md).
+
+    Unlike the in-program collectives (trace-time byte accounting
+    only), this call BLOCKS the host, so its wall is a true fenced
+    collective latency: counted in ``collective_host_allgather_*``
+    and observed into the ``collective_host_allgather_ms``
+    histogram."""
+    import time
+
     from ..reliability.faults import FAULTS
+    from ..telemetry import TELEMETRY as tm
     FAULTS.fault_point("collectives.allgather")
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(arr))
+    t0 = time.perf_counter() if tm.on else 0.0
+    with tm.span("collective_allgather"):
+        out = np.asarray(multihost_utils.process_allgather(arr))
+    if tm.on:
+        # bytes as a counter; latency ONLY as the histogram — its
+        # _sum/_count already carry total wall and call count, and a
+        # same-named counter would collide with the histogram family
+        # in the Prometheus exposition
+        tm.add("collective_host_allgather_bytes", int(out.nbytes))
+        tm.observe("collective_host_allgather_ms",
+                   (time.perf_counter() - t0) * 1e3)
+    return out
 
 
 def allgather_samples(local_sample: np.ndarray) -> np.ndarray:

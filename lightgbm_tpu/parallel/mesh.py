@@ -79,6 +79,16 @@ class ShardingPolicy:
         # shards (device_put of a full array cannot address other
         # hosts' devices)
         self.multihost = mesh is not None and self.nproc > 1
+        from ..telemetry import TELEMETRY
+        if TELEMETRY.on and mesh is not None:
+            # topology gauges: a scraped metrics page should say what
+            # fabric the run is on without reading logs
+            TELEMETRY.gauge("mesh_devices", int(mesh.size))
+            TELEMETRY.gauge("mesh_hosts", int(self.nproc))
+            TELEMETRY.gauge("mesh_axes",
+                            ",".join(f"{a}={n}" for a, n in
+                                     zip(mesh.axis_names,
+                                         mesh.devices.shape)))
         if mesh is None:
             self.row_spec = None
             self.hist_spec = None

@@ -194,6 +194,12 @@ class FaultInjector:
             return
         from ..telemetry import TELEMETRY
         TELEMETRY.add("faults_injected", 1)
+        # crash flight recorder (docs/OBSERVABILITY.md): every fired
+        # fault dumps the last-N telemetry/log events tagged with THIS
+        # seam — for 'kill' the dump lands BEFORE the SIGKILL, which is
+        # the whole point: the only trace a kill leaves behind
+        TELEMETRY.flight.dump(f"fault:{entry.action}", seam=seam,
+                              call=n)
         if entry.action == "kill":
             Log.debug(f"fault plan: SIGKILL at seam {seam} call {n}")
             os.kill(os.getpid(), signal.SIGKILL)

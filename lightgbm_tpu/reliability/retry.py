@@ -110,6 +110,12 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
                 raise
             if policy.budget_s is None:
                 if attempt >= policy.max_retries:
+                    # retry budget exhausted: flight-dump the last-N
+                    # telemetry events before re-raising the original
+                    # error (no-op unless the recorder is armed)
+                    TELEMETRY.flight.dump("retry_exhausted", seam=seam,
+                                          attempts=attempt + 1,
+                                          error=repr(e)[:300])
                     raise
                 d = policy.delay(attempt, rng)
             else:
@@ -118,6 +124,9 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
                 # cannot hot-spin the budget away
                 d = max(policy.delay(attempt, rng), 0.05)
                 if spent + d > policy.budget_s:
+                    TELEMETRY.flight.dump("retry_exhausted", seam=seam,
+                                          attempts=attempt + 1,
+                                          error=repr(e)[:300])
                     raise
             TELEMETRY.add("retries", 1)
             bound = (f"{policy.budget_s:.0f}s budget"

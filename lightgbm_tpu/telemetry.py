@@ -53,17 +53,40 @@ in a streaming load the ``parse`` spans live on the producer thread
 and visibly overlap the consumer's ``bin`` spans in the Perfetto
 view, which is exactly the pipelining the round-11 construct bench
 series tracks.
+
+Round-13 distributed/production surface (docs/OBSERVABILITY.md):
+
+- **Histograms** (``observe``): fixed log-spaced-bucket latency/depth
+  histograms (Prometheus ``le`` semantics) so any scraper can derive
+  p50/p95/p99 without the process keeping raw samples.
+- **Prometheus export** (``to_prometheus``/``write_prom``/
+  ``serve_metrics``): stdlib-only text-format writer — a node-exporter
+  style textfile (``Config.telemetry_prom_out``) and an optional
+  ``/metrics`` + ``/healthz`` HTTP endpoint
+  (``Config.telemetry_http_port``).
+- **Cross-host trace shards** (``export`` tags every file with
+  ``(host_id, run_id)`` and a rendezvous clock-sync mark) merged by
+  ``python -m lightgbm_tpu.telemetry merge`` into ONE Perfetto
+  timeline with one track lane per host.
+- **Crash flight recorder** (``flight``): a bounded ring of recent
+  span/counter/log events, dumped to a timestamped JSON by the
+  reliability layer on injected faults, retry exhaustion, OOM
+  downshift or unhandled exception
+  (``Config.flight_recorder_out``).
 """
 from __future__ import annotations
 
 import atexit
+import bisect
+import collections
 import json
 import os
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from .utils.log import Log
+from .utils import log as _log_mod
 
 MODES = ("off", "counters", "spans", "trace")
 _OFF, _COUNTERS, _SPANS, _TRACE = range(4)
@@ -72,6 +95,200 @@ _OFF, _COUNTERS, _SPANS, _TRACE = range(4)
 # not grow its heap linearly in requests.  Overflow increments the
 # ``events_dropped`` counter instead of silently truncating.
 MAX_EVENTS = 500_000
+
+# log-spaced histogram bucket spec (docs/OBSERVABILITY.md): upper
+# bounds 0.05ms * 2^i for i in 0..20 (~0.05 ms .. ~52 s) + an implicit
+# +Inf overflow bucket.  Fixed power-of-two spacing means every host
+# and every process bins identically, so shard histograms are
+# mergeable by bucket-wise addition and any scraper can derive
+# p50/p95/p99 from the cumulative counts.
+LATENCY_BOUNDS_MS = tuple(0.05 * (1 << i) for i in range(21))
+# small-integer bound spec for depth/occupancy histograms
+DEPTH_BOUNDS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+
+# prometheus metric name prefix (docs/OBSERVABILITY.md name mapping:
+# counter `x` -> `ltpu_x_total`, gauge `x` -> `ltpu_x`, histogram `x`
+# -> `ltpu_x_bucket{le=...}` / `ltpu_x_sum` / `ltpu_x_count`)
+PROM_PREFIX = "ltpu_"
+
+# flight-recorder ring capacity (events, not bytes): the last-N
+# span/counter/log events correlated with a fault
+FLIGHT_EVENTS = 512
+
+
+class _Hist:
+    """Fixed-bucket histogram, Prometheus ``le`` semantics: bucket i
+    counts observations <= bounds[i]; the trailing slot is +Inf."""
+
+    __slots__ = ("bounds", "counts", "total", "count")
+
+    def __init__(self, bounds):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        # bisect_left: a value exactly on a bound lands in that
+        # bound's bucket (<= semantics)
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.total += value
+        self.count += 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"bounds": list(self.bounds), "counts": list(self.counts),
+                "sum": round(self.total, 6), "count": self.count}
+
+
+def hist_quantile(h: Dict[str, Any], q: float) -> float:
+    """Quantile from a histogram dict (``snapshot()["histograms"]``
+    entry): the upper bound of the bucket where the cumulative count
+    first reaches ``q * count`` (conservative — the true quantile is
+    <= the returned bound; +Inf for the overflow bucket).  A scraper
+    reads the SAME cumulative ``_bucket`` series, so it lands in the
+    same bucket; note PromQL's ``histogram_quantile`` additionally
+    interpolates linearly WITHIN that bucket, so its estimate can sit
+    below this bound by up to one bucket width (a factor-2 spacing
+    here)."""
+    total = h["count"]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(h["counts"]):
+        cum += c
+        if cum >= target:
+            bounds = h["bounds"]
+            return float(bounds[i]) if i < len(bounds) else float("inf")
+    return float("inf")  # pragma: no cover - cum always reaches total
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_"
+                  for c in str(name))
+    if out and out[0].isdigit():
+        out = "_" + out
+    return PROM_PREFIX + out
+
+
+def _fmt_val(v: float) -> str:
+    """Full-precision sample rendering: '%g' would truncate to 6
+    significant digits, silently flattening large byte/row counters
+    (a 12,345,678-row counter scraping as 1.23457e+07 makes
+    scrape-to-scrape rate() read zero then jump)."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2 ** 63:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_le(bound: float) -> str:
+    """Prometheus le label: integral bounds print bare, others with
+    enough digits to round-trip."""
+    if bound == float("inf"):
+        return "+Inf"
+    if float(bound).is_integer():
+        return str(int(bound))
+    return repr(float(bound))
+
+
+class FlightRecorder:
+    """Bounded ring of recent telemetry/log events + the dump that
+    correlates them with the fault seam that fired (the crash flight
+    recorder, docs/OBSERVABILITY.md).  Disarmed (the default) every
+    hook is one attribute check; arming (``Config.flight_recorder_out``)
+    starts recording and installs an unhandled-exception dump hook."""
+
+    def __init__(self, maxlen: int = FLIGHT_EVENTS):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=maxlen)
+        self.out = ""
+        self.dumps: List[str] = []
+        self._hook_installed = False
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.out)
+
+    def arm(self, out_prefix: str) -> "FlightRecorder":
+        self.out = str(out_prefix)
+        if not self._hook_installed:
+            self._hook_installed = True
+            _log_mod.set_sink(self._log_sink)
+            import sys
+            prev = sys.excepthook
+
+            def _hook(exc_type, exc, tb):  # pragma: no cover - crash path
+                try:
+                    self.dump(f"unhandled:{exc_type.__name__}",
+                              detail=str(exc)[:500])
+                except Exception:
+                    pass
+                prev(exc_type, exc, tb)
+            sys.excepthook = _hook
+        return self
+
+    def disarm(self) -> None:
+        with self._lock:
+            self.out = ""
+            self._ring.clear()
+            self.dumps = []
+
+    def _log_sink(self, tag: str, msg: str) -> None:
+        self.note("log", tag, msg=msg[:300])
+
+    def note(self, kind: str, name: str, **detail) -> None:
+        if not self.out:
+            return
+        with self._lock:
+            self._ring.append((time.time(), kind, name,  # lint: disable=TRC001(flight-recorder wall-clock stamp: host observability only, never read by traced code)
+                               detail or None))
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            ring = list(self._ring)
+        return [{"ts_unix": round(ts, 6), "kind": kind, "name": name,
+                 **({"detail": det} if det else {})}
+                for ts, kind, name, det in ring]
+
+    def dump(self, reason: str, seam: str = "", **extra) -> Optional[str]:
+        """Write the flight dump (timestamped JSON next to ``out``);
+        returns the path, or None when disarmed."""
+        if not self.out:
+            return None
+        tm = TELEMETRY
+        ns = time.time_ns()
+        payload = {
+            "reason": reason,
+            "seam": seam,
+            "unix_ts": ns / 1e9,
+            "run_id": tm.run_id,
+            "host_id": tm.host(),
+            "pid": os.getpid(),
+            "events": self.events(),
+            "counters": tm.counters(),
+            "gauges": tm.gauges(),
+            "retraces": tm.retraces(),
+        }
+        if extra:
+            payload.update(extra)
+        path = f"{self.out}-{ns}.flight.json"
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=1)
+            os.replace(tmp, path)
+        except OSError as e:  # pragma: no cover - fs-dependent
+            Log.warning(f"flight recorder dump failed: {e}")
+            return None
+        self.dumps.append(path)
+        Log.warning(f"flight recorder: {reason}"
+                    + (f" at seam {seam}" if seam else "")
+                    + f" — dumped {path}")
+        return path
 
 
 class _NullCtx:
@@ -123,17 +340,31 @@ class Telemetry:
         self._lock = threading.RLock()
         self._tls = threading.local()
         self._t0 = time.perf_counter()
+        # wall-clock anchor for t0: lets the merge tool (and humans)
+        # place the relative timestamps on an absolute timeline
+        self._t0_unix = time.time()
         self.mode = _OFF
         self.out = ""
+        self.prom_out = ""
         self.retrace_warn = 8
         self._fence = False
         self._fence_suspended = 0
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, Any] = {}
+        self._hists: Dict[str, _Hist] = {}
         self._events: list = []          # (name, t0, dur, tid, depth, attrs)
         self._traces: Dict[str, set] = {}
         self._retrace_warned: set = set()
         self._atexit_armed = False
+        # cross-host identity: host_id resolves lazily (env override
+        # LTPU_HOST_ID, else jax.process_index() IF jax is already
+        # imported — a pure-host tool must not boot a backend);
+        # run_id is stamped at first configure
+        self.host_id: Optional[int] = None
+        self.run_id = ""
+        self._sync: Optional[tuple] = None   # (name, rel_ts_s)
+        self.flight = FlightRecorder()
+        self._http = None
 
     # -- configuration -------------------------------------------------
     def configure(self, mode: str = "counters", out: str = "",
@@ -147,6 +378,9 @@ class Telemetry:
                              f"got {mode!r}")
         with self._lock:
             self.mode = MODES.index(mode)
+            if not self.run_id:
+                import uuid
+                self.run_id = uuid.uuid4().hex[:12]
             self._fence = (self.mode >= _SPANS) if fence is None \
                 else bool(fence)
             if retrace_warn is not None:
@@ -164,10 +398,13 @@ class Telemetry:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
             self._events = []
             self._traces.clear()
             self._retrace_warned.clear()
+            self._sync = None
             self._t0 = time.perf_counter()
+            self._t0_unix = time.time()
 
     @property
     def on(self) -> bool:
@@ -180,6 +417,68 @@ class Telemetry:
     @property
     def level(self) -> str:
         return MODES[self.mode]
+
+    # -- cross-host identity -------------------------------------------
+    @staticmethod
+    def _distributed_state():
+        """jax's multi-process rendezvous state WITHOUT booting a
+        backend: ``jax.process_index()`` would initialize XLA (fatal
+        before ``jax.distributed.initialize``, and a /metrics scrape
+        can land in that window), so read the distributed global state
+        directly.  Returns (process_id, num_processes, initialized)."""
+        import sys
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return 0, 1, False
+        try:
+            from jax._src import distributed as _dist
+            st = _dist.global_state
+            return (int(getattr(st, "process_id", 0) or 0),
+                    int(getattr(st, "num_processes", 1) or 1),
+                    getattr(st, "client", None) is not None)
+        except Exception:  # pragma: no cover - jax-version-dependent
+            return 0, 1, False
+
+    def host(self) -> int:
+        """This process's host id for trace-shard tagging:
+        ``LTPU_HOST_ID`` env override (tests, external launchers), else
+        the ``jax.distributed`` process id.  The id is only CACHED once
+        it is authoritative (env override, or the rendezvous client
+        exists) — a pre-rendezvous call must not latch host 0 onto
+        every process of a fleet that has not initialized yet."""
+        if self.host_id is not None:
+            return self.host_id
+        env = os.environ.get("LTPU_HOST_ID")
+        if env is not None:
+            self.host_id = int(env)
+            return self.host_id
+        pid, _n, initialized = self._distributed_state()
+        if initialized:
+            self.host_id = pid
+            return self.host_id
+        return pid  # uncached: may resolve differently after rendezvous
+
+    def _n_hosts(self) -> int:
+        env = os.environ.get("LTPU_NUM_HOSTS")
+        if env is not None:
+            return max(1, int(env))
+        return max(1, self._distributed_state()[1])
+
+    def mark_sync(self, name: str = "rendezvous") -> None:
+        """Record the clock-sync marker the cross-host merge aligns
+        shards on: the multi-host rendezvous is a barrier every
+        process exits near-simultaneously, so shifting each shard's
+        clock to make its marker coincide with host 0's puts all
+        hosts on one timeline (docs/OBSERVABILITY.md, trace merge).
+        Recorded as a zero-duration event whenever telemetry is on
+        (counters mode included — the marker is one event, not a
+        span stream)."""
+        if self.mode < _COUNTERS:
+            return
+        ts = time.perf_counter()
+        with self._lock:
+            self._sync = (name, ts - self._t0)
+        self._record(name, ts, 0.0, 0, None)
 
     # -- spans ---------------------------------------------------------
     def _stack(self):
@@ -212,6 +511,8 @@ class Telemetry:
         self._record(name, t0, time.perf_counter() - t0, 0, attrs)
 
     def _record(self, name, t0, dur, depth, attrs):
+        if self.flight.out:
+            self.flight.note("span", name, dur_ms=round(dur * 1e3, 3))
         with self._lock:
             if len(self._events) >= MAX_EVENTS:
                 self._counters["events_dropped"] = \
@@ -230,6 +531,8 @@ class Telemetry:
     def add(self, name: str, value: float = 1) -> None:
         if self.mode < _COUNTERS:
             return
+        if self.flight.out:
+            self.flight.note("counter", name, add=value)
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + value
 
@@ -250,6 +553,38 @@ class Telemetry:
     def counters(self) -> Dict[str, float]:
         with self._lock:
             return dict(self._counters)
+
+    def gauges(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._gauges)
+
+    # -- histograms ----------------------------------------------------
+    def observe(self, name: str, value: float, bounds=None) -> None:
+        """Record ``value`` into the fixed-bucket histogram ``name``
+        (created on first observe; default bounds LATENCY_BOUNDS_MS).
+        Active from ``counters`` mode — one lock + one bisect, cheap
+        enough for the serving hot path."""
+        if self.mode < _COUNTERS:
+            return
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Hist(bounds or
+                                              LATENCY_BOUNDS_MS)
+            h.observe(float(value))
+
+    def histograms(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: h.to_dict() for k, h in self._hists.items()}
+
+    def set_prom_out(self, path: str) -> None:
+        """Arm the Prometheus textfile path (written at CLI task end
+        and process exit, like ``out``)."""
+        with self._lock:
+            self.prom_out = str(path)
+            if self.prom_out and not self._atexit_armed:
+                self._atexit_armed = True
+                atexit.register(self._export_atexit)
 
     # -- device fence --------------------------------------------------
     @property
@@ -371,10 +706,15 @@ class Telemetry:
         with self._lock:
             out: Dict[str, Any] = {
                 "mode": MODES[self.mode],
+                "host_id": None,
+                "run_id": self.run_id,
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
+                "histograms": {k: h.to_dict()
+                               for k, h in self._hists.items()},
                 "retraces": {fn: len(s) for fn, s in self._traces.items()},
             }
+        out["host_id"] = self.host()
         c = out["counters"]
         derived: Dict[str, float] = {}
         trees = c.get("trees_dispatched", 0)
@@ -387,29 +727,62 @@ class Telemetry:
         if scored:
             derived["predict_tail_waste"] = round(
                 c.get("predict_pad_rows", 0) / scored, 4)
+        lat = out["histograms"].get("predict_latency_ms")
+        if lat and lat["count"]:
+            # the tail percentiles any scraper would derive from the
+            # cumulative buckets, precomputed for in-process readers
+            for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                derived[f"predict_latency_{tag}_ms"] = \
+                    hist_quantile(lat, q)
         if derived:
             out["derived"] = derived
+        if not out["histograms"]:
+            del out["histograms"]
         return out
 
     def events_snapshot(self) -> list:
         with self._lock:
             return list(self._events)
 
-    def export(self, prefix: Optional[str] = None) -> list:
-        """Write ``<prefix>.jsonl`` (events + snapshot) and
-        ``<prefix>.perfetto.json`` (Chrome trace_event, loadable in
-        ui.perfetto.dev).  Returns the written paths."""
+    def export(self, prefix: Optional[str] = None,
+               shard: Optional[bool] = None) -> list:
+        """Write ``<prefix>.jsonl`` (meta line + events + snapshot)
+        and ``<prefix>.perfetto.json`` (Chrome trace_event, loadable
+        in ui.perfetto.dev).  Returns the written paths.
+
+        ``shard`` (default auto): in a multi-host run — or when
+        ``LTPU_HOST_ID`` tags this process — each host writes its OWN
+        ``<prefix>.host<id>.jsonl`` trace shard tagged with
+        ``(host_id, run_id)`` and the rendezvous clock-sync mark, so
+        N processes never clobber one file; merge the shards into one
+        per-host-lane timeline with
+        ``python -m lightgbm_tpu.telemetry merge``."""
         prefix = prefix or self.out
         if not prefix:
             raise ValueError("telemetry export needs a path prefix "
                              "(Config.telemetry_out)")
+        host = self.host()
+        if shard is None:
+            shard = self._n_hosts() > 1 \
+                or os.environ.get("LTPU_HOST_ID") is not None
+        if shard:
+            prefix = f"{prefix}.host{host}"
         d = os.path.dirname(os.path.abspath(prefix))
         if d:
             os.makedirs(d, exist_ok=True)
         events = self.events_snapshot()
         snap = self.snapshot()
+        with self._lock:
+            sync = self._sync
+            t0_unix = self._t0_unix
+        meta = {"type": "meta", "host_id": host, "run_id": self.run_id,
+                "pid": os.getpid(), "t0_unix": round(t0_unix, 6)}
+        if sync is not None:
+            meta["sync_name"] = sync[0]
+            meta["sync_ts_us"] = round(sync[1] * 1e6, 1)
         jsonl = f"{prefix}.jsonl"
         with open(jsonl, "w") as f:
+            f.write(json.dumps(meta) + "\n")
             for name, ts, dur, tid, depth, attrs in events:
                 ev = {"type": "span", "name": name,
                       "ts_us": round(ts * 1e6, 1),
@@ -459,10 +832,124 @@ class Telemetry:
                           "args": {"name": f"thread-{short}"}})
         return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
+    # -- prometheus export ---------------------------------------------
+    def to_prometheus(self) -> str:
+        """Render counters/gauges/histograms in the Prometheus text
+        exposition format (stdlib only — docs/OBSERVABILITY.md name
+        mapping): counter ``x`` -> ``ltpu_x_total``, numeric gauge
+        ``x`` -> ``ltpu_x``, histogram ``x`` -> cumulative
+        ``ltpu_x_bucket{le="..."}`` + ``ltpu_x_sum`` / ``ltpu_x_count``
+        — p50/p95/p99 derivable by any scraper via
+        ``histogram_quantile``."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: h.to_dict() for k, h in self._hists.items()}
+        lines: List[str] = []
+        info_name = PROM_PREFIX + "info"
+        lines.append(f"# TYPE {info_name} gauge")
+        lines.append(
+            f'{info_name}{{run_id="{self.run_id}",'
+            f'host_id="{self.host()}",mode="{MODES[self.mode]}"}} 1')
+        for k in sorted(counters):
+            name = _prom_name(k) + "_total"
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {_fmt_val(counters[k])}")
+        for k in sorted(gauges):
+            v = gauges[k]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue  # string gauges have no prometheus form
+            name = _prom_name(k)
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt_val(v)}")
+        for k in sorted(hists):
+            h = hists[k]
+            name = _prom_name(k)
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for bound, c in zip(list(h["bounds"]) + [float("inf")],
+                                h["counts"]):
+                cum += c
+                lines.append(
+                    f'{name}_bucket{{le="{_fmt_le(bound)}"}} {cum}')
+            lines.append(f"{name}_sum {_fmt_val(h['sum'])}")
+            lines.append(f"{name}_count {h['count']}")
+        return "\n".join(lines) + "\n"
+
+    def write_prom(self, path: Optional[str] = None) -> str:
+        """Atomically write the Prometheus textfile (the
+        node-exporter textfile-collector pattern;
+        ``Config.telemetry_prom_out``).  Returns the path."""
+        path = path or self.prom_out
+        if not path:
+            raise ValueError("prometheus export needs a path "
+                             "(Config.telemetry_prom_out)")
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_prometheus())
+        os.replace(tmp, path)
+        return path
+
+    def serve_metrics(self, port: int, host: str = "127.0.0.1"):
+        """Start the stdlib HTTP scrape endpoint
+        (``Config.telemetry_http_port``): ``GET /metrics`` returns the
+        Prometheus text format, ``GET /healthz`` a JSON liveness body.
+        Daemon-threaded; returns the server (``.server_address`` for
+        an ephemeral port, ``.shutdown()`` to stop)."""
+        if self._http is not None:
+            return self._http
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+        tm = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0] == "/metrics":
+                    body = tm.to_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.split("?")[0] == "/healthz":
+                    body = json.dumps(
+                        {"status": "ok", "run_id": tm.run_id,
+                         "host_id": tm.host(),
+                         "mode": MODES[tm.mode]}).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: scrapes are periodic
+                pass
+
+        srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name="ltpu-metrics")
+        t.start()
+        self._http = srv
+        Log.info(f"telemetry /metrics endpoint on "
+                 f"http://{host}:{srv.server_address[1]} (+ /healthz)")
+        return srv
+
+    def stop_metrics_server(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+
     def _export_atexit(self) -> None:  # pragma: no cover - process exit
         try:
             if self.out and (self._events or self._counters):
                 self.export(self.out)
+            if self.prom_out and (self._counters or self._hists
+                                  or self._gauges):
+                self.write_prom(self.prom_out)
         except Exception:
             pass
 
@@ -490,3 +977,172 @@ def apply_config(cfg) -> None:
         TELEMETRY.configure(mode, out=out)
     elif out and TELEMETRY.on:
         TELEMETRY.configure(TELEMETRY.level, out=out)
+    # production-surface knobs (round 13): each only ever ARMS — a
+    # default-valued internal Config must not disarm an earlier one
+    prom = str(getattr(cfg, "telemetry_prom_out", ""))
+    if prom:
+        TELEMETRY.set_prom_out(prom)
+    flight = str(getattr(cfg, "flight_recorder_out", ""))
+    if flight:
+        TELEMETRY.flight.arm(flight)
+    port = int(getattr(cfg, "telemetry_http_port", 0))
+    if port > 0 and TELEMETRY._http is None:
+        try:
+            TELEMETRY.serve_metrics(port)
+        except OSError as e:  # pragma: no cover - port in use
+            Log.warning(f"telemetry_http_port {port} unavailable: {e}")
+
+
+# ---------------------------------------------------------------------------
+# Cross-host trace merge (``python -m lightgbm_tpu.telemetry merge``)
+# ---------------------------------------------------------------------------
+def _read_shard(path: str) -> Dict[str, Any]:
+    meta: Dict[str, Any] = {}
+    spans: List[dict] = []
+    snap: Dict[str, Any] = {}
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            obj = json.loads(ln)
+            t = obj.get("type")
+            if t == "meta":
+                meta = obj
+            elif t == "span":
+                spans.append(obj)
+            elif t == "snapshot":
+                snap = obj
+    if not meta:
+        # pre-r13 shard (no meta line): synthesize identity from the
+        # snapshot, clock alignment falls back to zero shift
+        meta = {"host_id": snap.get("host_id", 0),
+                "run_id": snap.get("run_id", "")}
+    meta["path"] = path
+    return {"meta": meta, "spans": spans, "snapshot": snap}
+
+
+def merge_shards(paths: List[str]) -> Dict[str, Any]:
+    """Merge per-host trace shards into ONE Perfetto timeline with one
+    track lane (pid) per host.
+
+    Clock alignment: every host records the ``rendezvous`` sync mark
+    when it exits the multi-host barrier (near-simultaneous on all
+    hosts), so each shard's relative clock is shifted to make its mark
+    coincide with the reference host's — collective skew between hosts
+    then reads directly as slice offsets between lanes.  Shards
+    without a sync mark merge with zero shift and are listed under
+    ``metadata.unaligned``."""
+    if not paths:
+        raise ValueError("merge needs at least one shard path")
+    shards = [_read_shard(p) for p in paths]
+    shards.sort(key=lambda s: int(s["meta"].get("host_id", 0)))
+    run_ids = {s["meta"].get("run_id", "") for s in shards}
+    ref = next((s for s in shards
+                if s["meta"].get("sync_ts_us") is not None),
+               shards[0])
+    ref_sync = ref["meta"].get("sync_ts_us")
+    trace: List[dict] = []
+    shifts: Dict[str, float] = {}
+    unaligned: List[str] = []
+    seen_hosts: List[int] = []
+    for s in shards:
+        meta = s["meta"]
+        host = int(meta.get("host_id", 0))
+        seen_hosts.append(host)
+        sync = meta.get("sync_ts_us")
+        if ref_sync is not None and sync is not None:
+            shift = float(ref_sync) - float(sync)
+        else:
+            shift = 0.0
+            unaligned.append(meta["path"])
+        shifts[meta["path"]] = round(shift, 1)
+        trace.append({"name": "process_name", "ph": "M", "pid": host,
+                      "args": {"name": f"host {host}"}})
+        trace.append({"name": "process_sort_index", "ph": "M",
+                      "pid": host, "args": {"sort_index": host}})
+        tids: Dict[int, int] = {}
+        for ev in s["spans"]:
+            tid = tids.setdefault(ev.get("tid", 0), len(tids) + 1)
+            out = {"name": ev["name"], "cat": "host", "ph": "X",
+                   "ts": round(ev["ts_us"] + shift, 1),
+                   "dur": ev.get("dur_us", 0.0),
+                   "pid": host, "tid": tid}
+            if ev.get("attrs"):
+                out["args"] = ev["attrs"]
+            trace.append(out)
+        for tid, short in tids.items():
+            trace.append({"name": "thread_name", "ph": "M", "pid": host,
+                          "tid": short,
+                          "args": {"name": f"host{host}-t{short}"}})
+        counters = (s["snapshot"] or {}).get("counters", {})
+        last_ts = max((ev["ts_us"] + shift for ev in s["spans"]),
+                      default=0.0)
+        for k, v in sorted(counters.items()):
+            trace.append({"name": k, "cat": "counter", "ph": "C",
+                          "ts": round(last_ts, 1), "pid": host,
+                          "args": {"value": round(float(v), 3)}})
+    merged = {
+        "traceEvents": trace,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "tool": "lightgbm_tpu.telemetry merge",
+            "run_ids": sorted(r for r in run_ids if r),
+            "hosts": seen_hosts,
+            "clock_shifts_us": shifts,
+        },
+    }
+    if unaligned:
+        merged["metadata"]["unaligned"] = unaligned
+    return merged
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m lightgbm_tpu.telemetry merge [-o OUT] shard.jsonl...``
+    — merge per-host trace shards (``<prefix>.host<i>.jsonl``) into one
+    Perfetto file (default ``<first shard dir>/merged.perfetto.json``).
+    rc 0 ok / 2 usage."""
+    import sys
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] != "merge":
+        print("usage: python -m lightgbm_tpu.telemetry merge "
+              "[-o OUT.perfetto.json] <shard.jsonl> [...]",
+              file=sys.stderr)
+        return 2
+    argv = argv[1:]
+    out_path = None
+    if "-o" in argv:
+        i = argv.index("-o")
+        try:
+            out_path = argv[i + 1]
+        except IndexError:
+            print("merge: -o needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    if not argv:
+        print("merge: no shard files given", file=sys.stderr)
+        return 2
+    missing = [p for p in argv if not os.path.exists(p)]
+    if missing:
+        print(f"merge: shard(s) not found: {missing}", file=sys.stderr)
+        return 2
+    merged = merge_shards(argv)
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(argv[0])) or ".",
+            "merged.perfetto.json")
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    run_ids = merged["metadata"]["run_ids"]
+    if len(run_ids) > 1:
+        print(f"merge: WARNING shards carry {len(run_ids)} distinct "
+              f"run_ids {run_ids} — merged anyway", file=sys.stderr)
+    print(f"merged {len(argv)} shard(s), "
+          f"{len(merged['metadata']['hosts'])} host lane(s) -> "
+          f"{out_path}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI entry
+    import sys
+    sys.exit(main())

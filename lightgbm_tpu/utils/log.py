@@ -13,6 +13,17 @@ class LightGBMError(RuntimeError):
     """Raised by Log.fatal (reference: Log::Fatal throws std::runtime_error)."""
 
 
+_SINK = None
+
+
+def set_sink(fn) -> None:
+    """Install an observer for emitted log lines (``fn(tag, msg)``) —
+    the crash flight recorder subscribes here so the last-N warnings
+    ride its ring buffer.  One sink; None uninstalls."""
+    global _SINK
+    _SINK = fn
+
+
 class Log:
     # verbosity: <0 fatal only, =0 warning+, =1 info+, >1 debug+
     level: int = 1
@@ -25,6 +36,11 @@ class Log:
     def _emit(cls, tag: str, msg: str) -> None:
         sys.stderr.write(f"[LightGBM-TPU] [{tag}] {msg}\n")
         sys.stderr.flush()
+        if _SINK is not None:
+            try:
+                _SINK(tag, msg)
+            except Exception:
+                pass
 
     @classmethod
     def debug(cls, msg: str) -> None:

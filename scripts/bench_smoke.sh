@@ -51,6 +51,61 @@ EOF
 # run; writes /tmp/lgbtpu_smoke/reliability.json for test_bench_smoke
 python scripts/reliability_probe.py /tmp/lgbtpu_smoke/reliability.json >&2
 test -s /tmp/lgbtpu_smoke/reliability.json
+# distributed-observability probe (round 13): serving latency
+# histograms exported as a Prometheus textfile, plus a crash
+# flight-recorder smoke — one fault injected through the plan
+# grammar, the dump must exist and name the seam
+rm -f /tmp/lgbtpu_smoke/flight*.flight.json
+python - >&2 <<'EOF'
+import glob, json
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import TELEMETRY
+from lightgbm_tpu.reliability.faults import FAULTS
+TELEMETRY.configure("counters")
+TELEMETRY.flight.arm("/tmp/lgbtpu_smoke/flight")
+rng = np.random.RandomState(0)
+X = rng.randn(400, 5)
+bst = lgb.train({"objective": "regression", "verbose": -1,
+                 "num_leaves": 7, "min_data_in_leaf": 5},
+                lgb.Dataset(X, label=X[:, 0]), 3, verbose_eval=False)
+for n in (1, 3, 16, 40):
+    bst.predict(X[:n], device=True)
+TELEMETRY.write_prom("/tmp/lgbtpu_smoke/metrics.prom")
+FAULTS.configure("predict.dispatch:1:RuntimeError")
+try:
+    bst.predict(X[:4], device=True)
+    raise SystemExit("fault plan did not fire")
+except RuntimeError:
+    pass
+FAULTS.reset()
+dumps = glob.glob("/tmp/lgbtpu_smoke/flight*.flight.json")
+assert dumps, "flight recorder wrote no dump"
+d = json.load(open(dumps[-1]))
+assert d["seam"] == "predict.dispatch", d["seam"]
+assert d["events"], "flight dump carries no events"
+print(f"observability smoke ok: prom + flight dump ({d['reason']})")
+EOF
+test -s /tmp/lgbtpu_smoke/metrics.prom
+# scrape-parse the textfile with a ten-line stdlib parser: histogram
+# buckets must be cumulative (monotone) and end at +Inf == _count
+python - >&2 <<'EOF'
+hists = {}
+for ln in open("/tmp/lgbtpu_smoke/metrics.prom"):
+    if ln.startswith("#") or not ln.strip():
+        continue
+    name, val = ln.rsplit(None, 1)
+    if "_bucket{le=" in name:
+        base, le = name.split("_bucket{le=\"", 1)
+        hists.setdefault(base, []).append((le[:-2], float(val)))
+assert "ltpu_predict_latency_ms" in hists, sorted(hists)
+for base, buckets in hists.items():
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals), f"{base} buckets not cumulative"
+    assert buckets[-1][0] == "+Inf", f"{base} missing +Inf bucket"
+print(f"prom scrape ok: {len(hists)} histogram series, "
+      f"buckets monotone")
+EOF
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \

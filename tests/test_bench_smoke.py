@@ -83,6 +83,51 @@ def test_bench_smoke_json_contract():
     assert r["byte_identical"] is True
     assert r["checkpoint_saves"] >= 2
     assert r["save_ms_per_snapshot"] > 0
+    # distributed-observability probe (round 13): the Prometheus
+    # textfile was written and scrape-parsed (bucket monotonicity is
+    # asserted inside bench_smoke.sh), and the flight-recorder smoke
+    # left a dump naming the injected seam
+    import glob
+    with open("/tmp/lgbtpu_smoke/metrics.prom") as f:
+        prom = f.read()
+    assert "ltpu_predict_latency_ms_bucket{le=" in prom
+    assert 'le="+Inf"' in prom
+    dumps = glob.glob("/tmp/lgbtpu_smoke/flight*.flight.json")
+    assert dumps, "flight-recorder smoke left no dump"
+    d = json.load(open(dumps[-1]))
+    assert d["seam"] == "predict.dispatch"
+    assert d["events"]
+
+
+@pytest.mark.slow
+def test_bench_big_time_box_contains_rc124():
+    """The r5 rc=124 regression (BENCH_r05.json `parsed: null`): an
+    ADMITTED big-scale run that overruns used to blow the outer driver
+    timeout and kill the whole bench.  Round 13 runs the big scale in
+    a time-boxed subprocess — this pins the containment: a 3s box no
+    real training run can meet must degrade to a skip-with-note record
+    while the bench still exits rc 0 with its one-line JSON."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", BENCH_CHUNK="1",
+        BENCH_ROWS="2048", BENCH_ITERS="2", BENCH_VALID_ROWS="1024",
+        BENCH_LEAVES="15", BENCH_MAX_BIN="31",
+        BENCH_BIG="1", BENCH_ROWS_BIG="4096", BENCH_ITERS_BIG="2",
+        BENCH_BIG_BOX_S="3", BENCH_BUDGET_S="100000",
+        BENCH_LTR="0", BENCH_PREDICT="0", BENCH_CONSTRUCT="0",
+        BENCH_LOCAL_REF="0", BENCH_SKIP_F32="1",
+        BENCH_SLOPE_PROBE="0")
+    run = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=420)
+    assert run.returncode == 0, (run.stdout or "")[-2000:] + \
+        (run.stderr or "")[-2000:]
+    lines = [ln for ln in run.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got {lines!r}"
+    out = json.loads(lines[0])
+    big = next(s for s in out["scales"]
+               if s.get("task") == "binary_big")
+    assert "skipped" in big, big
+    assert "time box" in big["skipped"], big["skipped"]
 
 
 if __name__ == "__main__":

@@ -1,0 +1,613 @@
+"""Distributed-and-production observability tests (round-13 tentpole).
+
+Covers the issue's hard requirements:
+- fixed log-bucket histograms: exact counts, Prometheus ``le``
+  semantics, p50/p95/p99 derivable (asserted against the serving
+  path's real latency histograms),
+- Prometheus text export (name mapping, cumulative bucket
+  monotonicity, atomic textfile) + the stdlib /metrics + /healthz
+  endpoint,
+- collective instrumentation: trace-time byte/call counters for the
+  explicit collectives and the compiled-HLO scanner that covers the
+  sharding-implicit ones (the MULTICHIP gate's numbers as counters),
+- step-wall gauges + straggler detector exactness with an injected
+  ``time.sleep`` on one simulated host thread,
+- cross-host trace shards: per-host export tagged (host_id, run_id),
+  clock alignment on the rendezvous mark, one-lane-per-host Perfetto
+  validity of the merge tool,
+- crash flight recorder: ring bounds, dump triggers (fault seam,
+  retry exhaustion, OOM downshift) and dump schema.
+"""
+import glob
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.reliability.faults import FAULTS
+from lightgbm_tpu.telemetry import (DEPTH_BOUNDS, LATENCY_BOUNDS_MS,
+                                    TELEMETRY, Telemetry, hist_quantile,
+                                    merge_shards)
+from lightgbm_tpu.telemetry import main as telemetry_main
+from lightgbm_tpu.utils.log import Log
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    level = Log.level
+    TELEMETRY.configure("off")
+    TELEMETRY.set_fence(False)
+    TELEMETRY.reset()
+    TELEMETRY.flight.disarm()
+    FAULTS.reset()
+    yield
+    TELEMETRY.configure("off")
+    TELEMETRY.set_fence(False)
+    TELEMETRY.reset()
+    TELEMETRY.flight.disarm()
+    TELEMETRY.stop_metrics_server()
+    FAULTS.reset()
+    Log.set_level(level)
+
+
+def _train(n=300, iters=4, seed=0, f=6, **params):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] - 0.4 * X[:, 1]
+    p = {"objective": "regression", "verbose": -1, "num_leaves": 7,
+         "min_data_in_leaf": 5, **params}
+    return lgb.train(p, lgb.Dataset(X, label=y), iters,
+                     verbose_eval=False), X
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+def test_histogram_exact_counts_and_le_semantics():
+    TELEMETRY.configure("counters")
+    # 0.05 sits exactly ON the first bound: le semantics put it there
+    for v in (0.03, 0.05, 0.07, 102.4, 1e9):
+        TELEMETRY.observe("lat_ms", v)
+    h = TELEMETRY.histograms()["lat_ms"]
+    assert h["count"] == 5
+    assert h["sum"] == pytest.approx(0.03 + 0.05 + 0.07 + 102.4 + 1e9)
+    counts = h["counts"]
+    bounds = h["bounds"]
+    assert bounds == list(LATENCY_BOUNDS_MS)
+    assert counts[0] == 2                      # 0.03 and 0.05 (on-bound)
+    assert counts[1] == 1                      # 0.07 <= 0.1
+    assert counts[bounds.index(102.4)] == 1    # exactly on 102.4
+    assert counts[-1] == 1                     # 1e9 -> +Inf overflow
+    assert sum(counts) == h["count"]
+
+
+def test_histogram_quantiles_derivable():
+    TELEMETRY.configure("counters")
+    # 90 fast (<=0.4ms) + 10 slow (~200ms): p50 in the fast bucket,
+    # p95/p99 in the slow one — the serving-tail shape the histograms
+    # exist to expose
+    for _ in range(90):
+        TELEMETRY.observe("q_ms", 0.3)
+    for _ in range(10):
+        TELEMETRY.observe("q_ms", 150.0)
+    h = TELEMETRY.histograms()["q_ms"]
+    assert hist_quantile(h, 0.5) == 0.4
+    assert hist_quantile(h, 0.95) == 204.8
+    assert hist_quantile(h, 0.99) == 204.8
+    # empty histogram never divides by zero
+    assert hist_quantile({"bounds": [1.0], "counts": [0, 0],
+                          "count": 0, "sum": 0}, 0.5) == 0.0
+
+
+def test_histogram_custom_bounds_and_off_mode():
+    TELEMETRY.observe("nope", 1.0)             # off: not recorded
+    assert TELEMETRY.histograms() == {}
+    TELEMETRY.configure("counters")
+    TELEMETRY.observe("depth", 2, bounds=DEPTH_BOUNDS)
+    TELEMETRY.observe("depth", 33)             # bounds fixed at first observe
+    h = TELEMETRY.histograms()["depth"]
+    assert h["bounds"] == list(DEPTH_BOUNDS)
+    assert h["counts"][1] == 1 and h["counts"][-1] == 1
+
+
+# ---------------------------------------------------------------------------
+# prometheus export
+# ---------------------------------------------------------------------------
+def _parse_prom(text):
+    metrics = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name, val = ln.rsplit(None, 1)
+        metrics.setdefault(name, float(val))
+    return metrics
+
+
+def test_prometheus_text_format():
+    TELEMETRY.configure("counters")
+    TELEMETRY.add("predict_requests", 7)
+    TELEMETRY.gauge("rss_mb_peak", 123.5)
+    TELEMETRY.gauge("grower.hist_kernel", "pallas")   # string: skipped
+    for v in (0.3, 0.3, 150.0):
+        TELEMETRY.observe("predict_latency_ms", v)
+    text = TELEMETRY.to_prometheus()
+    m = _parse_prom(text)
+    assert m["ltpu_predict_requests_total"] == 7
+    assert m["ltpu_rss_mb_peak"] == 123.5
+    assert not any("hist_kernel" in k for k in m)
+    # histogram: cumulative buckets, +Inf == count, sum present
+    buckets = [(k, v) for k, v in m.items()
+               if k.startswith("ltpu_predict_latency_ms_bucket")]
+    assert buckets, text
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals), "buckets must be cumulative"
+    assert m['ltpu_predict_latency_ms_bucket{le="+Inf"}'] == 3
+    assert m["ltpu_predict_latency_ms_count"] == 3
+    assert m["ltpu_predict_latency_ms_sum"] == pytest.approx(150.6)
+    assert 'ltpu_info{run_id="' in text
+
+
+def test_write_prom_file(tmp_path):
+    TELEMETRY.configure("counters")
+    TELEMETRY.add("c", 1)
+    path = tmp_path / "metrics" / "ltpu.prom"
+    out = TELEMETRY.write_prom(str(path))
+    assert out == str(path)
+    assert "ltpu_c_total 1" in path.read_text()
+    with pytest.raises(ValueError):
+        TELEMETRY.write_prom("")
+
+
+def test_http_metrics_endpoint():
+    TELEMETRY.configure("counters")
+    TELEMETRY.add("scraped", 3)
+    srv = TELEMETRY.serve_metrics(0)           # ephemeral port
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+        assert b"ltpu_scraped_total 3" in body
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert health["status"] == "ok"
+        assert health["mode"] == "counters"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/other", timeout=10)
+        # idempotent: a second call returns the running server
+        assert TELEMETRY.serve_metrics(0) is srv
+    finally:
+        TELEMETRY.stop_metrics_server()
+
+
+def test_serving_latency_histograms_end_to_end():
+    """Acceptance criterion: the Prometheus textfile exposes serving
+    latency histograms from which p50/p95/p99 are computable."""
+    bst, X = _train(n=220, iters=4, seed=3, f=8)
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    for n in (1, 3, 9, 16, 40):
+        bst.predict(X[:n], device=True)
+    hists = TELEMETRY.histograms()
+    lat = hists["predict_latency_ms"]
+    assert lat["count"] == 5
+    assert hists["predict_drain_ms"]["count"] >= 5
+    depth = hists["predict_queue_depth"]
+    assert depth["bounds"] == list(DEPTH_BOUNDS)
+    assert depth["count"] >= 5
+    d = TELEMETRY.snapshot()["derived"]
+    for tag in ("p50", "p95", "p99"):
+        assert d[f"predict_latency_{tag}_ms"] > 0
+    assert d["predict_latency_p50_ms"] <= d["predict_latency_p99_ms"]
+    # and the same numbers are derivable from the prom text alone
+    m = _parse_prom(TELEMETRY.to_prometheus())
+    cum = [(float(k.split('le="')[1].rstrip('"}'))
+            if "+Inf" not in k else float("inf"), v)
+           for k, v in m.items()
+           if k.startswith("ltpu_predict_latency_ms_bucket")]
+    cum.sort()
+    total = m["ltpu_predict_latency_ms_count"]
+    p50 = next(b for b, c in cum if c >= 0.5 * total)
+    assert p50 == d["predict_latency_p50_ms"]
+
+
+# ---------------------------------------------------------------------------
+# collective instrumentation
+# ---------------------------------------------------------------------------
+def test_collective_trace_counters_exact():
+    """Explicit collectives record call count + payload bytes at trace
+    time; bytes are exact from the abstract shape."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from lightgbm_tpu.learner.grower import _get_shard_map
+    from lightgbm_tpu.parallel.collectives import Collectives
+
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    comm = Collectives("data")
+    shard_map = _get_shard_map()
+
+    def step(x):
+        g = comm.all_gather(x)              # (8,) f32 per shard
+        y = comm.reduce_scatter(g)          # (64,) f32
+        s = comm.allreduce_sum(jnp.sum(x))  # scalar f32
+        return y + s
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P("data"),
+                           out_specs=P("data")))
+    fn.lower(jnp.zeros(64, jnp.float32))    # trace only — no execution
+    c = TELEMETRY.counters()
+    assert c["collective_allgather_calls"] == 1
+    assert c["collective_allgather_bytes"] == 8 * 4       # per-shard view
+    assert c["collective_reduce_scatter_calls"] == 1
+    assert c["collective_reduce_scatter_bytes"] == 64 * 4
+    assert c["collective_allreduce_calls"] == 1
+    assert c["collective_allreduce_bytes"] == 4
+
+
+def test_collective_counters_none_axis_noop():
+    from lightgbm_tpu.parallel.collectives import Collectives
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    comm = Collectives(None)
+    comm.allreduce_sum(np.ones(4, np.float32))
+    comm.all_gather(np.ones(4, np.float32))
+    assert not any(k.startswith("collective_")
+                   for k in TELEMETRY.counters())
+
+
+def test_host_collectives_counters():
+    from lightgbm_tpu.parallel.collectives import HostCollectives
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    hc = HostCollectives(shards=2)
+    shards = [np.ones((4, 3), np.float32)] * 2
+    hc.simulate_allreduce(shards)
+    hc.simulate_allgather(shards)
+    c = TELEMETRY.counters()
+    assert c["collective_allreduce_calls"] == 2
+    assert c["collective_allreduce_bytes"] == 2 * 4 * 3 * 4
+    assert c["collective_allgather_calls"] == 2
+
+
+def test_scan_and_record_compiled_collectives():
+    from lightgbm_tpu.parallel.collectives import (
+        record_compiled_collectives, scan_compiled_collectives)
+    txt = """\
+  %ar = (f32[378]{0}, f32[8192]{0}) all-reduce(f32[378] %a, f32[8192] %b), replica_groups={}
+  %rs = u8[1024]{0} reduce-scatter(u8[8192] %c), dimensions={0}
+  %ag = f32[4096]{0} all-gather-start(f32[512] %d), dimensions={0}
+  %no = f32[4096]{0} add(f32[4096] %e, f32[4096] %f)
+"""
+    st = scan_compiled_collectives(txt)
+    assert st["kinds"]["all-reduce"] == {"count": 1,
+                                         "bytes": (378 + 8192) * 4}
+    assert st["kinds"]["reduce-scatter"] == {"count": 1, "bytes": 1024}
+    assert st["kinds"]["all-gather"] == {"count": 1, "bytes": 4096 * 4}
+    assert st["largest_reduce_bytes"] == (378 + 8192) * 4
+    assert st["reduce_count"] == 2
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    record_compiled_collectives(txt, program="unit")
+    c = TELEMETRY.counters()
+    g = TELEMETRY.gauges()
+    assert c["hlo_collective_all_reduce_bytes"] == (378 + 8192) * 4
+    assert c["hlo_collective_reduce_scatter_count"] == 1
+    assert g["collective_largest_reduce_bytes"] == (378 + 8192) * 4
+    assert g["collective_reduce_count"] == 2
+    assert "all-gather:1x" in g["collective_profile.unit"]
+
+
+def test_mesh_topology_gauges():
+    import jax
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.mesh import ShardingPolicy, build_mesh
+
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    cfg = Config.from_params({"tree_learner": "data", "verbose": -1})
+    mesh = build_mesh(cfg)
+    assert mesh is not None
+    ShardingPolicy(cfg, mesh)
+    g = TELEMETRY.gauges()
+    assert g["mesh_devices"] == len(jax.devices())
+    assert g["mesh_hosts"] == 1
+    assert g["mesh_axes"] == f"data={len(jax.devices())}"
+
+
+# ---------------------------------------------------------------------------
+# step wall + straggler detector
+# ---------------------------------------------------------------------------
+def test_step_wall_stats_exact():
+    from lightgbm_tpu.parallel.monitor import step_wall_stats
+    st = step_wall_stats([0.1, 0.1, 0.3])
+    assert st["max"] == 0.3 and st["min"] == 0.1
+    assert st["mean"] == pytest.approx(0.5 / 3)
+    assert st["ratio"] == pytest.approx(0.3 / (0.5 / 3))
+    with pytest.raises(ValueError):
+        step_wall_stats([])
+
+
+def test_straggler_ratio_with_injected_sleep():
+    """The issue's exactness requirement: 4 simulated host threads
+    each time their own step, one sleeps ~4x longer; the gauges must
+    equal step_wall_stats over the gathered walls EXACTLY, and the
+    slow host must trip the straggler counter."""
+    from lightgbm_tpu.parallel import monitor
+
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    monitor._warned["straggler"] = False
+    n_hosts = 4
+    barrier = threading.Barrier(n_hosts)
+    walls = [None] * n_hosts
+    results = [None] * n_hosts
+
+    def gather_for(host):
+        def gather(seconds):
+            walls[host] = seconds
+            barrier.wait(timeout=30)     # the allgather rendezvous
+            barrier.wait(timeout=30)     # everyone has published
+            return list(walls)
+        return gather
+
+    def host_thread(host):
+        t0 = time.perf_counter()
+        time.sleep(0.2 if host == 2 else 0.05)   # host 2 straggles
+        results[host] = monitor.record_step_wall(
+            time.perf_counter() - t0, gather=gather_for(host))
+
+    threads = [threading.Thread(target=host_thread, args=(h,))
+               for h in range(n_hosts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    from lightgbm_tpu.parallel.monitor import step_wall_stats
+    expect = step_wall_stats(walls)
+    for st in results:
+        assert st == expect              # identical derivation per host
+    g = TELEMETRY.gauges()
+    assert g["step_wall_ms_max"] == round(expect["max"] * 1e3, 3)
+    assert g["step_wall_ms_min"] == round(expect["min"] * 1e3, 3)
+    assert g["step_wall_ms_mean"] == round(expect["mean"] * 1e3, 3)
+    assert g["straggler_ratio"] == round(expect["ratio"], 4)
+    assert expect["ratio"] > 1.5         # the injected sleep shows up
+    assert TELEMETRY.counters()["straggler_steps"] >= 1
+    assert TELEMETRY.histograms()["step_wall_hist_ms"]["count"] \
+        == n_hosts
+
+
+def test_record_step_wall_single_host():
+    from lightgbm_tpu.parallel.monitor import record_step_wall
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    assert record_step_wall(0.01) is None      # nothing to compare
+    g = TELEMETRY.gauges()
+    assert g["step_wall_ms"] == 10.0
+    assert "straggler_ratio" not in g
+    TELEMETRY.configure("off")
+    assert record_step_wall(0.01) is None      # off: no-op
+
+
+def test_prometheus_no_gauge_histogram_family_collision():
+    """One Prometheus metric name cannot be declared both gauge and
+    histogram — the exposition the scrapers reject.  Drive the two
+    code paths that used to collide (step wall, host allgather) and
+    assert every family name is declared exactly once."""
+    from lightgbm_tpu.parallel.monitor import record_step_wall
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    record_step_wall(0.01, gather=lambda s: [s, 2 * s])
+    TELEMETRY.add("collective_host_allgather_bytes", 1024)
+    TELEMETRY.observe("collective_host_allgather_ms", 0.4)
+    types = {}
+    for ln in TELEMETRY.to_prometheus().splitlines():
+        if ln.startswith("# TYPE "):
+            _, _, name, kind = ln.split()
+            assert name not in types, \
+                f"{name} declared {types[name]} AND {kind}"
+            types[name] = kind
+    assert types["ltpu_step_wall_ms"] == "gauge"
+    assert types["ltpu_step_wall_hist_ms"] == "histogram"
+    assert types["ltpu_collective_host_allgather_ms"] == "histogram"
+    assert types["ltpu_collective_host_allgather_bytes_total"] \
+        == "counter"
+
+
+# ---------------------------------------------------------------------------
+# cross-host trace shards + merge
+# ---------------------------------------------------------------------------
+def _make_shard(tmp_path, host, t_skew_s, run_id="runx"):
+    """Simulate one host's telemetry lifetime and export its shard.
+    ``t_skew_s`` shifts this host's clock: its rendezvous mark lands
+    later on its own (relative) timeline, which is exactly what the
+    merge must undo."""
+    tm = Telemetry()
+    tm.run_id = run_id
+    tm.host_id = host
+    tm.configure("spans")
+    if t_skew_s:
+        time.sleep(t_skew_s)
+    tm.mark_sync("rendezvous")
+    with tm.span("train_chunk", iters=2):
+        time.sleep(0.01)
+    tm.add("trees_dispatched", 2)
+    jsonl, _ = tm.export(str(tmp_path / "run"), shard=True)
+    assert jsonl.endswith(f".host{host}.jsonl")
+    return jsonl
+
+
+def test_shard_export_tags_host_and_run(tmp_path):
+    shard = _make_shard(tmp_path, 3, 0.0)
+    lines = [json.loads(ln) for ln in open(shard)]
+    meta = lines[0]
+    assert meta["type"] == "meta"
+    assert meta["host_id"] == 3
+    assert meta["run_id"] == "runx"
+    assert meta["sync_name"] == "rendezvous"
+    assert meta["sync_ts_us"] >= 0
+    assert lines[-1]["type"] == "snapshot"
+    assert lines[-1]["host_id"] == 3
+    names = {ln["name"] for ln in lines if ln.get("type") == "span"}
+    assert {"rendezvous", "train_chunk"} <= names
+
+
+def test_merge_aligns_clocks_one_lane_per_host(tmp_path):
+    # host 1 "starts" 50ms later and host 2 100ms later than host 0:
+    # without alignment their spans would sit at different offsets
+    shards = [_make_shard(tmp_path, h, skew)
+              for h, skew in ((0, 0.0), (1, 0.05), (2, 0.10))]
+    merged = merge_shards(shards)
+    evs = merged["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert pids == {0, 1, 2}
+    # one process_name lane per host, sort order by host id
+    lanes = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert lanes == {0: "host 0", 1: "host 1", 2: "host 2"}
+    # clock alignment: the rendezvous marks coincide after the shift
+    sync_ts = {e["pid"]: e["ts"] for e in evs
+               if e["ph"] == "X" and e["name"] == "rendezvous"}
+    assert len(sync_ts) == 3
+    spread = max(sync_ts.values()) - min(sync_ts.values())
+    assert spread < 1.0, f"sync marks {spread}us apart after alignment"
+    assert not merged["metadata"].get("unaligned")
+    assert merged["metadata"]["hosts"] == [0, 1, 2]
+    # per-host counters survive as counter tracks
+    assert any(e["ph"] == "C" and e["name"] == "trees_dispatched"
+               and e["pid"] == 2 for e in evs)
+
+
+def test_merge_cli_and_missing_sync(tmp_path, capsys):
+    s0 = _make_shard(tmp_path, 0, 0.0)
+    # a shard WITHOUT a sync mark (pre-rendezvous crash): merges with
+    # zero shift and is reported, not dropped
+    tm = Telemetry()
+    tm.run_id = "runx"
+    tm.host_id = 1
+    tm.configure("spans")
+    with tm.span("binning"):
+        pass
+    s1, _ = tm.export(str(tmp_path / "run"), shard=True)
+    out = str(tmp_path / "merged.perfetto.json")
+    rc = telemetry_main(["merge", "-o", out, s0, s1])
+    assert rc == 0
+    merged = json.load(open(out))          # valid JSON on disk
+    assert {e["pid"] for e in merged["traceEvents"]} == {0, 1}
+    assert merged["metadata"]["unaligned"] == [s1]
+    assert "merged 2 shard(s), 2 host lane(s)" in capsys.readouterr().out
+    # usage errors: rc 2
+    assert telemetry_main([]) == 2
+    assert telemetry_main(["merge"]) == 2
+    assert telemetry_main(["merge", str(tmp_path / "absent.jsonl")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder
+# ---------------------------------------------------------------------------
+def test_flight_ring_bounded_and_dump_schema(tmp_path):
+    TELEMETRY.configure("spans")
+    fl = TELEMETRY.flight
+    fl.arm(str(tmp_path / "fl"))
+    for i in range(600):                   # > ring capacity of 512
+        TELEMETRY.add("burst", 1)
+    with TELEMETRY.span("train_chunk"):
+        pass
+    Log.set_level(0)          # the sink sees only EMITTED lines —
+    Log.warning("something odd")  # suite order must not mute this
+    path = fl.dump("manual_test", seam="gbdt.train_chunk", note=7)
+    d = json.load(open(path))
+    assert d["reason"] == "manual_test"
+    assert d["seam"] == "gbdt.train_chunk"
+    assert d["note"] == 7
+    assert d["run_id"] == TELEMETRY.run_id
+    assert len(d["events"]) <= 512
+    kinds = {e["kind"] for e in d["events"]}
+    assert {"counter", "span", "log"} <= kinds
+    assert any(e["kind"] == "log" and "something odd" in
+               e["detail"]["msg"] for e in d["events"])
+    assert d["counters"]["burst"] == 600
+    # disarmed: dump is a no-op returning None
+    fl.disarm()
+    assert fl.dump("after_disarm") is None
+
+
+def test_flight_dump_on_fault_seam(tmp_path):
+    TELEMETRY.configure("counters")
+    TELEMETRY.flight.arm(str(tmp_path / "fl"))
+    FAULTS.configure("native.entry:1:RuntimeError")
+    with pytest.raises(RuntimeError):
+        FAULTS.fault_point("native.entry")
+    dumps = glob.glob(str(tmp_path / "fl-*.flight.json"))
+    assert len(dumps) == 1
+    d = json.load(open(dumps[0]))
+    assert d["reason"] == "fault:RuntimeError"
+    assert d["seam"] == "native.entry"
+    assert d["call"] == 1
+
+
+def test_flight_dump_on_retry_exhaustion(tmp_path):
+    from lightgbm_tpu.reliability.retry import RetryPolicy, retry_call
+    TELEMETRY.flight.arm(str(tmp_path / "fl"))
+
+    def always_transient():
+        raise ConnectionError("connection reset by peer")
+
+    with pytest.raises(ConnectionError):
+        retry_call(always_transient, seam="gbdt.train_chunk",
+                   policy=RetryPolicy(max_retries=1, base_delay_s=0),
+                   sleep=lambda s: None)
+    dumps = glob.glob(str(tmp_path / "fl-*.flight.json"))
+    assert len(dumps) == 1
+    d = json.load(open(dumps[0]))
+    assert d["reason"] == "retry_exhausted"
+    assert d["seam"] == "gbdt.train_chunk"
+    assert d["attempts"] == 2
+
+
+def test_flight_dump_on_serving_oom_downshift(tmp_path):
+    """The OOM ladder keeps serving alive AND leaves a flight dump
+    explaining what degraded."""
+    bst, X = _train(n=150, iters=3, seed=5)
+    host = bst.predict(X[:20], device=False)
+    TELEMETRY.configure("counters")
+    TELEMETRY.flight.arm(str(tmp_path / "fl"))
+    FAULTS.configure("predict.dispatch:1:oom")
+    out = bst.predict(X[:20], device=True)     # downshifts, succeeds
+    np.testing.assert_allclose(out, host, rtol=1e-5, atol=1e-7)
+    dumps = sorted(glob.glob(str(tmp_path / "fl-*.flight.json")))
+    reasons = {json.load(open(p))["reason"] for p in dumps}
+    assert "oom_downshift" in reasons
+    oom = next(json.load(open(p)) for p in dumps
+               if json.load(open(p))["reason"] == "oom_downshift")
+    assert oom["seam"] == "predict.dispatch"
+    assert oom["new_cap"] >= 1
+    assert TELEMETRY.counters()["oom_downshifts"] == 1
+
+
+def test_flight_recorder_config_knobs(tmp_path):
+    from lightgbm_tpu.config import Config
+    Config.from_params({"verbose": -1,
+                        "flight_recorder_out": str(tmp_path / "fr"),
+                        "telemetry_prom_out": str(tmp_path / "m.prom")})
+    assert TELEMETRY.flight.armed
+    assert TELEMETRY.prom_out == str(tmp_path / "m.prom")
+    # a later default-valued Config must not disarm either
+    Config.from_params({"verbose": -1})
+    assert TELEMETRY.flight.armed
+    assert TELEMETRY.prom_out == str(tmp_path / "m.prom")
+    TELEMETRY.prom_out = ""
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -279,6 +279,83 @@ def test_off_mode_hlo_identity():
         "telemetry=spans changed the lowered fused chunk")
 
 
+def _lowered_collective_text():
+    """Lower a shard_map program through the INSTRUMENTED Collectives
+    wrappers (round 13: they record bytes/calls at trace time)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from lightgbm_tpu.learner.grower import _get_shard_map
+    from lightgbm_tpu.parallel.collectives import Collectives
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    comm = Collectives("data")
+    shard_map = _get_shard_map()
+
+    def step(x):
+        y = comm.reduce_scatter(comm.all_gather(x))
+        return y + comm.allreduce_sum(jnp.sum(x)) \
+            + comm.global_max(jnp.max(x))
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P("data"),
+                           out_specs=P("data")))
+    return fn.lower(jnp.zeros(64, jnp.float32)).as_text()
+
+
+def test_off_mode_hlo_identity_collectives():
+    """The round-13 acceptance extension: the instrumented collective
+    wrappers record ONLY trace-time Python (counter adds from abstract
+    shapes), so telemetry=off/counters/spans lower byte-identical
+    StableHLO for a program built from every instrumented collective
+    kind."""
+    TELEMETRY.configure("off")
+    base = _lowered_collective_text()
+    TELEMETRY.configure("counters")
+    assert _lowered_collective_text() == base, (
+        "telemetry=counters changed the lowered collective program")
+    assert TELEMETRY.counters()["collective_allgather_calls"] == 1
+    TELEMETRY.configure("spans")
+    assert _lowered_collective_text() == base, (
+        "telemetry=spans changed the lowered collective program")
+
+
+def _lowered_serving_text():
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import predict as P
+    from lightgbm_tpu.tree import flatten_ensemble
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(200, 5)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "num_leaves": 7, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=X[:, 0]), 3,
+                    verbose_eval=False)
+    flat = flatten_ensemble(bst.models, 1)
+    depth = int(flat.pop("depth"))
+    stack = P.LevelEnsemble(**{k: jnp.asarray(v)
+                               for k, v in flat.items()})
+    x2 = jnp.zeros((16, 10), jnp.float32)
+    return P.predict_level_ensemble.lower(stack, x2,
+                                          depth=depth).as_text()
+
+
+def test_off_mode_hlo_identity_serving():
+    """The serving program (the bucketed level-ensemble descent) must
+    also lower byte-identically across off/counters/spans — the
+    round-13 latency histograms live at the host seam around the
+    dispatch, never inside it."""
+    TELEMETRY.configure("off")
+    base = _lowered_serving_text()
+    TELEMETRY.configure("counters")
+    assert _lowered_serving_text() == base, (
+        "telemetry=counters changed the lowered serving program")
+    TELEMETRY.configure("spans")
+    assert _lowered_serving_text() == base, (
+        "telemetry=spans changed the lowered serving program")
+
+
 def test_trace_mode_trees_byte_identical():
     """trace mode adds named-scope METADATA only: the trained model
     must be byte-identical to an off-mode run."""

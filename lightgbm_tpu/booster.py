@@ -73,6 +73,17 @@ _PREDICT_CHUNK_BUDGET_BYTES = 256 << 20  # transient per-chunk device
 # footprint bound for predict_chunk_rows=auto (two chunks in flight)
 
 
+def round_up_bucket(m: int, min_bucket: int) -> int:
+    """The serving bucket ladder: smallest power-of-two multiple of
+    ``min_bucket`` covering ``m`` rows.  ONE definition shared by the
+    predictor's dispatch rounding and the serving micro-batcher's
+    fill metric (callers clamp to their own caps)."""
+    b = max(1, int(min_bucket))
+    while b < m:
+        b <<= 1
+    return b
+
+
 class _ServingPredictor:
     """Shape-bucketed, chunk-streamed device predictor over one
     ensemble slice — the serving subsystem's compiled-program unit.
@@ -140,10 +151,7 @@ class _ServingPredictor:
     def _bucket(self, m: int, cap: int) -> int:
         if not self.bucketed:
             return m
-        b = self.min_bucket
-        while b < m:
-            b <<= 1
-        return min(b, cap)
+        return min(round_up_bucket(m, self.min_bucket), cap)
 
     # ------------------------------------------------------------------
     def _dispatch(self, x2_dev):
@@ -821,14 +829,18 @@ class Booster:
         return by_count[count]
 
     def warm_predictor(self, batch_sizes=(1,),
-                       num_iteration: int = -1) -> "Booster":
+                       num_iteration: int = -1,
+                       log: bool = False) -> "Booster":
         """Serving warm-up: compile the bucketed device predictor for
         the given batch sizes at deploy time instead of on the first
         request (with compile_cache_dir wired this is a disk hit in
         later processes).  Drives the serving predictor DIRECTLY —
         predict() routing would send an in-session booster's call
         through the binned scan instead, warming the wrong programs.
-        Wired to `predict_warm_buckets` in engine.train()."""
+        Wired to `predict_warm_buckets` in engine.train(); the CLI
+        predict/serve tasks pass ``log=True`` so deploy scripts see
+        the per-bucket warm compile wall before taking traffic."""
+        import time
         self._sync_models()
         if not self.models:
             return self
@@ -838,7 +850,15 @@ class Booster:
         pred = self._serving_predictor(count)
         f = self.max_feature_idx + 1
         for b in batch_sizes:
-            pred(np.zeros((max(int(b), 1), f)))
+            m = max(int(b), 1)
+            t0 = time.perf_counter()
+            pred(np.zeros((m, f)))
+            if log:
+                bucket = pred._bucket(m, pred._chunk_cap(2 * f))
+                Log.info(
+                    f"warm_predictor: batch {m} -> bucket {bucket} "
+                    f"warmed in {(time.perf_counter() - t0) * 1e3:.1f} "
+                    "ms")
         return self
 
     def _device_predict_loaded(self, data: np.ndarray,

@@ -1,8 +1,13 @@
-"""Command-line application: train / predict / convert_model / refit.
+"""Command-line application: train / predict / convert_model / refit
+/ serve.
 
 The analog of the reference CLI driver (reference: src/main.cpp,
 src/application/application.cpp:30-268 — param parsing with config
-file + k=v args, task dispatch, data loading, prediction output file).
+file + k=v args, task dispatch, data loading, prediction output file)
+plus the online-serving entry point the reference never had:
+``task=serve`` publishes ``input_model`` into a model registry
+(buckets warmed before traffic) and serves ``POST /predict/<model>``
+from the shared telemetry listener (docs/SERVING.md).
 
 Usage:  python -m lightgbm_tpu config=train.conf [key=value ...]
 """
@@ -54,6 +59,8 @@ def run(argv: List[str]) -> int:
         _task_convert(params, config)
     elif task == "refit":
         _task_refit(params, config)
+    elif task == "serve":
+        _task_serve(params, config)
     else:
         Log.fatal(f"Unknown task {task}")
     from .telemetry import TELEMETRY
@@ -142,6 +149,11 @@ def _task_predict(params, config: Config) -> None:
     # (predict_kernel, predict_bucket, predict_chunk_rows, ...) reach
     # the serving predictor
     booster = Booster(config=config, model_file=config.input_model)
+    if config.predict_warm_buckets:
+        # deploy-script warm-up without the Python API: pre-compile
+        # the declared serving buckets (and log each bucket's warm
+        # compile wall) before the first real prediction
+        booster.warm_predictor(config.predict_warm_buckets, log=True)
     from .data_loader import load_file
     X, _, _ = load_file(config.data, config)
     pred = booster.predict(
@@ -175,6 +187,37 @@ def _task_convert(params, config: Config) -> None:
         f.write(code)
     Log.info(f"Finished converting model to if-else code at "
              f"{config.convert_model}")
+
+
+def _task_serve(params, config: Config) -> None:
+    """Online serving (docs/SERVING.md): publish input_model into a
+    registry (warming its buckets first — predict_warm_buckets, or
+    the 1-row + serve_max_batch_rows defaults), then serve
+    POST /predict/<model> with micro-batching and load shedding from
+    the shared /metrics + /healthz listener until interrupted."""
+    if not config.input_model:
+        Log.fatal("No model file: set input_model=<file>")
+    import os
+    import threading
+
+    from .serving import ModelRegistry, ServingFrontend
+    name = os.path.splitext(
+        os.path.basename(config.input_model))[0] or "model"
+    registry = ModelRegistry(config)
+    registry.publish(name, config.input_model, log_warm=True)
+    frontend = ServingFrontend(registry, config)
+    srv = frontend.start()
+    port = srv.server_address[1]
+    Log.info(f"serving model {name!r} at "
+             f"http://127.0.0.1:{port}/predict/{name} "
+             '(POST JSON {"rows": [[...]]} or CSV rows; '
+             "GET /models /metrics /healthz)")
+    try:
+        threading.Event().wait()      # serve until SIGINT
+    except KeyboardInterrupt:
+        Log.info("interrupt: draining serving queues")
+    finally:
+        frontend.stop(drain=True)
 
 
 def _task_refit(params, config: Config) -> None:

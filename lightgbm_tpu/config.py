@@ -43,6 +43,13 @@ def _setup_compile_cache(cache_dir: str) -> None:
         import os
 
         import jax
+        # bridge jax's cache-hit/miss monitoring events into the
+        # compile_cache_hits/compile_cache_misses telemetry counters —
+        # the registry's warm-before-cutover guarantee is monitored on
+        # the Prometheus surface, so the cache can't stay log-only.
+        # Armed whenever a cache is (or already was) wired
+        from .telemetry import watch_compile_cache
+        watch_compile_cache()
         if jax.config.jax_compilation_cache_dir:
             Log.debug(
                 "compilation cache already configured at "
@@ -190,7 +197,7 @@ OBJECTIVES = (
 BOOSTING_TYPES = ("gbdt", "dart", "goss", "rf")
 TREE_LEARNERS = ("serial", "feature", "data", "voting")
 DEVICE_TYPES = ("cpu", "tpu", "gpu")  # "gpu" accepted as alias for tpu
-TASK_TYPES = ("train", "predict", "convert_model", "refit")
+TASK_TYPES = ("train", "predict", "convert_model", "refit", "serve")
 
 _TREE_LEARNER_ALIASES = {
     "serial": "serial",
@@ -535,6 +542,33 @@ class Config:
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
 
+    # -- serving (new; no reference analog) --
+    serve_batch_deadline_ms: float = 2.0  # micro-batching scheduler
+    # (lightgbm_tpu/serving/batcher.py): how long the dispatcher holds
+    # the OLDEST queued request open to coalesce concurrent requests
+    # into one power-of-two bucket dispatch.  0 dispatches immediately
+    # (no coalescing window); larger values trade first-request
+    # latency for batch fill under concurrent single-row traffic
+    serve_shed_deadline_ms: float = 100.0  # admission control: a
+    # request whose PROJECTED queue wait (batches ahead x the EWMA
+    # dispatch wall) exceeds this is shed at submit time — the HTTP
+    # frontend answers 503 with a Retry-After header instead of
+    # letting the queue grow without bound (docs/SERVING.md)
+    serve_queue_depth: int = 1024   # bounded request queue per served
+    # model version: submissions beyond this many waiting requests are
+    # shed (503) rather than queued — the memory bound on a stalled
+    # serving process
+    serve_max_batch_rows: int = 1024  # coalesced-dispatch row cap:
+    # the batcher never merges requests past this many rows into one
+    # dispatch (rounded up to the power-of-two bucket); a single
+    # request larger than the cap dispatches alone and chunk-streams
+    # inside the predictor
+    serve_port: int = 0             # HTTP port for task=serve (the
+    # /predict/<model> endpoint shares ONE listener with the
+    # telemetry /metrics + /healthz daemon).  0 binds an ephemeral
+    # port (logged at startup); when telemetry_http_port is set the
+    # serving routes mount on that already-running listener instead
+
     # -- reliability (new; no reference analog) --
     checkpoint_freq: int = -1   # save a crash-safe FULL-training-state
     # checkpoint every this many iterations (model + score cache +
@@ -654,6 +688,17 @@ class Config:
         if not (0 <= self.telemetry_http_port <= 65535):
             raise ValueError("telemetry_http_port must be in [0, "
                              "65535] (0 = disabled)")
+        if self.serve_batch_deadline_ms < 0:
+            raise ValueError("serve_batch_deadline_ms must be >= 0")
+        if self.serve_shed_deadline_ms <= 0:
+            raise ValueError("serve_shed_deadline_ms must be > 0")
+        if self.serve_queue_depth < 1:
+            raise ValueError("serve_queue_depth must be >= 1")
+        if self.serve_max_batch_rows < 1:
+            raise ValueError("serve_max_batch_rows must be >= 1")
+        if not (0 <= self.serve_port <= 65535):
+            raise ValueError("serve_port must be in [0, 65535] "
+                             "(0 = ephemeral)")
         if self.snapshot_keep < 0:
             raise ValueError("snapshot_keep must be >= 0 (0 = keep all)")
         if self.checkpoint_keep < 1:

@@ -60,6 +60,11 @@ SEAMS = (
     "gbdt.train_chunk",      # fused multi-iteration dispatch enqueue
     "gbdt.train_one_iter",   # per-iteration fused dispatch enqueue
     "predict.dispatch",      # serving predictor device dispatch
+    "serving.request",       # HTTP serving request handler entry
+                             # (serving/server.py — the socket-facing
+                             # seam: an injected fault exercises the
+                             # 500 + flight-dump path, never tears
+                             # down the listener)
     "distributed.init",      # multi-machine rendezvous / network init
     "collectives.allgather", # host-side collective backend calls
     "dataset.cache_io",      # binary dataset cache file open (r/w)

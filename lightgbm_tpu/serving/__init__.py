@@ -1,0 +1,24 @@
+"""Online serving subsystem: the path from socket to device and back.
+
+Three layers over the r8 shape-bucketed compiled predictor
+(docs/SERVING.md):
+
+- :mod:`.batcher` — micro-batching scheduler: concurrent requests
+  coalesce into one power-of-two-bucket dispatch under a deadline
+  knob, with bounded-queue admission control (load shedding).
+- :mod:`.registry` — named, versioned Boosters with atomic hot swap:
+  buckets warm BEFORE cutover, the old version drains then releases,
+  rollback is a pointer flip.
+- :mod:`.server` — stdlib HTTP frontend sharing one listener with
+  the telemetry ``/metrics`` + ``/healthz`` daemon.
+
+CLI: ``python -m lightgbm_tpu task=serve input_model=model.txt``;
+load generator: ``scripts/serve_bench.py``.
+"""
+from .batcher import BatcherClosed, MicroBatcher, ShedLoad
+from .registry import FeatureWidthMismatch, ModelEntry, ModelRegistry
+from .server import ServingFrontend, parse_rows, serve
+
+__all__ = ["MicroBatcher", "ShedLoad", "BatcherClosed",
+           "FeatureWidthMismatch", "ModelEntry", "ModelRegistry",
+           "ServingFrontend", "parse_rows", "serve"]

@@ -1,0 +1,324 @@
+"""Micro-batching scheduler: coalesce concurrent predict requests
+into the serving predictor's power-of-two buckets.
+
+The shape-bucketed predictor (booster.py `_ServingPredictor`, r8) was
+built so micro-batch traffic reuses ONE compiled program per bucket —
+but until now it only ever saw one caller's batch at a time, so N
+concurrent single-row requests still cost N dispatches of a
+16-row bucket each.  This module is the missing aggregation layer
+(the Booster-paper batching argument, arXiv 2011.02022): a bounded
+request queue whose dispatcher thread holds the oldest request open
+for at most ``serve_batch_deadline_ms``, merges every request that
+arrived in the window into one concatenated matrix (capped at
+``serve_max_batch_rows``), dispatches ONCE, and slices the result
+back per request.  Per-row scores are independent of batch
+composition in every predict path (host walk and device level
+descent alike), so coalesced results are byte-identical to a direct
+``Booster.predict`` of the same rows — pinned by
+``tests/test_serving.py``.
+
+Admission control lives at ``submit``: a full queue
+(``serve_queue_depth``) or a projected queue wait beyond
+``serve_shed_deadline_ms`` (batches ahead x the EWMA dispatch wall)
+raises :class:`ShedLoad`, which the HTTP frontend turns into
+503 + Retry-After.  Shedding at the door keeps the latency of
+admitted requests bounded instead of letting every client time out
+together.
+
+Determinism seams (no sleeps in tests): the clock is injectable
+(``clock=``), the dispatcher thread is optional (``start=False``),
+and ``drain_pending()`` runs the coalescing loop inline — the
+deadline/coalescing semantics are tested against a fake clock, the
+threaded path against real concurrent load.
+
+Telemetry (docs/OBSERVABILITY.md): ``serve_requests`` /
+``serve_dispatches`` / ``serve_rows`` / ``serve_coalesced_requests``
+/ ``serve_shed_requests`` / ``serve_errors`` counters, a
+``serve_dispatch`` span per coalesced dispatch, and the
+``serve_queue_wait_ms`` / ``serve_batch_fill`` / ``serve_batch_rows``
+histograms the capacity-planning guide (docs/SERVING.md) reads.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Deque, List, Optional
+
+import numpy as np
+
+from ..telemetry import TELEMETRY, BATCH_BOUNDS, RATIO_BOUNDS
+
+
+class ShedLoad(Exception):
+    """Admission-control rejection: the request was NOT queued.  The
+    HTTP frontend maps this to 503 with ``Retry-After`` =
+    ``retry_after_s`` (rounded up to a whole second)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class BatcherClosed(RuntimeError):
+    """Submit raced a hot swap: this batcher drained and closed while
+    the caller held a reference.  The registry retries against the
+    current entry — callers never see this as a failed response."""
+
+
+class _Request:
+    __slots__ = ("rows", "n", "t_enq", "done", "result", "error")
+
+    def __init__(self, rows: np.ndarray, t_enq: float):
+        self.rows = rows
+        self.n = int(rows.shape[0])
+        self.t_enq = t_enq
+        self.done = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    """Bounded request queue + coalescing dispatcher over one predict
+    callable (one instance per served model version — the queue IS
+    the version's in-flight work, which is what hot-swap drains)."""
+
+    def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
+                 config=None, clock: Optional[Callable[[], float]] = None,
+                 start: bool = True, name: str = ""):
+        self.predict = predict_fn
+        self.name = name
+        self.deadline_ms = float(getattr(
+            config, "serve_batch_deadline_ms", 2.0))
+        self.shed_ms = float(getattr(
+            config, "serve_shed_deadline_ms", 100.0))
+        self.queue_depth = max(1, int(getattr(
+            config, "serve_queue_depth", 1024)))
+        self.max_rows = max(1, int(getattr(
+            config, "serve_max_batch_rows", 1024)))
+        self.min_bucket = max(1, int(getattr(
+            config, "predict_min_bucket_rows", 16)))
+        # mirror the predictor's bucket policy for the fill metric:
+        # with predict_bucket=off dispatches are exact-shaped, so the
+        # fill denominator is the batch itself
+        self.bucketed = str(getattr(config, "predict_bucket", "auto")
+                            ).lower() not in ("off", "false", "0")
+        self._clock = clock or time.perf_counter
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: Deque[_Request] = collections.deque()
+        self._pending_rows = 0
+        self._closed = False
+        self._dispatch_ewma_ms = 0.0
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is None and not self._closed:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"ltpu-batcher-{self.name or hex(id(self))}")
+            self._thread.start()
+        return self
+
+    def close(self, drain: bool = True,
+              timeout_s: float = 60.0) -> "MicroBatcher":
+        """Stop accepting work; with ``drain`` (the default) every
+        already-queued request is still dispatched and answered before
+        the dispatcher exits — the hot-swap "old version drains
+        in-flight work then releases" semantic."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                for r in self._pending:
+                    r.error = BatcherClosed("batcher closed")
+                    r.done.set()
+                self._pending.clear()
+                self._pending_rows = 0
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+        elif drain:
+            self.drain_pending()
+        return self
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- admission + submit --------------------------------------------
+    def _projected_wait_ms(self) -> float:
+        """Estimated queue wait for a NEW request (lock held): whole
+        batches ahead of it x the EWMA coalesced-dispatch wall.  Zero
+        until the first dispatch has been timed — admission never
+        sheds on a cold estimator, only on real measured backlog."""
+        if self._dispatch_ewma_ms <= 0.0 or not self._pending:
+            return 0.0
+        batches_ahead = -(-self._pending_rows // self.max_rows)
+        return batches_ahead * self._dispatch_ewma_ms
+
+    def submit(self, rows: np.ndarray,
+               timeout_s: Optional[float] = None) -> np.ndarray:
+        """Queue ``rows`` (1D = one row) for the next coalesced
+        dispatch; blocks until its slice of the batch result is ready.
+        Raises :class:`ShedLoad` when admission control rejects, and
+        re-raises the dispatch's exception on failure."""
+        rows = np.asarray(rows, dtype=np.float64)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        tm = TELEMETRY
+        if rows.shape[0] == 0:
+            return np.asarray(self.predict(rows))
+        with self._cond:
+            if self._closed:
+                # NOT counted: the registry transparently retries a
+                # swap-raced submit, and counting each attempt would
+                # inflate serve_requests past serve_http_requests
+                raise BatcherClosed("batcher closed")
+            if tm.on:
+                tm.add("serve_requests", 1)
+            if len(self._pending) >= self.queue_depth:
+                if tm.on:
+                    tm.add("serve_shed_requests", 1)
+                raise ShedLoad(
+                    f"serving queue full ({self.queue_depth} requests "
+                    "waiting)",
+                    retry_after_s=max(self.shed_ms, 1000.0) / 1e3)
+            wait = self._projected_wait_ms()
+            if wait > self.shed_ms:
+                if tm.on:
+                    tm.add("serve_shed_requests", 1)
+                raise ShedLoad(
+                    f"projected queue wait {wait:.0f} ms exceeds "
+                    f"serve_shed_deadline_ms={self.shed_ms:g}",
+                    retry_after_s=wait / 1e3)
+            req = _Request(rows, self._clock())
+            self._pending.append(req)
+            self._pending_rows += req.n
+            self._cond.notify_all()
+        if not req.done.wait(timeout_s):
+            raise TimeoutError(
+                f"serve request timed out after {timeout_s}s "
+                "(dispatcher stalled?)")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- coalescing decisions (pure w.r.t. the injected clock) ---------
+    def _ready(self, now: float) -> bool:
+        """Whether the dispatcher should dispatch NOW: pending work
+        and (closing, or a full batch, or the oldest request's
+        coalescing deadline expired)."""
+        if not self._pending:
+            return False
+        if self._closed or self._pending_rows >= self.max_rows:
+            return True
+        return (now - self._pending[0].t_enq) * 1e3 >= self.deadline_ms
+
+    def _take_batch(self) -> List[_Request]:
+        """Pop the longest request prefix within ``max_rows`` (lock
+        held).  A single over-cap request dispatches alone — the
+        predictor chunk-streams it internally."""
+        batch: List[_Request] = []
+        rows = 0
+        while self._pending:
+            r = self._pending[0]
+            if batch and rows + r.n > self.max_rows:
+                break
+            batch.append(self._pending.popleft())
+            rows += r.n
+        self._pending_rows -= rows
+        return batch
+
+    # -- dispatcher ----------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._ready(self._clock()):
+                    if self._closed and not self._pending:
+                        return
+                    if self._pending:
+                        age_s = self._clock() - self._pending[0].t_enq
+                        left = max(self.deadline_ms / 1e3 - age_s, 1e-4)
+                        self._cond.wait(left)
+                    else:
+                        self._cond.wait()
+                if self._closed and not self._pending:
+                    return
+                batch = self._take_batch()
+            self._run_batch(batch)
+
+    def drain_pending(self) -> int:
+        """Dispatch everything pending inline (deadline ignored) on
+        the CALLING thread; returns the number of dispatches.  The
+        deterministic seam for tests and for draining a never-started
+        batcher."""
+        dispatches = 0
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return dispatches
+                batch = self._take_batch()
+            self._run_batch(batch)
+            dispatches += 1
+
+    def _bucket(self, m: int) -> int:
+        """Nominal bucket the predictor's ladder rounds ``m`` rows up
+        to — the fill-metric denominator (exact shape when bucketing
+        is off; the predictor may additionally clamp to its chunk
+        cap, which this metric deliberately ignores: fill measures
+        batching quality against the ladder, not chunking)."""
+        if not self.bucketed:
+            return m
+        from ..booster import round_up_bucket
+        return round_up_bucket(m, self.min_bucket)
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        tm = TELEMETRY
+        now = self._clock()
+        t0 = time.perf_counter()
+        rows = sum(r.n for r in batch)
+        try:
+            x = batch[0].rows if len(batch) == 1 else np.concatenate(
+                [r.rows for r in batch], axis=0)
+            with tm.span("serve_dispatch", requests=len(batch),
+                         rows=rows):
+                out = np.asarray(self.predict(x))
+        except Exception as e:
+            # per-request failure propagation: the whole coalesced
+            # batch shares the dispatch, so it shares the error
+            for r in batch:
+                r.error = e
+                r.done.set()
+            if tm.on:
+                tm.add("serve_errors", len(batch))
+            return
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._dispatch_ewma_ms = dt_ms if not self._dispatch_ewma_ms \
+                else 0.8 * self._dispatch_ewma_ms + 0.2 * dt_ms
+        s = 0
+        for r in batch:
+            r.result = out[s:s + r.n]
+            s += r.n
+            r.done.set()
+        if tm.on:
+            tm.add("serve_dispatches", 1)
+            tm.add("serve_rows", rows)
+            if len(batch) > 1:
+                # requests that shared a dispatch with at least one
+                # other — the amortization the micro-batcher exists for
+                tm.add("serve_coalesced_requests", len(batch))
+            tm.observe("serve_batch_fill", rows / self._bucket(rows),
+                       bounds=RATIO_BOUNDS)
+            tm.observe("serve_batch_rows", rows, bounds=BATCH_BOUNDS)
+            for r in batch:
+                tm.observe("serve_queue_wait_ms",
+                           max(now - r.t_enq, 0.0) * 1e3)

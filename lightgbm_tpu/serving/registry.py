@@ -1,0 +1,270 @@
+"""Model registry: named, versioned Boosters with atomic hot swap.
+
+Deploys must never serve a cold compile: ``publish`` warms the new
+version's serving-predictor buckets (``Booster.warm_predictor`` —
+with ``compile_cache_dir`` wired this is a disk hit in repeat
+processes, visible as ``compile_cache_hits``) BEFORE the cutover, so
+the new version's first request dispatches an already-compiled
+bucket.  The cutover itself is one pointer flip under the registry
+lock; entries are immutable (booster + version + batcher fixed at
+publish), so a request that grabbed an entry can never observe a
+half-swapped ensemble.  The old version's micro-batcher then drains
+its in-flight queue and closes — a submit that raced the swap gets
+:class:`~lightgbm_tpu.serving.batcher.BatcherClosed` and the
+registry transparently retries against the new current entry, so
+hot swap produces zero failed and zero mixed-version responses
+(pinned by ``tests/test_serving.py``).
+
+Rollback is the same pointer flip back to the previous version
+(kept resident: its booster — and the process-wide compiled
+programs underneath — stay warm), with a fresh batcher replacing
+the drained one.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..telemetry import TELEMETRY
+from ..utils.log import Log
+from .batcher import BatcherClosed, MicroBatcher
+
+
+class FeatureWidthMismatch(ValueError):
+    """Request rows don't match the served model's feature count.
+    Raised per attempt inside :meth:`ModelRegistry.predict` (so a
+    width check can never race a hot swap to a different-width
+    model); the HTTP frontend maps it to 400."""
+
+    def __init__(self, expected: int, got: int):
+        super().__init__(f"expected {expected} features per row, "
+                         f"got {got}")
+        self.expected = expected
+        self.got = got
+
+
+class ModelEntry:
+    """One immutable (name, version) serving unit: the Booster, its
+    predict closure, and the micro-batcher that owns its in-flight
+    queue."""
+
+    __slots__ = ("name", "version", "booster", "batcher", "_predict_fn")
+
+    def __init__(self, name: str, version: int, booster, predict_fn,
+                 batcher: MicroBatcher):
+        self.name = name
+        self.version = int(version)
+        self.booster = booster
+        self._predict_fn = predict_fn
+        self.batcher = batcher
+
+    def predict(self, rows: np.ndarray) -> np.ndarray:
+        return self.batcher.submit(rows)
+
+
+class ModelRegistry:
+    """Process-local registry of served models (one per frontend)."""
+
+    def __init__(self, config=None):
+        self.config = config
+        self._lock = threading.Lock()
+        self._current: Dict[str, ModelEntry] = {}
+        self._versions: Dict[str, List[ModelEntry]] = {}
+        # serving history per name: what _current pointed at before
+        # each swap, in order — rollback restores from HERE, not from
+        # publish order (after rollback-then-republish, the previous
+        # SERVING version is not the previously PUBLISHED one)
+        self._history: Dict[str, List[ModelEntry]] = {}
+
+    # -- publish / swap ------------------------------------------------
+    @staticmethod
+    def _routes_to_device(predict_kwargs: dict) -> bool:
+        """Whether this entry's predict calls will reach the bucketed
+        device predictor (what ``warm_predictor`` compiles).  Pinned
+        routing wins; auto routing follows the backend."""
+        device = predict_kwargs.get("device")
+        if device is not None:
+            return bool(device)
+        import jax
+        return jax.default_backend() in ("tpu", "axon")
+
+    def _default_warm(self, predict_kwargs: dict) -> Tuple[int, ...]:
+        cfg = self.config
+        declared = tuple(getattr(cfg, "predict_warm_buckets", ()) or ())
+        if declared:
+            # explicitly declared shapes always warm — the operator
+            # said so (e.g. ahead of forcing device routing later)
+            return declared
+        if not self._routes_to_device(predict_kwargs):
+            # auto routing on a host backend takes the float64 tree
+            # walk: compiling the device bucket ladder would burn
+            # publish time on programs no request ever dispatches
+            Log.debug("serving registry: implicit warm skipped — "
+                      "predict routes to the host walk on this "
+                      "backend")
+            return ()
+        # no declared shapes: warm the WHOLE power-of-two ladder from
+        # the single-row bucket up to the coalesced-dispatch cap — a
+        # mid-size coalesced batch lands on an intermediate bucket,
+        # and warming only the endpoints would leave it a cold
+        # compile mid-traffic (with compile_cache_dir wired, repeat
+        # deploys disk-hit every rung anyway)
+        lo = max(1, int(getattr(cfg, "predict_min_bucket_rows", 16)))
+        hi = max(lo, int(getattr(cfg, "serve_max_batch_rows", 1024)))
+        ladder = []
+        b = lo
+        while b < hi:
+            ladder.append(b)
+            b <<= 1
+        ladder.append(hi)
+        return tuple(ladder)
+
+    def publish(self, name: str, model, version: Optional[int] = None,
+                warm: Optional[Tuple[int, ...]] = None,
+                predict_kwargs: Optional[dict] = None,
+                log_warm: bool = False) -> ModelEntry:
+        """Register ``model`` (a Booster or a model-file path) as the
+        new current version of ``name``.  Buckets are warmed BEFORE
+        the pointer flip; the replaced version drains its in-flight
+        work and releases its dispatcher."""
+        from ..booster import Booster
+        cfg = self.config
+        if isinstance(model, str):
+            booster = Booster(config=cfg, model_file=model)
+        else:
+            booster = model
+        kw = dict(predict_kwargs or {})
+
+        def predict_fn(rows, _b=booster, _kw=kw):
+            return _b.predict(rows, **_kw)
+
+        warm = self._default_warm(kw) if warm is None else tuple(warm)
+        if warm:
+            # warm-before-cutover: compile (or disk-hit) every
+            # declared bucket while the OLD version still serves
+            booster.warm_predictor(warm, log=log_warm)
+        with self._lock:
+            versions = self._versions.setdefault(name, [])
+            if version is None:
+                version = max((e.version for e in versions),
+                              default=0) + 1
+            version = int(version)
+            if any(e.version == version for e in versions):
+                raise ValueError(
+                    f"model {name!r} already has a version {version}")
+            entry = ModelEntry(
+                name, version, booster, predict_fn,
+                MicroBatcher(predict_fn, cfg,
+                             name=f"{name}@v{version}"))
+            versions.append(entry)
+            old = self._current.get(name)
+            if old is not None:
+                self._history.setdefault(name, []).append(old)
+            self._current[name] = entry      # THE atomic cutover
+        tm = TELEMETRY
+        if tm.on:
+            tm.add("serve_model_swaps" if old is not None
+                   else "serve_model_publishes", 1)
+            tm.gauge(f"serve_version.{name}", version)
+        if old is not None:
+            # new version already serves; finish the old one's queue
+            old.batcher.close(drain=True)
+        Log.info(f"serving registry: {name!r} -> v{version}"
+                 + (f" (replaced v{old.version})" if old else "")
+                 + (f", warmed buckets {list(warm)}" if warm else ""))
+        return entry
+
+    def rollback(self, name: str) -> ModelEntry:
+        """Pointer-flip ``name`` back to the version that was SERVING
+        before the current one took over (the serving history, not
+        publish order — after a rollback-then-republish, the previous
+        publish may be the very version ops already rolled back as
+        bad).  The restored version's compiled programs are still
+        resident, so rollback serves warm immediately."""
+        with self._lock:
+            if name not in self._current:
+                raise KeyError(f"no model named {name!r}")
+            cur = self._current[name]
+            hist = self._history.get(name) or []
+            if not hist:
+                raise ValueError(
+                    f"model {name!r} has no earlier serving version "
+                    f"to roll back to (current v{cur.version})")
+            prev = hist.pop()
+            if prev.batcher.closed:
+                prev.batcher = MicroBatcher(
+                    prev._predict_fn, self.config,
+                    name=f"{name}@v{prev.version}")
+            self._current[name] = prev
+        tm = TELEMETRY
+        if tm.on:
+            tm.add("serve_rollbacks", 1)
+            tm.gauge(f"serve_version.{name}", prev.version)
+        cur.batcher.close(drain=True)
+        Log.warning(f"serving registry: rolled {name!r} back "
+                    f"v{cur.version} -> v{prev.version}")
+        return prev
+
+    # -- lookup / serve ------------------------------------------------
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            entry = self._current.get(name)
+        if entry is None:
+            raise KeyError(f"no model named {name!r}")
+        return entry
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._current)
+
+    def predict(self, name: str,
+                rows: np.ndarray) -> Tuple[ModelEntry, np.ndarray]:
+        """Serve one request against the current version of ``name``.
+        A submit that lands on a version mid-drain (hot-swap race)
+        retries against the new current pointer — the caller never
+        sees the swap.  Feature width is validated against the SAME
+        entry the request is submitted to (per attempt, so a swap to
+        a different-width model between check and submit is
+        impossible); a mismatch raises
+        :class:`FeatureWidthMismatch`, which one bad client gets as
+        a 400 instead of failing every batchmate's concatenate."""
+        rows = np.asarray(rows, dtype=np.float64)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        for _ in range(64):
+            entry = self.get(name)
+            nf = entry.booster.num_feature()
+            if rows.shape[1] != nf:
+                raise FeatureWidthMismatch(nf, rows.shape[1])
+            try:
+                return entry, entry.batcher.submit(rows)
+            except BatcherClosed:
+                continue
+        raise RuntimeError(
+            f"model {name!r}: current version kept closing underneath "
+            "the request (registry shutting down?)")
+
+    def describe(self) -> Dict[str, dict]:
+        """The ``/models`` endpoint body."""
+        with self._lock:
+            return {
+                name: {
+                    "version": entry.version,
+                    "versions": [e.version
+                                 for e in self._versions.get(name, [])],
+                    "queue_depth": entry.batcher.depth(),
+                }
+                for name, entry in self._current.items()
+            }
+
+    def close(self) -> None:
+        """Drain and release every entry (process shutdown)."""
+        with self._lock:
+            entries = [e for vs in self._versions.values() for e in vs]
+            self._current.clear()
+            self._versions.clear()
+            self._history.clear()
+        for e in entries:
+            e.batcher.close(drain=True)

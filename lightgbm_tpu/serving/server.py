@@ -1,0 +1,219 @@
+"""Load-shedding HTTP frontend over the bucketed predictor.
+
+Stdlib-only (the project-wide zero-dependency constraint): the
+frontend does not open its own port — it mounts routes on the SAME
+listener as the r13 telemetry daemon (``TELEMETRY.serve_metrics``),
+so one process exposes ``/predict/<model>``, ``/models``,
+``/metrics`` and ``/healthz`` together.
+
+Request surface::
+
+    POST /predict/<model>
+        body: JSON {"rows": [[...], ...]} (or a bare array), or CSV
+              rows (Content-Type text/csv, one row per line)
+        200: {"model": ..., "version": ..., "predictions": [...]}
+        400 bad body / 404 unknown model / 405 non-POST
+        503 + Retry-After: admission control shed the request
+            (queue full, or projected wait > serve_shed_deadline_ms)
+        500: handler crash — flight-recorder dump, listener survives
+    GET /models
+        registry listing {name: {version, versions, queue_depth}}
+
+Predictions serialize through ``float -> repr`` JSON round-tripping,
+so a client parsing the body recovers byte-identical float64 values
+to a direct ``Booster.predict`` of the same rows (the
+``tests/test_serving.py`` parity pin).
+
+Reliability seams: every request passes the ``serving.request``
+fault point (an injected fault exercises the 500 path), an unhandled
+handler exception dumps the crash flight recorder
+(``serving_handler_crash``) and answers 500 without tearing down the
+listener, and a device OOM inside the predictor engages the r12
+bucket-downshift ladder — counted, not fatal.
+"""
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..reliability.faults import FAULTS
+from ..telemetry import TELEMETRY
+from ..utils.log import Log
+from .batcher import ShedLoad
+from .registry import FeatureWidthMismatch, ModelRegistry
+
+
+def parse_rows(body: bytes, content_type: str = "") -> np.ndarray:
+    """Decode a request body into an (n, F) float64 matrix.  JSON
+    (object with "rows"/"data", or a bare nested array) or CSV
+    (one row per line, ``,``/whitespace separated).  Raises
+    ValueError on anything else."""
+    text = body.decode("utf-8", errors="strict").strip()
+    if not text:
+        raise ValueError("empty request body")
+    ctype = (content_type or "").lower()
+    if "csv" in ctype or not text.startswith(("[", "{")):
+        rows = [[float(tok) for tok in
+                 ln.replace("\t", ",").replace(" ", ",").split(",")
+                 if tok != ""]
+                for ln in text.splitlines() if ln.strip()]
+    else:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            obj = obj.get("rows", obj.get("data"))
+            if obj is None:
+                raise ValueError('JSON body must carry "rows"')
+        rows = obj
+    arr = np.asarray(rows, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    if arr.ndim != 2 or arr.shape[1] == 0:
+        raise ValueError(f"rows must be a 2D matrix, got shape "
+                         f"{arr.shape}")
+    return arr
+
+
+def _json_response(status: int, payload: dict, extra=None):
+    return (status, "application/json",
+            json.dumps(payload).encode(), extra)
+
+
+class ServingFrontend:
+    """Mounts the serving routes on the shared telemetry listener and
+    answers them against a :class:`ModelRegistry`."""
+
+    def __init__(self, registry: ModelRegistry, config=None):
+        self.registry = registry
+        self.config = config
+        self._srv = None
+        self._owns_listener = False
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, port: Optional[int] = None):
+        """Register routes and ensure the shared HTTP listener runs.
+        ``port=None`` resolves ``telemetry_http_port`` (an
+        already-running daemon is reused as-is) then ``serve_port``
+        (0 = ephemeral).  Returns the server."""
+        tm = TELEMETRY
+        tm.register_http_route("/predict/", self._predict_route)
+        tm.register_http_route("/models", self._models_route)
+        if port is None:
+            port = int(getattr(self.config, "telemetry_http_port", 0)) \
+                or int(getattr(self.config, "serve_port", 0))
+        self._owns_listener = tm._http is None
+        self._srv = tm.serve_metrics(int(port))
+        return self._srv
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def stop(self, drain: bool = True) -> None:
+        """Unmount the serving routes and drain the registry.  The
+        listener is stopped only if ``start()`` created it — a
+        pre-existing ``telemetry_http_port`` daemon keeps scraping
+        after serving shuts down."""
+        tm = TELEMETRY
+        tm.unregister_http_route("/predict/")
+        tm.unregister_http_route("/models")
+        if drain:
+            self.registry.close()
+        if self._srv is not None:
+            if self._owns_listener:
+                tm.stop_metrics_server()
+            self._srv = None
+
+    # -- routes --------------------------------------------------------
+    def _models_route(self, method, path, body, headers):
+        return _json_response(200, self.registry.describe())
+
+    def _predict_route(self, method, path, body, headers):
+        t0 = time.perf_counter()
+        tm = TELEMETRY
+        span = tm.start_span("serve_request")
+        try:
+            resp = self._handle_predict(method, path, body, headers)
+        except Exception as e:
+            # handler crash: dump the flight recorder (when armed)
+            # with the serving seam, answer 500, keep the listener up
+            tm.flight.dump("serving_handler_crash",
+                           seam="serving.request",
+                           error=repr(e)[:300])
+            if tm.on:
+                tm.add("serve_errors", 1)
+            resp = _json_response(500, {"error": repr(e)[:300]})
+        finally:
+            tm.end_span(span)
+        if tm.on:
+            tm.add("serve_http_requests", 1)
+            tm.observe("serve_request_ms",
+                       (time.perf_counter() - t0) * 1e3)
+        return resp
+
+    def _handle_predict(self, method, path, body, headers):
+        FAULTS.fault_point("serving.request")
+        if method != "POST":
+            return _json_response(
+                405, {"error": "POST rows to /predict/<model>"},
+                {"Allow": "POST"})
+        name = path.split("?", 1)[0].rstrip("/").rsplit("/", 1)[-1]
+        if not name or name == "predict":
+            return _json_response(
+                404, {"error": "no model in path; POST "
+                               "/predict/<model>"})
+        try:
+            rows = parse_rows(bytes(body),
+                              headers.get("Content-Type", "")
+                              if headers is not None else "")
+        except (ValueError, json.JSONDecodeError,
+                UnicodeDecodeError) as e:
+            return _json_response(400, {"error": str(e)[:300]})
+        try:
+            entry, out = self.registry.predict(name, rows)
+        except KeyError:
+            return _json_response(
+                404, {"error": f"no model named {name!r}",
+                      "models": self.registry.names()})
+        except FeatureWidthMismatch as e:
+            # rejected at admission, validated against the exact
+            # entry the rows would have been submitted to: a
+            # wrong-width matrix inside a coalesced batch would fail
+            # the concatenate and 500 every innocent batchmate
+            return _json_response(400, {"error": str(e)})
+        except ShedLoad as e:
+            # load shedding: tell the client when to come back
+            # instead of queueing it into a timeout
+            return _json_response(
+                503, {"error": str(e)},
+                {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))})
+        except Exception as e:
+            # dispatch failure, not a handler crash: the batcher
+            # already counted serve_errors per affected request and
+            # the OOM/flight machinery below it owns the dump — a
+            # second count + crash-labeled dump here would double
+            # every dispatch error
+            return _json_response(
+                500, {"error": f"prediction failed: {repr(e)[:300]}"})
+        return _json_response(200, {
+            "model": name,
+            "version": entry.version,
+            # float64 -> Python float -> repr round-trips exactly:
+            # the client recovers byte-identical doubles
+            "predictions": np.asarray(out).tolist(),
+        })
+
+
+def serve(registry: ModelRegistry, config=None,
+          port: Optional[int] = None) -> ServingFrontend:
+    """Convenience one-liner: mount ``registry`` and start serving."""
+    frontend = ServingFrontend(registry, config)
+    srv = frontend.start(port)
+    Log.info("serving frontend on "
+             f"http://127.0.0.1:{srv.server_address[1]} "
+             f"(models: {', '.join(registry.names()) or '<none>'}; "
+             "POST /predict/<model>, GET /models /metrics /healthz)")
+    return frontend

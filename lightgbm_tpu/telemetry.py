@@ -105,6 +105,12 @@ MAX_EVENTS = 500_000
 LATENCY_BOUNDS_MS = tuple(0.05 * (1 << i) for i in range(21))
 # small-integer bound spec for depth/occupancy histograms
 DEPTH_BOUNDS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+# fraction bound spec (0..1]: batch fill ratio of the serving
+# micro-batcher (real rows / bucket rows of one coalesced dispatch)
+RATIO_BOUNDS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+# power-of-two row-count bounds for coalesced-batch-size histograms
+# (mirrors the serving predictor's bucket ladder)
+BATCH_BOUNDS = tuple(float(1 << i) for i in range(13))  # 1 .. 4096
 
 # prometheus metric name prefix (docs/OBSERVABILITY.md name mapping:
 # counter `x` -> `ltpu_x_total`, gauge `x` -> `ltpu_x`, histogram `x`
@@ -365,6 +371,12 @@ class Telemetry:
         self._sync: Optional[tuple] = None   # (name, rel_ts_s)
         self.flight = FlightRecorder()
         self._http = None
+        # HTTP route table for the shared scrape/serving listener:
+        # {path or prefix-ending-in-/: fn(method, path, body, headers)
+        # -> (status, content_type, body_bytes, extra_headers|None)}.
+        # serve_metrics installs /metrics and /healthz; the serving
+        # frontend mounts /predict/ and /models on the SAME server
+        self._http_routes: Dict[str, Any] = {}
 
     # -- configuration -------------------------------------------------
     def configure(self, mode: str = "counters", out: str = "",
@@ -893,37 +905,90 @@ class Telemetry:
         os.replace(tmp, path)
         return path
 
+    def register_http_route(self, prefix: str, fn) -> None:
+        """Mount ``fn(method, path, body, headers) -> (status, ctype,
+        body_bytes, extra_headers|None)`` on the shared HTTP listener.
+        A ``prefix`` ending in ``/`` matches any path under it (longest
+        prefix wins); otherwise the match is exact.  Routes may be
+        registered before OR after ``serve_metrics`` starts the
+        server — the handler resolves against the live table."""
+        with self._lock:
+            self._http_routes[str(prefix)] = fn
+
+    def unregister_http_route(self, prefix: str) -> None:
+        with self._lock:
+            self._http_routes.pop(str(prefix), None)
+
+    def _resolve_route(self, path: str):
+        with self._lock:
+            routes = dict(self._http_routes)
+        best = None
+        for prefix, fn in routes.items():
+            if prefix.endswith("/"):
+                if not path.startswith(prefix):
+                    continue
+            elif path != prefix:
+                continue
+            if best is None or len(prefix) > len(best[0]):
+                best = (prefix, fn)
+        return best[1] if best else None
+
+    def _metrics_route(self, method, path, body, headers):
+        return (200, "text/plain; version=0.0.4",
+                self.to_prometheus().encode(), None)
+
+    def _healthz_route(self, method, path, body, headers):
+        return (200, "application/json", json.dumps(
+            {"status": "ok", "run_id": self.run_id,
+             "host_id": self.host(),
+             "mode": MODES[self.mode]}).encode(), None)
+
     def serve_metrics(self, port: int, host: str = "127.0.0.1"):
         """Start the stdlib HTTP scrape endpoint
         (``Config.telemetry_http_port``): ``GET /metrics`` returns the
-        Prometheus text format, ``GET /healthz`` a JSON liveness body.
-        Daemon-threaded; returns the server (``.server_address`` for
-        an ephemeral port, ``.shutdown()`` to stop)."""
+        Prometheus text format, ``GET /healthz`` a JSON liveness body,
+        plus any route mounted via ``register_http_route`` (the
+        serving frontend's ``/predict/<model>`` shares this one
+        listener instead of opening a second port).  Daemon-threaded;
+        returns the server (``.server_address`` for an ephemeral port,
+        ``.shutdown()`` to stop)."""
         if self._http is not None:
             return self._http
+        self.register_http_route("/metrics", self._metrics_route)
+        self.register_http_route("/healthz", self._healthz_route)
         from http.server import BaseHTTPRequestHandler, \
             ThreadingHTTPServer
         tm = self
 
         class _Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path.split("?")[0] == "/metrics":
-                    body = tm.to_prometheus().encode()
-                    ctype = "text/plain; version=0.0.4"
-                elif self.path.split("?")[0] == "/healthz":
-                    body = json.dumps(
-                        {"status": "ok", "run_id": tm.run_id,
-                         "host_id": tm.host(),
-                         "mode": MODES[tm.mode]}).encode()
-                    ctype = "application/json"
-                else:
+            def _dispatch(self, method):
+                fn = tm._resolve_route(self.path.split("?", 1)[0])
+                if fn is None:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                n = int(self.headers.get("Content-Length") or 0)
+                req_body = self.rfile.read(n) if n > 0 else b""
+                try:
+                    status, ctype, body, extra = fn(
+                        method, self.path, req_body, self.headers)
+                except Exception as e:  # pragma: no cover - route bug
+                    # routes are expected to answer errors themselves;
+                    # a crash here must not tear down the listener
+                    self.send_error(500, explain=str(e)[:200])
+                    return
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
 
             def log_message(self, *args):  # quiet: scrapes are periodic
                 pass
@@ -955,6 +1020,43 @@ class Telemetry:
 
 
 TELEMETRY = Telemetry()
+
+
+# ---------------------------------------------------------------------------
+# Persistent-compile-cache counters (round 14): jax emits monitoring
+# events on every persistent-cache lookup; bridging them into named
+# counters makes the cache visible on the Prometheus surface (the
+# registry's warm-before-cutover guarantee is monitored there —
+# a deploy that compiles instead of disk-hitting shows up as
+# compile_cache_misses climbing).
+# ---------------------------------------------------------------------------
+_CACHE_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "compile_cache_hits",
+    "/jax/compilation_cache/cache_misses": "compile_cache_misses",
+}
+_CACHE_WATCH = {"armed": False}
+
+
+def _compile_cache_event(event: str, **kwargs) -> None:
+    name = _CACHE_EVENT_COUNTERS.get(event)
+    if name is not None:
+        TELEMETRY.add(name, 1)
+
+
+def watch_compile_cache() -> None:
+    """Register the jax monitoring listener mapping persistent-cache
+    hit/miss events to ``compile_cache_hits``/``compile_cache_misses``
+    counters.  Idempotent; a jax version without the monitoring
+    surface degrades to log-only (the pre-r14 behavior)."""
+    if _CACHE_WATCH["armed"]:
+        return
+    try:
+        from jax._src import monitoring as _monitoring
+        _monitoring.register_event_listener(_compile_cache_event)
+        _CACHE_WATCH["armed"] = True
+    except Exception as e:  # pragma: no cover - jax-version-dependent
+        Log.debug(f"compile-cache telemetry unavailable "
+                  f"({type(e).__name__}: {e})")
 
 
 _RETRACE_WARN_DEFAULT = 8

@@ -106,6 +106,15 @@ for base, buckets in hists.items():
 print(f"prom scrape ok: {len(hists)} histogram series, "
       f"buckets monotone")
 EOF
+# serving probe (round 14): in-process registry + micro-batching
+# frontend under concurrent single-row clients through real HTTP —
+# parity vs direct predict, coalescing actually occurring
+# (dispatches < requests), a generous p99 bound and clean queue
+# drain on shutdown are asserted by test_bench_smoke on the JSON
+SERVE_CLIENTS=${SERVE_CLIENTS:-8} \
+SERVE_REQUESTS=${SERVE_REQUESTS:-12} \
+python scripts/serve_bench.py /tmp/lgbtpu_smoke/serve.json >&2
+test -s /tmp/lgbtpu_smoke/serve.json
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Serving load generator: closed/open-loop clients against the
+lightgbm_tpu HTTP frontend, reporting p50/p99 latency and dispatch
+amortization vs offered load.
+
+Runs the whole stack in-process (train a tiny model — or load
+SERVE_MODEL — publish it warm, mount the frontend on an ephemeral
+port), fires real HTTP requests from concurrent client threads, and
+reads the serving telemetry counters for the numbers no client can
+see: coalesced dispatches, batch fill, queue wait.  Used two ways:
+
+- ``scripts/bench_smoke.sh`` runs it as the serve probe
+  (``tests/test_bench_smoke.py`` asserts parity, coalescing,
+  p99 bound and clean drain on the JSON it writes), and
+- by hand against capacity questions: sweep SERVE_CLIENTS /
+  SERVE_MODE=open SERVE_RATE and read the shed rate + p99 curve
+  (docs/SERVING.md, capacity planning).
+
+Usage:  python scripts/serve_bench.py [OUT.json]
+
+Env knobs (defaults in parens): SERVE_CLIENTS (8) concurrent client
+threads; SERVE_REQUESTS (24) requests per client; SERVE_ROWS ("1")
+comma list of request row counts cycled per request; SERVE_MODE
+(closed) closed|open; SERVE_RATE (200) open-loop offered requests/s
+across all clients; SERVE_DEADLINE_MS (5) serve_batch_deadline_ms;
+SERVE_MODEL ("") model file to serve instead of the built-in tiny
+model (needs SERVE_FEATURES for row width).
+"""
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def build_model(features=8, rows=400, iters=6):
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(7)
+    X = rng.randn(rows, features)
+    y = X[:, 0] - 0.3 * X[:, 1]
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), iters, verbose_eval=False)
+    return bst, X
+
+
+def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
+              mode="closed", rate=200.0, deadline_ms=5.0) -> dict:
+    """Serve ``bst`` in-process and drive it with ``clients``
+    concurrent threads; returns the result record (latencies from the
+    clients, amortization/fill from the telemetry counters, parity
+    vs direct predict, drain state)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serving import ModelRegistry, ServingFrontend
+    from lightgbm_tpu.telemetry import TELEMETRY, hist_quantile
+
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    cfg = Config.from_params({
+        "verbose": -1,
+        "serve_batch_deadline_ms": deadline_ms,
+    })
+    registry = ModelRegistry(cfg)
+    registry.publish("bench", bst)
+    frontend = ServingFrontend(registry, cfg)
+    port = frontend.start(0).server_address[1]
+
+    rows_spec = tuple(int(r) for r in rows_spec) or (1,)
+    lat_ms = [[] for _ in range(clients)]
+    sheds = [0] * clients
+    failures = []
+    # every client's first response is parity-checked against direct
+    # predict of the same rows (byte-identical: JSON repr round-trip)
+    parity_bad = []
+    t_start = time.perf_counter()
+
+    def client(ci):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        interval = clients / rate if mode == "open" else 0.0
+        for k in range(requests):
+            n = rows_spec[(ci + k) % len(rows_spec)]
+            lo = (ci * requests + k * n) % max(X.shape[0] - n, 1)
+            rows = X[lo:lo + n]
+            body = json.dumps({"rows": rows.tolist()}).encode()
+            if mode == "open" and k:
+                # open loop: hold the offered rate regardless of
+                # response latency (sleep off the schedule, not the
+                # reply)
+                next_t = t_start + ci * (interval / clients) \
+                    + k * interval
+                dt = next_t - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/predict/bench", body=body,
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                payload = resp.read()
+            except Exception as e:
+                failures.append(repr(e))
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                continue
+            wall = (time.perf_counter() - t0) * 1e3
+            if resp.status == 503:
+                sheds[ci] += 1
+                continue
+            if resp.status != 200:
+                failures.append(f"HTTP {resp.status}: "
+                                f"{payload[:200]!r}")
+                continue
+            lat_ms[ci].append(wall)
+            if k == 0:
+                got = json.loads(payload)["predictions"]
+                want = bst.predict(rows).tolist()
+                if got != want:
+                    parity_bad.append((ci, got, want))
+        conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_start
+
+    # clean drain: stop() drains every batcher queue before returning;
+    # grab the entry first (close() empties the registry)
+    entry = registry.get("bench")
+    frontend.stop(drain=True)
+    drained = entry.batcher.closed and entry.batcher.depth() == 0
+    c = TELEMETRY.counters()
+    hists = TELEMETRY.histograms()
+    lats = sorted(x for per in lat_ms for x in per)
+    total_ok = len(lats)
+    total_shed = sum(sheds)
+    dispatches = int(c.get("serve_dispatches", 0))
+    reqs = int(c.get("serve_requests", 0))
+    fill = hists.get("serve_batch_fill")
+    qwait = hists.get("serve_queue_wait_ms")
+    qwait_p99 = hist_quantile(qwait, 0.99) if qwait else None
+    if qwait_p99 is not None and not np.isfinite(qwait_p99):
+        qwait_p99 = None    # overflow bucket: not a JSON number
+    out = {
+        "mode": mode,
+        "clients": clients,
+        "requests": reqs,
+        "requests_ok": total_ok,
+        "shed": total_shed,
+        "failures": failures[:5],
+        "offered_rps": round(rate if mode == "open"
+                             else (reqs / wall_s if wall_s else 0), 1),
+        "wall_s": round(wall_s, 3),
+        "p50_ms": round(float(np.percentile(lats, 50)), 3) if lats
+        else None,
+        "p99_ms": round(float(np.percentile(lats, 99)), 3) if lats
+        else None,
+        "dispatches": dispatches,
+        "rows": int(c.get("serve_rows", 0)),
+        "coalesced_requests": int(c.get("serve_coalesced_requests", 0)),
+        # the number the micro-batcher exists for: requests answered
+        # per device dispatch (1.0 = no coalescing)
+        "amortization": round(reqs / dispatches, 2) if dispatches
+        else None,
+        "batch_fill_mean": round(fill["sum"] / fill["count"], 3)
+        if fill and fill["count"] else None,
+        "queue_wait_p99_ms": qwait_p99,
+        "parity": "fail" if (parity_bad or failures) else "pass",
+        "drain": "clean" if drained else "dirty",
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    model_file = os.environ.get("SERVE_MODEL", "")
+    if model_file:
+        import lightgbm_tpu as lgb
+        bst = lgb.Booster(model_file=model_file)
+        f = bst.num_feature()
+        rng = np.random.RandomState(7)
+        X = rng.randn(512, f)
+    else:
+        bst, X = build_model()
+    rows_spec = tuple(
+        int(r) for r in os.environ.get("SERVE_ROWS", "1").split(",")
+        if r.strip())
+    out = run_bench(
+        bst, X,
+        clients=_env_int("SERVE_CLIENTS", 8),
+        requests=_env_int("SERVE_REQUESTS", 24),
+        rows_spec=rows_spec,
+        mode=os.environ.get("SERVE_MODE", "closed"),
+        rate=float(os.environ.get("SERVE_RATE", "200")),
+        deadline_ms=float(os.environ.get("SERVE_DEADLINE_MS", "5")),
+    )
+    text = json.dumps(out, indent=1)
+    if argv:
+        with open(argv[0], "w") as fh:
+            fh.write(text + "\n")
+        print(f"serve_bench: {out['requests']} requests -> "
+              f"{out['dispatches']} dispatches "
+              f"(amortization {out['amortization']}), "
+              f"p50 {out['p50_ms']} ms p99 {out['p99_ms']} ms, "
+              f"parity {out['parity']} -> {argv[0]}", file=sys.stderr)
+    else:
+        print(text)
+    return 0 if out["parity"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,31 @@ def test_bench_smoke_json_contract():
     d = json.load(open(dumps[-1]))
     assert d["seam"] == "predict.dispatch"
     assert d["events"]
+    # serving probe (round 14): concurrent single-row clients through
+    # the micro-batching HTTP frontend — scripts/serve_bench.py, run
+    # in-line by bench_smoke.sh
+    with open("/tmp/lgbtpu_smoke/serve.json") as f:
+        s = json.load(f)
+    for field in ("requests", "requests_ok", "dispatches",
+                  "amortization", "p50_ms", "p99_ms", "shed",
+                  "coalesced_requests", "parity", "drain"):
+        assert field in s, f"serve probe missing {field}"
+    assert s["parity"] == "pass"
+    assert not s["failures"]
+    # every offered request was either answered or explicitly shed
+    # (bounds derived from the run's own totals — SERVE_CLIENTS /
+    # SERVE_REQUESTS overrides must not break the assertion)
+    assert s["requests_ok"] + s["shed"] >= s["requests"]
+    assert s["requests_ok"] >= s["clients"]
+    # the tentpole claim: N concurrent single-row requests cost
+    # strictly fewer than N dispatches
+    assert s["dispatches"] < s["requests"], (
+        f"{s['dispatches']} dispatches for {s['requests']} requests "
+        "— the micro-batcher coalesced nothing")
+    assert s["coalesced_requests"] > 0
+    # generous tail bound: the smoke runs on CPU with cold jit
+    assert s["p99_ms"] < 30000
+    assert s["drain"] == "clean", "serving queues not drained at stop"
 
 
 @pytest.mark.slow

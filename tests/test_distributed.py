@@ -89,6 +89,26 @@ def _free_port():
     return p
 
 
+def _skip_if_backend_incapable(err: str) -> None:
+    """Backend-capability gate (round 14, ROADMAP open items): this
+    jaxlib's CPU client cannot run cross-process computations AT ALL
+    — every collective in the 2-process path fails with
+    "INVALID_ARGUMENT: Multiprocess computations aren't implemented
+    on the CPU backend."  That is a missing backend capability, not a
+    regression in our distributed layer (the same path passes on a
+    multi-host-capable backend), so it skips with the reason recorded
+    instead of failing tier-1 red on every run."""
+    low = err.lower()
+    if ("implemented on the cpu backend" in low
+            and "multiprocess" in low):
+        last = [ln for ln in err.strip().splitlines() if ln.strip()]
+        pytest.skip("backend capability: this jaxlib cannot run "
+                    "multiprocess computations on the CPU backend "
+                    f"({last[-1][:160] if last else ''})")
+    if "distributed" in low and "support" in low:
+        pytest.skip(f"jax.distributed unsupported: {err[-300:]}")
+
+
 @pytest.mark.slow
 def test_two_process_distributed_binning(tmp_path):
     port = _free_port()
@@ -108,8 +128,7 @@ def test_two_process_distributed_binning(tmp_path):
                 q.kill()
             pytest.skip("jax.distributed CPU rendezvous timed out here")
         if p.returncode != 0:
-            if "distributed" in err.lower() and "support" in err.lower():
-                pytest.skip(f"jax.distributed unsupported: {err[-300:]}")
+            _skip_if_backend_incapable(err)
             raise AssertionError(out + err)
         outs.append(out)
     lines = {ln.split()[1]: ln.split() for o in outs
@@ -149,8 +168,7 @@ def test_two_process_distributed_training(tmp_path):
                 q.kill()
             pytest.skip("jax.distributed CPU rendezvous timed out here")
         if p.returncode != 0:
-            if "distributed" in err.lower() and "support" in err.lower():
-                pytest.skip(f"jax.distributed unsupported: {err[-300:]}")
+            _skip_if_backend_incapable(err)
             raise AssertionError(out + err)
         outs.append(out)
     lines = {ln.split()[1]: ln.split() for o in outs

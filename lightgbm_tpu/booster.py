@@ -1209,11 +1209,24 @@ class Booster:
     def refit(self, data: np.ndarray, label: np.ndarray,
               params: Optional[Dict[str, Any]] = None) -> "Booster":
         """Refit leaf values on new data keeping the tree structures
-        (reference gbdt.cpp:338-360 RefitTree + c_api refit task)."""
+        (reference gbdt.cpp:338-360 RefitTree + c_api refit task).
+        Telemetry: wrapped in a ``refit`` span, with every leaf whose
+        value was recomputed counted in ``refit_leaves_updated`` —
+        the continuous lane's refit cycles are sized by it."""
+        from .telemetry import TELEMETRY
+        span = TELEMETRY.start_span("refit",
+                                    rows=int(np.shape(data)[0]))
+        try:
+            return self._refit_impl(data, label, params)
+        finally:
+            TELEMETRY.end_span(span)
+
+    def _refit_impl(self, data, label, params) -> "Booster":
         from .config import Config
         from .dataset import Metadata
         from .objectives import create_objective
         from .ops.split import calculate_leaf_output
+        from .telemetry import TELEMETRY
 
         import jax.numpy as jnp  # noqa: F401  (objectives use jnp)
 
@@ -1237,6 +1250,7 @@ class Booster:
         k = max(self.num_tree_per_iteration, 1)
         leaf_preds = self.predict(data, pred_leaf=True)  # (n, ntrees)
         scores = np.zeros((n, k), dtype=np.float64)
+        leaves_updated = 0
         for i, tree in enumerate(self.models):
             cls = i % k
             s = scores if k > 1 else scores[:, 0]
@@ -1257,7 +1271,10 @@ class Booster:
                     config.lambda_l2, config.max_delta_step))
                 tree.leaf_value[leaf] = out * shrink
                 tree.leaf_count[leaf] = int(mask.sum())
+                leaves_updated += 1
             scores[:, cls] += tree.leaf_value[lp]
+        if TELEMETRY.on:
+            TELEMETRY.add("refit_leaves_updated", leaves_updated)
         # host trees diverged from the in-session device stacks;
         # invalidate every device path's cache (the serving/raw-stack
         # predictors rebuild from the refitted host trees on next use

@@ -194,7 +194,15 @@ def _task_serve(params, config: Config) -> None:
     registry (warming its buckets first — predict_warm_buckets, or
     the 1-row + serve_max_batch_rows defaults), then serve
     POST /predict/<model> with micro-batching and load shedding from
-    the shared /metrics + /healthz listener until interrupted."""
+    the shared /metrics + /healthz listener until interrupted.
+
+    With ``continuous_ingest_dir`` set, the continuous-training lane
+    (docs/CONTINUOUS_TRAINING.md) runs BESIDE the frontend: new data
+    slices dropped into the directory are append-constructed against
+    the base dataset (``data=``), trained from the last good model
+    (``continuous_mode=continue|refit``), eval-gated and hot-published
+    into the SAME registry this frontend serves from — control it via
+    GET/POST /continuous on the shared listener."""
     if not config.input_model:
         Log.fatal("No model file: set input_model=<file>")
     import os
@@ -212,18 +220,37 @@ def _task_serve(params, config: Config) -> None:
              f"http://127.0.0.1:{port}/predict/{name} "
              '(POST JSON {"rows": [[...]]} or CSV rows; '
              "GET /models /metrics /healthz)")
+    lane = None
+    if config.continuous_ingest_dir:
+        if not config.data:
+            Log.fatal("continuous_ingest_dir is set but data= is not: "
+                      "the lane needs the base dataset whose bin "
+                      "mappers ingested slices bind to")
+        from .continuous import ContinuousLane
+        lane = ContinuousLane(config, registry, name=name,
+                              train_params=dict(params)).start()
+        Log.info(f"continuous-training lane armed: watching "
+                 f"{config.continuous_ingest_dir} "
+                 f"(mode={config.continuous_mode}, poll "
+                 f"{config.continuous_poll_s:g}s; GET/POST "
+                 f"http://127.0.0.1:{port}/continuous)")
     try:
         threading.Event().wait()      # serve until SIGINT
     except KeyboardInterrupt:
         Log.info("interrupt: draining serving queues")
     finally:
+        if lane is not None:
+            lane.stop()
         frontend.stop(drain=True)
 
 
 def _task_refit(params, config: Config) -> None:
     if not config.input_model:
         Log.fatal("No model file: set input_model=<file>")
-    booster = Booster(model_file=config.input_model)
+    # the parsed config rides along (like task=predict) so predict
+    # knobs reach the pred_leaf pass and the telemetry/export knobs
+    # configured on the command line govern the refit run too
+    booster = Booster(config=config, model_file=config.input_model)
     from .data_loader import load_file
     X, label, _ = load_file(config.data, config)
     booster.refit(X, label, params)

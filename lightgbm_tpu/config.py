@@ -569,6 +569,45 @@ class Config:
     # port (logged at startup); when telemetry_http_port is set the
     # serving routes mount on that already-running listener instead
 
+    # -- continuous training (new; no reference analog) --
+    continuous_mode: str = "continue"  # training lane per-cycle
+    # strategy (docs/CONTINUOUS_TRAINING.md): "continue" boosts
+    # continuous_iterations NEW trees per cycle from the last accepted
+    # model (init_model semantics) over the base rows plus every
+    # ingested slice; "refit" keeps the tree structures and refits
+    # leaf values on the cycle's fresh labels (reference RefitTree
+    # semantics via Booster.refit)
+    continuous_ingest_dir: str = ""  # directory the ingest watcher
+    # polls for new data slices (same text formats as `data`; a
+    # MANIFEST file in the directory pins an explicit slice order
+    # instead of sorted names).  Setting it arms the continuous lane
+    # under task=serve; "" disables
+    continuous_state_dir: str = ""  # continuous lane state directory
+    # (ledger, per-cycle candidate models, mid-cycle checkpoints,
+    # quarantine records); "" derives <continuous_ingest_dir>/.continuous
+    continuous_poll_s: float = 5.0  # ingest watcher poll interval
+    # (seconds) between directory scans when the lane runs threaded;
+    # POST /continuous {"action": "force_cycle"} skips the wait
+    continuous_iterations: int = 10  # boosting iterations added per
+    # continue-mode cycle (ignored by refit mode, which grows no trees)
+    continuous_eval_holdout: float = 0.2  # tail fraction of every
+    # ingested slice held out of training and scored by the eval gate
+    # (deterministic tail split — no RNG, so a killed cycle replays
+    # the exact same train/eval rows).  0 disables the gate: every
+    # candidate publishes
+    continuous_publish_max_regression: float = 0.0  # eval gate: a
+    # candidate may regress the gated metric by at most this much
+    # against the currently published model on the same eval slice
+    # (metric-direction aware); worse candidates are quarantined
+    # instead of published.  The same bound guards the post-publish
+    # live-metric hook — a live regression past it auto-rolls the
+    # registry back
+    continuous_checkpoint_freq: int = 0  # mid-cycle crash-safe
+    # checkpoint cadence (iterations) for continue-mode training
+    # (docs/RELIABILITY.md machinery, per-cycle checkpoint files); 0
+    # checkpoints nothing mid-cycle — a killed cycle then replays from
+    # the cycle start, which stays byte-identical, just slower
+
     # -- reliability (new; no reference analog) --
     checkpoint_freq: int = -1   # save a crash-safe FULL-training-state
     # checkpoint every this many iterations (model + score cache +
@@ -699,6 +738,22 @@ class Config:
         if not (0 <= self.serve_port <= 65535):
             raise ValueError("serve_port must be in [0, 65535] "
                              "(0 = ephemeral)")
+        if self.continuous_mode not in ("continue", "refit"):
+            raise ValueError("continuous_mode must be continue/refit, "
+                             f"got {self.continuous_mode!r}")
+        if self.continuous_poll_s <= 0:
+            raise ValueError("continuous_poll_s must be > 0")
+        if self.continuous_iterations < 1:
+            raise ValueError("continuous_iterations must be >= 1")
+        if not (0.0 <= self.continuous_eval_holdout < 1.0):
+            raise ValueError("continuous_eval_holdout must be in "
+                             "[0, 1)")
+        if self.continuous_publish_max_regression < 0:
+            raise ValueError("continuous_publish_max_regression must "
+                             "be >= 0")
+        if self.continuous_checkpoint_freq < 0:
+            raise ValueError("continuous_checkpoint_freq must be >= 0 "
+                             "(0 = cycle-start replay only)")
         if self.snapshot_keep < 0:
             raise ValueError("snapshot_keep must be >= 0 (0 = keep all)")
         if self.checkpoint_keep < 1:

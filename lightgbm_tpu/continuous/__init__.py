@@ -1,0 +1,26 @@
+"""Continuous-training service: the train→evaluate→publish daemon
+closing the loop between checkpoints (r12), streaming construction
+(r11) and the serving registry (r14) — docs/CONTINUOUS_TRAINING.md.
+
+Two modules:
+
+- :mod:`.ingest` — slice discovery (directory poll / MANIFEST order),
+  append-construction against the base dataset's FROZEN bin mappers
+  (the r11 ``from_reference_for_push`` streaming protocol), and the
+  drift detector for values the frozen mappers cannot resolve.
+- :mod:`.lane` — the four-phase cycle state machine
+  (ingest→train→eval→publish) with a crash-safe ledger, the eval
+  gate + quarantine, post-publish live-metric rollback, the
+  ``/continuous`` control surface and the ``continuous.cycle`` fault
+  seam.
+
+CLI: ``python -m lightgbm_tpu task=serve input_model=model.txt
+data=base.csv continuous_ingest_dir=incoming/`` serves AND keeps
+training.
+"""
+from .ingest import (append_construct, discover_slices, drift_check,
+                     holdout_split, load_slice)
+from .lane import ContinuousLane
+
+__all__ = ["ContinuousLane", "append_construct", "discover_slices",
+           "drift_check", "holdout_split", "load_slice"]

@@ -1,0 +1,196 @@
+"""Ingest side of the continuous-training lane: slice discovery,
+drift detection, and append-construction against frozen bin mappers.
+
+A *slice* is one data file dropped into ``continuous_ingest_dir`` —
+same text formats as ``data`` (csv/tsv/libsvm, label column resolved
+the same way).  Discovery is deterministic: slices process in sorted
+name order, or in the order listed by an optional ``MANIFEST`` file in
+the directory (one relative path per line, ``#`` comments allowed) —
+determinism is what makes a SIGKILLed cycle replay byte-identical
+from the ledger.
+
+Appended slices are binned through the r11 streaming-construction
+protocol (``Dataset.from_reference_for_push`` + ``push_rows``) against
+the BASE dataset's FROZEN bin mappers: base rows are never re-binned
+(their packed bins are copied), new rows bin into the base bin space,
+and trees trained on the result stay in the same threshold space as
+every previously published model.
+
+Freezing the mappers is also what makes drift *observable*: a new
+value past a numerical mapper's fitted ``[min_val, max_val]`` range,
+or a category the mapper never saw, clamps into an edge/overflow bin
+— silently degrading resolution.  ``drift_check`` counts exactly
+those values per feature, warns loudly once per slice, and feeds the
+``continuous_drift_values`` / ``continuous_drift_slices`` counters
+(docs/CONTINUOUS_TRAINING.md, drift semantics).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..binning import BIN_CATEGORICAL, BIN_NUMERICAL
+from ..config import Config
+from ..telemetry import TELEMETRY
+from ..utils.log import Log
+
+MANIFEST_NAME = "MANIFEST"
+
+# names the watcher never treats as slices: the manifest itself,
+# hidden files, partial writes, binary dataset caches and the lane's
+# own state directory
+_SKIP_SUFFIXES = (".tmp", ".part", ".bin", ".swp")
+
+
+def discover_slices(ingest_dir: str,
+                    processed: Sequence[str] = ()) -> List[str]:
+    """New slice file names in ``ingest_dir`` in DETERMINISTIC order:
+    the ``MANIFEST`` order when one exists (files it lists that are
+    not on disk yet are simply not ready), else sorted names.  Names
+    in ``processed`` (the ledger) are skipped."""
+    if not os.path.isdir(ingest_dir):
+        return []
+    done = set(processed)
+    manifest = os.path.join(ingest_dir, MANIFEST_NAME)
+    if os.path.exists(manifest):
+        names = []
+        with open(manifest) as f:
+            for ln in f:
+                ln = ln.split("#", 1)[0].strip()
+                if ln:
+                    names.append(ln)
+    else:
+        names = sorted(os.listdir(ingest_dir))
+    out = []
+    for name in names:
+        if name in done or name == MANIFEST_NAME \
+                or name.startswith(".") \
+                or name.endswith(_SKIP_SUFFIXES):
+            continue
+        path = os.path.join(ingest_dir, name)
+        if os.path.isfile(path):
+            out.append(name)
+    return out
+
+
+def load_slice(path: str, config: Config
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse one slice file into (X float64 matrix, label).  Slices
+    must carry labels — the lane trains and gates on them."""
+    from ..data_loader import load_file
+    X, label, _extras = load_file(path, config)
+    if label is None:
+        raise ValueError(
+            f"continuous ingest: slice {path} carries no label column "
+            "(the lane trains on fresh labels; set label_column)")
+    return np.ascontiguousarray(np.asarray(X, dtype=np.float64)), \
+        np.asarray(label, dtype=np.float64)
+
+
+def drift_check(base_core, X: np.ndarray, slice_name: str = "",
+                count: bool = True) -> Dict[int, int]:
+    """Count values of ``X`` that fall OUTSIDE the base dataset's
+    fitted bin ranges: numerical values past ``[min_val, max_val]``
+    (finite only — NaN is a modeled missing value, not drift) and
+    unseen categories.  Returns {real feature index: count}, warns
+    loudly and bumps the drift counters when anything drifted.
+    ``count=False`` computes silently — the crash-resume reload path
+    must not double-count a slice's drift."""
+    per_feature: Dict[int, int] = {}
+    for f in base_core.features:
+        j = f.feature_idx
+        m = base_core.mappers[j]
+        col = X[:, j]
+        if m.bin_type == BIN_NUMERICAL:
+            finite = np.isfinite(col)
+            n = int(np.count_nonzero(
+                finite & ((col < m.min_val) | (col > m.max_val))))
+        elif m.bin_type == BIN_CATEGORICAL:
+            with np.errstate(invalid="ignore"):
+                iv = col.astype(np.int64)
+            valid = ~np.isnan(col)
+            known = np.zeros(len(col), dtype=bool)
+            if m.categorical_2_bin:
+                keys = np.fromiter(m.categorical_2_bin.keys(),
+                                   dtype=np.int64)
+                known[valid] = np.isin(iv[valid], keys)
+            n = int(np.count_nonzero(valid & ~known))
+        else:  # pragma: no cover - no third bin type exists
+            continue
+        if n:
+            per_feature[j] = n
+    if per_feature and count:
+        total = sum(per_feature.values())
+        tm = TELEMETRY
+        if tm.on:
+            tm.add("continuous_drift_values", total)
+            tm.add("continuous_drift_slices", 1)
+        worst = sorted(per_feature.items(), key=lambda kv: -kv[1])[:5]
+        Log.warning(
+            "continuous ingest: DATA DRIFT in slice "
+            f"{slice_name or '<array>'} — {total} value(s) across "
+            f"{len(per_feature)} feature(s) fall outside the base "
+            "dataset's fitted bin ranges and will clamp into edge "
+            "bins (worst: "
+            + ", ".join(f"feature {j}: {c}" for j, c in worst)
+            + "). The frozen mappers cannot resolve these values; "
+              "consider retraining the base dataset "
+              "(docs/CONTINUOUS_TRAINING.md, drift semantics)")
+    return per_feature
+
+
+def append_construct(base_core, slices: Sequence[np.ndarray],
+                     labels: Sequence[np.ndarray],
+                     base_raw: Optional[np.ndarray] = None):
+    """Build the cycle's training dataset: base rows + every slice,
+    binned in the base's FROZEN bin space.
+
+    The base's packed bins are COPIED (never re-binned — byte-for-byte
+    the construction the base model trained on); each slice pushes
+    through the r11 streaming protocol row chunk by row chunk.  When
+    ``base_raw`` is given (continue-mode needs raw rows to seed
+    continued-training scores), the returned core carries the stacked
+    raw matrix in ``_raw_data``.
+
+    Labels: base labels + per-slice labels, concatenated in push
+    order."""
+    from ..dataset import Dataset as CoreDataset
+    base_n = int(base_core.num_data)
+    new_n = int(sum(x.shape[0] for x in slices))
+    core = CoreDataset.from_reference_for_push(
+        base_core, base_n + new_n)
+    core.group_bins[:base_n] = base_core.group_bins
+    core._pushed_rows = base_n
+    off = base_n
+    for x in slices:
+        core.push_rows(x, off)
+        off += int(x.shape[0])
+    core.finish_load()
+    base_label = base_core.metadata.label
+    core.metadata.set_label(np.concatenate(
+        [np.asarray(base_label, dtype=np.float64)]
+        + [np.asarray(y, dtype=np.float64) for y in labels]))
+    core.pandas_categorical = getattr(
+        base_core, "pandas_categorical", None)
+    if base_raw is not None:
+        core._raw_data = np.ascontiguousarray(np.concatenate(
+            [np.asarray(base_raw, dtype=np.float64)] + list(slices),
+            axis=0))
+    return core
+
+
+def holdout_split(X: np.ndarray, y: np.ndarray, holdout: float
+                  ) -> Tuple[np.ndarray, np.ndarray,
+                             np.ndarray, np.ndarray]:
+    """Deterministic tail split of one slice into (train rows, train
+    labels, eval rows, eval labels): the LAST ``ceil(n * holdout)``
+    rows are held out for the eval gate.  No RNG — a crash-replayed
+    cycle must cut the exact same rows.  A 1-row slice always keeps
+    its row in training (an empty train set can't boost)."""
+    n = int(X.shape[0])
+    k = int(np.ceil(n * float(holdout))) if holdout > 0 else 0
+    k = min(k, n - 1) if n > 1 else 0
+    cut = n - k
+    return X[:cut], y[:cut], X[cut:], y[cut:]

@@ -1,0 +1,826 @@
+"""The continuous-training lane: a train→evaluate→publish daemon
+closing the loop between checkpoints (r12), streaming construction
+(r11) and the serving registry (r14).
+
+One :class:`ContinuousLane` supervises one served model name.  Each
+*cycle* walks a four-phase state machine::
+
+    ingest -> train -> eval -> publish
+      |        |         |        |
+      v        v         v        v
+    load new  continue/  gate vs  hot-publish into the registry
+    slices,   refit a    current  (warm-before-cutover), or
+    drift     candidate  model    quarantine the candidate
+
+Crash safety is ledger-based: every phase COMMITS its outputs to
+``ledger.json`` (atomic tmp+fsync+rename, the r12 writer) before the
+next phase starts, and every phase's work is a deterministic function
+of the ledger + the slice files still sitting in the ingest directory.
+A SIGKILL at ANY instant therefore resumes by re-entering the recorded
+phase and replaying it — same slices, same tail holdout split, same
+training (mid-cycle checkpoints via ``continuous_checkpoint_freq``
+make the replay cheap; without them the cycle re-trains from its
+start) — and publishes a byte-identical model
+(``tests/test_continuous.py`` pins this with real SIGKILLs through the
+``continuous.cycle`` fault seam).
+
+Publish is gated: the candidate and the currently accepted model are
+both scored on the cycle's held-out eval rows, and the candidate may
+not regress the gated metric past
+``continuous_publish_max_regression``.  Rejected candidates are
+QUARANTINED (recorded in the ledger with the metrics that damned
+them; the next cycle continues from the last good model).  After a
+publish, the serving side can feed live quality back through
+``report_live_metric`` (or ``POST /continuous`` with
+``{"action": "live_metric", "value": ...}``); a live regression past
+the same bound auto-rolls the registry back to the previous version
+and quarantines the published candidate.
+
+Control + observability ride the SAME listener as ``/metrics`` and
+``/predict/<model>``: ``GET /continuous`` returns the lane status,
+``POST /continuous`` takes ``pause`` / ``resume`` / ``force_cycle`` /
+``live_metric`` actions.  Spans (``continuous_cycle`` + one per
+phase), counters (cycles, rows, publishes, rejects, rollbacks, drift)
+and the ``continuous_cycle_ms`` histogram are in the
+docs/OBSERVABILITY.md glossary; a cycle failure dumps the crash
+flight recorder naming the phase it died in.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..reliability import checkpoint as _ckpt
+from ..reliability.faults import FAULTS
+from ..telemetry import TELEMETRY
+from ..utils.log import Log
+from . import ingest as _ingest
+
+LEDGER_NAME = "ledger.json"
+LEDGER_SCHEMA = 1
+BASE_MODEL = "model_base.txt"
+PHASES = ("ingest", "train", "eval", "publish")
+
+# objectives whose eval gate the lane can score without side metadata
+# (ranking needs query boundaries per slice — not carried by slices
+# yet, so the lane refuses at construction instead of gating on a
+# meaningless metric)
+_UNSUPPORTED_OBJECTIVES = ("lambdarank",)
+
+
+class ContinuousLane:
+    """Supervised train→evaluate→publish loop for one served model.
+
+    Args:
+      config: the daemon :class:`~lightgbm_tpu.config.Config`
+        (``continuous_*`` knobs; ``continuous_ingest_dir`` required).
+      registry: the serving :class:`ModelRegistry` accepted candidates
+        hot-publish into (rollback flips its pointer back).
+      name: served model name (the ``/predict/<name>`` route).
+      base_model: Booster, model-file path, or None — the model the
+        first cycle continues/refits from; None falls back to
+        ``config.input_model``.
+      base_data / base_label: in-memory base training matrix, or None
+        to load ``config.data``.  The base dataset's bin mappers are
+        FROZEN: every ingested slice bins into this bin space.
+      train_params: the parameter dict each continue-cycle trains
+        under (objective, num_leaves, ... — the daemon's CLI params in
+        ``task=serve``).  Must be identical across restarts: the cycle
+        replay guarantee fingerprints training on it.
+    """
+
+    def __init__(self, config: Config, registry=None,
+                 name: str = "model", base_model=None,
+                 base_data=None, base_label=None,
+                 train_params: Optional[Dict[str, Any]] = None):
+        self.config = config
+        self.registry = registry
+        self.name = name
+        self.train_params = dict(train_params or {})
+        self._base_model_arg = base_model
+        self._base_data = base_data
+        self._base_label = base_label
+        self._base_core = None
+        self._metric_cfg = Config.from_params(self.train_params) \
+            if self.train_params else Config()
+        if self._metric_cfg.objective in _UNSUPPORTED_OBJECTIVES:
+            raise ValueError(
+                f"continuous lane: objective "
+                f"{self._metric_cfg.objective!r} is not supported yet "
+                "(the eval gate needs per-slice query metadata)")
+        self.ingest_dir = config.continuous_ingest_dir
+        if not self.ingest_dir:
+            raise ValueError("continuous lane needs "
+                             "continuous_ingest_dir")
+        self.state_dir = config.continuous_state_dir or \
+            os.path.join(self.ingest_dir, ".continuous")
+        os.makedirs(self.state_dir, exist_ok=True)
+        self._cycle_lock = threading.RLock()
+        # small mutation lock so status()/control reads never block
+        # behind a training phase holding the cycle lock
+        self._ledger_lock = threading.Lock()
+        # serializes publish-state transitions ONLY (the publish
+        # phase and rollbacks): a live-metric rollback must be able
+        # to pull a bad model while a training phase holds the cycle
+        # lock for minutes
+        self._publish_lock = threading.RLock()
+        self._ledger = self._load_ledger()
+        # accumulated training slices (train portions only), rebuilt
+        # deterministically from the ledger on restart
+        self._acc: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._acc_names: List[str] = []
+        self._paused = False
+        self._stop = threading.Event()
+        self._force = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._routes_mounted = False
+        self.last_cycle: Optional[dict] = None
+
+    # -- paths / ledger ------------------------------------------------
+    def _p(self, *parts: str) -> str:
+        return os.path.join(self.state_dir, *parts)
+
+    def _load_ledger(self) -> dict:
+        path = self._p(LEDGER_NAME)
+        if os.path.exists(path):
+            with open(path) as f:
+                led = json.load(f)
+            if led.get("schema") != LEDGER_SCHEMA:
+                raise ValueError(
+                    f"continuous ledger {path} has schema "
+                    f"{led.get('schema')} (this build reads "
+                    f"{LEDGER_SCHEMA})")
+            return led
+        return {
+            "schema": LEDGER_SCHEMA,
+            "cycle": 1,
+            "phase": "idle",
+            "cycle_slices": [],      # in-flight cycle's slice names
+            "cycle_decision": None,  # committed eval-gate outcome
+            "processed": [],         # [{name, cycle, rows}] in order
+            "last_good": BASE_MODEL,
+            "published": [],         # publish stack, current last
+            "quarantined": [],
+        }
+
+    def _commit(self, **updates) -> None:
+        """Atomically persist the ledger (the phase-commit point: the
+        crash-replay contract is 'everything before the last commit
+        is durable, everything after replays')."""
+        with self._ledger_lock:
+            self._ledger.update(updates)
+            text = json.dumps(self._ledger, indent=1, sort_keys=True)
+        _ckpt.atomic_write_text(self._p(LEDGER_NAME), text)
+
+    # -- base dataset / model ------------------------------------------
+    def _base(self):
+        """Construct (once) the base dataset whose bin mappers every
+        ingested slice binds to."""
+        if self._base_core is not None:
+            return self._base_core
+        from ..basic import Dataset
+        cfg = self._metric_cfg
+        if self._base_data is not None:
+            ds = Dataset(self._base_data, label=self._base_label,
+                         params=self.train_params, free_raw_data=False)
+        elif self.config.data:
+            ds = Dataset(self.config.data, params=self.train_params,
+                         free_raw_data=False)
+        else:
+            raise ValueError(
+                "continuous lane needs a base dataset: pass "
+                "base_data= or set data=<file>")
+        self._base_core = ds.construct(cfg)
+        if self.config.continuous_mode == "continue":
+            if self._base_core._raw_data is None:
+                raise ValueError(
+                    "continuous_mode=continue needs the base "
+                    "dataset's raw rows to seed continued-training "
+                    "scores — two-round streaming bases cannot "
+                    "continue-train (use continuous_mode=refit, or a "
+                    "non-streaming base)")
+            md = self._base_core.metadata
+            if md.weight is not None or md.init_score is not None:
+                # refusing loudly beats silently training every cycle
+                # unweighted: append_construct carries labels only, so
+                # a weighted base would produce systematically
+                # different candidates than the operator configured
+                raise ValueError(
+                    "continuous lane: the base dataset carries row "
+                    "weights/init_score, which the append-construct "
+                    "cycle datasets do not propagate yet — continue "
+                    "cycles would train unweighted. Drop the weights "
+                    "or use continuous_mode=refit.")
+        return self._base_core
+
+    def _base_model_path(self) -> str:
+        """Materialize the base model into the state dir exactly once
+        (byte-stable across restarts: never rewritten when present)."""
+        path = self._p(BASE_MODEL)
+        if os.path.exists(path):
+            return path
+        src = self._base_model_arg
+        if src is None and self.config.input_model:
+            src = self.config.input_model
+        if src is None:
+            raise ValueError(
+                "continuous lane needs a base model: pass base_model= "
+                "or set input_model=<file>")
+        if isinstance(src, str):
+            with open(src) as f:
+                text = f.read()
+        else:
+            text = src.model_to_string()
+        _ckpt.atomic_write_text(path, text)
+        return path
+
+    def _booster(self, model_name: str):
+        from ..booster import Booster
+        return Booster(config=self._metric_cfg,
+                       model_file=self._p(model_name))
+
+    # -- accumulated slice state ---------------------------------------
+    def _restore_accumulated(self) -> None:
+        """Re-load every processed slice's TRAIN rows in ledger order
+        (deterministic: name order within each cycle was the discovery
+        order the ledger recorded)."""
+        want = [rec["name"] for rec in self._ledger["processed"]]
+        if self._acc_names == want:
+            return
+        self._acc = []
+        self._acc_names = []
+        for rec in self._ledger["processed"]:
+            X, y = _ingest.load_slice(
+                os.path.join(self.ingest_dir, rec["name"]),
+                self._metric_cfg)
+            Xt, yt, _Xe, _ye = _ingest.holdout_split(
+                X, y, self.config.continuous_eval_holdout)
+            self._acc.append((Xt, yt))
+            self._acc_names.append(rec["name"])
+
+    # -- cycle phases ---------------------------------------------------
+    def _phase(self, phase: str, cycle: int) -> None:
+        """Enter a cycle phase: the ``continuous.cycle`` fault seam
+        fires BEFORE the phase's side effects (kill/OOM injection
+        lands between commits, where recovery must replay)."""
+        FAULTS.fault_point("continuous.cycle")
+        TELEMETRY.gauge("continuous_phase", f"{phase}@{cycle}")
+
+    def _load_cycle_slices(self, names,
+                           count_drift: bool = False) -> List[dict]:
+        """(Re)load the cycle's slices, cut the deterministic
+        train/eval tail split and compute per-slice drift.  Drift
+        counters/warnings only fire on the FIRST (ingest-phase) pass
+        — a crash-resume reload recomputes silently."""
+        base = self._base()
+        out = []
+        for name in names:
+            X, y = _ingest.load_slice(
+                os.path.join(self.ingest_dir, name), self._metric_cfg)
+            Xt, yt, Xe, ye = _ingest.holdout_split(
+                X, y, self.config.continuous_eval_holdout)
+            out.append({"name": name, "X": X, "y": y,
+                        "Xt": Xt, "yt": yt, "Xe": Xe, "ye": ye,
+                        "drift": _ingest.drift_check(
+                            base, X, name, count=count_drift)})
+        return out
+
+    def _cycle_train_params(self, cycle: int) -> Dict[str, Any]:
+        p = dict(self.train_params)
+        p["num_iterations"] = self.config.continuous_iterations
+        freq = self.config.continuous_checkpoint_freq
+        if freq > 0:
+            p["checkpoint_freq"] = freq
+            p["checkpoint_path"] = self._p(f"ckpt_cycle_{cycle}")
+            p["resume"] = "auto"
+        else:
+            # no mid-cycle checkpoints: a killed cycle replays from
+            # its start (still byte-identical, just recomputed)
+            p["checkpoint_freq"] = -1
+            p["resume"] = "off"
+        return p
+
+    def _train_candidate(self, cycle: int, slices: List[dict]) -> str:
+        """Train (or refit) this cycle's candidate and atomically
+        persist it as ``model_cycle_<cycle>.txt``.  Deterministic
+        given the ledger: replaying after a kill produces the same
+        bytes (mid-cycle checkpoints only shortcut the replay)."""
+        span = TELEMETRY.start_span("continuous_train", cycle=cycle)
+        try:
+            init_path = self._p(self._ledger["last_good"])
+            mode = self.config.continuous_mode
+            if mode == "refit":
+                Xs = [s["Xt"] for s in slices if len(s["Xt"])]
+                ys = [s["yt"] for s in slices if len(s["yt"])]
+                if not Xs:
+                    raise ValueError(
+                        "continuous refit cycle has no train rows")
+                from ..booster import Booster
+                cand = Booster(config=self._metric_cfg,
+                               model_file=init_path)
+                cand.refit(np.concatenate(Xs, axis=0),
+                           np.concatenate(ys, axis=0),
+                           dict(self.train_params))
+            else:
+                from ..engine import train as _train
+                base = self._base()
+                self._restore_accumulated()
+                new = [(s["Xt"], s["yt"]) for s in slices
+                       if len(s["Xt"])]
+                parts = self._acc + new
+                core = _ingest.append_construct(
+                    base, [x for x, _ in parts],
+                    [y for _, y in parts],
+                    base_raw=base._raw_data)
+                cand = _train(self._cycle_train_params(cycle), core,
+                              init_model=init_path,
+                              verbose_eval=False)
+            path = self._p(f"model_cycle_{cycle}.txt")
+            _ckpt.atomic_write_text(path, cand.model_to_string())
+            return os.path.basename(path)
+        finally:
+            TELEMETRY.end_span(span)
+
+    # -- eval gate ------------------------------------------------------
+    def _metric(self, booster, X: np.ndarray, y: np.ndarray
+                ) -> Tuple[float, bool, str]:
+        """Score ``booster`` on (X, y) with the gated metric: the
+        configured metric (or the objective's default), evaluated on
+        converted predictions — (value, bigger_is_better, name)."""
+        import jax.numpy as jnp
+
+        from ..dataset import Metadata
+        from ..metrics import create_metrics
+        pred = np.asarray(booster.predict(X))
+        if pred.ndim == 2 and pred.shape[1] > 1:
+            # multiclass probabilities: score logloss directly (the
+            # Metric classes expect raw scores to softmax themselves)
+            li = np.clip(y.astype(np.int64), 0, pred.shape[1] - 1)
+            pt = np.clip(pred[np.arange(len(y)), li], 1e-15, None)
+            return float(np.mean(-np.log(pt))), False, "multi_logloss"
+        metrics = create_metrics(self._metric_cfg)
+        m = next((mm for mm in metrics
+                  if not mm.name.startswith(("multi_", "ndcg", "map"))),
+                 None)
+        if m is None:
+            from ..metrics import L2Metric
+            m = L2Metric(self._metric_cfg)
+        if m.name in ("cross_entropy_lambda", "kldiv"):
+            # these two metrics apply the output link THEMSELVES
+            # (score -> hhat / sigmoid): feeding converted predictions
+            # would double-transform; hand them raw scores like the
+            # training-time eval does
+            pred = np.asarray(booster.predict(X, raw_score=True))
+        meta = Metadata(len(y))
+        meta.set_label(y)
+        m.init(meta, len(y))
+        val = m.eval(jnp.asarray(pred.reshape(-1),
+                                 dtype=jnp.float32))[0]
+        return float(val), bool(m.bigger_is_better), m.name
+
+    def _gate(self, cycle: int, cand_name: str,
+              slices: List[dict]) -> dict:
+        """Score candidate vs the current (last good) model on the
+        cycle's held-out rows and commit the publish/quarantine
+        decision."""
+        span = TELEMETRY.start_span("continuous_eval", cycle=cycle)
+        try:
+            Xe = [s["Xe"] for s in slices if len(s["Xe"])]
+            ye = [s["ye"] for s in slices if len(s["ye"])]
+            decision = {"cycle": cycle, "candidate": cand_name,
+                        "publish_unix": time.time()}
+            if not Xe or self.config.continuous_eval_holdout <= 0:
+                # no held-out rows: the gate cannot measure, publish
+                decision.update(accept=True, metric=None,
+                                candidate_metric=None,
+                                current_metric=None)
+                return decision
+            X = np.concatenate(Xe, axis=0)
+            y = np.concatenate(ye, axis=0)
+            cand_v, bigger, mname = self._metric(
+                self._booster(cand_name), X, y)
+            cur_v, _, _ = self._metric(
+                self._booster(self._ledger["last_good"]), X, y)
+            regression = (cur_v - cand_v) if bigger else (cand_v - cur_v)
+            accept = regression <= \
+                self.config.continuous_publish_max_regression
+            decision.update(
+                accept=bool(accept), metric=mname,
+                bigger_is_better=bigger,
+                candidate_metric=cand_v, current_metric=cur_v,
+                regression=round(float(regression), 12),
+                eval_rows=int(len(y)))
+            TELEMETRY.gauge("continuous_last_eval_metric", cand_v)
+            return decision
+        finally:
+            TELEMETRY.end_span(span)
+
+    # -- publish / quarantine / rollback --------------------------------
+    def _publish(self, cycle: int, decision: dict,
+                 slices_meta: List[dict]) -> dict:
+        """Act on the committed gate decision: hot-publish the
+        accepted candidate (warm-before-cutover, zero failed
+        responses — the r14 registry guarantee) or quarantine it;
+        then retire the cycle in the ledger."""
+        span = TELEMETRY.start_span("continuous_publish", cycle=cycle)
+        tm = TELEMETRY
+        with self._publish_lock:
+            return self._publish_locked(cycle, decision, slices_meta,
+                                        span, tm)
+
+    def _publish_locked(self, cycle, decision, slices_meta, span, tm):
+        try:
+            cand = decision["candidate"]
+            processed = self._ledger["processed"] + slices_meta
+            if decision["accept"]:
+                version = None
+                if self.registry is not None:
+                    entry = self.registry.publish(
+                        self.name, self._p(cand),
+                        published_unix=decision["publish_unix"],
+                        eval_metric=decision.get("candidate_metric"),
+                        source="continuous")
+                    version = entry.version
+                published = self._ledger["published"] + [{
+                    "cycle": cycle, "model": cand, "version": version,
+                    "metric": decision.get("candidate_metric"),
+                    "metric_name": decision.get("metric"),
+                    "bigger_is_better": decision.get(
+                        "bigger_is_better", False),
+                    "unix": decision["publish_unix"],
+                }]
+                self._commit(phase="idle", cycle=cycle + 1,
+                             cycle_slices=[], cycle_decision=None,
+                             processed=processed, published=published,
+                             last_good=cand)
+                if tm.on:
+                    tm.add("continuous_publishes", 1)
+                Log.info(
+                    f"continuous lane {self.name!r}: cycle {cycle} "
+                    f"published {cand}"
+                    + (f" as v{version}" if version else "")
+                    + (f" ({decision['metric']}="
+                       f"{decision['candidate_metric']:g} vs current "
+                       f"{decision['current_metric']:g})"
+                       if decision.get("metric") else ""))
+            else:
+                quarantined = self._ledger["quarantined"] + [{
+                    "cycle": cycle, "model": cand,
+                    "reason": "eval gate",
+                    "metric": decision.get("metric"),
+                    "candidate_metric": decision.get(
+                        "candidate_metric"),
+                    "current_metric": decision.get("current_metric"),
+                    "regression": decision.get("regression"),
+                }]
+                self._commit(phase="idle", cycle=cycle + 1,
+                             cycle_slices=[], cycle_decision=None,
+                             processed=processed,
+                             quarantined=quarantined)
+                if tm.on:
+                    tm.add("continuous_publish_rejects", 1)
+                    tm.add("continuous_quarantined", 1)
+                Log.warning(
+                    f"continuous lane {self.name!r}: cycle {cycle} "
+                    f"candidate {cand} QUARANTINED by the eval gate "
+                    f"({decision.get('metric')}: candidate "
+                    f"{decision.get('candidate_metric')} vs current "
+                    f"{decision.get('current_metric')}, regression "
+                    f"{decision.get('regression')} > "
+                    f"{self.config.continuous_publish_max_regression:g}"
+                    "); continuing from the last good model")
+            return decision
+        finally:
+            TELEMETRY.end_span(span)
+
+    def report_live_metric(self, value: float) -> bool:
+        """Serving-side live-quality hook: compare ``value`` against
+        the eval metric the current version published at; a
+        regression past ``continuous_publish_max_regression``
+        auto-rolls the registry back and quarantines the published
+        candidate.  Returns True when a rollback fired.
+
+        Serialized against the PUBLISH phase only (not the whole
+        cycle): pulling a bad model must not wait minutes behind an
+        in-flight training phase.  A cycle mid-train keeps building
+        its candidate from the pre-rollback model — the eval gate
+        re-reads ``last_good`` and judges it against the restored
+        one."""
+        with self._publish_lock:
+            published = self._ledger["published"]
+            if not published:
+                return False
+            cur = published[-1]
+            if cur.get("metric") is None:
+                return False
+            bigger = bool(cur.get("bigger_is_better", False))
+            regression = (cur["metric"] - value) if bigger \
+                else (value - cur["metric"])
+            if regression <= \
+                    self.config.continuous_publish_max_regression:
+                return False
+            self._rollback(reason="live metric regression",
+                           live_metric=float(value),
+                           regression=float(regression))
+            return True
+
+    def _rollback(self, reason: str, **detail) -> None:
+        """Registry pointer flip back + ledger retirement of the bad
+        publish (the rolled-back candidate joins the quarantine)."""
+        tm = TELEMETRY
+        published = list(self._ledger["published"])
+        bad = published.pop()
+        prev_model = published[-1]["model"] if published else BASE_MODEL
+        if self.registry is not None:
+            try:
+                self.registry.rollback(self.name)
+            except (KeyError, ValueError):
+                # nothing earlier resident in THIS process (daemon
+                # restarted since): re-publish the previous good model
+                self.registry.publish(
+                    self.name, self._p(prev_model),
+                    published_unix=time.time(),
+                    eval_metric=(published[-1]["metric"]
+                                 if published else None),
+                    source="continuous")
+        quarantined = self._ledger["quarantined"] + [{
+            "cycle": bad["cycle"], "model": bad["model"],
+            "reason": reason, **detail,
+        }]
+        self._commit(published=published, quarantined=quarantined,
+                     last_good=prev_model)
+        if tm.on:
+            tm.add("continuous_rollbacks", 1)
+            tm.add("continuous_quarantined", 1)
+        tm.flight.dump("continuous_rollback", seam="continuous.cycle",
+                       model=bad["model"], cause=reason, **detail)
+        Log.warning(
+            f"continuous lane {self.name!r}: ROLLED BACK "
+            f"{bad['model']} ({reason}"
+            + (f", live {detail.get('live_metric')}"
+               if "live_metric" in detail else "")
+            + f"); serving {prev_model} again — candidate quarantined")
+
+    # -- the cycle driver -----------------------------------------------
+    def run_cycle(self, force: bool = False) -> Optional[dict]:
+        """Run (or crash-resume) ONE cycle synchronously; returns the
+        cycle's decision record, or None when there was nothing to do.
+        The worker thread calls this on every poll tick; tests drive
+        it directly for determinism."""
+        with self._cycle_lock:
+            t0 = time.perf_counter()
+            led = self._ledger
+            cycle = int(led["cycle"])
+            resuming = led["phase"] != "idle"
+            cycle_span = TELEMETRY.start_span("continuous_cycle",
+                                              cycle=cycle)
+            try:
+                if resuming:
+                    names = list(led["cycle_slices"])
+                    Log.warning(
+                        f"continuous lane {self.name!r}: resuming "
+                        f"cycle {cycle} from phase "
+                        f"{led['phase']!r} ({len(names)} slice(s) "
+                        "from the ledger)")
+                else:
+                    done = {rec["name"] for rec in led["processed"]}
+                    names = _ingest.discover_slices(self.ingest_dir,
+                                                    done)
+                    if not names and not (
+                            force
+                            and self.config.continuous_mode
+                            == "continue"):
+                        return None
+                decision = self._run_phases(cycle, names,
+                                            led["phase"])
+                decision["resumed"] = resuming
+                self.last_cycle = decision
+                tm = TELEMETRY
+                if tm.on:
+                    tm.add("continuous_cycles", 1)
+                    tm.gauge("continuous_cycle", cycle)
+                    tm.observe("continuous_cycle_ms",
+                               (time.perf_counter() - t0) * 1e3)
+                return decision
+            except BaseException as e:
+                # the flight dump names the phase the cycle died in —
+                # for 'kill' actions this is the only trace left
+                TELEMETRY.flight.dump(
+                    "continuous_cycle_failed", seam="continuous.cycle",
+                    phase=self._ledger["phase"], cycle=cycle,
+                    error=repr(e)[:300])
+                if TELEMETRY.on:
+                    TELEMETRY.add("continuous_cycle_failures", 1)
+                raise
+            finally:
+                TELEMETRY.end_span(cycle_span)
+
+    def _run_phases(self, cycle: int, names: List[str],
+                    start_phase: str) -> dict:
+        """Walk the phase machine from ``start_phase`` (``idle`` =
+        fresh cycle).  Each phase re-derives its inputs from the
+        ledger, does its work, and commits before the next starts."""
+        start = PHASES.index(start_phase) if start_phase in PHASES \
+            else 0
+        slices = None
+        decision = self._ledger.get("cycle_decision")
+        # ingest: load + drift-check the slices, commit the cycle
+        if start <= PHASES.index("ingest"):
+            self._phase("ingest", cycle)
+            span = TELEMETRY.start_span("continuous_ingest",
+                                        cycle=cycle,
+                                        slices=len(names))
+            try:
+                slices = self._load_cycle_slices(names,
+                                                 count_drift=True)
+                if TELEMETRY.on and slices:
+                    TELEMETRY.add(
+                        "continuous_rows_ingested",
+                        int(sum(len(s["X"]) for s in slices)))
+            finally:
+                TELEMETRY.end_span(span)
+            self._commit(phase="train", cycle_slices=names)
+        if slices is None:
+            slices = self._load_cycle_slices(names)
+        # train: produce the candidate model file
+        if start <= PHASES.index("train"):
+            self._phase("train", cycle)
+            cand = self._train_candidate(cycle, slices)
+            self._commit(phase="eval")
+        else:
+            cand = f"model_cycle_{cycle}.txt"
+        # eval: gate the candidate, commit the decision
+        if start <= PHASES.index("eval") or decision is None:
+            self._phase("eval", cycle)
+            decision = self._gate(cycle, cand, slices)
+            self._commit(phase="publish", cycle_decision=decision)
+        # publish: act on the committed decision, retire the cycle
+        self._phase("publish", cycle)
+        slices_meta = [{"name": s["name"], "cycle": cycle,
+                        "rows": int(len(s["X"]))} for s in slices]
+        decision = dict(decision)
+        decision["drift"] = {s["name"]: s["drift"] for s in slices
+                             if s.get("drift")}
+        self._publish(cycle, decision, slices_meta)
+        # fold the cycle's train rows into the in-memory accumulator
+        for s in slices:
+            self._acc.append((s["Xt"], s["yt"]))
+            self._acc_names.append(s["name"])
+        return decision
+
+    # -- worker thread + control surface --------------------------------
+    def start(self, mount_routes: bool = True) -> "ContinuousLane":
+        """Publish the base model if the registry has nothing under
+        ``name`` yet, mount ``/continuous`` on the shared listener,
+        and start the poll worker."""
+        self._base_model_path()
+        if self.registry is not None:
+            try:
+                self.registry.get(self.name)
+            except KeyError:
+                self.registry.publish(self.name,
+                                      self._p(BASE_MODEL),
+                                      published_unix=time.time(),
+                                      source="manual")
+        if mount_routes:
+            TELEMETRY.register_http_route("/continuous",
+                                          self._http_route)
+            self._routes_mounted = True
+        if self._thread is not None and not self._thread.is_alive():
+            self._thread = None        # a previous worker finished
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"ltpu-continuous-{self.name}")
+            self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        self._stop.set()
+        self._force.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+            if t.is_alive():
+                # a long training phase is still draining: keep the
+                # handle so a premature start() cannot spawn a SECOND
+                # worker over the same ledger
+                Log.warning(
+                    f"continuous lane {self.name!r}: worker still "
+                    f"finishing its cycle after {timeout_s:g}s; it "
+                    "will exit at the next poll check")
+            else:
+                self._thread = None
+        if self._routes_mounted:
+            TELEMETRY.unregister_http_route("/continuous")
+            self._routes_mounted = False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._paused:
+                # don't consume a pending force_cycle while paused —
+                # it fires on resume (cheap pause-flag poll)
+                self._stop.wait(min(self.config.continuous_poll_s,
+                                    0.5))
+                continue
+            forced = self._force.is_set()
+            self._force.clear()
+            try:
+                self.run_cycle(force=forced)
+            except Exception as e:
+                # the cycle already dumped the flight recorder;
+                # the lane survives and retries next poll (the
+                # ledger replays the failed cycle)
+                Log.warning(
+                    f"continuous lane {self.name!r}: cycle "
+                    f"failed ({type(e).__name__}: {e}); will "
+                    "retry from the ledger next poll")
+            self._force.wait(self.config.continuous_poll_s)
+
+    def pause(self) -> None:
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
+    def force_cycle(self) -> None:
+        """Skip the poll wait (worker runs a cycle immediately, and a
+        continue-mode cycle runs even with no new slices)."""
+        self._force.set()
+
+    def status(self) -> dict:
+        with self._ledger_lock:
+            led = self._ledger
+            pub = led["published"][-1] if led["published"] else None
+            return {
+                "name": self.name,
+                "mode": self.config.continuous_mode,
+                "state": "paused" if self._paused else (
+                    "running" if self._thread is not None else
+                    "stopped"),
+                "cycle": led["cycle"],
+                "phase": led["phase"],
+                "ingest_dir": self.ingest_dir,
+                "slices_processed": len(led["processed"]),
+                "published": pub,
+                "publishes": len(led["published"]),
+                "quarantined": led["quarantined"],
+                "last_good": led["last_good"],
+                "last_cycle": self.last_cycle,
+            }
+
+    def _http_route(self, method, path, body, headers):
+        """``GET /continuous`` status; ``POST /continuous`` control
+        (``{"action": "pause"|"resume"|"force_cycle"|"live_metric",
+        "value": ...}``)."""
+        if method == "GET":
+            return (200, "application/json",
+                    json.dumps(self.status()).encode(), None)
+        if method != "POST":
+            return (405, "application/json",
+                    json.dumps({"error": "GET for status, POST "
+                                "{'action': ...} for control"}
+                               ).encode(), {"Allow": "GET, POST"})
+        try:
+            req = json.loads(body.decode("utf-8")) if body else {}
+            action = req.get("action", "")
+        except (ValueError, UnicodeDecodeError) as e:
+            return (400, "application/json",
+                    json.dumps({"error": str(e)[:200]}).encode(),
+                    None)
+        if action == "pause":
+            self.pause()
+        elif action == "resume":
+            self.resume()
+        elif action == "force_cycle":
+            self.force_cycle()
+        elif action == "live_metric":
+            try:
+                value = float(req["value"])
+            except (KeyError, TypeError, ValueError):
+                return (400, "application/json",
+                        json.dumps({"error": "live_metric needs a "
+                                    "numeric 'value'"}).encode(),
+                        None)
+            rolled = self.report_live_metric(value)
+            return (200, "application/json",
+                    json.dumps({"action": action,
+                                "rolled_back": rolled,
+                                **self.status()}).encode(), None)
+        else:
+            return (400, "application/json",
+                    json.dumps(
+                        {"error": f"unknown action {action!r}",
+                         "actions": ["pause", "resume",
+                                     "force_cycle", "live_metric"]}
+                    ).encode(), None)
+        return (200, "application/json",
+                json.dumps({"action": action,
+                            **self.status()}).encode(), None)

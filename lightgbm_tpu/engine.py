@@ -84,6 +84,28 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # verbosity is global the same way); Log.fatal ignores the level
     Log.set_level(config.verbose)
 
+    resume_arg = config.resume if resume is None else resume
+    if isinstance(resume_arg, bool):
+        resume_arg = "auto" if resume_arg else "off"
+    resume_arg = str(resume_arg or "off")
+    if resume_arg.lower() not in ("off", "false", "0", "none", "auto") \
+            and init_model is not None:
+        # an explicit checkpoint path + init_model is a contradiction,
+        # not a precedence question: the checkpoint carries the FULL
+        # training state (model included), so whichever fingerprint
+        # happens to match would silently discard the other input.
+        # (resume="auto" composes fine — the fingerprint carries the
+        # init_model identity, so auto only ever adopts checkpoints
+        # from an identically-seeded run.)  Checked BEFORE any dataset
+        # construction: the conflict must fail fast.
+        raise ValueError(
+            "engine.train: both init_model= and an explicit resume= "
+            f"checkpoint path ({resume_arg!r}) are set — the "
+            "checkpoint already contains the full training state, so "
+            "one of them would be silently ignored. Pass resume='off' "
+            "to continue from init_model, or drop init_model to "
+            "resume from the checkpoint.")
+
     if hasattr(train_set, "construct"):
         core_train = train_set.construct(config)
     else:
@@ -243,10 +265,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     # --- resume (docs/RELIABILITY.md): continue from the newest valid
     # checkpoint (auto) or an explicit checkpoint file ---------------
-    resume_arg = config.resume if resume is None else resume
-    if isinstance(resume_arg, bool):
-        resume_arg = "auto" if resume_arg else "off"
-    resume_arg = str(resume_arg or "off")
     loaded = None
     if resume_arg.lower() not in ("off", "false", "0", "none", ""):
         if resume_arg.lower() == "auto":

@@ -271,7 +271,8 @@ _FP_EXCLUDE_EXACT = frozenset({
 _FP_EXCLUDE_PREFIX = ("telemetry", "predict_", "is_predict_",
                       "pred_early_stop", "snapshot_", "checkpoint_",
                       "resume", "fault_plan", "dispatch_retries",
-                      "retry_backoff", "oom_downshift")
+                      "retry_backoff", "oom_downshift", "serve_",
+                      "flight_recorder", "continuous_")
 
 
 def training_fingerprint(config, dataset, num_valid: int = 0,

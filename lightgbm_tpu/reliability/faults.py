@@ -65,6 +65,14 @@ SEAMS = (
                              # seam: an injected fault exercises the
                              # 500 + flight-dump path, never tears
                              # down the listener)
+    "continuous.cycle",      # continuous-training lane phase entry
+                             # (continuous/lane.py — fires once per
+                             # cycle PHASE: ingest, train, eval,
+                             # publish.  A kill here proves the cycle
+                             # state machine resumes from its ledger;
+                             # the byte-identity of the resumed
+                             # published model is pinned by
+                             # tests/test_continuous.py)
     "distributed.init",      # multi-machine rendezvous / network init
     "collectives.allgather", # host-side collective backend calls
     "dataset.cache_io",      # binary dataset cache file open (r/w)

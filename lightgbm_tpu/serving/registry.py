@@ -47,18 +47,21 @@ class FeatureWidthMismatch(ValueError):
 
 class ModelEntry:
     """One immutable (name, version) serving unit: the Booster, its
-    predict closure, and the micro-batcher that owns its in-flight
-    queue."""
+    predict closure, the micro-batcher that owns its in-flight queue,
+    and the publish-time audit metadata (``meta``: who published it,
+    when, and at what eval metric — what a rollback decision reads)."""
 
-    __slots__ = ("name", "version", "booster", "batcher", "_predict_fn")
+    __slots__ = ("name", "version", "booster", "batcher", "_predict_fn",
+                 "meta")
 
     def __init__(self, name: str, version: int, booster, predict_fn,
-                 batcher: MicroBatcher):
+                 batcher: MicroBatcher, meta=None):
         self.name = name
         self.version = int(version)
         self.booster = booster
         self._predict_fn = predict_fn
         self.batcher = batcher
+        self.meta: dict = dict(meta or {})
 
     def predict(self, rows: np.ndarray) -> np.ndarray:
         return self.batcher.submit(rows)
@@ -124,17 +127,38 @@ class ModelRegistry:
     def publish(self, name: str, model, version: Optional[int] = None,
                 warm: Optional[Tuple[int, ...]] = None,
                 predict_kwargs: Optional[dict] = None,
-                log_warm: bool = False) -> ModelEntry:
+                log_warm: bool = False,
+                published_unix: Optional[float] = None,
+                eval_metric: Optional[float] = None,
+                source: str = "manual") -> ModelEntry:
         """Register ``model`` (a Booster or a model-file path) as the
         new current version of ``name``.  Buckets are warmed BEFORE
         the pointer flip; the replaced version drains its in-flight
-        work and releases its dispatcher."""
+        work and releases its dispatcher.
+
+        Audit metadata (surfaced per version by ``GET /models`` so a
+        rollback decision can be traced): ``published_unix`` is the
+        publish wall clock PASSED IN BY THE CALLER (the registry never
+        stamps it itself — the continuous lane records the clock its
+        ledger committed, so a crash-replayed publish carries the same
+        timestamp), ``eval_metric`` the gate metric the candidate
+        scored at publish, and ``source`` who published it
+        (``manual`` | ``continuous``)."""
         from ..booster import Booster
+        if source not in ("manual", "continuous"):
+            raise ValueError(
+                f"publish source must be manual/continuous, got "
+                f"{source!r}")
         cfg = self.config
         if isinstance(model, str):
             booster = Booster(config=cfg, model_file=model)
         else:
             booster = model
+        meta = {"source": source}
+        if published_unix is not None:
+            meta["published_unix"] = round(float(published_unix), 6)
+        if eval_metric is not None:
+            meta["eval_metric"] = float(eval_metric)
         kw = dict(predict_kwargs or {})
 
         def predict_fn(rows, _b=booster, _kw=kw):
@@ -157,7 +181,7 @@ class ModelRegistry:
             entry = ModelEntry(
                 name, version, booster, predict_fn,
                 MicroBatcher(predict_fn, cfg,
-                             name=f"{name}@v{version}"))
+                             name=f"{name}@v{version}"), meta=meta)
             versions.append(entry)
             old = self._current.get(name)
             if old is not None:
@@ -247,13 +271,19 @@ class ModelRegistry:
             "the request (registry shutting down?)")
 
     def describe(self) -> Dict[str, dict]:
-        """The ``/models`` endpoint body."""
+        """The ``/models`` endpoint body.  ``versions`` carries one
+        record per published version with its audit metadata
+        (``published_unix`` / ``eval_metric`` / ``source`` as passed to
+        :meth:`publish`) and whether that version is the one currently
+        serving — the trail a rollback decision is audited against."""
         with self._lock:
             return {
                 name: {
                     "version": entry.version,
-                    "versions": [e.version
-                                 for e in self._versions.get(name, [])],
+                    "versions": [
+                        {"version": e.version,
+                         "serving": e is entry, **e.meta}
+                        for e in self._versions.get(name, [])],
                     "queue_depth": entry.batcher.depth(),
                 }
                 for name, entry in self._current.items()

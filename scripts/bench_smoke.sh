@@ -106,6 +106,14 @@ for base, buckets in hists.items():
 print(f"prom scrape ok: {len(hists)} histogram series, "
       f"buckets monotone")
 EOF
+# continuous-training probe (round 15): 2-cycle in-process loop
+# (ingest -> append-construct -> continue-train -> gated publish),
+# served-vs-direct parity, a forced live regression -> auto-rollback,
+# and a continuous.cycle SIGKILL fault-plan smoke proving the cycle
+# state machine resumes to a byte-identical published model; asserted
+# by test_bench_smoke on the JSON it writes
+python scripts/continuous_probe.py /tmp/lgbtpu_smoke/continuous.json >&2
+test -s /tmp/lgbtpu_smoke/continuous.json
 # serving probe (round 14): in-process registry + micro-batching
 # frontend under concurrent single-row clients through real HTTP —
 # parity vs direct predict, coalescing actually occurring

@@ -1,0 +1,224 @@
+"""Continuous-training probe: the closed train→evaluate→publish loop
+exercised end to end, in-process and under SIGKILL.
+
+Run by ``scripts/bench_smoke.sh`` and asserted by
+``tests/test_bench_smoke.py``.  Two parts:
+
+1. **In-process 2-cycle run** — base model published into a real
+   ModelRegistry, two data slices dropped into an ingest dir, two
+   continue-mode cycles: ingest → append-construct → continue-train →
+   eval gate → hot publish.  Served predictions are parity-checked
+   byte-identical against a direct ``Booster.predict`` of the
+   published model file; then a forced live-metric regression must
+   auto-roll the registry back (pointer flip, candidate quarantined).
+2. **SIGKILL cycle-resume smoke** — a child lane run is SIGKILLed at
+   the TRAIN phase entry through the ``continuous.cycle`` fault seam
+   (``LTPU_FAULT_PLAN=continuous.cycle:2:kill`` — call 1 is ingest,
+   call 2 is train), then re-run without the plan; the resumed cycle
+   must publish a model byte-identical to an uninterrupted control
+   run's (docs/CONTINUOUS_TRAINING.md, crash safety).
+
+Writes ``/tmp/lgbtpu_smoke/continuous.json``.
+
+Usage: python scripts/continuous_probe.py [out_json]
+       python scripts/continuous_probe.py --child <workdir>
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PARAMS = dict(objective="regression", verbose=-1, num_leaves=7,
+              min_data_in_leaf=5, max_bin=31)
+CYCLE_ITERS = 4
+
+
+def _data(seed, n=300, shift=0.0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 5)
+    y = X[:, 0] - 0.3 * X[:, 1] + shift
+    return X, y
+
+
+def _write_slice(ingest, name, seed, n=120, shift=0.0):
+    import numpy as np
+    X, y = _data(seed, n, shift)
+    np.savetxt(os.path.join(ingest, name),
+               np.column_stack([y, X]), delimiter=",")
+
+
+def _setup(work):
+    """Deterministic base model + lane over ``work`` (shared by the
+    control / kill / resume children: identical setups fingerprint
+    identically, which is what makes replay byte-identical)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.continuous import ContinuousLane
+    ingest = os.path.join(work, "ingest")
+    os.makedirs(ingest, exist_ok=True)
+    Xb, yb = _data(0)
+    base = lgb.train(PARAMS, lgb.Dataset(Xb, label=yb), 4,
+                     verbose_eval=False)
+    cfg = Config.from_params(dict(
+        PARAMS, continuous_ingest_dir=ingest,
+        continuous_iterations=CYCLE_ITERS,
+        continuous_eval_holdout=0.25,
+        continuous_checkpoint_freq=2))
+    lane = ContinuousLane(cfg, None, name="probe", base_model=base,
+                          base_data=Xb, base_label=yb,
+                          train_params=dict(PARAMS))
+    lane._base_model_path()
+    return lane, ingest
+
+
+def child(work: str) -> None:
+    """One lane cycle over whatever slice/ledger state ``work`` holds
+    (the kill/control/resume unit).  Prints the published model's
+    bytes digest + path."""
+    import hashlib
+    lane, ingest = _setup(work)
+    if not os.path.exists(os.path.join(ingest, "s1.csv")):
+        _write_slice(ingest, "s1.csv", seed=7)
+    rec = lane.run_cycle()
+    model = lane._p(lane._ledger["last_good"])
+    with open(model, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    print(json.dumps({"digest": digest, "accept": rec["accept"],
+                      "cycle": rec["cycle"],
+                      "resumed": rec.get("resumed", False)}))
+
+
+def run_child(work: str, fault_plan: str = ""):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("LTPU_FAULT_PLAN", None)
+    if fault_plan:
+        env["LTPU_FAULT_PLAN"] = fault_plan
+    run = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", work],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    info = {}
+    for line in (run.stdout or "").splitlines():
+        if line.strip().startswith("{"):
+            info = json.loads(line)
+    return run.returncode, info, run
+
+
+def in_process_probe(work: str) -> dict:
+    """2-cycle ingest→train→gate→publish + forced live regression →
+    auto-rollback, against a REAL registry."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import ModelRegistry
+    from lightgbm_tpu.telemetry import TELEMETRY
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    lane, ingest = _setup(work)
+    registry = ModelRegistry(lane.config)
+    lane.registry = registry
+    registry.publish("probe", lane._p("model_base.txt"),
+                     published_unix=time.time(), source="manual")
+
+    _write_slice(ingest, "s1.csv", seed=7)
+    rec1 = lane.run_cycle()
+    _write_slice(ingest, "s2.csv", seed=8)
+    rec2 = lane.run_cycle()
+
+    # parity: served predictions byte-identical to a direct predict of
+    # the published model file
+    Xq, _ = _data(99, n=16)
+    entry, served = registry.predict("probe", Xq)
+    direct = lgb.Booster(
+        model_file=lane._p(lane._ledger["last_good"])).predict(Xq)
+    parity = bool(np.array_equal(np.asarray(served),
+                                 np.asarray(direct)))
+    version_before = registry.get("probe").version
+
+    # forced regression: report a live metric far past the publish
+    # bound -> rollback must fire and flip the registry pointer back
+    live = (rec2["candidate_metric"] or 0.0) + 1e6
+    rolled = lane.report_live_metric(live)
+    version_after = registry.get("probe").version
+    # rollback restores the prior version's outputs byte-identically
+    _e, after = registry.predict("probe", Xq)
+    prev_model = lane._p(lane._ledger["last_good"])
+    rollback_parity = bool(np.array_equal(
+        np.asarray(after),
+        lgb.Booster(model_file=prev_model).predict(Xq)))
+
+    c = TELEMETRY.counters()
+    registry.close()
+    return {
+        "cycles": int(c.get("continuous_cycles", 0)),
+        "rows_ingested": int(c.get("continuous_rows_ingested", 0)),
+        "publishes": int(c.get("continuous_publishes", 0)),
+        "rollbacks": int(c.get("continuous_rollbacks", 0)),
+        "quarantined": int(c.get("continuous_quarantined", 0)),
+        "cycle1_accept": bool(rec1["accept"]),
+        "cycle2_accept": bool(rec2["accept"]),
+        "parity": "pass" if parity else "fail",
+        "rollback_fired": bool(rolled),
+        "rollback_parity": "pass" if rollback_parity else "fail",
+        "version_before_rollback": version_before,
+        "version_after_rollback": version_after,
+    }
+
+
+def main() -> int:
+    out_json = sys.argv[1] if len(sys.argv) > 1 \
+        else "/tmp/lgbtpu_smoke/continuous.json"
+    os.makedirs(os.path.dirname(out_json), exist_ok=True)
+    base = os.path.join(os.path.dirname(out_json), "continuous_work")
+
+    # part 1: in-process 2-cycle + rollback
+    w1 = os.path.join(base, "inproc")
+    shutil.rmtree(w1, ignore_errors=True)
+    os.makedirs(w1)
+    out = in_process_probe(w1)
+
+    # part 2: SIGKILL at the train-phase entry, then resume
+    wc = os.path.join(base, "control")
+    wk = os.path.join(base, "kill")
+    for w in (wc, wk):
+        shutil.rmtree(w, ignore_errors=True)
+        os.makedirs(w)
+    rc_ctrl, ctrl, ctrl_run = run_child(wc)
+    if rc_ctrl != 0:
+        sys.stderr.write(ctrl_run.stdout + ctrl_run.stderr)
+        return 1
+    rc_kill, _, _ = run_child(wk,
+                              fault_plan="continuous.cycle:2:kill")
+    rc_res, res, res_run = run_child(wk)
+    resumed = bool(res.get("resumed"))
+    out.update({
+        "kill_returncode": rc_kill,
+        "resume_returncode": rc_res,
+        "cycle_resumed_from_ledger": bool(resumed),
+        "byte_identical": bool(rc_res == 0
+                               and res.get("digest") == ctrl["digest"]),
+        "kill_recovery": "pass" if (
+            rc_kill == -9 and rc_res == 0 and resumed
+            and res.get("digest") == ctrl["digest"]) else "fail",
+    })
+    ok = (out["parity"] == "pass" and out["rollback_parity"] == "pass"
+          and out["rollback_fired"] and out["publishes"] >= 2
+          and out["kill_recovery"] == "pass")
+    with open(out_json, "w") as f:
+        json.dump(out, f, indent=1)
+    sys.stderr.write("continuous probe: " + json.dumps(out) + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+        sys.exit(0)
+    sys.exit(main())

@@ -22,7 +22,7 @@ def test_bench_smoke_json_contract():
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_CHUNK="1")
     run = subprocess.run(
         ["sh", os.path.join(REPO, "scripts", "bench_smoke.sh")],
-        capture_output=True, text=True, env=env, cwd=REPO, timeout=560)
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=640)
     assert run.returncode == 0, (run.stdout or "")[-2000:] + \
         (run.stderr or "")[-2000:]
     lines = [ln for ln in run.stdout.strip().splitlines() if ln.strip()]
@@ -97,6 +97,28 @@ def test_bench_smoke_json_contract():
     d = json.load(open(dumps[-1]))
     assert d["seam"] == "predict.dispatch"
     assert d["events"]
+    # continuous-training probe (round 15): the closed
+    # train->evaluate->publish loop — scripts/continuous_probe.py,
+    # run in-line by bench_smoke.sh
+    with open("/tmp/lgbtpu_smoke/continuous.json") as f:
+        ct = json.load(f)
+    for field in ("cycles", "rows_ingested", "publishes", "rollbacks",
+                  "parity", "rollback_fired", "rollback_parity",
+                  "kill_returncode", "byte_identical",
+                  "kill_recovery"):
+        assert field in ct, f"continuous probe missing {field}"
+    assert ct["cycles"] >= 2 and ct["publishes"] >= 2
+    # served predictions byte-identical to a direct Booster.predict
+    # of the published model, before AND after the auto-rollback
+    assert ct["parity"] == "pass"
+    assert ct["rollback_fired"] and ct["rollbacks"] >= 1
+    assert ct["rollback_parity"] == "pass"
+    # the SIGKILL smoke really killed (-9), the cycle resumed from
+    # its ledger, and the resumed publish is byte-identical
+    assert ct["kill_returncode"] == -9
+    assert ct["cycle_resumed_from_ledger"] is True
+    assert ct["byte_identical"] is True
+    assert ct["kill_recovery"] == "pass"
     # serving probe (round 14): concurrent single-row clients through
     # the micro-batching HTTP frontend — scripts/serve_bench.py, run
     # in-line by bench_smoke.sh

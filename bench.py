@@ -1038,6 +1038,127 @@ def run_construct_scale(params):
     return out
 
 
+def _rss_mb() -> float:
+    """Current VmRSS in MB (/proc; 0.0 where unavailable) — the
+    shard_construct block reports the resident-set DELTA of each
+    construction route, the rows-per-chip signal sharding exists for."""
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    return float(ln.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def run_shard_construct(params):
+    """Sharded-construct roofline point (round 16, ROADMAP item 1):
+    the mesh-sharded data plane measured against the single-matrix
+    route on the same draw — per-shard construct rows/s, the
+    distributed bin-find merge wall, resident-set delta per route —
+    gated on the packed shards being byte-identical to the
+    single-matrix construction and on a shard-cache v2 round trip
+    (manifest world-size refusal included).  2 simulated participants
+    by default (BENCH_SHARD_PARTICIPANTS); the
+    order-of-magnitude-past-10.5M-rows series tracks the same keys in
+    MULTICHIP_r*.json runs."""
+    import shutil
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.sharded import (ShardCacheError, ShardedDataset,
+                                      binfind, load_shard_cache,
+                                      save_shard_cache)
+
+    rows = int(os.environ.get("BENCH_SHARD_ROWS",
+                              min(BENCH_ROWS, 500_000)))
+    shards = int(os.environ.get("BENCH_SHARD_PARTICIPANTS", 2))
+    X, y, _ = make_data(rows, BENCH_FEATURES, seed=41)
+    base = {"objective": "binary", "num_leaves": params["num_leaves"],
+            "max_bin": params["max_bin"], "verbose": -1}
+    cfg = Config.from_params(base)
+
+    gc.collect()
+    rss0 = _rss_mb()
+    t0 = time.time()
+    single = lgb.Dataset(X, label=y).construct(cfg)
+    single_s = time.time() - t0
+    rss_single = max(0.0, _rss_mb() - rss0)
+
+    # the merge wall on its own: candidates + instrumented allgather +
+    # deterministic merge (the network-facing slice of construction)
+    from lightgbm_tpu.sharded.dataset import shard_row_ranges
+    ranges = shard_row_ranges(rows, shards)
+    t0 = time.time()
+    cands = [binfind.collect_candidates(X[a:b], cfg, rank=i,
+                                        world=shards)
+             for i, (a, b) in enumerate(ranges)]
+    _vals, _rows_m, _tot = binfind.merge_candidates(cands)
+    merge_wall_ms = (time.time() - t0) * 1e3
+    del cands, _vals, _rows_m
+
+    gc.collect()
+    rss1 = _rss_mb()
+    t0 = time.time()
+    sds = ShardedDataset.construct_sharded(X, label=y, config=cfg,
+                                           num_shards=shards)
+    shard_s = time.time() - t0
+    rss_sharded = max(0.0, _rss_mb() - rss1)
+
+    if not np.array_equal(sds.assembled_group_bins(),
+                          np.asarray(single.group_bins)):
+        raise SystemExit(
+            "shard_construct parity gate failed: sharded-route bins "
+            "differ from the single-matrix construction")
+    if binfind.mapper_fingerprint(sds.mappers, sds._bundles,
+                                  sds.max_bin) \
+            != binfind.mapper_fingerprint(single.mappers,
+                                          single._bundles,
+                                          single.max_bin):
+        raise SystemExit("shard_construct mapper gate failed: merged "
+                         "mappers differ from the single-host fit")
+
+    tmp = tempfile.mkdtemp(prefix="bench_shard_")
+    try:
+        save_shard_cache(sds, tmp)
+        t0 = time.time()
+        re = load_shard_cache(tmp, expect_world_size=shards)
+        reload_s = time.time() - t0
+        if not np.array_equal(re.assembled_group_bins(),
+                              sds.assembled_group_bins()):
+            raise SystemExit("shard-cache v2 reload parity gate "
+                             "failed")
+        try:
+            load_shard_cache(tmp, expect_world_size=shards + 1)
+            raise SystemExit("shard-cache manifest accepted a wrong "
+                             "world size")
+        except ShardCacheError:
+            manifest_reject = "pass"
+        del re
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    per_shard_rows = rows / shards
+    return {
+        "task": "shard_construct", "rows": rows, "shards": shards,
+        "features": BENCH_FEATURES,
+        "shard_construct_s": round(shard_s, 3),
+        "shard_rows_per_s": round(rows / max(shard_s, 1e-9)),
+        "per_shard_rows_per_s": round(
+            per_shard_rows / max(shard_s, 1e-9)),
+        "single_construct_s": round(single_s, 3),
+        "vs_single_matrix": round(single_s / max(shard_s, 1e-9), 2),
+        "merge_wall_ms": round(merge_wall_ms, 2),
+        "rss_single_mb": round(rss_single, 1),
+        "rss_sharded_mb": round(rss_sharded, 1),
+        "cache_reload_s": round(reload_s, 3),
+        "parity": "pass",
+        "manifest_reject": manifest_reject,
+    }
+
+
 def run_predict_scale(params):
     """Serving roofline point: bulk scoring throughput, micro-batch
     p50 latency and the compile count of the shape-bucketed device
@@ -1531,6 +1652,20 @@ def main():
         else:
             construct_block = {"task": "construct", "rows": c_rows,
                                "skipped": note}
+    shard_block = None
+    if os.environ.get("BENCH_SHARD", "1") != "0":
+        s_rows = int(os.environ.get("BENCH_SHARD_ROWS",
+                                    min(BENCH_ROWS, 500_000)))
+        # two constructions (single-matrix + sharded) + a standalone
+        # merge pass + a cache round trip; same per-row ceiling as the
+        # construct block, doubled
+        est = max(10.0, 40.0 * s_rows / 1e6)
+        note = admit("shard_construct", est)
+        if note is None:
+            shard_block = run_shard_construct(params)
+        else:
+            shard_block = {"task": "shard_construct", "rows": s_rows,
+                           "skipped": note}
     if budget_left() > 60 + FINISH_RESERVE_S:
         higgs = run_higgs_real(params)
         if higgs is not None:
@@ -1572,6 +1707,12 @@ def main():
         # cache v2 reload ratio and the reference-CSV-load anchor
         # (docs/ROOFLINE.md round-11 delta)
         result["construct"] = construct_block
+    if shard_block is not None:
+        # the sharded-construct block (round 16): per-shard construct
+        # rows/s, distributed bin-find merge wall, RSS per route,
+        # shard-cache round trip — parity-gated against the
+        # single-matrix construction inside the block
+        result["shard_construct"] = shard_block
     if "chunk_slope" in primary:
         # the round-6/7 per-iteration chunk-slope fit and what
         # dispatch_chunk=auto would pick locally and on an axon-RPC
@@ -1637,6 +1778,20 @@ def main():
                   f"reload={c['cache_reload_s']}s "
                   f"({c['reload_x_cold']}x cold){extra}",
                   file=sys.stderr)
+    if shard_block is not None:
+        if "skipped" in shard_block:
+            print(f"shard_construct skipped: {shard_block['skipped']}",
+                  file=sys.stderr)
+        else:
+            sb = shard_block
+            print(f"shard_construct rows={sb['rows']} "
+                  f"shards={sb['shards']} "
+                  f"wall={sb['shard_construct_s']}s "
+                  f"({sb['per_shard_rows_per_s']} rows/s/shard) "
+                  f"merge={sb['merge_wall_ms']}ms "
+                  f"vs_single={sb['vs_single_matrix']}x "
+                  f"rss={sb['rss_sharded_mb']}MB "
+                  f"(single {sb['rss_single_mb']}MB)", file=sys.stderr)
     if predict_block is not None:
         if "skipped" in predict_block:
             print(f"predict skipped: {predict_block['skipped']}",

@@ -71,13 +71,51 @@ class Dataset:
                 if isinstance(self.feature_name, (list, tuple)):
                     self._core.feature_names = list(self.feature_name)
                 return self._core
+        if config.sharded_shards > 1 and self.reference is None \
+                and config.sharded_cache_dir:
+            # shard-cache v2 reload (the sharded analog of the binary-
+            # token sniff above): a committed manifest short-circuits
+            # parsing AND binning; world-size/fingerprint mismatches
+            # refuse loudly inside the loader
+            from .sharded import has_shard_cache, load_shard_cache
+            if has_shard_cache(config.sharded_cache_dir):
+                if self.group is not None:
+                    # the fresh-construct route refuses query groups
+                    # loudly — the cache-reload route must not let
+                    # them vanish silently instead
+                    Log.fatal("sharded construction does not support "
+                              "query groups yet — drop group= or "
+                              "sharded_shards")
+                self._core = load_shard_cache(
+                    config.sharded_cache_dir,
+                    expect_world_size=config.sharded_shards,
+                    config=config)
+                if self.label is not None:
+                    self._core.metadata.set_label(self.label)
+                if self.weight is not None:
+                    self._core.metadata.set_weight(self.weight)
+                if self.init_score is not None:
+                    self._core.metadata.set_init_score(self.init_score)
+                if isinstance(self.feature_name, (list, tuple)):
+                    self._core.feature_names = list(self.feature_name)
+                self._core.pandas_categorical = None
+                return self._core
+        sharded_on = config.sharded_shards > 1 and self.reference is None
         streaming_ok = (isinstance(data, str)
                         and config.use_two_round_loading
                         and self.reference is None
+                        and not sharded_on
                         and not isinstance(self.categorical_feature,
                                            (list, tuple)))
+        if sharded_on and isinstance(data, str) \
+                and config.use_two_round_loading:
+            Log.warning("two_round loading is bypassed by sharded "
+                        "construction: the file parses into one "
+                        "in-RAM matrix before row-range splitting "
+                        "(per-shard ingest still streams in "
+                        "streaming_chunk_rows chunks)")
         if (isinstance(data, str) and config.use_two_round_loading
-                and not streaming_ok):
+                and not streaming_ok and not sharded_on):
             Log.warning("two_round loading does not support reference-"
                         "aligned or explicitly-categorical datasets yet; "
                         "falling back to in-RAM loading")
@@ -145,6 +183,38 @@ class Dataset:
         import time as _time
 
         from .telemetry import TELEMETRY
+        if sharded_on and ref_core is None:
+            # mesh-sharded construction (lightgbm_tpu/sharded/,
+            # docs/Parallel-Learning-Guide.md "Sharded construction"):
+            # distributed bin finding + per-shard streaming ingest;
+            # reference-aligned (validation) datasets never shard —
+            # they bin whole against the training mappers
+            if _is_sparse(data):
+                Log.warning("sharded_shards ignored for sparse input; "
+                            "using the single-matrix sparse path")
+            else:
+                from .sharded import ShardedDataset, save_shard_cache
+                t0 = _time.perf_counter()
+                with TELEMETRY.span("binning", rows=int(data.shape[0])):
+                    self._core = ShardedDataset.construct_sharded(
+                        data, label=label, weight=self.weight,
+                        group=self.group, init_score=self.init_score,
+                        config=config,
+                        categorical_features=cat_indices,
+                        feature_names=feature_names)
+                wall = _time.perf_counter() - t0
+                if wall > 0:
+                    TELEMETRY.gauge("construct_rows_per_s",
+                                    round(int(data.shape[0]) / wall))
+                if config.sharded_cache_dir:
+                    save_shard_cache(self._core,
+                                     config.sharded_cache_dir)
+                self._core._raw_data = None if self.free_raw_data \
+                    else data
+                self._core.pandas_categorical = pandas_cats
+                if self.free_raw_data:
+                    self.data = None
+                return self._core
         t0 = _time.perf_counter()
         with TELEMETRY.span("binning", rows=int(data.shape[0])):
             # host-side bin-mapper fit + matrix binning — the one

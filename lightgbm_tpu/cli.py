@@ -117,6 +117,16 @@ def _task_train(params, config: Config) -> None:
             policy.budget_s = config.time_out * 60.0
             retry_call(_net_init, seam="distributed.init",
                        policy=policy)
+    if config.sharded_shards > 1:
+        # mesh-sharded construction (docs/Parallel-Learning-Guide.md,
+        # "Sharded construction"): Dataset.construct routes through
+        # lightgbm_tpu/sharded/ — distributed bin finding, per-shard
+        # streaming ingest, per-device placement over the mesh row
+        # axis, optional shard-cache v2 under sharded_cache_dir
+        Log.info(f"sharded construction armed: "
+                 f"{config.sharded_shards} participant shard(s)"
+                 + (f", cache {config.sharded_cache_dir}"
+                    if config.sharded_cache_dir else ""))
     # input_model (continued training) seeds scores from raw data —
     # retain it in that case (reference CLI keeps data in memory too)
     train_set = Dataset(config.data, params=params,

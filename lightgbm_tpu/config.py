@@ -541,6 +541,26 @@ class Config:
     # events correlated with the fault seam that fired.  "" disables
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
+    sharded_shards: int = 0         # mesh-sharded dataset construction
+    # (lightgbm_tpu/sharded/, docs/Parallel-Learning-Guide.md "Sharded
+    # construction"): split the training rows into this many disjoint
+    # participant ranges, fit bin mappers DISTRIBUTED (per-range
+    # boundary candidates allgathered + deterministically merged — the
+    # reference DatasetLoader's bin-boundary sync), stream-ingest each
+    # range into its own bin-matrix shard and place the shards
+    # per-device over the mesh row axis.  Trees are byte-identical to
+    # the single-matrix route.  0/1 disables (default: one host-
+    # resident packed matrix)
+    sharded_cache_dir: str = ""     # shard-cache v2 directory: after a
+    # sharded construction the per-shard bin matrices are persisted as
+    # one v2 binary-cache file each plus a manifest (world size, row
+    # ranges, mapper fingerprint); a later run with a matching
+    # sharded_shards reloads the shards zero-copy (memmap) and REFUSES
+    # a world-size or fingerprint mismatch loudly.  "" disables
+    sharded_sample_per_shard: int = 0  # per-participant boundary-
+    # candidate sample quota for distributed bin finding; 0 derives
+    # bin_construct_sample_cnt / sharded_shards (so the merged sample
+    # matches the single-host sample budget)
 
     # -- serving (new; no reference analog) --
     serve_batch_deadline_ms: float = 2.0  # micro-batching scheduler
@@ -602,6 +622,14 @@ class Config:
     # instead of published.  The same bound guards the post-publish
     # live-metric hook — a live regression past it auto-rolls the
     # registry back
+    continuous_drift_refit_threshold: int = 0  # drift-triggered
+    # base-refit (docs/CONTINUOUS_TRAINING.md, drift semantics): once
+    # this many slices have drifted (cumulative across cycles, tracked
+    # in the ledger), the NEXT cycle runs a `refit` against the
+    # slices' raw values — leaf values refreshed through the model's
+    # REAL-VALUED thresholds, immune to the frozen mappers' edge-bin
+    # clamping — instead of only warning, then the drift tally resets.
+    # 0 disables (the default: drift warns and counts only)
     continuous_checkpoint_freq: int = 0  # mid-cycle crash-safe
     # checkpoint cadence (iterations) for continue-mode training
     # (docs/RELIABILITY.md machinery, per-cycle checkpoint files); 0
@@ -754,6 +782,15 @@ class Config:
         if self.continuous_checkpoint_freq < 0:
             raise ValueError("continuous_checkpoint_freq must be >= 0 "
                              "(0 = cycle-start replay only)")
+        if self.continuous_drift_refit_threshold < 0:
+            raise ValueError("continuous_drift_refit_threshold must be "
+                             ">= 0 (0 = drift warns only)")
+        if self.sharded_shards < 0:
+            raise ValueError("sharded_shards must be >= 0 "
+                             "(0/1 = single-matrix construction)")
+        if self.sharded_sample_per_shard < 0:
+            raise ValueError("sharded_sample_per_shard must be >= 0 "
+                             "(0 = derive from bin_construct_sample_cnt)")
         if self.snapshot_keep < 0:
             raise ValueError("snapshot_keep must be >= 0 (0 = keep all)")
         if self.checkpoint_keep < 1:

@@ -291,6 +291,35 @@ class ContinuousLane:
                             base, X, name, count=count_drift)})
         return out
 
+    def _drift_refit_updates(self, drifted_slices: int) -> dict:
+        """Ingest-commit updates for the drift-triggered base refit
+        (``continuous_drift_refit_threshold``): the cumulative
+        drifted-slice tally lives in the LEDGER (so a crash-replayed
+        cycle decides the same mode), and once it crosses the
+        threshold the cycle's committed mode flips to ``refit`` —
+        leaf values refreshed through the model's REAL-VALUED
+        thresholds, immune to the frozen mappers' edge-bin clamping —
+        then the tally resets.  Threshold 0 (default) keeps the
+        r15 warn-and-count-only behavior."""
+        thr = int(getattr(self.config,
+                          "continuous_drift_refit_threshold", 0) or 0)
+        tally = int(self._ledger.get("drift_slices", 0)) \
+            + int(drifted_slices)
+        mode = self.config.continuous_mode
+        if thr > 0 and tally >= thr:
+            mode = "refit"
+            tally = 0
+            if TELEMETRY.on:
+                TELEMETRY.add("continuous_drift_refits", 1)
+            Log.warning(
+                f"continuous lane {self.name!r}: drifted-slice tally "
+                f"reached continuous_drift_refit_threshold={thr} — "
+                "this cycle REFITS leaf values on the fresh labels "
+                "(real-valued thresholds, no frozen-mapper clamping) "
+                "instead of continue-training, then the tally resets "
+                "(docs/CONTINUOUS_TRAINING.md, drift semantics)")
+        return {"drift_slices": tally, "cycle_mode": mode}
+
     def _cycle_train_params(self, cycle: int) -> Dict[str, Any]:
         p = dict(self.train_params)
         p["num_iterations"] = self.config.continuous_iterations
@@ -314,7 +343,12 @@ class ContinuousLane:
         span = TELEMETRY.start_span("continuous_train", cycle=cycle)
         try:
             init_path = self._p(self._ledger["last_good"])
-            mode = self.config.continuous_mode
+            # the MODE is a ledger fact committed at ingest (the
+            # drift-refit trigger may override the configured mode for
+            # this one cycle) — reading config here would let a crash
+            # replay train a different candidate than the first pass
+            mode = self._ledger.get("cycle_mode") \
+                or self.config.continuous_mode
             if mode == "refit":
                 Xs = [s["Xt"] for s in slices if len(s["Xt"])]
                 ys = [s["yt"] for s in slices if len(s["yt"])]
@@ -645,7 +679,9 @@ class ContinuousLane:
                         int(sum(len(s["X"]) for s in slices)))
             finally:
                 TELEMETRY.end_span(span)
-            self._commit(phase="train", cycle_slices=names)
+            n_drifted = sum(1 for s in slices if s.get("drift"))
+            self._commit(phase="train", cycle_slices=names,
+                         **self._drift_refit_updates(n_drifted))
         if slices is None:
             slices = self._load_cycle_slices(names)
         # train: produce the candidate model file
@@ -774,6 +810,10 @@ class ContinuousLane:
                 "quarantined": led["quarantined"],
                 "last_good": led["last_good"],
                 "last_cycle": self.last_cycle,
+                "drift_slices": int(led.get("drift_slices", 0)),
+                "drift_refit_threshold": int(getattr(
+                    self.config, "continuous_drift_refit_threshold",
+                    0) or 0),
             }
 
     def _http_route(self, method, path, body, headers):

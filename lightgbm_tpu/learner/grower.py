@@ -284,12 +284,24 @@ class TreeGrower:
             self.n_padded = ((n + self.chunk - 1)
                              // self.chunk) * self.chunk
             pad = self.n_padded - n
-            bins_np = dataset.group_bins
-            if pad:
-                bins_np = np.concatenate(
-                    [bins_np,
-                     np.zeros((pad, bins_np.shape[1]), dtype=np.uint8)])
-            self.bins = self.policy.place_bins(bins_np)
+            shard_bins = getattr(dataset, "shard_bins", None)
+            if shard_bins:
+                # sharded-construct dataset (lightgbm_tpu/sharded/):
+                # per-participant shards are placed straight onto
+                # their mesh devices; the logical global layout (rows
+                # in order, tail pad) is identical to the
+                # single-matrix route, so the compiled program and
+                # the trained trees are byte-identical across routes
+                self.bins = self.policy.place_row_shards(shard_bins,
+                                                         self.n_padded)
+            else:
+                bins_np = dataset.group_bins
+                if pad:
+                    bins_np = np.concatenate(
+                        [bins_np,
+                         np.zeros((pad, bins_np.shape[1]),
+                                  dtype=np.uint8)])
+                self.bins = self.policy.place_bins(bins_np)
             self._row_valid = self.policy.place_rows(
                 np.concatenate([np.ones(n, bool), np.zeros(pad, bool)]))
         # the Pallas kernel path: single TPU device only (its sequential

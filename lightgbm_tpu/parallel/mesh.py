@@ -158,6 +158,69 @@ class ShardingPolicy:
             return jax.device_put(local_arr, sh)
         return jax.make_array_from_process_local_data(sh, local_arr)
 
+    def place_row_shards(self, shard_arrays, n_padded: int):
+        """Sharded-construct placement (lightgbm_tpu/sharded/): the
+        per-participant row shards of the bin matrix go STRAIGHT onto
+        their devices along the row mesh axis — device d receives its
+        ``n_padded / mesh.size`` row block sliced from the shard list
+        (plus the zero tail pad) and the global array assembles via
+        ``jax.make_array_from_single_device_arrays``, so the host
+        never materializes the concatenated matrix on the mesh path.
+        The logical global layout is IDENTICAL to the single-matrix
+        route (rows in construction order, pad at the tail): the
+        compiled program, and therefore the trained trees, are
+        byte-identical across the two routes.
+
+        Falls back to a host concat (then the normal placement) when
+        there is no 1-D row mesh to tile — serial runs, multi-axis
+        meshes, the feature learner's vertical partition, multi-host
+        (each host passes its own shards through
+        ``place_local_rows``), or a row count the mesh can't divide."""
+        arrs = [np.asarray(a) for a in shard_arrays]
+        rest = tuple(arrs[0].shape[1:])
+        n = sum(a.shape[0] for a in arrs)
+        if n > n_padded:
+            raise ValueError(f"shards hold {n} rows > n_padded "
+                             f"{n_padded}")
+        mesh = self.mesh
+        direct = (mesh is not None and self.row_spec is not None
+                  and not self.multihost
+                  and len(mesh.axis_names) == 1
+                  and getattr(self, "bins_spec", None) is None
+                  and n_padded % mesh.size == 0)
+        if not direct:
+            full = np.zeros((n_padded,) + rest, dtype=arrs[0].dtype)
+            full[:n] = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+            return self.place_bins(full) if full.ndim == 2 \
+                else self.place_rows(full)
+        spec = P(self.row_spec[0], *([None] * len(rest)))
+        sh = NamedSharding(mesh, spec)
+        shape = (n_padded,) + rest
+        # shard start offsets within the logical global row order
+        starts = np.cumsum([0] + [a.shape[0] for a in arrs])
+        blocks = []
+        for dev, idx in sh.addressable_devices_indices_map(
+                shape).items():
+            lo = idx[0].start or 0
+            hi = idx[0].stop if idx[0].stop is not None else n_padded
+            parts = []
+            for i, a in enumerate(arrs):
+                s, e = max(lo, int(starts[i])), \
+                    min(hi, int(starts[i + 1]))
+                if s < e:
+                    parts.append(a[s - int(starts[i]):
+                                   e - int(starts[i])])
+            have = sum(p.shape[0] for p in parts)
+            if have < hi - lo:          # zero tail pad on this device
+                parts.append(np.zeros((hi - lo - have,) + rest,
+                                      dtype=arrs[0].dtype))
+            block = parts[0] if len(parts) == 1 \
+                else np.concatenate(parts)
+            blocks.append(jax.device_put(
+                np.ascontiguousarray(block), dev))
+        return jax.make_array_from_single_device_arrays(shape, sh,
+                                                        blocks)
+
     def place_score_rows(self, arr):
         """Place a (K, N) class-major score matrix (rows on axis 1)."""
         if self.mesh is None or self.row_spec is None:

@@ -75,6 +75,18 @@ SEAMS = (
                              # tests/test_continuous.py)
     "distributed.init",      # multi-machine rendezvous / network init
     "collectives.allgather", # host-side collective backend calls
+    "sharded.binfind",       # sharded-construct boundary-candidate
+                             # collection, once per participant
+                             # (sharded/binfind.py — fires BEFORE the
+                             # candidate allgather, so a killed
+                             # participant leaves no merged mappers
+                             # behind)
+    "sharded.ingest",        # sharded-construct per-shard ingest entry
+                             # (sharded/dataset.py — fires BEFORE a
+                             # shard's rows are binned; a kill here
+                             # must leave any shard-cache manifest
+                             # untouched, pinned by tests/
+                             # test_sharded.py)
     "dataset.cache_io",      # binary dataset cache file open (r/w)
     "native.entry",          # native libltpu.so entry (load/build)
     "checkpoint.io",         # checkpoint file open (r/w)

@@ -1,0 +1,29 @@
+"""Mesh-sharded dataset subsystem (ROADMAP item 1, round 16).
+
+The end-to-end sharded data plane joining the r11 construction
+pipeline to the r13-instrumented collectives layer:
+
+* :mod:`binfind` — distributed bin-mapper finding: per-participant
+  boundary candidates, instrumented/fault-injectable allgather,
+  deterministic merge, byte-equal to a single-host fit;
+* :mod:`dataset` — :class:`ShardedDataset`: disjoint row ranges
+  stream-ingested into per-shard bin matrices, placed per-device over
+  the mesh row axis by the grower;
+* :mod:`cache` — shard-cache v2: per-shard v2 binary-cache files + a
+  manifest (world size, row ranges, mapper fingerprint), zero-copy
+  reload, loud mismatch refusal.
+
+See docs/Parallel-Learning-Guide.md, "Sharded construction".
+"""
+from .binfind import (BoundaryCandidates, collect_candidates,
+                      mapper_fingerprint, merge_candidates,
+                      shard_sample_quota)
+from .cache import (ShardCacheError, has_shard_cache, load_shard_cache,
+                    save_shard_cache)
+from .dataset import ShardedDataset, shard_row_ranges
+
+__all__ = ["ShardedDataset", "shard_row_ranges", "BoundaryCandidates",
+           "collect_candidates", "merge_candidates",
+           "mapper_fingerprint", "shard_sample_quota",
+           "save_shard_cache", "load_shard_cache", "has_shard_cache",
+           "ShardCacheError"]

@@ -1,0 +1,205 @@
+"""Shard-cache v2: the sharded extension of the binary dataset cache.
+
+Layout: one DIRECTORY holding one standard v2 binary-cache file per
+shard (``shard_<i>.bin`` — the r11 format ``dataset_io`` writes, so
+reload memmaps each shard's bin section zero-copy) plus a
+``manifest.json`` carrying the construction identity: schema, world
+size, global row count, per-shard row ranges and file sizes, and the
+merged-mapper fingerprint (``binfind.mapper_fingerprint``).
+
+Crash safety: shard files write FIRST, the manifest LAST (atomic
+tmp+fsync+rename — the r12 writer).  A kill during shard ingest or
+save leaves either the previous complete manifest or none at all, so
+a loader can never assemble a half-written cache (pinned through the
+``sharded.ingest`` fault seam, tests/test_sharded.py).
+
+Loading REFUSES loudly on: a missing/alien manifest, a world-size
+mismatch against the caller's expectation, a per-shard mapper
+fingerprint that disagrees with the manifest (stale shards next to a
+new manifest or vice versa), truncated/corrupted shard files (size
+check here + the v2 reader's own header/section checks), and row
+ranges that do not tile the global row count.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..dataset import Dataset as CoreDataset
+from ..dataset import Metadata
+from ..dataset_io import load_binary, save_binary
+from ..reliability.checkpoint import atomic_write_text
+from ..utils.log import Log
+from . import binfind
+from .dataset import ShardedDataset
+
+MANIFEST_NAME = "manifest.json"
+SHARD_CACHE_SCHEMA = 1
+
+
+class ShardCacheError(ValueError):
+    """Loud shard-cache rejection (mismatched or damaged cache)."""
+
+
+def _shard_file(i: int) -> str:
+    return f"shard_{i}.bin"
+
+
+def _shard_core(sds: ShardedDataset, i: int) -> CoreDataset:
+    """A per-shard CoreDataset view (shared mappers/groups, this
+    shard's bins + metadata slice) for the v2 writer."""
+    a, b = sds.shard_ranges[i]
+    sd = CoreDataset.from_reference_for_push(sds, b - a)
+    sd.group_bins = sds.shard_bins[i]
+    sd._pushed_rows = b - a
+    md = sds.metadata
+    sd.metadata.set_label(md.label[a:b])
+    if md.weight is not None:
+        sd.metadata.set_weight(md.weight[a:b])
+    return sd
+
+
+def save_shard_cache(sds: ShardedDataset, cache_dir: str) -> str:
+    """Persist every shard as its own v2 binary-cache file, then
+    commit the manifest.  Returns the manifest path."""
+    os.makedirs(cache_dir, exist_ok=True)
+    shards = []
+    for i in range(sds.world_size):
+        path = os.path.join(cache_dir, _shard_file(i))
+        save_binary(_shard_core(sds, i), path)
+        a, b = sds.shard_ranges[i]
+        shards.append({"file": _shard_file(i), "rows": int(b - a),
+                       "bytes": int(os.path.getsize(path))})
+    manifest = {
+        "schema": SHARD_CACHE_SCHEMA,
+        "world_size": int(sds.world_size),
+        "num_data": int(sds.num_data),
+        "num_total_features": int(sds.num_total_features),
+        "max_bin": int(sds.max_bin),
+        "row_ranges": [[int(a), int(b)] for a, b in sds.shard_ranges],
+        "mapper_fingerprint": sds.bin_fingerprint,
+        "shards": shards,
+    }
+    mpath = os.path.join(cache_dir, MANIFEST_NAME)
+    atomic_write_text(mpath, json.dumps(manifest, indent=1,
+                                        sort_keys=True))
+    Log.info(f"Saved sharded dataset cache to {cache_dir} "
+             f"({sds.world_size} shard(s), {sds.num_data} rows)")
+    return mpath
+
+
+def has_shard_cache(cache_dir: str) -> bool:
+    return bool(cache_dir) and os.path.isfile(
+        os.path.join(cache_dir, MANIFEST_NAME))
+
+
+def load_shard_cache(cache_dir: str,
+                     expect_world_size: Optional[int] = None,
+                     config=None) -> ShardedDataset:
+    """Reload a shard cache into a ShardedDataset.  Each shard's bin
+    section comes back as a read-only memmap (the v2 zero-copy
+    reload); every mismatch listed in the module docstring raises
+    :class:`ShardCacheError` instead of training silently wrong."""
+    mpath = os.path.join(cache_dir, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        raise ShardCacheError(
+            f"{cache_dir}: no shard-cache manifest ({MANIFEST_NAME}) "
+            "— not a shard cache, or an interrupted save that never "
+            "committed (reconstruct to repair)")
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except Exception as e:
+        raise ShardCacheError(
+            f"{mpath}: corrupted shard-cache manifest "
+            f"({type(e).__name__}: {e})") from e
+    if man.get("schema") != SHARD_CACHE_SCHEMA:
+        raise ShardCacheError(
+            f"{mpath}: shard-cache schema {man.get('schema')!r} "
+            f"(this build reads {SHARD_CACHE_SCHEMA})")
+    world = int(man["world_size"])
+    if expect_world_size is not None and world != int(expect_world_size):
+        raise ShardCacheError(
+            f"{cache_dir}: shard cache was built for world size "
+            f"{world}, this run asked for {int(expect_world_size)} — "
+            "re-shard the cache (reconstruct with the new "
+            "sharded_shards) instead of silently re-splitting rows")
+    ranges = [(int(a), int(b)) for a, b in man["row_ranges"]]
+    if len(ranges) != world or len(man["shards"]) != world:
+        raise ShardCacheError(
+            f"{mpath}: manifest lists {len(man['shards'])} shard(s) / "
+            f"{len(ranges)} range(s) for world size {world}")
+    pos = 0
+    for a, b in ranges:
+        if a != pos or b < a:
+            raise ShardCacheError(
+                f"{mpath}: row ranges do not tile [0, "
+                f"{man['num_data']}) contiguously (at [{a}, {b}))")
+        pos = b
+    if pos != int(man["num_data"]):
+        raise ShardCacheError(
+            f"{mpath}: row ranges cover {pos} rows, manifest says "
+            f"{man['num_data']}")
+
+    cores = []
+    for i, rec in enumerate(man["shards"]):
+        path = os.path.join(cache_dir, rec["file"])
+        if not os.path.isfile(path):
+            raise ShardCacheError(f"{cache_dir}: shard file "
+                                  f"{rec['file']} is missing")
+        size = os.path.getsize(path)
+        if size < int(rec["bytes"]):
+            raise ShardCacheError(
+                f"{path}: truncated shard file ({size} bytes, "
+                f"manifest recorded {rec['bytes']})")
+        core = load_binary(path)
+        if core.num_data != int(rec["rows"]):
+            raise ShardCacheError(
+                f"{path}: shard holds {core.num_data} rows, manifest "
+                f"recorded {rec['rows']}")
+        fp = binfind.mapper_fingerprint(core.mappers, core._bundles,
+                                        core.max_bin)
+        if fp != man["mapper_fingerprint"]:
+            raise ShardCacheError(
+                f"{path}: shard mapper fingerprint {fp[:12]}... does "
+                f"not match the manifest "
+                f"({man['mapper_fingerprint'][:12]}...) — stale shard "
+                "next to a newer manifest (or vice versa); "
+                "reconstruct the cache")
+        cores.append(core)
+
+    sds = ShardedDataset()
+    tpl = cores[0]
+    sds.config = config if config is not None else tpl.config
+    sds.num_data = int(man["num_data"])
+    sds.num_total_features = tpl.num_total_features
+    sds.max_bin = tpl.max_bin
+    sds.mappers = tpl.mappers
+    sds.used_features = tpl.used_features
+    sds.features = tpl.features
+    sds.group_num_bin = tpl.group_num_bin
+    sds.group_is_multi = tpl.group_is_multi
+    sds._bundles = tpl._bundles
+    sds.feature_names = tpl.feature_names
+    sds._categorical_features = tpl._categorical_features
+    sds.monotone_constraints = tpl.monotone_constraints
+    sds.world_size = world
+    sds.shard_ranges = ranges
+    sds.shard_bins = [c.group_bins for c in cores]
+    sds.bin_fingerprint = man["mapper_fingerprint"]
+    md = Metadata(sds.num_data)
+    md.label = np.concatenate(
+        [np.asarray(c.metadata.label, dtype=np.float32)
+         for c in cores]) if cores else md.label
+    if all(c.metadata.weight is not None for c in cores) and cores:
+        md.weight = np.concatenate(
+            [np.asarray(c.metadata.weight, dtype=np.float32)
+             for c in cores])
+    sds.metadata = md
+    Log.info(f"Loaded sharded dataset cache from {cache_dir} "
+             f"({world} shard(s), {sds.num_data} rows, zero-copy "
+             "shard maps)")
+    return sds

@@ -1,0 +1,200 @@
+"""ShardedDataset: the mesh-sharded training matrix.
+
+End-to-end sharded data plane (ROADMAP item 1): instead of ONE
+host-resident packed ``(N, G)`` uint8 matrix (``dataset.py``), the
+training rows are split into disjoint contiguous participant ranges,
+bin mappers are fitted DISTRIBUTED (``binfind.py`` — per-range
+boundary candidates allgathered and deterministically merged, the
+reference ``DatasetLoader`` bin-boundary sync), and each range is
+stream-ingested through the r11 two-round push protocol
+(``Dataset.from_reference_for_push`` + chunked ``push_rows``) into its
+OWN per-shard bin matrix.  The grower places the shards straight onto
+their mesh devices (``ShardingPolicy.place_row_shards`` — the host
+never materializes the concatenated matrix on the mesh path) and the
+data-parallel histogram allreduce rides the same collective seams the
+single-matrix route compiles to, so trees are BYTE-IDENTICAL across
+the two routes (tests/test_sharded.py, the ``sharded_construct``
+MULTICHIP gate).
+
+Host peak memory is samples + one streaming chunk + the per-shard
+uint8 matrices (the LiteMORT rows-per-chip argument, PAPERS.md arxiv
+2001.09419): sharding buys capacity per participant, not just per
+fleet.  The shard-cache v2 (``cache.py``) persists the shards +
+manifest for zero-copy reload.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset as CoreDataset
+from ..dataset import Metadata
+from ..reliability.faults import FAULTS
+from ..telemetry import TELEMETRY
+from ..utils.log import Log
+from . import binfind
+
+
+def shard_row_ranges(num_data: int, num_shards: int
+                     ) -> List[Tuple[int, int]]:
+    """Disjoint contiguous [start, stop) participant ranges covering
+    ``num_data`` rows — ``np.array_split`` semantics (first
+    ``num_data % num_shards`` shards one row longer), deterministic."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    bounds = np.linspace(0, num_data, num_shards + 1).astype(np.int64)
+    return [(int(bounds[i]), int(bounds[i + 1]))
+            for i in range(num_shards)]
+
+
+class ShardedDataset(CoreDataset):
+    """A constructed dataset whose packed bin matrix lives as
+    per-participant row shards (``shard_bins``) instead of one
+    ``group_bins`` array.  All mapper/feature/group metadata is the
+    merged-fit result shared by every shard; ``metadata`` is the
+    GLOBAL view (labels/weights in original row order)."""
+
+    def __init__(self):
+        super().__init__()
+        self.shard_bins: List[np.ndarray] = []
+        self.shard_ranges: List[Tuple[int, int]] = []
+        self.world_size = 0
+        self.bin_fingerprint = ""
+
+    # engine.train / Booster accept lazy datasets and call construct()
+    # — a ShardedDataset is already constructed
+    def construct(self, config: Optional[Config] = None
+                  ) -> "ShardedDataset":
+        return self
+
+    def construct_aligned(self, ref_core, config) -> "ShardedDataset":
+        return self
+
+    def assembled_group_bins(self) -> np.ndarray:
+        """The concatenated (N, G) matrix — parity checks and the
+        no-mesh fallback only; the mesh training path never calls
+        this (shards go to devices individually)."""
+        return np.concatenate(self.shard_bins, axis=0)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def construct_sharded(cls, data, label=None, weight=None,
+                          group=None, init_score=None,
+                          config: Optional[Config] = None,
+                          num_shards: Optional[int] = None,
+                          categorical_features: Optional[Sequence[int]]
+                          = None,
+                          feature_names: Optional[Sequence[str]] = None,
+                          collective=None) -> "ShardedDataset":
+        """Build the sharded dataset from an in-memory float matrix
+        (or a text file path, parsed through the standard loader).
+
+        1. rows split into ``num_shards`` (default
+           ``config.sharded_shards``) disjoint contiguous ranges;
+        2. distributed bin finding: per-range boundary candidates ->
+           instrumented allgather -> deterministic merge -> the ONE
+           threaded ``_fit_mappers`` path (+ EFB bundling) — identical
+           mappers on every shard, byte-equal to a single-host fit
+           whenever the quotas cover the shards;
+        3. per-shard streaming ingest (``from_reference_for_push`` +
+           ``streaming_chunk_rows`` chunked pushes) into per-shard bin
+           matrices, behind the ``sharded.ingest`` fault seam.
+        """
+        config = config or Config()
+        if isinstance(data, str):
+            from ..data_loader import load_file
+            data, label_from_file, extras = load_file(data, config)
+            if label is None:
+                label = label_from_file
+            if weight is None:
+                weight = extras.get("weight")
+            if group is None:
+                group = extras.get("group")
+            if categorical_features is None \
+                    and extras.get("categorical_feature"):
+                categorical_features = extras["categorical_feature"]
+        if hasattr(data, "tocsc") and hasattr(data, "nnz"):
+            Log.fatal("sharded construction does not take sparse "
+                      "input yet — densify, or use the single-matrix "
+                      "sparse path (sharded_shards=0)")
+        if group is not None:
+            Log.fatal("sharded construction does not support query "
+                      "groups yet — queries must not span shards "
+                      "(same bound as multi-host ranking)")
+        X = np.asarray(data, dtype=np.float64)
+        if X.ndim != 2:
+            raise ValueError("data must be 2-dimensional")
+        num_data, num_features = X.shape
+        world = int(num_shards if num_shards is not None
+                    else getattr(config, "sharded_shards", 0) or 0)
+        if world < 1:
+            raise ValueError(
+                "construct_sharded needs num_shards >= 1 (or "
+                "sharded_shards set in the config)")
+        if world > max(1, num_data):
+            # a hard error, not a silent clamp: a clamped world size
+            # would commit a shard cache whose manifest disagrees with
+            # the UNCHANGED config on the very next run
+            Log.fatal(f"sharded_shards={world} exceeds the {num_data} "
+                      "data rows — lower sharded_shards (every "
+                      "participant needs at least one row)")
+        ranges = shard_row_ranges(num_data, world)
+
+        self = cls()
+        self.config = config
+        self.num_data = num_data
+        self.num_total_features = num_features
+        self.max_bin = config.max_bin
+        self.world_size = world
+        self.shard_ranges = ranges
+        self.feature_names = list(feature_names) if feature_names else [
+            f"Column_{i}" for i in range(num_features)]
+        cat_set = set(categorical_features or [])
+
+        # ---- distributed bin finding (binfind.py) ----
+        with TELEMETRY.span("shard_binfind", shards=world,
+                            rows=num_data):
+            cands = [binfind.collect_candidates(X[a:b], config,
+                                                rank=i, world=world)
+                     for i, (a, b) in enumerate(ranges)]
+            binfind.warn_if_quota_truncated(cands)
+            sample_vals, sample_rows, total_sample = \
+                binfind.merge_candidates(cands, collective)
+            self.mappers = self._fit_mappers(sample_vals, total_sample,
+                                             config, cat_set)
+        self.used_features = [i for i, m in enumerate(self.mappers)
+                              if not m.is_trivial]
+        if not self.used_features:
+            Log.warning("There are no meaningful features; "
+                        "all features are constant or filtered")
+        self._build_groups(reference=None, sample_nonzero=sample_rows,
+                           sample_cnt=total_sample)
+        self._categorical_features = list(categorical_features or [])
+        self._resolve_monotone(config)
+        self.bin_fingerprint = binfind.mapper_fingerprint(
+            self.mappers, self._bundles, self.max_bin)
+
+        # ---- per-shard streaming ingest ----
+        chunk_rows = max(1, int(config.streaming_chunk_rows))
+        for i, (a, b) in enumerate(ranges):
+            FAULTS.fault_point("sharded.ingest")
+            with TELEMETRY.span("shard_ingest", shard=i, rows=b - a):
+                sd = CoreDataset.from_reference_for_push(self, b - a)
+                for start in range(0, b - a, chunk_rows):
+                    stop = min(b - a, start + chunk_rows)
+                    sd.push_rows(X[a + start:a + stop], start)
+                sd.finish_load()
+            self.shard_bins.append(sd.group_bins)
+            if TELEMETRY.on:
+                TELEMETRY.add("sharded_rows_ingested", int(b - a))
+        if TELEMETRY.on:
+            TELEMETRY.gauge("sharded_world_size", world)
+
+        self.metadata = Metadata(num_data)
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weight(weight)
+        self.metadata.set_init_score(init_score)
+        return self

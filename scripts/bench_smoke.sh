@@ -123,6 +123,10 @@ SERVE_CLIENTS=${SERVE_CLIENTS:-8} \
 SERVE_REQUESTS=${SERVE_REQUESTS:-12} \
 python scripts/serve_bench.py /tmp/lgbtpu_smoke/serve.json >&2
 test -s /tmp/lgbtpu_smoke/serve.json
+# BENCH_SHARD pins the round-16 shard_construct probe on: 2 simulated
+# participants, merged-mapper + bin parity vs the single-matrix route,
+# shard-cache v2 manifest round trip — its JSON block is asserted by
+# tests/test_bench_smoke.py
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \
@@ -136,5 +140,7 @@ BENCH_PREDICT_ROWS=${BENCH_PREDICT_ROWS:-4096} \
 BENCH_PREDICT_CALLS=${BENCH_PREDICT_CALLS:-10} \
 BENCH_LOCAL_REF=0 \
 BENCH_SKIP_F32=1 \
+BENCH_SHARD=1 \
+BENCH_SHARD_PARTICIPANTS=${BENCH_SHARD_PARTICIPANTS:-2} \
 BENCH_BUDGET_S=${BENCH_BUDGET_S:-600} \
 exec python bench.py

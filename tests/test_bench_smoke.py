@@ -67,6 +67,22 @@ def test_bench_smoke_json_contract():
     assert set(c["thread_scaling"]) == {"1", "auto", "x"}
     # the anchor must be present or carry an explicit skip reason
     assert "local_ref" in c or "local_ref_skipped" in c
+    # sharded-construct probe (round 16): 2 simulated participants,
+    # merged-mapper + bin parity vs the single-matrix route, merge
+    # wall, RSS per route, shard-cache v2 manifest round trip with
+    # the wrong-world-size refusal exercised
+    assert "shard_construct" in out, \
+        "shard_construct probe must run in the smoke"
+    sc = out["shard_construct"]
+    for field in ("rows", "shards", "shard_construct_s",
+                  "shard_rows_per_s", "per_shard_rows_per_s",
+                  "single_construct_s", "merge_wall_ms",
+                  "rss_single_mb", "rss_sharded_mb", "cache_reload_s",
+                  "parity", "manifest_reject"):
+        assert field in sc, f"shard_construct block missing {field}"
+    assert sc["shards"] == 2, "smoke runs 2 simulated participants"
+    assert sc["parity"] == "pass"
+    assert sc["manifest_reject"] == "pass"
     # reliability probe (round 12): checkpoint save overhead measured
     # and the smoke fault-plan recovery (SIGKILL mid-train -> resume)
     # byte-identical — scripts/reliability_probe.py, run in-line by

@@ -450,6 +450,65 @@ def test_refit_mode_cycle_updates_leaves_only(tmp_path, base_model):
     TELEMETRY.configure("off")
 
 
+def test_drift_triggered_refit_cycle(tmp_path, base_model):
+    """Drift-triggered base refit (round-16 satellite,
+    continuous_drift_refit_threshold): once the cumulative drifted-
+    slice tally crosses the threshold, the NEXT cycle runs a refit
+    (leaf values refreshed through real-valued thresholds, no new
+    trees) instead of only warning, commits the mode to the ledger
+    (crash-replay deterministic) and resets the tally."""
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    bst, _Xb, _yb = base_model
+    lane, ingest = _lane(tmp_path, base_model,
+                         continuous_mode="continue",
+                         continuous_drift_refit_threshold=2,
+                         continuous_publish_max_regression=1e9)
+    # cycle 1: one drifted slice (values far outside the base range)
+    # — below the threshold, so it continue-trains as configured
+    _write_slice(ingest, "s1.csv", seed=7, shift=500.0)
+    rec1 = lane.run_cycle()
+    assert rec1 is not None
+    assert lane._ledger.get("cycle_mode") == "continue"
+    assert lane._ledger.get("drift_slices") == 1
+    m1 = lgb.Booster(model_file=lane._p(lane._ledger["last_good"]))
+    assert m1.num_trees() == bst.num_trees() + 3   # continue added trees
+
+    # cycle 2: a second drifted slice crosses the threshold — the
+    # cycle flips to refit (tree count unchanged) and the tally resets
+    _write_slice(ingest, "s2.csv", seed=8, shift=500.0)
+    rec2 = lane.run_cycle()
+    assert rec2 is not None
+    assert lane._ledger.get("cycle_mode") == "refit"
+    assert lane._ledger.get("drift_slices") == 0
+    cand = lgb.Booster(
+        model_file=lane._p(f"model_cycle_{rec2['cycle']}.txt"))
+    assert cand.num_trees() == m1.num_trees(), \
+        "drift-triggered cycle must refit, not grow trees"
+    assert TELEMETRY.counters().get("continuous_drift_refits") == 1
+
+    # cycle 3: an undrifted slice goes back to continue mode
+    _write_slice(ingest, "s3.csv", seed=9, shift=0.0)
+    rec3 = lane.run_cycle()
+    assert rec3 is not None
+    assert lane._ledger.get("cycle_mode") == "continue"
+    TELEMETRY.configure("off")
+
+
+def test_drift_refit_off_by_default(tmp_path, base_model):
+    """Threshold 0 (the default) keeps the r15 warn-and-count-only
+    behavior: a drifted slice still continue-trains."""
+    bst, _Xb, _yb = base_model
+    lane, ingest = _lane(tmp_path, base_model,
+                         continuous_publish_max_regression=1e9)
+    _write_slice(ingest, "s1.csv", seed=7, shift=500.0)
+    rec = lane.run_cycle()
+    assert rec is not None
+    assert lane._ledger.get("cycle_mode") == "continue"
+    m = lgb.Booster(model_file=lane._p(lane._ledger["last_good"]))
+    assert m.num_trees() == bst.num_trees() + 3
+
+
 # ---------------------------------------------------------------------------
 # control surface on the shared listener
 # ---------------------------------------------------------------------------

@@ -230,6 +230,17 @@ class Dataset:
             TELEMETRY.gauge("construct_rows_per_s",
                             round(int(data.shape[0]) / wall))
         self._core._raw_data = None if self.free_raw_data else data
+        if self.free_raw_data and not _is_sparse(data) \
+                and str(getattr(config, "quality", "off")).lower() \
+                == "on":
+            # quality=on + free_raw_data: the profile's leaf-occupancy
+            # pass (pred_leaf) needs raw feature rows AFTER training,
+            # but the float matrix dies right here — retain a
+            # deterministic strided sample (quality_profile_rows cap)
+            # instead of the whole matrix (docs/MODEL_MONITORING.md)
+            from .quality.profile import strided_rows
+            self._core._quality_row_sample = strided_rows(
+                data, int(config.quality_profile_rows))
         self._core._categorical_features = cat_indices
         self._core.pandas_categorical = pandas_cats
         if self.free_raw_data:

@@ -451,6 +451,58 @@ class BinMapper:
         return out
 
     # ------------------------------------------------------------------
+    def to_state(self) -> dict:
+        """JSON-serializable frozen-mapper state — the portable form of
+        the fitted table (the quality profile carries one per feature
+        so serving-side drift monitors can bin rows WITHOUT the
+        training dataset; docs/MODEL_MONITORING.md).  Round-trips
+        exactly through :meth:`from_state`: bounds serialize via
+        ``float.hex`` so the binary-search boundaries are bit-identical
+        after a JSON trip (repr would survive too, but hex is explicit
+        about the contract)."""
+        state = {
+            "num_bin": int(self.num_bin),
+            "missing_type": int(self.missing_type),
+            "bin_type": int(self.bin_type),
+            "min_val": float(self.min_val),
+            "max_val": float(self.max_val),
+            "default_bin": int(self.default_bin),
+            "is_trivial": bool(self.is_trivial),
+        }
+        if self.bin_type == BIN_NUMERICAL:
+            state["bin_upper_bound"] = [
+                float(b).hex() for b in np.asarray(self.bin_upper_bound)]
+        else:
+            state["bin_2_categorical"] = [int(c)
+                                          for c in self.bin_2_categorical]
+        return state
+
+    @classmethod
+    def from_state(cls, state: dict) -> "BinMapper":
+        """Rebuild a fitted mapper from :meth:`to_state` output;
+        ``value_to_bin`` on the result is bit-identical to the
+        original's."""
+        m = cls()
+        m.num_bin = int(state["num_bin"])
+        m.missing_type = int(state["missing_type"])
+        m.bin_type = int(state["bin_type"])
+        m.min_val = float(state["min_val"])
+        m.max_val = float(state["max_val"])
+        m.default_bin = int(state["default_bin"])
+        m.is_trivial = bool(state.get("is_trivial", False))
+        if m.bin_type == BIN_NUMERICAL:
+            m.bin_upper_bound = np.asarray(
+                [float.fromhex(b) if isinstance(b, str) else float(b)
+                 for b in state["bin_upper_bound"]], dtype=np.float64)
+        else:
+            m.bin_2_categorical = [int(c)
+                                   for c in state["bin_2_categorical"]]
+            m.categorical_2_bin = {c: i for i, c
+                                   in enumerate(m.bin_2_categorical)}
+            m._build_cat_cache()
+        return m
+
+    # ------------------------------------------------------------------
     def bin_to_value(self, bin_idx: int) -> float:
         """Representative threshold value for a bin (used by model text
         format: the split threshold written is the bin's upper bound)."""

@@ -354,6 +354,10 @@ class Booster:
         self._train_data_name = "training"
         self._attrs: Dict[str, str] = {}
         self._datasets_freed = False
+        # reference QualityProfile attached by engine.train under
+        # quality=on; save_model persists it beside the model file
+        # (docs/MODEL_MONITORING.md)
+        self.quality_profile = None
 
         if model_file is not None:
             with open(model_file) as f:
@@ -1075,8 +1079,26 @@ class Booster:
 
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: int = -1) -> None:
+        text = self.model_to_string(num_iteration)
         with open(filename, "w") as f:
-            f.write(self.model_to_string(num_iteration))
+            f.write(text)
+        prof = getattr(self, "quality_profile", None)
+        if prof is not None:
+            from .quality import model_fingerprint, profile_path
+            if model_fingerprint(text) == prof.fingerprint:
+                # the profile is bound to the FULL model it was built
+                # from — persist it beside the file so a later
+                # task=serve can arm drift monitors from disk
+                path = prof.save(profile_path(filename))
+                Log.info(f"quality profile saved to {path}")
+            else:
+                # e.g. a num_iteration-sliced save: the written text
+                # is not the profiled model — writing the sidecar
+                # would trip the fingerprint refusal at serve time
+                Log.debug("quality profile not saved beside "
+                          f"{filename}: the written model text does "
+                          "not match the profiled model (sliced "
+                          "save?)")
 
     def model_to_string(self, num_iteration: int = -1) -> str:
         """reference gbdt_model_text.cpp:235-315 SaveModelToString."""

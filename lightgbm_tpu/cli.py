@@ -222,7 +222,7 @@ def _task_serve(params, config: Config) -> None:
     name = os.path.splitext(
         os.path.basename(config.input_model))[0] or "model"
     registry = ModelRegistry(config)
-    registry.publish(name, config.input_model, log_warm=True)
+    entry = registry.publish(name, config.input_model, log_warm=True)
     frontend = ServingFrontend(registry, config)
     srv = frontend.start()
     port = srv.server_address[1]
@@ -230,6 +230,14 @@ def _task_serve(params, config: Config) -> None:
              f"http://127.0.0.1:{port}/predict/{name} "
              '(POST JSON {"rows": [[...]]} or CSV rows; '
              "GET /models /metrics /healthz)")
+    if entry.monitor is not None:
+        # model-quality drift monitors (docs/MODEL_MONITORING.md):
+        # armed from the <input_model>.quality.json sidecar a
+        # quality=on training run saved beside the model
+        Log.info(f"quality monitors armed for {name!r}: sample "
+                 f"stride {entry.monitor.stride}, drift report at "
+                 f"http://127.0.0.1:{port}/quality/{name} "
+                 f"(ltpu_quality_* gauges on /metrics)")
     lane = None
     if config.continuous_ingest_dir:
         if not config.data:

@@ -589,6 +589,43 @@ class Config:
     # port (logged at startup); when telemetry_http_port is set the
     # serving routes mount on that already-running listener instead
 
+    # -- model-quality observability (new; no reference analog) --
+    quality: str = "auto"           # model-quality observability
+    # (lightgbm_tpu/quality/, docs/MODEL_MONITORING.md): "on" captures
+    # a QualityProfile at train time (per-feature bin-occupancy
+    # histograms from the already-built bin matrix, the training
+    # prediction-score histogram, per-tree leaf occupancy) persisted
+    # beside the model file, and REQUIRES serving-side drift monitors
+    # (warns when no profile is found); "auto" (default) captures
+    # nothing at train time but arms serving monitors whenever a
+    # profile sits beside the published model AND quality_sample_rate
+    # is > 0; "off" disables everything — the serving path then does
+    # ONE attribute check and lowers byte-identical StableHLO
+    # (pinned by tests/test_quality.py)
+    quality_sample_rate: float = 0.0  # serving-side drift monitors:
+    # fraction of served rows the deterministic counter-strided
+    # sampler feeds the monitors (no RNG — row k of the serving
+    # stream is sampled iff k % round(1/rate) == 0, so replays sample
+    # identical rows regardless of batch coalescing).  Sampled rows
+    # bin host-side through the profile's frozen BinMapper tables;
+    # predictions stay byte-identical.  0 disables the monitors
+    quality_psi_warn: float = 0.2   # per-feature PSI threshold: past
+    # it the monitor warns ONCE naming the top drifted features,
+    # bumps quality_drift_warns and fires a flight-recorder event
+    # (0.1 = minor shift, 0.2 = action-worthy drift — the standard
+    # PSI rule of thumb; docs/MODEL_MONITORING.md runbook)
+    quality_drift_refit_threshold: float = 0.0  # close the loop:
+    # worst-feature PSI past this reports a serving-drift event into
+    # the continuous lane's ledger-committed drift tally (the same
+    # tally continuous_drift_refit_threshold reads), so LIVE drift —
+    # not only ingest drift — can flip a continuous cycle to refit.
+    # One report per breach episode (re-arms once PSI falls back
+    # under half the threshold).  0 disables (the default)
+    quality_profile_rows: int = 4096  # deterministic strided row cap
+    # for the profile's leaf-occupancy pass (pred_leaf over every
+    # stride-th training row) and for the raw-row sample retained
+    # when free_raw_data would drop the matrix before profiling
+
     # -- continuous training (new; no reference analog) --
     continuous_mode: str = "continue"  # training lane per-cycle
     # strategy (docs/CONTINUOUS_TRAINING.md): "continue" boosts
@@ -630,6 +667,15 @@ class Config:
     # REAL-VALUED thresholds, immune to the frozen mappers' edge-bin
     # clamping — instead of only warning, then the drift tally resets.
     # 0 disables (the default: drift warns and counts only)
+    continuous_cycle_interval_s: float = 0.0  # scheduled (cron-style)
+    # cycles beside the directory watcher: every this many seconds the
+    # lane runs a cycle even when no new slices arrived (continue mode
+    # trains continuous_iterations fresh trees over the accumulated
+    # data, exactly like a force_cycle).  The next-due time is
+    # LEDGER-COMMITTED, so a restarted daemon keeps the schedule
+    # instead of firing immediately; the clock is injectable for
+    # tests.  0 disables (the default: cycles fire on new slices or
+    # force_cycle only)
     continuous_checkpoint_freq: int = 0  # mid-cycle crash-safe
     # checkpoint cadence (iterations) for continue-mode training
     # (docs/RELIABILITY.md machinery, per-cycle checkpoint files); 0
@@ -688,6 +734,7 @@ class Config:
         if self.device == "gpu":
             self.device = "tpu"
         self.telemetry = str(self.telemetry).lower()
+        self.quality = str(self.quality).lower()
         self.check()
         _setup_compile_cache(self.compile_cache_dir)
         from .telemetry import apply_config as _telemetry_apply
@@ -766,6 +813,22 @@ class Config:
         if not (0 <= self.serve_port <= 65535):
             raise ValueError("serve_port must be in [0, 65535] "
                              "(0 = ephemeral)")
+        if str(self.quality).lower() not in ("off", "auto", "on"):
+            raise ValueError("quality must be off/auto/on, got "
+                             f"{self.quality!r}")
+        if not (0.0 <= self.quality_sample_rate <= 1.0):
+            raise ValueError("quality_sample_rate must be in [0, 1] "
+                             "(0 = monitors off)")
+        if self.quality_psi_warn <= 0:
+            raise ValueError("quality_psi_warn must be > 0")
+        if self.quality_drift_refit_threshold < 0:
+            raise ValueError("quality_drift_refit_threshold must be "
+                             ">= 0 (0 = never report to the lane)")
+        if self.quality_profile_rows < 1:
+            raise ValueError("quality_profile_rows must be >= 1")
+        if self.continuous_cycle_interval_s < 0:
+            raise ValueError("continuous_cycle_interval_s must be "
+                             ">= 0 (0 = no scheduled cycles)")
         if self.continuous_mode not in ("continue", "refit"):
             raise ValueError("continuous_mode must be continue/refit, "
                              f"got {self.continuous_mode!r}")

@@ -98,10 +98,14 @@ class ContinuousLane:
     def __init__(self, config: Config, registry=None,
                  name: str = "model", base_model=None,
                  base_data=None, base_label=None,
-                 train_params: Optional[Dict[str, Any]] = None):
+                 train_params: Optional[Dict[str, Any]] = None,
+                 clock=None):
         self.config = config
         self.registry = registry
         self.name = name
+        # injectable wall clock (tests drive the scheduled-cycle timer
+        # without sleeping); the ledger stores absolute times from it
+        self._clock = clock or time.time
         self.train_params = dict(train_params or {})
         self._base_model_arg = base_model
         self._base_data = base_data
@@ -173,10 +177,20 @@ class ContinuousLane:
         """Atomically persist the ledger (the phase-commit point: the
         crash-replay contract is 'everything before the last commit
         is durable, everything after replays')."""
+        self._commit_mutate(lambda led: led.update(updates))
+
+    def _commit_mutate(self, fn) -> None:
+        """Read-modify-write commit: ``fn(ledger)`` runs UNDER the
+        ledger lock, so increments (the serving-drift tally) cannot
+        lose updates to a concurrent phase commit.  The durable write
+        happens INSIDE the lock too — serialize-then-write-outside
+        would let two racing commits rename in the wrong order and
+        leave the OLDER serialization as the on-disk ledger a crash
+        replays from."""
         with self._ledger_lock:
-            self._ledger.update(updates)
+            fn(self._ledger)
             text = json.dumps(self._ledger, indent=1, sort_keys=True)
-        _ckpt.atomic_write_text(self._p(LEDGER_NAME), text)
+            _ckpt.atomic_write_text(self._p(LEDGER_NAME), text)
 
     # -- base dataset / model ------------------------------------------
     def _base(self):
@@ -291,7 +305,8 @@ class ContinuousLane:
                             base, X, name, count=count_drift)})
         return out
 
-    def _drift_refit_updates(self, drifted_slices: int) -> dict:
+    def _drift_refit_updates(self, drifted_slices: int,
+                             led: dict) -> dict:
         """Ingest-commit updates for the drift-triggered base refit
         (``continuous_drift_refit_threshold``): the cumulative
         drifted-slice tally lives in the LEDGER (so a crash-replayed
@@ -300,10 +315,15 @@ class ContinuousLane:
         leaf values refreshed through the model's REAL-VALUED
         thresholds, immune to the frozen mappers' edge-bin clamping —
         then the tally resets.  Threshold 0 (default) keeps the
-        r15 warn-and-count-only behavior."""
+        r15 warn-and-count-only behavior.  The tally is read from
+        ``led`` (the commit-locked ledger view) so serving-drift
+        reports (:meth:`report_serving_drift`) landing during ingest
+        are folded in, never overwritten (``led`` is required — an
+        unlocked ``self._ledger`` read here would reintroduce the
+        lost-update race the locked commit exists to fix)."""
         thr = int(getattr(self.config,
                           "continuous_drift_refit_threshold", 0) or 0)
-        tally = int(self._ledger.get("drift_slices", 0)) \
+        tally = int(led.get("drift_slices", 0)) \
             + int(drifted_slices)
         mode = self.config.continuous_mode
         if thr > 0 and tally >= thr:
@@ -319,6 +339,43 @@ class ContinuousLane:
                 "instead of continue-training, then the tally resets "
                 "(docs/CONTINUOUS_TRAINING.md, drift semantics)")
         return {"drift_slices": tally, "cycle_mode": mode}
+
+    def report_serving_drift(self, model: str = "",
+                             worst_feature: Optional[int] = None,
+                             psi: Optional[float] = None,
+                             **detail) -> int:
+        """SERVING-side drift report (the quality monitors'
+        drift→refit hook, docs/MODEL_MONITORING.md): live traffic
+        drifting past ``quality_drift_refit_threshold`` increments the
+        SAME ledger-committed drift tally ingest drift feeds, so
+        ``continuous_drift_refit_threshold`` can flip a future cycle
+        to refit on what the model actually serves — not only on what
+        the ingest directory happens to receive.  Atomic
+        read-modify-write under the ledger lock (never blocks behind
+        a training phase; the cycle lock is not taken).  Returns the
+        new tally."""
+        out = {}
+
+        def bump(led):
+            led["drift_slices"] = int(led.get("drift_slices", 0)) + 1
+            led["serving_drift_reports"] = int(
+                led.get("serving_drift_reports", 0)) + 1
+            out["tally"] = led["drift_slices"]
+        self._commit_mutate(bump)
+        if TELEMETRY.on:
+            TELEMETRY.add("continuous_serving_drift_reports", 1)
+        thr = int(getattr(self.config,
+                          "continuous_drift_refit_threshold", 0) or 0)
+        Log.warning(
+            f"continuous lane {self.name!r}: SERVING drift report"
+            + (f" from model {model!r}" if model else "")
+            + (f" (feature f{worst_feature}, PSI {psi:g})"
+               if psi is not None else "")
+            + f" — ledger drift tally now {out['tally']}"
+            + (f" of refit threshold {thr}" if thr > 0
+               else " (continuous_drift_refit_threshold=0: counted, "
+                    "no refit trigger)"))
+        return out["tally"]
 
     def _cycle_train_params(self, cycle: int) -> Dict[str, Any]:
         p = dict(self.train_params)
@@ -376,7 +433,19 @@ class ContinuousLane:
                               init_model=init_path,
                               verbose_eval=False)
             path = self._p(f"model_cycle_{cycle}.txt")
-            _ckpt.atomic_write_text(path, cand.model_to_string())
+            text = cand.model_to_string()
+            _ckpt.atomic_write_text(path, text)
+            prof = getattr(cand, "quality_profile", None)
+            if prof is not None:
+                # quality=on rode the cycle's train params: persist
+                # the candidate's reference profile beside its model
+                # file so the hot-publish arms fresh drift monitors
+                # for the new version (refit cycles carry none — the
+                # refit path has no constructed cycle dataset to
+                # profile; docs/MODEL_MONITORING.md)
+                from ..quality import model_fingerprint, profile_path
+                if model_fingerprint(text) == prof.fingerprint:
+                    prof.save(profile_path(path))
             return os.path.basename(path)
         finally:
             TELEMETRY.end_span(span)
@@ -601,6 +670,44 @@ class ContinuousLane:
                if "live_metric" in detail else "")
             + f"); serving {prev_model} again — candidate quarantined")
 
+    # -- scheduled (cron-style) cycles ----------------------------------
+    def _cycle_interval(self) -> float:
+        return float(getattr(self.config,
+                             "continuous_cycle_interval_s", 0.0) or 0.0)
+
+    def scheduled_due(self) -> bool:
+        """Whether the ledger-committed next-due time has passed (the
+        cron-style timer beside the directory watcher;
+        ``continuous_cycle_interval_s``)."""
+        iv = self._cycle_interval()
+        if iv <= 0:
+            return False
+        with self._ledger_lock:
+            due = self._ledger.get("next_cycle_unix")
+        return due is not None and self._clock() >= float(due)
+
+    def run_scheduled_cycle(self) -> Optional[dict]:
+        """Run one scheduled cycle when due (no-op otherwise) and
+        commit the next due time to the ledger — committed in a
+        ``finally`` so a failing cycle keeps its poll-driven ledger
+        replay instead of hot-looping the schedule; a restarted
+        daemon reads the committed due time and keeps the cadence
+        instead of firing immediately.  A scheduled fire behaves like
+        ``force_cycle``: a continue-mode cycle trains even with no
+        new slices."""
+        if not self.scheduled_due():
+            return None
+        Log.info(f"continuous lane {self.name!r}: scheduled cycle due "
+                 f"(continuous_cycle_interval_s="
+                 f"{self._cycle_interval():g})")
+        if TELEMETRY.on:
+            TELEMETRY.add("continuous_scheduled_cycles", 1)
+        try:
+            return self.run_cycle(force=True)
+        finally:
+            self._commit(next_cycle_unix=round(
+                self._clock() + self._cycle_interval(), 6))
+
     # -- the cycle driver -----------------------------------------------
     def run_cycle(self, force: bool = False) -> Optional[dict]:
         """Run (or crash-resume) ONE cycle synchronously; returns the
@@ -680,8 +787,9 @@ class ContinuousLane:
             finally:
                 TELEMETRY.end_span(span)
             n_drifted = sum(1 for s in slices if s.get("drift"))
-            self._commit(phase="train", cycle_slices=names,
-                         **self._drift_refit_updates(n_drifted))
+            self._commit_mutate(lambda led: led.update(
+                phase="train", cycle_slices=names,
+                **self._drift_refit_updates(n_drifted, led)))
         if slices is None:
             slices = self._load_cycle_slices(names)
         # train: produce the candidate model file
@@ -724,6 +832,19 @@ class ContinuousLane:
                                       self._p(BASE_MODEL),
                                       published_unix=time.time(),
                                       source="manual")
+            # close the drift→refit loop for LIVE traffic: serving
+            # quality monitors read this hook at fire time, so drift
+            # past quality_drift_refit_threshold lands in the same
+            # ledger tally ingest drift feeds
+            self.registry.on_quality_drift = self.report_serving_drift
+        if self._cycle_interval() > 0 \
+                and self._ledger.get("next_cycle_unix") is None:
+            # first arm of the cron-style timer: commit the due time
+            # so a restart keeps the cadence (an already-committed due
+            # time is left alone — including one now in the past,
+            # which fires on the first poll)
+            self._commit(next_cycle_unix=round(
+                self._clock() + self._cycle_interval(), 6))
         if mount_routes:
             TELEMETRY.register_http_route("/continuous",
                                           self._http_route)
@@ -739,6 +860,16 @@ class ContinuousLane:
         return self
 
     def stop(self, timeout_s: float = 60.0) -> None:
+        if self.registry is not None and getattr(
+                self.registry, "on_quality_drift", None) \
+                == self.report_serving_drift:
+            # == not `is`: each attribute access creates a FRESH
+            # bound-method object, so `is` would never match and the
+            # hook would leak past stop()
+            # symmetric teardown of what start() installed: a stopped
+            # (possibly decommissioned) lane must not keep receiving
+            # serving-drift reports into its ledger
+            self.registry.on_quality_drift = None
         self._stop.set()
         self._force.set()
         t = self._thread
@@ -769,7 +900,18 @@ class ContinuousLane:
             forced = self._force.is_set()
             self._force.clear()
             try:
-                self.run_cycle(force=forced)
+                if not forced and self.scheduled_due():
+                    self.run_scheduled_cycle()
+                else:
+                    self.run_cycle(force=forced)
+                    if forced and self.scheduled_due():
+                        # the forced cycle already trained over
+                        # everything the due scheduled cycle would —
+                        # re-arm the timer instead of immediately
+                        # training a duplicate cycle next poll
+                        self._commit(next_cycle_unix=round(
+                            self._clock() + self._cycle_interval(),
+                            6))
             except Exception as e:
                 # the cycle already dumped the flight recorder;
                 # the lane survives and retries next poll (the
@@ -814,6 +956,10 @@ class ContinuousLane:
                 "drift_refit_threshold": int(getattr(
                     self.config, "continuous_drift_refit_threshold",
                     0) or 0),
+                "serving_drift_reports": int(led.get(
+                    "serving_drift_reports", 0)),
+                "cycle_interval_s": self._cycle_interval(),
+                "next_cycle_unix": led.get("next_cycle_unix"),
             }
 
     def _http_route(self, method, path, body, headers):

@@ -451,6 +451,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if booster.gbdt is not None and booster.gbdt.timer.acc:
         Log.debug("training phase timings: "
                   + booster.gbdt.timer.report())
+    if str(config.quality).lower() == "on" \
+            and booster.gbdt is not None and booster.models:
+        # model-quality reference profile (docs/MODEL_MONITORING.md):
+        # captured while the training state is still resident — the
+        # feature histograms read the already-built bin matrix, the
+        # score histogram reads the boosting score cache, so capture
+        # costs one bincount pass + a pred_leaf over a strided sample.
+        # save_model persists it as <model>.quality.json; serving
+        # monitors bin live traffic against it.
+        from .quality import build_profile
+        try:
+            booster.quality_profile = build_profile(booster, train_set,
+                                                    config)
+        except Exception as e:  # capture must never fail the training
+            Log.warning(
+                f"quality profile capture failed "
+                f"({type(e).__name__}: {e}); model trains/saves "
+                "without a profile")
     if not keep_training_booster:
         # reference engine.py:224-226: the default return is a
         # predictor — training state (binned device matrix, padded

@@ -1,0 +1,29 @@
+"""Model-quality observability (docs/MODEL_MONITORING.md): does the
+model still fit the traffic it serves?
+
+- :mod:`.profile` — training-time reference profiles: per-feature
+  bin-occupancy histograms from the already-built bin matrix, the
+  training prediction-score histogram, per-tree leaf occupancy, all
+  fingerprinted against the model text and persisted as
+  ``<model>.quality.json``.
+- :mod:`.monitor` — serving-side drift monitors: a deterministic
+  counter-strided sampler bins live rows through the profile's frozen
+  BinMapper tables, scores per-feature/score/leaf PSI, exports
+  ``ltpu_quality_*`` gauges, warns once past ``quality_psi_warn`` and
+  feeds the continuous lane's drift-refit tally past
+  ``quality_drift_refit_threshold``.
+- ``python -m lightgbm_tpu.quality report`` — operator-facing
+  current-vs-reference diff (JSON / markdown).
+"""
+from .monitor import (ServingQualityMonitor, maybe_monitor,
+                      resolve_stride)
+from .profile import (PROFILE_SUFFIX, PSI_EPS, ProfileMismatch,
+                      QualityProfile, build_profile, load_profile_for,
+                      model_fingerprint, profile_path, psi)
+
+__all__ = [
+    "PROFILE_SUFFIX", "PSI_EPS", "ProfileMismatch", "QualityProfile",
+    "ServingQualityMonitor", "build_profile", "load_profile_for",
+    "maybe_monitor", "model_fingerprint", "profile_path",
+    "resolve_stride", "psi",
+]

@@ -1,0 +1,180 @@
+"""``python -m lightgbm_tpu.quality report`` — operator-facing
+current-vs-reference drift diff (docs/MODEL_MONITORING.md).
+
+Usage::
+
+    python -m lightgbm_tpu.quality report <profile.quality.json> \\
+        <current_data_file> [--model model.txt] [--markdown] \\
+        [-o OUT] [key=value ...]
+
+Bins the current data file through the profile's frozen BinMapper
+tables (same parser/params as training data: ``label_column``,
+``has_header``, ... accepted as trailing ``key=value`` pairs), scores
+per-feature PSI against the reference bin-occupancy histograms, and —
+when ``--model`` is given — score-distribution PSI from the model's
+predictions.  Emits JSON (default) or a markdown table sorted by PSI.
+
+Exit code: 0 = no feature past ``quality_psi_warn``, 1 = drift past
+the threshold (cron-able), 2 = usage error.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+USAGE = ("usage: python -m lightgbm_tpu.quality report "
+         "<profile.quality.json> <data> [--model MODEL] [--markdown] "
+         "[-o OUT] [key=value ...]")
+
+
+def build_report(profile, X: np.ndarray, booster=None,
+                 psi_warn: float = 0.2) -> dict:
+    """Pure diff: current matrix vs reference profile.  Refuses a
+    current matrix narrower than the profiled feature set — silently
+    dropping the missing features would let a structurally mismatched
+    export read as 'no drift' (rc 0), the phantom-clean outcome the
+    fingerprint refusal elsewhere exists to prevent."""
+    from .profile import psi, psi_grouped, score_counts
+    need = max(profile.features) + 1 if profile.features else 0
+    if X.shape[1] < need:
+        raise ValueError(
+            f"current data has {X.shape[1]} feature column(s) but the "
+            f"profile covers feature indices up to {need - 1} — wrong "
+            "file, lost columns, or a mis-set label_column")
+    mappers = profile.mappers()
+    feats = {}
+    for j, rec in sorted(profile.features.items()):
+        ref = np.asarray(rec["counts"])
+        bins = np.asarray(mappers[j].value_to_bin(
+            np.asarray(X[:, j], dtype=np.float64)), dtype=np.int64)
+        cur = np.bincount(np.clip(bins, 0, len(ref) - 1),
+                          minlength=len(ref))
+        feats[j] = {"name": rec.get("name", f"Column_{j}"),
+                    "psi": round(psi_grouped(ref, cur), 6),
+                    "rows": int(X.shape[0]),
+                    "reference_rows": int(ref.sum())}
+    worst = max(feats, key=lambda j: feats[j]["psi"], default=None)
+    out = {
+        "profile_fingerprint": profile.fingerprint,
+        "rows": int(X.shape[0]),
+        "reference_rows": int(profile.num_rows),
+        "psi_warn": psi_warn,
+        "features": feats,
+        "worst_feature": worst,
+        "worst_feature_psi": (feats[worst]["psi"]
+                              if worst is not None else 0.0),
+        "drifted_features": sorted(
+            (j for j, rec in feats.items() if rec["psi"] >= psi_warn),
+            key=lambda j: -feats[j]["psi"]),
+    }
+    if booster is not None:
+        preds = np.asarray(booster.predict(X)).reshape(-1)
+        cur = score_counts(preds, profile.score["edges"])
+        out["score_psi"] = round(
+            psi(profile.score["counts"], cur), 6)
+    return out
+
+
+def to_markdown(rep: dict) -> str:
+    lines = [
+        "# Model-quality drift report", "",
+        f"- current rows: {rep['rows']} vs reference "
+        f"{rep['reference_rows']}",
+        f"- worst feature PSI: **{rep['worst_feature_psi']:g}** "
+        f"(threshold {rep['psi_warn']:g})",
+    ]
+    if "score_psi" in rep:
+        lines.append(f"- score PSI: **{rep['score_psi']:g}**")
+    lines += ["", "| Feature | PSI | Status |", "|---|---|---|"]
+    feats = sorted(rep["features"].items(),
+                   key=lambda kv: -kv[1]["psi"])
+    for j, rec in feats:
+        status = "DRIFTED" if rec["psi"] >= rep["psi_warn"] else "ok"
+        lines.append(f"| `{rec['name']}` (f{j}) | {rec['psi']:g} "
+                     f"| {status} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] != "report":
+        print(USAGE, file=sys.stderr)
+        return 2
+    argv = argv[1:]
+    markdown = "--markdown" in argv
+    if markdown:
+        argv.remove("--markdown")
+    model_path = None
+    if "--model" in argv:
+        i = argv.index("--model")
+        try:
+            model_path = argv[i + 1]
+        except IndexError:
+            print("report: --model needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    out_path = None
+    if "-o" in argv:
+        i = argv.index("-o")
+        try:
+            out_path = argv[i + 1]
+        except IndexError:
+            print("report: -o needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    positional = [a for a in argv if "=" not in a]
+    params = dict(a.split("=", 1) for a in argv if "=" in a)
+    if len(positional) != 2:
+        print(USAGE, file=sys.stderr)
+        return 2
+    profile_file, data_file = positional
+    for p in (profile_file, data_file):
+        if not os.path.exists(p):
+            print(f"report: no such file: {p}", file=sys.stderr)
+            return 2
+    from ..config import Config
+    from ..data_loader import load_file
+    from .profile import ProfileMismatch, QualityProfile
+    # tool errors exit 2, never 1 — rc 1 is the documented "drift
+    # detected" code a cron wrapper keys on, and a stale/corrupt
+    # profile is a configuration problem, not drift
+    try:
+        profile = QualityProfile.load(profile_file)
+    except (ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"report: cannot load profile {profile_file}: {e}",
+              file=sys.stderr)
+        return 2
+    config = Config.from_params(dict(params, task="predict"))
+    X, _label, _extras = load_file(data_file, config)
+    booster = None
+    if model_path is not None:
+        from ..booster import Booster
+        booster = Booster(config=config, model_file=model_path)
+        try:
+            profile.verify(open(model_path).read())
+        except ProfileMismatch as e:
+            print(f"report: {e}", file=sys.stderr)
+            return 2
+    try:
+        rep = build_report(profile, np.asarray(X, dtype=np.float64),
+                           booster, psi_warn=config.quality_psi_warn)
+    except ValueError as e:
+        print(f"report: {e}", file=sys.stderr)
+        return 2
+    text = to_markdown(rep) if markdown \
+        else json.dumps(rep, indent=1, sort_keys=True) + "\n"
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text)
+        print(f"report written: {out_path}")
+    else:
+        sys.stdout.write(text)
+    return 1 if rep["drifted_features"] else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI entry
+    sys.exit(main())

@@ -1,0 +1,405 @@
+"""Serving-side model-quality monitors: online drift detection
+against a training-time :class:`~lightgbm_tpu.quality.QualityProfile`.
+
+One :class:`ServingQualityMonitor` rides each served model version
+(created at ``registry.publish`` when a fingerprint-matching profile
+is available, ``quality != off`` and ``quality_sample_rate > 0``).
+The micro-batcher hands it every coalesced dispatch AFTER the results
+are sliced back — the monitor only ever READS the request rows and
+predictions, so served outputs stay byte-identical to a direct
+``Booster.predict`` (pinned by ``tests/test_quality.py``), and with
+``quality=off`` the whole hook is one attribute check.
+
+Sampling is a deterministic counter stride (no RNG): row ``k`` of the
+model's serving stream is sampled iff ``k % stride == 0`` with
+``stride = round(1 / quality_sample_rate)``.  The counter advances by
+the batch size whether or not rows are sampled, so the sampled set
+depends only on the arrival ORDER of rows, never on how the batcher
+happened to coalesce them — replays sample identical rows.
+
+Sampled rows bin host-side through the profile's frozen BinMapper
+tables into per-feature online histograms; predictions feed the
+profile's equal-count score buckets; the leading trees' ``pred_leaf``
+feeds per-tree leaf-occupancy histograms.  Per-feature PSI and the
+score/leaf drift scores export as ``ltpu_quality_*`` Prometheus
+gauges, surface on ``GET /quality/<model>`` and in the ``/models``
+metadata, warn ONCE (top-k drifted features named) + flight-record
+past ``quality_psi_warn``, and past
+``quality_drift_refit_threshold`` report a serving-drift event into
+the continuous lane's ledger-committed drift-refit tally — closing
+the drift→refit loop for LIVE traffic, not just ingest
+(docs/MODEL_MONITORING.md).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..telemetry import TELEMETRY, Hist
+from ..utils.log import Log
+from .profile import (PROFILE_SUFFIX, ProfileMismatch, QualityProfile,
+                      load_profile_for, psi, psi_group_bounds)
+
+# drifted features named in the warn-once message / report
+TOP_K_DRIFTED = 5
+
+# sampled rows between drift-score refreshes: recomputing PSI over
+# every feature + monitored tree AND re-exporting a gauge per feature
+# on EVERY sampled dispatch would stall the dispatcher thread under
+# single-row traffic on wide models; the scores are only ever read by
+# HTTP polls and threshold checks, so a refresh per ~256 sampled rows
+# (plus a lazy refresh on read) is observationally identical.  The
+# FIRST sampled batch always refreshes, so low-traffic monitors
+# publish gauges immediately.
+REFRESH_SAMPLED_ROWS = 256
+
+
+def resolve_stride(sample_rate: float) -> int:
+    """quality_sample_rate -> counter stride (0 disables)."""
+    rate = float(sample_rate)
+    if rate <= 0.0:
+        return 0
+    return max(1, int(round(1.0 / rate)))
+
+
+class ServingQualityMonitor:
+    """Online feature/score/leaf-occupancy histograms + drift scores
+    for ONE served model version."""
+
+    def __init__(self, profile: QualityProfile, booster, config=None,
+                 name: str = "model", registry=None):
+        self.profile = profile
+        self.name = name
+        self.stride = resolve_stride(getattr(
+            config, "quality_sample_rate", 0.0))
+        self.psi_warn = float(getattr(config, "quality_psi_warn", 0.2))
+        self.refit_threshold = float(getattr(
+            config, "quality_drift_refit_threshold", 0.0))
+        # late-bound drift→refit hook: the registry carries
+        # ``on_quality_drift`` (set by ContinuousLane.start), read at
+        # FIRE time so monitors armed before the lane still report
+        self._registry = registry
+        self.on_drift = None
+        self._lock = threading.Lock()
+        self._seen = 0           # rows offered (sampled or not)
+        self._done_rows = 0      # rows whose observation FULLY
+        # completed (histograms + gauges + warn/drift side effects) —
+        # the observer runs post-release on the dispatcher thread, so
+        # a just-answered request's observation may still be in
+        # flight; wait_observed() is the quiesce point tests/probes
+        # synchronize on
+        self._sampled = 0
+        self._mappers = profile.mappers()
+        self._feat_counts: Dict[int, np.ndarray] = {
+            j: np.zeros(len(rec["counts"]), dtype=np.int64)
+            for j, rec in profile.features.items()}
+        # PSI group bounds + grouped reference masses are pure
+        # functions of the FIXED profile — precomputed once here, not
+        # per refresh (a refresh runs on the dispatcher thread per
+        # sampled dispatch, under the monitor lock)
+        self._feat_groups: Dict[int, tuple] = {}
+        for j, rec in profile.features.items():
+            ref = np.asarray(rec["counts"], dtype=np.float64)
+            b = psi_group_bounds(ref)
+            self._feat_groups[j] = (b, np.add.reduceat(ref, b))
+        self._score_hist = Hist(profile.score["edges"])
+        n_trees = int(profile.leaves["trees"])
+        self._trees = list(booster.models[:n_trees])
+        self._leaf_counts = [
+            np.zeros(len(ref), dtype=np.int64)
+            for ref in profile.leaves["counts"][:len(self._trees)]]
+        self._leaf_groups = []
+        for ref in profile.leaves["counts"][:len(self._trees)]:
+            ref = np.asarray(ref, dtype=np.float64)
+            b = psi_group_bounds(ref)
+            self._leaf_groups.append((b, np.add.reduceat(ref, b)))
+        self._warned = False
+        self._refit_reported = False
+        self._dirty = 0          # sampled rows since the last refresh
+        self._published_once = False
+        self._scores: Dict[str, object] = {
+            "features": {}, "worst_feature": None,
+            "worst_feature_psi": 0.0, "score_psi": 0.0,
+            "leaf_psi": 0.0}
+
+    # ------------------------------------------------------------------
+    def _take(self, n: int) -> np.ndarray:
+        """Advance the stream counter by ``n`` rows and return the
+        sampled in-batch indices (counter-strided, lock-held)."""
+        start = self._seen
+        self._seen += n
+        if self.stride <= 0:
+            return np.empty(0, dtype=np.int64)
+        first = (-start) % self.stride
+        idx = np.arange(first, n, self.stride, dtype=np.int64)
+        self._sampled += int(idx.size)
+        return idx
+
+    def observe(self, rows: np.ndarray, preds: np.ndarray) -> None:
+        """Fold one dispatched batch into the online histograms.
+        READ-ONLY on both arguments; never raises into the serving
+        path (the batcher additionally guards the call)."""
+        rows = np.asarray(rows)
+        n = int(rows.shape[0])
+        if n == 0:
+            return
+        refresh = False
+        with self._lock:
+            idx = self._take(n)
+            if idx.size:
+                sample = np.asarray(rows[idx], dtype=np.float64)
+                p = np.asarray(preds)[idx]
+                for j, m in self._mappers.items():
+                    bins = np.asarray(m.value_to_bin(sample[:, j]),
+                                      dtype=np.int64)
+                    counts = self._feat_counts[j]
+                    np.add.at(counts,
+                              np.clip(bins, 0, len(counts) - 1), 1)
+                self._score_hist.observe_many(p)
+                for t, counts in zip(self._trees, self._leaf_counts):
+                    lp = np.asarray(t.predict_leaf(sample),
+                                    dtype=np.int64)
+                    np.add.at(counts,
+                              np.clip(lp, 0, len(counts) - 1), 1)
+                self._dirty += int(idx.size)
+                refresh = (self._dirty >= REFRESH_SAMPLED_ROWS
+                           or not self._published_once)
+                if refresh:
+                    self._refresh_locked()
+                    self._dirty = 0
+                    self._published_once = True
+        if idx.size:
+            if TELEMETRY.on:
+                TELEMETRY.add("quality_rows_sampled", int(idx.size))
+            if refresh:
+                self._publish()
+        with self._lock:
+            self._done_rows += n
+
+    def wait_observed(self, rows: int, timeout_s: float = 30.0) -> bool:
+        """Block until at least ``rows`` serving rows have been FULLY
+        observed (histograms, gauges, warn/drift side effects all
+        committed).  The quiesce point for tests/probes: requests are
+        answered BEFORE their observation runs, so reading the
+        monitor right after a predict returns may race it."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._done_rows >= rows:
+                    return True
+            time.sleep(0.002)
+        return False
+
+    # ------------------------------------------------------------------
+    def _refresh_locked(self) -> None:
+        feats: Dict[int, float] = {}
+        for j, (b, ref_grouped) in self._feat_groups.items():
+            feats[j] = psi(ref_grouped, np.add.reduceat(
+                self._feat_counts[j].astype(np.float64), b))
+        worst_j = max(feats, key=lambda j: feats[j], default=None) \
+            if feats else None
+        score_psi = psi(self.profile.score["counts"],
+                        self._score_hist.counts)
+        leaf_psis = [
+            psi(ref_grouped, np.add.reduceat(
+                cur.astype(np.float64), b))
+            for (b, ref_grouped), cur
+            in zip(self._leaf_groups, self._leaf_counts)]
+        self._scores = {
+            "features": feats,
+            "worst_feature": worst_j,
+            "worst_feature_psi": feats.get(worst_j, 0.0)
+            if worst_j is not None else 0.0,
+            "score_psi": score_psi,
+            "leaf_psi": float(np.mean(leaf_psis)) if leaf_psis else 0.0,
+            "leaf_psis": leaf_psis,
+        }
+
+    def _feature_name(self, j: int) -> str:
+        return self.profile.features[j].get("name", f"Column_{j}")
+
+    def _publish(self) -> None:
+        """Export the refreshed drift scores (gauges, warn-once,
+        flight event, drift→refit report) — outside the counter
+        lock."""
+        with self._lock:
+            s = dict(self._scores)
+            feats = dict(s.get("features", {}))
+            sampled = self._sampled
+        tm = TELEMETRY
+        if tm.on:
+            tm.gauge(f"quality_worst_feature_psi.{self.name}",
+                     round(float(s["worst_feature_psi"]), 6))
+            tm.gauge(f"quality_score_psi.{self.name}",
+                     round(float(s["score_psi"]), 6))
+            tm.gauge(f"quality_leaf_psi.{self.name}",
+                     round(float(s["leaf_psi"]), 6))
+            tm.gauge(f"quality_sampled_rows.{self.name}", sampled)
+            for j, v in feats.items():
+                tm.gauge(f"quality_psi.{self.name}.f{j}",
+                         round(float(v), 6))
+        worst = float(s["worst_feature_psi"])
+        if worst >= self.psi_warn and not self._warned:
+            self._warned = True
+            top = sorted(feats.items(), key=lambda kv: -kv[1])
+            top = [(j, v) for j, v in top[:TOP_K_DRIFTED]
+                   if v >= self.psi_warn] or top[:1]
+            if tm.on:
+                tm.add("quality_drift_warns", 1)
+            tm.flight.dump(
+                "quality_drift", seam="serving.request",
+                model=self.name,
+                worst_feature=int(s["worst_feature"]),
+                worst_feature_psi=round(worst, 6),
+                score_psi=round(float(s["score_psi"]), 6))
+            Log.warning(
+                f"quality monitor {self.name!r}: serving traffic has "
+                f"DRIFTED past quality_psi_warn={self.psi_warn:g} "
+                f"(over {sampled} sampled rows) — top drifted "
+                "features: "
+                + ", ".join(
+                    f"{self._feature_name(j)} (f{j}) PSI={v:.3f}"
+                    for j, v in top)
+                + f"; score PSI={s['score_psi']:.3f}, leaf "
+                f"PSI={s['leaf_psi']:.3f}. The model may no longer "
+                "fit its traffic (docs/MODEL_MONITORING.md runbook)")
+        thr = self.refit_threshold
+        if thr > 0:
+            if worst >= thr and not self._refit_reported:
+                cb = self.on_drift or getattr(
+                    self._registry, "on_quality_drift", None)
+                if cb is not None:
+                    self._refit_reported = True
+                    if tm.on:
+                        tm.add("quality_refit_reports", 1)
+                    cb(model=self.name,
+                       worst_feature=int(s["worst_feature"]),
+                       psi=round(worst, 6))
+            elif worst < thr * 0.5:
+                # re-arm once the episode clearly ended so a later,
+                # separate drift episode reports again
+                self._refit_reported = False
+
+    # ------------------------------------------------------------------
+    def summary(self) -> dict:
+        """The one-pane-of-glass block ``GET /models`` carries per
+        version: worst-feature PSI, score drift, sampled-row count."""
+        with self._lock:
+            if self._dirty:
+                # lazy refresh for readers; _dirty stays set so the
+                # observe path still publishes gauges/warns on its
+                # own schedule
+                self._refresh_locked()
+            s = self._scores
+            worst_j = s.get("worst_feature")
+            return {
+                "worst_feature_psi": round(
+                    float(s["worst_feature_psi"]), 6),
+                "worst_feature": (None if worst_j is None else
+                                  f"f{worst_j}"),
+                "score_psi": round(float(s["score_psi"]), 6),
+                "leaf_psi": round(float(s["leaf_psi"]), 6),
+                "sampled_rows": self._sampled,
+                "sample_stride": self.stride,
+            }
+
+    def report(self) -> dict:
+        """The full ``GET /quality/<model>`` body: per-feature PSI +
+        online/reference counts, score + leaf drift, thresholds."""
+        with self._lock:
+            if self._dirty:
+                self._refresh_locked()
+            s = dict(self._scores)
+            feats = {
+                int(j): {
+                    "name": self._feature_name(j),
+                    "psi": round(float(s["features"].get(j, 0.0)), 6),
+                    "sampled": int(self._feat_counts[j].sum()),
+                    "reference_rows": int(
+                        np.asarray(self.profile.features[j]["counts"])
+                        .sum()),
+                }
+                for j in self.profile.features}
+            return {
+                "model": self.name,
+                "fingerprint": self.profile.fingerprint,
+                "sampled_rows": self._sampled,
+                "rows_seen": self._seen,
+                "sample_stride": self.stride,
+                "psi_warn": self.psi_warn,
+                "drift_refit_threshold": self.refit_threshold,
+                "warned": self._warned,
+                "worst_feature_psi": round(
+                    float(s["worst_feature_psi"]), 6),
+                "worst_feature": s.get("worst_feature"),
+                "score_psi": round(float(s["score_psi"]), 6),
+                "leaf_psi": round(float(s["leaf_psi"]), 6),
+                "leaf_psis": [round(float(v), 6)
+                              for v in s.get("leaf_psis", [])],
+                "features": feats,
+            }
+
+
+def maybe_monitor(model, booster, config, name: str,
+                  registry=None) -> Optional[ServingQualityMonitor]:
+    """Arm a monitor for a publish when the knobs and a
+    fingerprint-matching profile allow it; None otherwise.
+
+    ``model`` is what ``publish`` received: a model-file path (the
+    sidecar ``<path>.quality.json`` is the profile source, and the
+    fingerprint is checked against the FILE bytes) or a Booster (the
+    in-memory ``quality_profile`` attached by ``engine.train``).
+    ``quality=off`` or ``quality_sample_rate=0`` returns None without
+    touching disk; ``quality=on`` warns loudly when no usable profile
+    is found (auto stays silent)."""
+    quality = str(getattr(config, "quality", "auto")).lower()
+    rate = float(getattr(config, "quality_sample_rate", 0.0))
+    if quality == "off" or rate <= 0.0:
+        return None
+    profile = None
+    text = None
+    if isinstance(model, str):
+        profile = load_profile_for(model)
+        if profile is not None:
+            with open(model) as f:
+                text = f.read()
+    else:
+        profile = getattr(model, "quality_profile", None)
+        if profile is not None:
+            text = model.model_to_string()
+    if profile is not None:
+        try:
+            profile.verify(text)
+        except ProfileMismatch as e:
+            Log.warning(f"quality monitor for {name!r} NOT armed: {e}")
+            profile = None
+    if profile is None:
+        if quality == "on":
+            Log.warning(
+                f"quality=on but no usable {PROFILE_SUFFIX} profile "
+                f"for {name!r} — train with quality=on so the profile "
+                "is captured beside the model; serving without drift "
+                "monitors")
+        return None
+    try:
+        monitor = ServingQualityMonitor(profile, booster, config,
+                                        name=name, registry=registry)
+    except (ValueError, KeyError, TypeError, IndexError) as e:
+        # a sidecar that parses AND fingerprint-matches can still
+        # carry a malformed mapper/leaf record (hand edit, or a
+        # future writer changing state keys without bumping the
+        # schema) — a monitoring artifact must degrade to
+        # monitors-off, never take a publish (and task=serve startup
+        # with it) down
+        Log.warning(f"quality monitor for {name!r} NOT armed: "
+                    f"profile unusable ({type(e).__name__}: {e}); "
+                    "serving without drift monitors")
+        return None
+    Log.info(f"quality monitor armed for {name!r}: "
+             f"{len(profile.features)} feature(s), stride "
+             f"{resolve_stride(rate)}, psi_warn "
+             f"{getattr(config, 'quality_psi_warn', 0.2)}")
+    return monitor

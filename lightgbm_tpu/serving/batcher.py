@@ -85,9 +85,19 @@ class MicroBatcher:
 
     def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
                  config=None, clock: Optional[Callable[[], float]] = None,
-                 start: bool = True, name: str = ""):
+                 start: bool = True, name: str = "",
+                 observer: Optional[Callable] = None):
         self.predict = predict_fn
         self.name = name
+        # read-only post-dispatch hook fed (rows, results) of every
+        # successful coalesced dispatch — the serving quality monitor
+        # (lightgbm_tpu/quality/).  None (quality=off) costs one
+        # attribute check; the hook sees rows in dispatch order on the
+        # dispatcher thread, which is what makes the monitor's
+        # counter-strided sampler replay-stable.  A hook crash is
+        # counted + warned once, never surfaced to the request.
+        self.observer = observer
+        self._observer_warned = False
         self.deadline_ms = float(getattr(
             config, "serve_batch_deadline_ms", 2.0))
         self.shed_ms = float(getattr(
@@ -309,6 +319,24 @@ class MicroBatcher:
             r.result = out[s:s + r.n]
             s += r.n
             r.done.set()
+        if self.observer is not None:
+            # AFTER the waiting requests are released: the monitor's
+            # host-side binning/PSI work (and a drift report's ledger
+            # write) must never sit on the request critical path —
+            # it still runs on the dispatcher thread in dispatch
+            # order, which is what the sampler's determinism needs
+            try:
+                self.observer(x, out)
+            except Exception as e:
+                if tm.on:
+                    tm.add("quality_observe_errors", 1)
+                if not self._observer_warned:
+                    self._observer_warned = True
+                    from ..utils.log import Log
+                    Log.warning(
+                        f"serving quality observer crashed "
+                        f"({type(e).__name__}: {e}); requests are "
+                        "unaffected, monitoring may undercount")
         if tm.on:
             tm.add("serve_dispatches", 1)
             tm.add("serve_rows", rows)

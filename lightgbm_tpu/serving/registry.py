@@ -52,16 +52,20 @@ class ModelEntry:
     when, and at what eval metric — what a rollback decision reads)."""
 
     __slots__ = ("name", "version", "booster", "batcher", "_predict_fn",
-                 "meta")
+                 "meta", "monitor")
 
     def __init__(self, name: str, version: int, booster, predict_fn,
-                 batcher: MicroBatcher, meta=None):
+                 batcher: MicroBatcher, meta=None, monitor=None):
         self.name = name
         self.version = int(version)
         self.booster = booster
         self._predict_fn = predict_fn
         self.batcher = batcher
         self.meta: dict = dict(meta or {})
+        # per-version serving quality monitor (lightgbm_tpu/quality/),
+        # or None when quality=off / no profile — the off-mode cost is
+        # this one attribute staying None
+        self.monitor = monitor
 
     def predict(self, rows: np.ndarray) -> np.ndarray:
         return self.batcher.submit(rows)
@@ -73,6 +77,12 @@ class ModelRegistry:
     def __init__(self, config=None):
         self.config = config
         self._lock = threading.Lock()
+        # drift→refit hook (quality monitors read it at FIRE time,
+        # late-bound): ContinuousLane.start() installs its
+        # report_serving_drift here so serving-side drift past
+        # quality_drift_refit_threshold lands in the lane's
+        # ledger-committed drift tally (docs/MODEL_MONITORING.md)
+        self.on_quality_drift = None
         self._current: Dict[str, ModelEntry] = {}
         self._versions: Dict[str, List[ModelEntry]] = {}
         # serving history per name: what _current pointed at before
@@ -169,6 +179,14 @@ class ModelRegistry:
             # warm-before-cutover: compile (or disk-hit) every
             # declared bucket while the OLD version still serves
             booster.warm_predictor(warm, log=log_warm)
+        # serving quality monitor (lightgbm_tpu/quality/): armed when
+        # the knobs allow it AND a fingerprint-matching profile rides
+        # the model (sidecar file for a path publish, the in-memory
+        # engine.train attachment for a Booster publish); observes
+        # every coalesced dispatch read-only through the batcher hook
+        from ..quality import maybe_monitor
+        monitor = maybe_monitor(model, booster, cfg, name,
+                                registry=self)
         with self._lock:
             versions = self._versions.setdefault(name, [])
             if version is None:
@@ -181,7 +199,10 @@ class ModelRegistry:
             entry = ModelEntry(
                 name, version, booster, predict_fn,
                 MicroBatcher(predict_fn, cfg,
-                             name=f"{name}@v{version}"), meta=meta)
+                             name=f"{name}@v{version}",
+                             observer=monitor.observe
+                             if monitor is not None else None),
+                meta=meta, monitor=monitor)
             versions.append(entry)
             old = self._current.get(name)
             if old is not None:
@@ -220,7 +241,9 @@ class ModelRegistry:
             if prev.batcher.closed:
                 prev.batcher = MicroBatcher(
                     prev._predict_fn, self.config,
-                    name=f"{name}@v{prev.version}")
+                    name=f"{name}@v{prev.version}",
+                    observer=prev.monitor.observe
+                    if prev.monitor is not None else None)
             self._current[name] = prev
         tm = TELEMETRY
         if tm.on:
@@ -275,19 +298,34 @@ class ModelRegistry:
         record per published version with its audit metadata
         (``published_unix`` / ``eval_metric`` / ``source`` as passed to
         :meth:`publish`) and whether that version is the one currently
-        serving — the trail a rollback decision is audited against."""
+        serving — the trail a rollback decision is audited against.
+        Versions with an armed quality monitor additionally carry a
+        live ``quality`` block (worst-feature PSI, score drift,
+        sampled-row count; full detail on ``GET /quality/<model>``) —
+        the registry is the one pane of glass."""
         with self._lock:
-            return {
-                name: {
-                    "version": entry.version,
-                    "versions": [
-                        {"version": e.version,
-                         "serving": e is entry, **e.meta}
-                        for e in self._versions.get(name, [])],
-                    "queue_depth": entry.batcher.depth(),
-                }
-                for name, entry in self._current.items()
+            # snapshot ONLY under the registry lock; the monitor
+            # summaries (which take each monitor's own lock, possibly
+            # held through a whole observation pass) are built after
+            # release — a /models poll must never park /predict
+            # requests behind a monitoring refresh
+            snap = {name: (entry, list(self._versions.get(name, [])))
+                    for name, entry in self._current.items()}
+        return {
+            name: {
+                "version": entry.version,
+                "versions": [
+                    {"version": e.version,
+                     "serving": e is entry, **e.meta,
+                     **({"quality": e.monitor.summary()}
+                        if e.monitor is not None else {})}
+                    for e in versions],
+                "queue_depth": entry.batcher.depth(),
+                "quality": (entry.monitor.summary()
+                            if entry.monitor is not None else None),
             }
+            for name, (entry, versions) in snap.items()
+        }
 
     def close(self) -> None:
         """Drain and release every entry (process shutdown)."""

@@ -101,6 +101,7 @@ class ServingFrontend:
         tm = TELEMETRY
         tm.register_http_route("/predict/", self._predict_route)
         tm.register_http_route("/models", self._models_route)
+        tm.register_http_route("/quality/", self._quality_route)
         if port is None:
             port = int(getattr(self.config, "telemetry_http_port", 0)) \
                 or int(getattr(self.config, "serve_port", 0))
@@ -120,6 +121,7 @@ class ServingFrontend:
         tm = TELEMETRY
         tm.unregister_http_route("/predict/")
         tm.unregister_http_route("/models")
+        tm.unregister_http_route("/quality/")
         if drain:
             self.registry.close()
         if self._srv is not None:
@@ -130,6 +132,36 @@ class ServingFrontend:
     # -- routes --------------------------------------------------------
     def _models_route(self, method, path, body, headers):
         return _json_response(200, self.registry.describe())
+
+    def _quality_route(self, method, path, body, headers):
+        """``GET /quality/<model>``: the serving quality monitor's
+        full drift report (per-feature PSI + online/reference counts,
+        score/leaf drift, thresholds — docs/MODEL_MONITORING.md).
+        404 when the model is unknown or no monitor is armed."""
+        if method != "GET":
+            return _json_response(
+                405, {"error": "GET /quality/<model>"},
+                {"Allow": "GET"})
+        name = path.split("?", 1)[0].rstrip("/").rsplit("/", 1)[-1]
+        if not name or name == "quality":
+            return _json_response(
+                404, {"error": "no model in path; GET "
+                               "/quality/<model>"})
+        try:
+            entry = self.registry.get(name)
+        except KeyError:
+            return _json_response(
+                404, {"error": f"no model named {name!r}",
+                      "models": self.registry.names()})
+        if entry.monitor is None:
+            return _json_response(
+                404, {"error": f"no quality monitor armed for "
+                               f"{name!r} (quality=off, "
+                               "quality_sample_rate=0, or no "
+                               "fingerprint-matching profile beside "
+                               "the model)",
+                      "model": name, "version": entry.version})
+        return _json_response(200, entry.monitor.report())
 
     def _predict_route(self, method, path, body, headers):
         t0 = time.perf_counter()

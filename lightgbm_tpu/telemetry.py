@@ -141,9 +141,33 @@ class _Hist:
         self.total += value
         self.count += 1
 
+    def observe_many(self, values) -> None:
+        """Vectorized bulk observe (numpy): one searchsorted over the
+        batch instead of a Python-level bisect per sample — the
+        serving-side quality monitors feed whole sampled batches
+        through their per-model score histograms this way.
+        ``side="left"`` matches ``bisect_left`` exactly, so a value on
+        a bound lands in the same bucket either route."""
+        import numpy as np
+        v = np.asarray(values, dtype=np.float64).reshape(-1)
+        if v.size == 0:
+            return
+        idx = np.searchsorted(np.asarray(self.bounds), v, side="left")
+        for i, c in zip(*np.unique(idx, return_counts=True)):
+            self.counts[int(i)] += int(c)
+        self.total += float(v.sum())
+        self.count += int(v.size)
+
     def to_dict(self) -> Dict[str, Any]:
         return {"bounds": list(self.bounds), "counts": list(self.counts),
                 "sum": round(self.total, 6), "count": self.count}
+
+
+# public name for the fixed-bucket histogram container: the quality
+# monitors (lightgbm_tpu/quality/) build per-model score histograms
+# over PROFILE-derived bounds with the same le-semantics machinery the
+# latency histograms use, so their counts merge/compare bucket-wise
+Hist = _Hist
 
 
 def hist_quantile(h: Dict[str, Any], q: float) -> float:

@@ -114,6 +114,16 @@ EOF
 # by test_bench_smoke on the JSON it writes
 python scripts/continuous_probe.py /tmp/lgbtpu_smoke/continuous.json >&2
 test -s /tmp/lgbtpu_smoke/continuous.json
+# model-quality observability probe (round 17): train with quality=on
+# (profile sidecar persisted), serve sampled traffic through a real
+# registry with drift monitors armed — byte parity + zero drift on
+# in-distribution rows, a deliberately shifted stream blowing a
+# per-feature PSI past threshold with the warn fired, ltpu_quality_*
+# gauges present in the Prometheus text, and the operator report CLI
+# agreeing (rc 1 + the drifted feature named); asserted by
+# test_bench_smoke on the JSON it writes
+python scripts/quality_probe.py /tmp/lgbtpu_smoke/quality.json >&2
+test -s /tmp/lgbtpu_smoke/quality.json
 # serving probe (round 14): in-process registry + micro-batching
 # frontend under concurrent single-row clients through real HTTP —
 # parity vs direct predict, coalescing actually occurring

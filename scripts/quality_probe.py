@@ -1,0 +1,146 @@
+"""Model-quality observability probe: train → serve sampled traffic →
+assert zero drift on in-distribution rows, nonzero PSI on a
+deliberately shifted stream, Prometheus gauges present.
+
+Run by ``scripts/bench_smoke.sh`` and asserted by
+``tests/test_bench_smoke.py``.  One in-process pass:
+
+1. Train a small model with ``quality=on`` — the QualityProfile is
+   captured at train end and persisted as ``<model>.quality.json``.
+2. Publish the model file into a real ModelRegistry with
+   ``quality_sample_rate=1`` — the sidecar profile arms a serving
+   drift monitor (fingerprint-checked).
+3. Serve the TRAINING rows back: predictions must be byte-identical
+   to a direct ``Booster.predict`` and every drift score must sit
+   well under ``quality_psi_warn`` (the zero-drift gate).
+4. Serve a deliberately shifted stream: the shifted feature's PSI
+   must blow past the warn threshold, the warn-once fires, and the
+   ``ltpu_quality_*`` gauges must be present in the Prometheus text.
+5. The operator report CLI must agree (rc 1 + the drifted feature
+   named).
+
+Writes ``/tmp/lgbtpu_smoke/quality.json``.
+
+Usage: python scripts/quality_probe.py [out_json]
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PARAMS = {"objective": "regression", "verbose": -1, "num_leaves": 15,
+          "min_data_in_leaf": 5, "quality": "on"}
+SHIFT_FEATURE = 2
+SHIFT = 8.0
+
+
+def probe(work: str) -> dict:
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.quality import profile_path
+    from lightgbm_tpu.quality.__main__ import main as report_main
+    from lightgbm_tpu.serving import ModelRegistry
+    from lightgbm_tpu.telemetry import TELEMETRY
+
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 6)
+    y = X[:, 0] - 0.4 * X[:, 1]
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), 5,
+                    verbose_eval=False)
+    model = os.path.join(work, "quality_model.txt")
+    bst.save_model(model)
+    assert os.path.exists(profile_path(model)), \
+        "quality=on training did not persist the profile sidecar"
+
+    cfg = Config.from_params({"verbose": -1,
+                              "quality_sample_rate": 1.0,
+                              "quality_psi_warn": 0.2})
+    reg = ModelRegistry(cfg)
+    out: dict = {"profile": os.path.basename(profile_path(model))}
+    try:
+        entry = reg.publish("qm", model)
+        assert entry.monitor is not None, "monitor did not arm"
+        out["profile_features"] = len(entry.monitor.profile.features)
+
+        # in-distribution traffic: byte parity + zero drift
+        _, served = reg.predict("qm", X)
+        direct = np.asarray(entry.booster.predict(X)).reshape(-1)
+        parity = np.array_equal(np.asarray(served).reshape(-1), direct)
+        out["parity"] = "pass" if parity else "FAIL"
+        assert entry.monitor.wait_observed(len(X)), "observer stalled"
+        rep = entry.monitor.report()
+        out["in_dist_worst_psi"] = rep["worst_feature_psi"]
+        out["in_dist_score_psi"] = rep["score_psi"]
+        out["in_dist_leaf_psi"] = rep["leaf_psi"]
+        assert rep["worst_feature_psi"] < 0.05, (
+            "in-distribution traffic reads as drifted: "
+            f"{rep['worst_feature_psi']}")
+        assert rep["score_psi"] < 0.05 and rep["leaf_psi"] < 0.05, rep
+        assert not rep["warned"]
+
+        # deliberately shifted stream
+        Xs = np.array(X)
+        Xs[:, SHIFT_FEATURE] += SHIFT
+        reg.predict("qm", Xs)
+        assert entry.monitor.wait_observed(2 * len(X)), \
+            "observer stalled"
+        rep = entry.monitor.report()
+        out["shifted_worst_feature"] = rep["worst_feature"]
+        out["shifted_worst_psi"] = rep["worst_feature_psi"]
+        out["warn_fired"] = bool(rep["warned"])
+        assert rep["worst_feature"] == SHIFT_FEATURE, rep
+        assert rep["worst_feature_psi"] > cfg.quality_psi_warn
+        out["sampled_rows"] = rep["sampled_rows"]
+
+        prom = TELEMETRY.to_prometheus()
+        gauges = [ln.split()[0] for ln in prom.splitlines()
+                  if ln.startswith("ltpu_quality_")]
+        out["prom_gauges"] = sorted({g.split("{")[0] for g in gauges})
+        assert any("worst_feature_psi" in g for g in gauges), gauges
+        q = reg.describe()["qm"]["quality"]
+        assert q["worst_feature"] == f"f{SHIFT_FEATURE}"
+        out["models_quality_block"] = "pass"
+    finally:
+        reg.close()
+
+    # operator report CLI agrees: rc 1 + the drifted feature named
+    cur = os.path.join(work, "quality_current.csv")
+    np.savetxt(cur, np.column_stack([y, Xs]), delimiter=",")
+    rep_path = os.path.join(work, "quality_report.json")
+    rc = report_main(["report", profile_path(model), cur,
+                      "-o", rep_path, "verbose=-1"])
+    rep = json.load(open(rep_path))
+    assert rc == 1, f"report rc {rc} on drifted data"
+    assert SHIFT_FEATURE in rep["drifted_features"] \
+        or str(SHIFT_FEATURE) in [str(j) for j in
+                                  rep["drifted_features"]], rep
+    out["report_cli"] = "pass"
+    return out
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 \
+        else "/tmp/lgbtpu_smoke/quality.json"
+    work = os.path.dirname(os.path.abspath(out_path)) or "."
+    os.makedirs(work, exist_ok=True)
+    out = probe(work)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(f"quality probe ok: in-dist worst PSI "
+          f"{out['in_dist_worst_psi']:g}, shifted f"
+          f"{out['shifted_worst_feature']} PSI "
+          f"{out['shifted_worst_psi']:g}, {len(out['prom_gauges'])} "
+          f"gauge families -> {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,28 @@ def test_bench_smoke_json_contract():
     assert ct["cycle_resumed_from_ledger"] is True
     assert ct["byte_identical"] is True
     assert ct["kill_recovery"] == "pass"
+    # model-quality probe (round 17): profile captured at train,
+    # monitors armed from the sidecar at publish, zero drift on
+    # in-distribution rows, a shifted stream past threshold with the
+    # warn fired, gauges on the Prometheus surface, report CLI
+    # agreeing — scripts/quality_probe.py, run in-line by
+    # bench_smoke.sh
+    with open("/tmp/lgbtpu_smoke/quality.json") as f:
+        q = json.load(f)
+    for field in ("parity", "profile_features", "in_dist_worst_psi",
+                  "shifted_worst_feature", "shifted_worst_psi",
+                  "warn_fired", "prom_gauges", "report_cli",
+                  "models_quality_block", "sampled_rows"):
+        assert field in q, f"quality probe missing {field}"
+    assert q["parity"] == "pass"
+    # zero drift on in-distribution rows, loud drift on the shift
+    assert q["in_dist_worst_psi"] < 0.05
+    assert q["shifted_worst_psi"] > 0.2
+    assert q["shifted_worst_feature"] == 2
+    assert q["warn_fired"] is True
+    assert any("worst_feature_psi" in g for g in q["prom_gauges"])
+    assert q["report_cli"] == "pass"
+    assert q["models_quality_block"] == "pass"
     # serving probe (round 14): concurrent single-row clients through
     # the micro-batching HTTP frontend — scripts/serve_bench.py, run
     # in-line by bench_smoke.sh

@@ -1,0 +1,630 @@
+"""Model-quality observability (round-17 tentpole): training-time
+reference profiles, serving-side drift monitors, the drift→refit
+loop, and scheduled continuous cycles.
+
+Pins the tentpole's contracts:
+
+- PSI is exact on crafted shifted distributions (no empty buckets →
+  the eps smoothing is a no-op) and zero on identical ones; the
+  grouped form merges fine-grained bin histograms into the
+  reference's equal-mass groups deterministically.
+- The profile's per-feature bin histograms — reconstructed from the
+  ALREADY-BUILT packed bin matrix, one bincount per group column —
+  equal a direct per-feature ``value_to_bin`` bincount (categorical
+  features included), and the carried BinMapper tables round-trip
+  bit-identically through JSON.
+- The serving sampler is a deterministic counter stride: the sampled
+  set depends only on row arrival order, never on batch coalescing —
+  replays produce identical monitor counts.
+- Monitors-on predictions are BYTE-identical to direct
+  ``Booster.predict``; ``quality=off`` arms nothing (one attribute
+  check) and the serving program lowers byte-identical StableHLO
+  across quality modes.
+- A stale profile (fingerprint mismatch) is REFUSED, never silently
+  monitored against.
+- Serving drift past ``quality_drift_refit_threshold`` lands in the
+  continuous lane's ledger-committed drift tally and flips the next
+  cycle to refit (the r16 ``continuous_drift_refit_threshold``
+  machinery, now fed by LIVE traffic).
+- Scheduled cycles (``continuous_cycle_interval_s``) fire on a
+  ledger-committed due time against an injectable clock.
+"""
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.binning import BinMapper
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.quality import (ProfileMismatch, QualityProfile,
+                                  ServingQualityMonitor, maybe_monitor,
+                                  profile_path, psi)
+from lightgbm_tpu.quality.profile import (feature_bin_counts,
+                                          psi_group_bounds, psi_grouped,
+                                          score_counts, strided_rows)
+from lightgbm_tpu.serving import ModelRegistry, ServingFrontend
+from lightgbm_tpu.telemetry import TELEMETRY
+
+PARAMS = {"objective": "regression", "verbose": -1, "num_leaves": 15,
+          "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    yield
+    TELEMETRY.flight.disarm()
+    TELEMETRY.stop_metrics_server()
+
+
+def _train(n=400, f=5, seed=0, iters=5, quality="on", **extra):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] - 0.4 * X[:, 1]
+    p = dict(PARAMS, quality=quality, **extra)
+    return lgb.train(p, lgb.Dataset(X, label=y), iters,
+                     verbose_eval=False), X, y
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One trained model + profile + saved sidecar, shared across the
+    module (training dominates this suite's wall otherwise)."""
+    import tempfile
+    bst, X, y = _train()
+    d = tempfile.mkdtemp(prefix="ltpu_quality_")
+    path = os.path.join(d, "model.txt")
+    bst.save_model(path)
+    return bst, X, y, path
+
+
+def _cfg(**over):
+    base = {"verbose": -1, "quality_sample_rate": 1.0}
+    base.update(over)
+    return Config.from_params(base)
+
+
+# ---------------------------------------------------------------------------
+# PSI
+# ---------------------------------------------------------------------------
+def test_psi_exact_on_crafted_shift():
+    """No empty bucket → the eps floor is a no-op and psi() equals
+    the closed-form sum((q-p)ln(q/p))."""
+    ref = np.array([10, 20, 30, 40], dtype=np.int64)
+    cur = np.array([40, 30, 20, 10], dtype=np.int64)
+    expect = sum((c / 100 - r / 100) * math.log((c / 100) / (r / 100))
+                 for r, c in zip(ref, cur))
+    assert psi(ref, cur) == pytest.approx(expect, abs=1e-12)
+    # symmetric by construction of the formula
+    assert psi(cur, ref) == pytest.approx(expect, abs=1e-12)
+
+
+def test_psi_identical_and_degenerate():
+    ref = np.array([5, 5, 5, 5])
+    assert psi(ref, ref) == 0.0
+    assert psi(ref, ref * 7) == 0.0          # scale-invariant
+    assert psi(np.zeros(4), ref) == 0.0      # empty side → no signal
+    with pytest.raises(ValueError):
+        psi(np.ones(3), np.ones(4))
+
+
+def test_psi_grouped_bounds_and_bias():
+    """Grouping merges a fine-grained histogram into <= PSI_BUCKETS
+    equal-reference-mass groups (deterministic, reference-only), and
+    kills the small-sample bias that made fine-grained PSI read ~1 on
+    IDENTICAL distributions."""
+    fine = np.arange(1, 256, dtype=np.int64)   # monotone ramp
+    b = psi_group_bounds(fine)
+    assert b[0] == 0 and len(b) <= 16
+    assert np.array_equal(b, psi_group_bounds(fine))  # deterministic
+    # a sparse strided sample of a uniform distribution (3 of 4 fine
+    # buckets empty): grouped PSI stays near zero while fine-grained
+    # PSI blows past any threshold — the small-sample bias the
+    # grouping exists to remove
+    uniform = np.full(255, 4, dtype=np.int64)
+    sparse = np.zeros(255, dtype=np.int64)
+    sparse[::4] = 4
+    assert psi_grouped(uniform, sparse) < 0.05
+    assert psi(uniform, sparse) > 1.0
+    # a genuine shape change still screams through the grouping
+    assert psi_grouped(fine, fine[::-1]) > 0.5
+    # a DOMINANT bin (zero-heavy sparse feature: 95%+ of mass in the
+    # default bin) must keep its own group — quantile-style cuts
+    # would collapse the reference to one group and leave the monitor
+    # permanently PSI-blind on the feature
+    dom = np.zeros(64, dtype=np.int64)
+    dom[0] = 970
+    dom[1:31] = 1
+    assert len(psi_group_bounds(dom)) >= 2
+    moved = np.zeros(64, dtype=np.int64)
+    moved[0] = 500
+    moved[40:50] = 50
+    assert psi_grouped(dom, moved) > 0.2
+    assert psi_grouped(dom, dom) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# profile capture
+# ---------------------------------------------------------------------------
+def test_profile_feature_counts_match_value_to_bin():
+    """The group-column bincount reconstruction == a direct
+    per-feature value_to_bin bincount, categoricals included."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(500, 4)
+    X[:, 3] = rng.randint(0, 6, size=500)     # categorical column
+    core = lgb.Dataset(X, label=X[:, 0], free_raw_data=False,
+                       categorical_feature=[3]).construct(
+        Config.from_params({"verbose": -1}))
+    counts = feature_bin_counts(core)
+    for f in core.features:
+        j = f.feature_idx
+        m = core.mappers[j]
+        direct = np.bincount(np.asarray(m.value_to_bin(X[:, j])),
+                             minlength=m.num_bin)
+        assert np.array_equal(counts[j], direct), f"feature {j}"
+
+
+def test_mapper_state_roundtrip_bit_identical():
+    probe = np.concatenate([
+        np.random.RandomState(2).randn(300) * 10,
+        [np.nan, np.inf, -np.inf, 0.0]])
+    num = BinMapper()
+    vals = np.random.RandomState(0).randn(1000)
+    vals[::7] = np.nan
+    num.find_bin(vals, 1000, 32, 3, 20)
+    cat = BinMapper()
+    from lightgbm_tpu.binning import BIN_CATEGORICAL
+    cat.find_bin(np.random.RandomState(1).randint(0, 9, 800).astype(
+        float), 800, 32, 3, 20, bin_type=BIN_CATEGORICAL)
+    for m in (num, cat):
+        # through an actual JSON trip, like the profile file
+        m2 = BinMapper.from_state(json.loads(json.dumps(m.to_state())))
+        assert np.array_equal(m.value_to_bin(probe),
+                              m2.value_to_bin(probe))
+
+
+def test_profile_save_load_roundtrip_and_schema(tmp_path, trained):
+    bst, X, y, path = trained
+    prof = bst.quality_profile
+    assert prof is not None
+    p = str(tmp_path / "p.quality.json")
+    prof.save(p)
+    back = QualityProfile.load(p)
+    assert back.fingerprint == prof.fingerprint
+    assert set(back.features) == set(prof.features)
+    for j in prof.features:
+        assert np.array_equal(back.features[j]["counts"],
+                              prof.features[j]["counts"])
+    assert back.score["edges"] == prof.score["edges"]
+    assert np.array_equal(back.score["counts"], prof.score["counts"])
+    assert back.leaves["source"] == prof.leaves["source"]
+    for a, b in zip(back.leaves["counts"], prof.leaves["counts"]):
+        assert np.array_equal(a, b)
+    # unreadable schema refuses loudly
+    bad = json.loads(open(p).read())
+    bad["schema"] = 99
+    with pytest.raises(ValueError, match="schema"):
+        QualityProfile.from_dict(bad)
+
+
+def test_profile_fingerprint_mismatch_refusal(tmp_path, trained):
+    bst, X, y, path = trained
+    other, _, _ = _train(seed=9, iters=3, quality="off")
+    with pytest.raises(ProfileMismatch):
+        bst.quality_profile.verify(other.model_to_string())
+    # maybe_monitor: a stale sidecar (profile of ANOTHER model) is
+    # refused, not monitored against
+    mp = str(tmp_path / "other.txt")
+    other.save_model(mp)
+    bst.quality_profile.save(profile_path(mp))
+    assert maybe_monitor(mp, other, _cfg(), "other") is None
+    # and the matching one arms
+    assert maybe_monitor(path, bst, _cfg(), "m") is not None
+    # a fingerprint-MATCHING sidecar with a malformed mapper record
+    # degrades to monitors-off (warn), never crashes the publish
+    broken = json.load(open(profile_path(path)))
+    first = next(iter(broken["features"]))
+    del broken["features"][first]["mapper"]["num_bin"]
+    broken_model = str(tmp_path / "broken.txt")
+    bst.save_model(broken_model)
+    json.dump(broken, open(profile_path(broken_model), "w"))
+    assert maybe_monitor(broken_model, bst, _cfg(), "b") is None
+
+
+def test_sidecar_saved_only_for_full_model(tmp_path, trained):
+    bst, X, y, path = trained
+    assert os.path.exists(profile_path(path))
+    # a num_iteration-sliced save writes NO sidecar (the text is not
+    # the profiled model — serving it against the profile would be a
+    # fingerprint refusal anyway)
+    sliced = str(tmp_path / "sliced.txt")
+    bst.save_model(sliced, num_iteration=2)
+    assert not os.path.exists(profile_path(sliced))
+
+
+def test_quality_auto_skips_capture():
+    bst, _, _ = _train(seed=4, iters=2, quality="auto")
+    assert bst.quality_profile is None
+
+
+def test_strided_sample_retained_when_raw_freed():
+    """free_raw_data=True + quality=on: the profile's leaf reference
+    still comes from pred_leaf over the retained strided sample."""
+    bst, X, y = _train(n=300, iters=3, quality="on",
+                       quality_profile_rows=64)
+    prof = bst.quality_profile
+    assert prof.leaves["source"] == "pred_leaf"
+    assert 0 < prof.leaves["sample_rows"] <= 64
+
+
+# ---------------------------------------------------------------------------
+# serving monitors
+# ---------------------------------------------------------------------------
+def test_deterministic_sampler_replay(trained):
+    """The counter-strided sampler depends only on arrival order:
+    the same stream split into different batch shapes yields
+    IDENTICAL monitor counts (what makes replays comparable)."""
+    bst, X, y, path = trained
+    cfg = _cfg(quality_sample_rate=1 / 3)
+    preds = np.asarray(bst.predict(X))
+
+    def run(splits):
+        m = ServingQualityMonitor(bst.quality_profile, bst, cfg,
+                                  name="m")
+        s = 0
+        for n in splits:
+            m.observe(X[s:s + n], preds[s:s + n])
+            s += n
+        return m
+
+    a = run([len(X)])
+    b = run([7, 100, 1, 3, 150, len(X) - 261])
+    assert a._sampled == b._sampled > 0
+    for j in a._feat_counts:
+        assert np.array_equal(a._feat_counts[j], b._feat_counts[j])
+    assert a._score_hist.counts == b._score_hist.counts
+    for ca, cb in zip(a._leaf_counts, b._leaf_counts):
+        assert np.array_equal(ca, cb)
+
+
+def test_monitor_on_predictions_byte_identical(trained):
+    bst, X, y, path = trained
+    reg = ModelRegistry(_cfg())
+    try:
+        entry = reg.publish("m", path)
+        assert entry.monitor is not None
+        assert entry.batcher.observer is not None
+        _, out = reg.predict("m", X[:100])
+        direct = np.asarray(entry.booster.predict(X[:100]))
+        assert np.array_equal(np.asarray(out).reshape(-1),
+                              direct.reshape(-1))
+        # observation runs post-release on the dispatcher thread —
+        # quiesce before reading the monitor
+        assert entry.monitor.wait_observed(100)
+        assert entry.monitor._sampled >= 100
+    finally:
+        reg.close()
+
+
+def test_quality_off_is_one_attribute_check(trained):
+    bst, X, y, path = trained
+    reg = ModelRegistry(_cfg(quality="off"))
+    try:
+        entry = reg.publish("m", path)
+        assert entry.monitor is None
+        assert entry.batcher.observer is None
+        assert reg.describe()["m"]["quality"] is None
+    finally:
+        reg.close()
+    # sample_rate=0 disarms too, profile or not
+    reg = ModelRegistry(_cfg(quality_sample_rate=0.0))
+    try:
+        assert reg.publish("m", path).monitor is None
+    finally:
+        reg.close()
+
+
+def _lowered_serving_text():
+    """The serving program's lowered StableHLO (the test_telemetry
+    idiom): quality must never reach into a jitted body."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import predict as P
+    from lightgbm_tpu.tree import flatten_ensemble
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(200, 5)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "num_leaves": 7, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=X[:, 0]), 3,
+                    verbose_eval=False)
+    flat = flatten_ensemble(bst.models, 1)
+    depth = int(flat.pop("depth"))
+    stack = P.LevelEnsemble(**{k: jnp.asarray(v)
+                               for k, v in flat.items()})
+    x2 = jnp.zeros((16, 10), jnp.float32)
+    return P.predict_level_ensemble.lower(stack, x2,
+                                          depth=depth).as_text()
+
+
+def test_off_mode_hlo_identity_quality():
+    """quality=off|auto|on lower BYTE-identical StableHLO for the
+    serving program: every monitor lives at host seams (the batcher's
+    post-dispatch observer), never inside a compiled body."""
+    Config.from_params({"verbose": -1, "quality": "off"})
+    base = _lowered_serving_text()
+    Config.from_params({"verbose": -1, "quality": "on",
+                        "quality_sample_rate": 1.0})
+    assert _lowered_serving_text() == base, (
+        "quality=on changed the lowered serving program")
+    Config.from_params({"verbose": -1, "quality": "auto",
+                        "quality_sample_rate": 0.5})
+    assert _lowered_serving_text() == base, (
+        "quality=auto changed the lowered serving program")
+
+
+def test_drift_detection_warn_once_flight_and_gauges(tmp_path,
+                                                     trained):
+    bst, X, y, path = trained
+    TELEMETRY.flight.arm(str(tmp_path / "flight"))
+    reg = ModelRegistry(_cfg(quality_psi_warn=0.2))
+    try:
+        entry = reg.publish("m", path)
+        reg.predict("m", X)                      # in-distribution
+        assert entry.monitor.wait_observed(len(X))
+        rep = entry.monitor.report()
+        assert rep["worst_feature_psi"] < 0.2
+        assert not rep["warned"]
+        Xs = np.array(X)
+        Xs[:, 2] += 8.0                          # shifted stream
+        reg.predict("m", Xs)
+        reg.predict("m", Xs)                     # second breach batch
+        assert entry.monitor.wait_observed(3 * len(X))
+        rep = entry.monitor.report()
+        assert rep["worst_feature"] == 2
+        assert rep["worst_feature_psi"] > 0.2
+        assert rep["warned"]
+        # warn-once: two breaching batches, ONE warn + ONE flight dump
+        assert TELEMETRY.counters()["quality_drift_warns"] == 1
+        dumps = [p for p in TELEMETRY.flight.dumps]
+        assert len(dumps) == 1
+        d = json.load(open(dumps[0]))
+        assert d["reason"] == "quality_drift"
+        assert d["worst_feature"] == 2
+        # gauges on the Prometheus surface
+        prom = TELEMETRY.to_prometheus()
+        assert "ltpu_quality_worst_feature_psi_m" in prom
+        assert "ltpu_quality_score_psi_m" in prom
+        assert "ltpu_quality_psi_m_f2" in prom
+        # one pane of glass: /models carries the live quality block
+        q = reg.describe()["m"]["quality"]
+        assert q["worst_feature"] == "f2"
+        assert q["worst_feature_psi"] > 0.2
+        assert q["sampled_rows"] == entry.monitor._sampled
+    finally:
+        reg.close()
+
+
+def test_quality_http_endpoint(trained):
+    bst, X, y, path = trained
+    reg = ModelRegistry(_cfg())
+    frontend = ServingFrontend(reg, _cfg())
+    try:
+        reg.publish("m", path)
+        srv = frontend.start(port=0)
+        port = srv.server_address[1]
+        reg.predict("m", X[:50])
+        assert reg.get("m").monitor.wait_observed(50)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/quality/m", timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["model"] == "m"
+        assert body["sampled_rows"] >= 50
+        assert len(body["features"]) == 5
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/quality/nope", timeout=30)
+        assert ei.value.code == 404
+        # /models carries the same summary over HTTP
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/models", timeout=30) as r:
+            models = json.loads(r.read())
+        assert models["m"]["quality"]["sampled_rows"] >= 50
+    finally:
+        frontend.stop(drain=True)
+
+
+
+# ---------------------------------------------------------------------------
+# drift→refit loop + scheduled cycles
+# ---------------------------------------------------------------------------
+def _lane(tmp_path, base, X, y, registry=None, **cfg_extra):
+    from lightgbm_tpu.continuous import ContinuousLane
+    ingest = str(tmp_path / "ingest")
+    os.makedirs(ingest, exist_ok=True)
+    params = dict(PARAMS, num_leaves=7)
+    cfg = Config.from_params(dict(
+        params, continuous_ingest_dir=ingest,
+        continuous_iterations=2, continuous_eval_holdout=0.25,
+        **cfg_extra))
+    lane = ContinuousLane(cfg, registry, name="m", base_model=base,
+                          base_data=X, base_label=y,
+                          train_params=dict(params))
+    lane._base_model_path()
+    return lane, ingest
+
+
+def test_serving_drift_feeds_ledger_and_flips_refit(tmp_path):
+    """End to end: a shifted serving stream drives a per-feature PSI
+    past quality_drift_refit_threshold, the monitor reports into the
+    lane's ledger drift tally, and the NEXT cycle trains in refit
+    mode (continuous_drift_refit_threshold=1)."""
+    from lightgbm_tpu.continuous import ContinuousLane  # noqa: F401
+    bst, X, y = _train(n=300, iters=3, quality="on",
+                       **{"num_leaves": 7})
+    cfg = _cfg(quality_drift_refit_threshold=0.5)
+    reg = ModelRegistry(cfg)
+    lane, ingest = _lane(tmp_path, bst, X, y, registry=reg,
+                         continuous_drift_refit_threshold=1)
+    try:
+        reg.publish("m", bst)          # in-memory profile attaches
+        entry = reg.get("m")
+        assert entry.monitor is not None
+        # what ContinuousLane.start() installs (no worker thread in
+        # the test — the hook is the contract)
+        reg.on_quality_drift = lane.report_serving_drift
+        Xs = np.array(X)
+        Xs[:, 3] += 9.0
+        reg.predict("m", Xs)
+        assert entry.monitor.wait_observed(len(X))
+        led = json.load(open(os.path.join(lane.state_dir,
+                                          "ledger.json")))
+        assert led["drift_slices"] == 1
+        assert led["serving_drift_reports"] == 1
+        c = TELEMETRY.counters()
+        assert c["quality_refit_reports"] == 1
+        assert c["continuous_serving_drift_reports"] == 1
+        # one report per breach episode: more drifted traffic does
+        # NOT double-report
+        reg.predict("m", Xs)
+        assert entry.monitor.wait_observed(2 * len(X))
+        led = json.load(open(os.path.join(lane.state_dir,
+                                          "ledger.json")))
+        assert led["serving_drift_reports"] == 1
+        # drop a (non-drifted) slice; the committed cycle mode flips
+        # to refit off the serving-fed tally and the tally resets
+        rng = np.random.RandomState(5)
+        Xn = rng.randn(60, 5)
+        yn = Xn[:, 0] - 0.4 * Xn[:, 1]
+        np.savetxt(os.path.join(ingest, "s1.csv"),
+                   np.column_stack([yn, Xn]), delimiter=",")
+        lane.run_cycle()
+        led = json.load(open(os.path.join(lane.state_dir,
+                                          "ledger.json")))
+        assert led["cycle_mode"] == "refit"
+        assert led["drift_slices"] == 0
+        assert TELEMETRY.counters()["continuous_drift_refits"] == 1
+        # symmetric teardown: stop() uninstalls the hook start()
+        # installed (bound-method equality — `is` would never match)
+        lane.stop(timeout_s=1.0)
+        assert reg.on_quality_drift is None
+    finally:
+        reg.close()
+
+
+def test_scheduled_cycles_ledger_committed_injectable_clock(tmp_path):
+    bst, X, y = _train(n=300, iters=3, quality="off",
+                       **{"num_leaves": 7})
+    now = [5000.0]
+    from lightgbm_tpu.continuous import ContinuousLane
+    ingest = str(tmp_path / "ingest")
+    os.makedirs(ingest, exist_ok=True)
+    params = dict(PARAMS, num_leaves=7)
+    cfg = Config.from_params(dict(
+        params, continuous_ingest_dir=ingest,
+        continuous_iterations=2, continuous_eval_holdout=0.25,
+        continuous_cycle_interval_s=60.0))
+    lane = ContinuousLane(cfg, None, name="m", base_model=bst,
+                          base_data=X, base_label=y,
+                          train_params=dict(params),
+                          clock=lambda: now[0])
+    lane._base_model_path()
+    # what start() arms (no worker thread in the test)
+    lane._commit(next_cycle_unix=now[0] + 60.0)
+    assert not lane.scheduled_due()
+    assert lane.run_scheduled_cycle() is None
+    now[0] += 61.0
+    assert lane.scheduled_due()
+    rec = lane.run_scheduled_cycle()
+    # a scheduled fire behaves like force_cycle: the continue-mode
+    # cycle ran with NO new slices in the ingest dir
+    assert rec is not None
+    led = json.load(open(os.path.join(lane.state_dir, "ledger.json")))
+    assert led["next_cycle_unix"] == pytest.approx(now[0] + 60.0)
+    assert lane.status()["cycle_interval_s"] == 60.0
+    assert TELEMETRY.counters()["continuous_scheduled_cycles"] == 1
+    # not due again until the clock advances
+    assert lane.run_scheduled_cycle() is None
+
+
+# ---------------------------------------------------------------------------
+# report CLI
+# ---------------------------------------------------------------------------
+def test_report_cli_json_markdown_and_rc(tmp_path, trained, capsys):
+    from lightgbm_tpu.quality.__main__ import main
+    bst, X, y, path = trained
+    ok_csv = str(tmp_path / "ok.csv")
+    np.savetxt(ok_csv, np.column_stack([y, X]), delimiter=",")
+    Xs = np.array(X)
+    Xs[:, 1] += 9.0
+    bad_csv = str(tmp_path / "bad.csv")
+    np.savetxt(bad_csv, np.column_stack([y, Xs]), delimiter=",")
+    prof = profile_path(path)
+    # clean data: rc 0, JSON body, score PSI present with --model
+    rc = main(["report", prof, ok_csv, "--model", path, "verbose=-1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rep = json.loads(out)
+    assert rep["drifted_features"] == []
+    assert "score_psi" in rep
+    # shifted data: rc 1, the drifted feature named, markdown renders
+    md_path = str(tmp_path / "rep.md")
+    rc = main(["report", prof, bad_csv, "--markdown", "-o", md_path,
+               "verbose=-1"])
+    assert rc == 1
+    md = open(md_path).read()
+    assert "DRIFTED" in md and "(f1)" in md
+    # usage errors: rc 2
+    assert main([]) == 2
+    assert main(["report", prof]) == 2
+    # a current file NARROWER than the profiled feature set is a loud
+    # rc-2 refusal, not a silently-clean rc-0 report missing the
+    # (possibly drifted) lost columns
+    capsys.readouterr()
+    narrow = str(tmp_path / "narrow.csv")
+    np.savetxt(narrow, np.column_stack([y, X[:, :3]]), delimiter=",")
+    assert main(["report", prof, narrow, "verbose=-1"]) == 2
+    # a stale profile (wrong model) is a TOOL error (rc 2), never the
+    # rc-1 "drift detected" code a cron wrapper pages on
+    other, _, _ = _train(seed=13, iters=2, quality="off")
+    other_path = str(tmp_path / "other_model.txt")
+    other.save_model(other_path)
+    assert main(["report", prof, ok_csv, "--model", other_path,
+                 "verbose=-1"]) == 2
+
+
+def test_score_counts_le_semantics():
+    """score_counts matches the telemetry histograms' bisect_left
+    bucketing exactly (a value ON an edge lands in that edge's
+    bucket)."""
+    from lightgbm_tpu.telemetry import Hist
+    edges = [0.0, 1.0, 2.0]
+    vals = np.array([-1.0, 0.0, 0.5, 1.0, 1.5, 2.0, 3.0])
+    h = Hist(edges)
+    for v in vals:
+        h.observe(float(v))
+    assert list(score_counts(vals, edges)) == h.counts
+    h2 = Hist(edges)
+    h2.observe_many(vals)
+    assert h2.counts == h.counts and h2.count == len(vals)
+
+
+def test_strided_rows_deterministic():
+    X = np.arange(100).reshape(50, 2)
+    a = strided_rows(X, 10)
+    assert np.array_equal(a, strided_rows(X, 10))
+    assert len(a) <= 10
+    assert np.array_equal(strided_rows(X, 64), X)
+    # a copy, not a view into the (about to be freed) matrix
+    assert strided_rows(X, 64).base is None
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-v"]))

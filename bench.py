@@ -1159,6 +1159,100 @@ def run_shard_construct(params):
     }
 
 
+def run_compact_bins(params, rows=None):
+    """Sub-byte packed bin matrix roofline point (round 18, ROADMAP
+    item 4): the nibble-packed (bin_packing=4bit) pipeline measured
+    against the 8-bit one on the same max_bin=15 draw.
+
+    Reports construct rows/s per mode (the pack adds one fused
+    byte-combine pass over each chunk — gate: within ~0.9x), the
+    HOST matrix bytes and the GAUGE-measured device bin-matrix bytes
+    (``bin_matrix_bytes``, rows_padded x storage cols), and an
+    analytic histogram bytes-read-per-row model (the packed stream the
+    tiled kernels actually read).  Hard gates: >= 2x packing ratio at
+    max_bin=15 (28 dense feature groups -> exactly 2x) and
+    byte-identical trees across modes."""
+    import re as _re
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.telemetry import TELEMETRY
+
+    if rows is None:        # standalone use; main() passes the
+        rows = int(os.environ.get("BENCH_COMPACT_ROWS",  # admitted count
+                                  min(BENCH_ROWS, 500_000)))
+    X, y, _ = make_data(rows, BENCH_FEATURES, seed=43)
+    base = {"objective": "binary", "num_leaves": params["num_leaves"],
+            "max_bin": 15, "num_iterations": 2, "min_data_in_leaf": 5,
+            "telemetry": "counters", "verbose": -1}
+
+    out = {"task": "compact_bins", "rows": rows,
+           "features": BENCH_FEATURES, "max_bin": 15}
+    host_bytes = {}
+    device_bytes = {}
+    trees = {}
+    for mode in ("8bit", "4bit"):
+        p = dict(base, bin_packing=mode)
+        gc.collect()
+        rss0 = _rss_mb()
+        t0 = time.time()
+        dset = lgb.Dataset(X, label=y).construct(
+            lgb.config.Config.from_params(p))
+        construct_s = time.time() - t0
+        host_bytes[mode] = int(np.asarray(dset.group_bins).nbytes)
+        out[f"construct_s_{mode}"] = round(construct_s, 3)
+        out[f"construct_rows_per_s_{mode}"] = round(
+            rows / max(construct_s, 1e-9))
+        out[f"rss_delta_mb_{mode}"] = round(
+            max(0.0, _rss_mb() - rss0), 1)
+        wrapped = lgb.Dataset(X, label=y, params=p)
+        wrapped._core = dset
+        booster = lgb.train(p, wrapped)
+        g = TELEMETRY.snapshot().get("gauges", {})
+        device_bytes[mode] = int(g.get("bin_matrix_bytes", 0))
+        trees[mode] = _re.sub(r"\[bin_packing: \w+\]", "",
+                              booster.model_to_string())
+        del dset, wrapped, booster
+        gc.collect()
+
+    out["host_matrix_bytes_8bit"] = host_bytes["8bit"]
+    out["host_matrix_bytes_4bit"] = host_bytes["4bit"]
+    out["bin_matrix_bytes_8bit"] = device_bytes["8bit"]
+    out["bin_matrix_bytes_4bit"] = device_bytes["4bit"]
+    out["packing_ratio"] = round(
+        host_bytes["8bit"] / max(host_bytes["4bit"], 1), 3)
+    out["device_packing_ratio"] = round(
+        device_bytes["8bit"] / max(device_bytes["4bit"], 1), 3)
+    out["construct_ratio_4bit_vs_8bit"] = round(
+        out["construct_rows_per_s_4bit"]
+        / max(out["construct_rows_per_s_8bit"], 1), 3)
+    # analytic histogram bytes-read model: the tiled/fused kernels
+    # stream the (transposed) bin matrix + 16 weight/leaf bytes per
+    # row per pass — packing halves the bins term, the whole
+    # bandwidth story at max_bin <= 16
+    g8, g4 = BENCH_FEATURES, (BENCH_FEATURES + 1) // 2
+    out["hist_bytes_per_row_8bit"] = g8 + 16
+    out["hist_bytes_per_row_4bit"] = g4 + 16
+    out["hist_stream_ratio"] = round((g8 + 16) / (g4 + 16), 3)
+
+    if out["packing_ratio"] < 2.0 - 1e-9:
+        raise SystemExit(
+            f"compact_bins packing gate failed: host ratio "
+            f"{out['packing_ratio']} < 2.0 at max_bin=15 "
+            f"({BENCH_FEATURES} dense groups must pack two per byte)")
+    if device_bytes["8bit"] and device_bytes["4bit"] \
+            and out["device_packing_ratio"] < 1.8:
+        # padded rows are identical across modes, so the device ratio
+        # only dips below 2.0 through an odd group count
+        raise SystemExit(
+            "compact_bins device gate failed: bin_matrix_bytes ratio "
+            f"{out['device_packing_ratio']} < 1.8")
+    if trees["8bit"] != trees["4bit"]:
+        raise SystemExit("compact_bins parity gate failed: trees "
+                         "differ between bin_packing=8bit and 4bit")
+    out["parity"] = "pass"
+    return out
+
+
 def run_predict_scale(params):
     """Serving roofline point: bulk scoring throughput, micro-batch
     p50 latency and the compile count of the shape-bucketed device
@@ -1666,6 +1760,22 @@ def main():
         else:
             shard_block = {"task": "shard_construct", "rows": s_rows,
                            "skipped": note}
+    compact_block = None
+    if os.environ.get("BENCH_COMPACT", "1") != "0":
+        cb_rows = int(os.environ.get("BENCH_COMPACT_ROWS",
+                                     min(BENCH_ROWS, 500_000)))
+        # two constructions + two tiny (2-iteration) trainings; same
+        # per-row ceiling as the construct block, doubled for the two
+        # modes
+        est = max(10.0, 40.0 * cb_rows / 1e6)
+        note = admit("compact_bins", est)
+        if note is None:
+            # the admitted cb_rows feeds the run too, so admission and
+            # workload can never diverge
+            compact_block = run_compact_bins(params, rows=cb_rows)
+        else:
+            compact_block = {"task": "compact_bins", "rows": cb_rows,
+                             "skipped": note}
     if budget_left() > 60 + FINISH_RESERVE_S:
         higgs = run_higgs_real(params)
         if higgs is not None:
@@ -1713,6 +1823,12 @@ def main():
         # shard-cache round trip — parity-gated against the
         # single-matrix construction inside the block
         result["shard_construct"] = shard_block
+    if compact_block is not None:
+        # the sub-byte packed-bin block (round 18): construct rows/s
+        # per bin width, host + gauge-measured device matrix bytes,
+        # the histogram bytes-read model — packing-ratio- and
+        # tree-parity-gated inside the block
+        result["compact_bins"] = compact_block
     if "chunk_slope" in primary:
         # the round-6/7 per-iteration chunk-slope fit and what
         # dispatch_chunk=auto would pick locally and on an axon-RPC
@@ -1792,6 +1908,19 @@ def main():
                   f"vs_single={sb['vs_single_matrix']}x "
                   f"rss={sb['rss_sharded_mb']}MB "
                   f"(single {sb['rss_single_mb']}MB)", file=sys.stderr)
+    if compact_block is not None:
+        if "skipped" in compact_block:
+            print(f"compact_bins skipped: {compact_block['skipped']}",
+                  file=sys.stderr)
+        else:
+            cb = compact_block
+            print(f"compact_bins rows={cb['rows']} "
+                  f"ratio={cb['packing_ratio']}x "
+                  f"(device {cb['device_packing_ratio']}x) "
+                  f"construct 4bit/8bit="
+                  f"{cb['construct_ratio_4bit_vs_8bit']}x "
+                  f"hist_stream={cb['hist_stream_ratio']}x "
+                  f"parity={cb['parity']}", file=sys.stderr)
     if predict_block is not None:
         if "skipped" in predict_block:
             print(f"predict skipped: {predict_block['skipped']}",

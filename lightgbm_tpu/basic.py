@@ -59,7 +59,10 @@ class Dataset:
             # wherever a text file would
             from .dataset_io import is_binary_file, load_binary
             if is_binary_file(data):
-                self._core = load_binary(data)
+                # the run's bin_packing intent is checked against the
+                # cache's recorded storage layout (loud mismatch
+                # refusal — see dataset_io._check_packing)
+                self._core = load_binary(data, config=config)
                 if self.label is not None:
                     self._core.metadata.set_label(self.label)
                 if self.weight is not None:

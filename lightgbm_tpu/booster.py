@@ -50,9 +50,11 @@ def _acc_fn():
         import jax
         from .ops.predict import predict_binned
 
-        @functools.partial(jax.jit, static_argnames=("max_steps",))
+        @functools.partial(jax.jit, static_argnames=("max_steps",
+                                                     "packed_groups"))
         def acc(total, stack, shrink_arr, vbins, f_group, g2f_lut,
-                f_missing, f_default_bin, f_num_bin, *, max_steps):
+                f_missing, f_default_bin, f_num_bin, *, max_steps,
+                packed_groups=0):
             from .telemetry import TELEMETRY
             TELEMETRY.note_trace("predict.binned_scan",
                                  (vbins.shape, max_steps))
@@ -61,7 +63,8 @@ def _acc_fn():
                 tr, sh = xs
                 pv = predict_binned(tr, vbins, f_group, g2f_lut,
                                     f_missing, f_default_bin, f_num_bin,
-                                    max_steps=max_steps)
+                                    max_steps=max_steps,
+                                    packed_groups=packed_groups)
                 return carry + sh * pv, None
             out, _ = jax.lax.scan(body, total, (stack, shrink_arr))
             return out
@@ -745,7 +748,8 @@ class Booster:
         def acc_jit(total, part, sh):
             return acc(total, part, sh, vbins, gr.f_group, gr.g2f_lut,
                        gr.f_missing, gr.f_default_bin, gr.f_num_bin,
-                       max_steps=cfg.num_leaves)
+                       max_steps=cfg.num_leaves,
+                       packed_groups=gr.pack_P)
         # iter-0 trained in session => the boost_from_average bias is
         # NOT folded into the device trees (flush folds it host-side)
         total = jnp.full(vbins.shape[0], np.float32(g.init_score))

@@ -272,6 +272,22 @@ class GBDT:
                       "the training data — create it with "
                       "reference=<train dataset> (its own bin mappers "
                       "differ from the training mappers)")
+        if self.train_set is not None and valid_set is not self.train_set:
+            # storage-layout gate: equal feature_infos no longer imply
+            # an equal matrix layout — the same data constructed under
+            # a different bin_packing packs (and group-reorders)
+            # differently, and _predict_valid walks the valid matrix
+            # with the TRAINING set's packed_groups
+            def _lay(ds):
+                lay = getattr(ds, "bin_layout", None)
+                return lay.to_state() if lay is not None else None
+            if _lay(valid_set) != _lay(self.train_set):
+                Log.fatal(
+                    f"validation set {name!r} has a different bin-"
+                    f"matrix storage layout ({_lay(valid_set)}) than "
+                    f"the training data ({_lay(self.train_set)}) — "
+                    "construct it with reference=<train dataset> or "
+                    "the same bin_packing setting")
         metrics = create_metrics(self.config)
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
@@ -346,10 +362,14 @@ class GBDT:
         return scores.at[class_idx].add(delta)
 
     def _predict_valid(self, tree: TreeArrays, bins):
+        # train and reference-aligned validation matrices share ONE
+        # storage layout (dataset alignment copies bin_layout), so the
+        # grower's packed_groups applies to both
         g = self.grower
         return predict_binned(tree, bins, g.f_group, g.g2f_lut, g.f_missing,
                               g.f_default_bin, g.f_num_bin,
-                              max_steps=self.config.num_leaves)
+                              max_steps=self.config.num_leaves,
+                              packed_groups=g.pack_P)
 
     # ------------------------------------------------------------------
     # hooks for DART/GOSS/RF subclasses --------------------------------

@@ -323,6 +323,20 @@ class Config:
     # 1 reproduces the serial path exactly — results are
     # byte-identical at EVERY setting (parallelism is across
     # features/row-blocks, never inside one reduction)
+    bin_packing: str = "8bit"  # bin-matrix storage width
+    # (lightgbm_tpu/packing.py): "8bit" stores one group per uint8
+    # byte (legacy layout, every existing cache); "4bit" nibble-packs
+    # two <=16-bin groups per byte end to end — host matrix, caches,
+    # device HBM and the histogram kernels' read stream all halve
+    # (requires max_bin <= 16; trees are byte-identical to the 8-bit
+    # path on every packed-capable kernel route — tiled/fused/
+    # streamed-one-hot/XLA, i.e. every default selection; the two
+    # Pallas formulations without a packed input path, paired and
+    # otf-int8, fall back to XLA with a loud warning and only
+    # f32-level parity); "auto" is adaptive precision — groups whose
+    # fitted bin count fits 4 bits pack even when others don't, via a
+    # two-section (packed + wide) layout.  The resolved device matrix
+    # size is the bin_matrix_bytes telemetry gauge
     binary_cache_v2: bool = True  # save_binary writes the v2 container
     # (magic + schema version + pickled mapper/metadata header + a raw
     # np.memmap-able group_bins section): load_binary maps the bin
@@ -760,7 +774,21 @@ class Config:
         if self.max_bin < 2:
             raise ValueError("max_bin must be >= 2")
         if self.max_bin > 256:
-            raise ValueError("max_bin must be <= 256 (uint8 packed bin matrix)")
+            raise ValueError(
+                "max_bin must be <= 256 (bin_packing=8bit stores one "
+                "group bin per uint8 byte; bin_packing=4bit/auto packs "
+                "two <=16-bin groups per byte but never widens past a "
+                "byte)")
+        if str(self.bin_packing).lower() not in ("auto", "8bit", "4bit"):
+            raise ValueError("bin_packing must be auto/8bit/4bit, got "
+                             f"{self.bin_packing!r}")
+        if str(self.bin_packing).lower() == "4bit" and self.max_bin > 16:
+            raise ValueError(
+                f"bin_packing=4bit requires max_bin <= 16 (a nibble "
+                f"holds 16 bins), got max_bin={self.max_bin} — lower "
+                "max_bin or use bin_packing=auto, which packs only the "
+                "feature groups that fit and keeps wide groups "
+                "byte-wide")
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError(f"num_class must be >= 2 for {self.objective}")
         if self.objective not in ("multiclass", "multiclassova") and self.num_class != 1:

@@ -23,6 +23,8 @@ from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
                       MISSING_NONE, MISSING_ZERO, BinMapper,
                       find_bin_mappers, resolve_construct_threads)
 from .config import Config
+from .packing import (NIBBLE_MAX_BIN, BinLayout, build_layout,
+                      resolve_bin_packing)
 from .utils.log import Log
 
 
@@ -144,7 +146,11 @@ class Dataset:
         self.mappers: List[BinMapper] = []
         self.used_features: List[int] = []       # real idx of non-trivial features
         self.features: List[FeatureView] = []    # one per used feature
-        self.group_bins: Optional[np.ndarray] = None  # (N, G) uint8
+        # STORAGE bin matrix: (N, G) uint8 when bin_layout is None;
+        # nibble-packed (N, bin_layout.cols) otherwise (packing.py —
+        # the first packed_groups groups ride two per byte)
+        self.group_bins: Optional[np.ndarray] = None
+        self.bin_layout: Optional[BinLayout] = None
         self.group_num_bin: List[int] = []
         self.group_is_multi: List[bool] = []
         self.metadata: Metadata = Metadata(0)
@@ -333,15 +339,19 @@ class Dataset:
         from_reference_for_push): allocate the packed matrix, prefill
         implicit-zero bins so sparse (CSR) pushes only write stored
         entries, and arm the pushed-row counter."""
-        self.group_bins = np.zeros((self.num_data, self.num_groups),
-                                   dtype=np.uint8)
+        self.group_bins = np.zeros(
+            (self.num_data, self._storage_cols()), dtype=np.uint8)
         for f in self.features:
             if not f.collapsed_default:
                 zb = int(np.asarray(
                     self.mappers[f.feature_idx].value_to_bin(
                         np.zeros(1)))[0])
                 if zb != 0:
-                    self.group_bins[:, f.group] = zb
+                    if self.bin_layout is not None:
+                        self.bin_layout.fill_group(self.group_bins,
+                                                   f.group, zb)
+                    else:
+                        self.group_bins[:, f.group] = zb
         self.metadata = Metadata(self.num_data)
         self._categorical_features = categorical_features
         self._resolve_monotone(self.config)
@@ -385,15 +395,39 @@ class Dataset:
             col = m.value_to_bin(vals_s[lo:hi])
             rr = rows_s[lo:hi]
             if not f.collapsed_default:
-                self.group_bins[rr, f.group] = col.astype(np.uint8)
+                self._write_group_rows(f.group, rr,
+                                       col.astype(np.uint8))
             else:
                 gb = col + f.offset
                 if m.default_bin == 0:
                     gb -= 1
                 keep = col != m.default_bin
-                self.group_bins[rr[keep], f.group] = gb[keep].astype(
-                    np.uint8)
+                self._write_group_rows(f.group, rr[keep],
+                                       gb[keep].astype(np.uint8))
         self._pushed_rows = getattr(self, "_pushed_rows", 0) + nrows
+
+    def _write_group_rows(self, group: int, rows, vals) -> None:
+        """Scattered per-group bin write, storage-layout aware (nibble
+        read-modify-write when the group is packed)."""
+        if self.bin_layout is None:
+            self.group_bins[rows, group] = vals
+        else:
+            self.bin_layout.write_group(self.group_bins, group, vals,
+                                        rows=rows)
+
+    def _storage_cols(self) -> int:
+        """Byte columns of the storage bin matrix."""
+        return (self.bin_layout.cols if self.bin_layout is not None
+                else self.num_groups)
+
+    def logical_group_bins(self) -> Optional[np.ndarray]:
+        """The logical (N, G) group-bin view — unpacks a nibble-packed
+        storage matrix (fresh array), passes the legacy matrix through.
+        Parity checks and host-side per-group readers only; the device
+        path streams the STORAGE matrix and unpacks in-register."""
+        if self.group_bins is None or self.bin_layout is None:
+            return self.group_bins
+        return self.bin_layout.unpack_rows(np.asarray(self.group_bins))
 
     def finish_load(self) -> "Dataset":
         """End of streaming pushes (reference FinishLoad)."""
@@ -446,8 +480,25 @@ class Dataset:
             self.group_num_bin = reference.group_num_bin
             self.group_is_multi = reference.group_is_multi
             self._bundles = reference._bundles
+            # aligned datasets share the training set's storage layout
+            # (group order AND nibble packing) — a packed train matrix
+            # with an unpacked validation matrix would split every
+            # device code path in two
+            self.bin_layout = getattr(reference, "bin_layout", None)
             return
         bundles = _find_bundles(self, sample_nonzero, sample_cnt)
+        pack_mode = resolve_bin_packing(self.config)
+        if pack_mode != "8bit" and bundles:
+            # packable-first group order (packing.py two-section
+            # layout): groups whose bin count fits a nibble come
+            # first, wide groups follow.  Stable within each section
+            # (by first feature index, the legacy order), so the
+            # reorder is deterministic; trees are invariant to group
+            # numbering — histograms expand to per-FEATURE space
+            # before the split finder ever sees them
+            bundles.sort(key=lambda b: (
+                0 if _bundle_num_bin(self, b) <= NIBBLE_MAX_BIN else 1,
+                b[0]))
         self._bundles = bundles
         self.features = [None] * 0
         feats: List[FeatureView] = []
@@ -477,11 +528,15 @@ class Dataset:
         # order features by real index for stable downstream numbering
         feats.sort(key=lambda f: f.feature_idx)
         self.features = feats
+        self.bin_layout = build_layout(
+            pack_mode, self.group_num_bin,
+            group_features=bundles,
+            feature_names=self.feature_names)
 
     # ------------------------------------------------------------------
     def _bin_data(self, data: np.ndarray) -> None:
-        self.group_bins = np.zeros((self.num_data, self.num_groups),
-                                   dtype=np.uint8)
+        self.group_bins = np.zeros(
+            (self.num_data, self._storage_cols()), dtype=np.uint8)
         self._bin_rows_dense(data, 0)
 
     def _bin_rows_dense(self, data: np.ndarray, row_start: int) -> None:
@@ -493,11 +548,31 @@ class Dataset:
         categorical lookup (``ltpu_bin_cat``) and EFB bundle
         offset/default-collapse writes (``ltpu_bin_bundle``) — with the
         per-feature Python mapper as the fallback for any feature the
-        library can't take."""
+        library can't take.
+
+        Nibble-packed datasets bin through a bounded LOGICAL scratch
+        chunk and pack it straight into the storage matrix
+        (``ltpu_pack_nibbles`` / the numpy fallback): the full-width
+        8-bit matrix never exists — peak extra memory is one scratch
+        chunk, regardless of N."""
         from .telemetry import TELEMETRY
         out = self.group_bins[row_start:row_start + data.shape[0]]
         with TELEMETRY.span("bin", rows=int(data.shape[0])):
-            self._bin_rows_dense_into(data, out)
+            if self.bin_layout is None:
+                self._bin_rows_dense_into(data, out)
+                return
+            lay = self.bin_layout
+            lib = self._native_lib()
+            step = max(1, int(getattr(self.config,
+                                      "streaming_chunk_rows", 65536)
+                              or 65536))
+            for i in range(0, data.shape[0], step):
+                chunk = np.asarray(data[i:i + step])
+                scratch = np.zeros((chunk.shape[0], self.num_groups),
+                                   dtype=np.uint8)
+                self._bin_rows_dense_into(chunk, scratch)
+                lay.pack_rows(scratch, out=out[i:i + chunk.shape[0]],
+                              lib=lib)
 
     def _bin_rows_dense_into(self, data: np.ndarray, out) -> None:
         native_feats = [f for f in self.features
@@ -716,8 +791,8 @@ class Dataset:
         byte-identical at every thread count."""
         from .telemetry import TELEMETRY
         N = self.num_data
-        G = self.num_groups
-        out = np.zeros((N, G), dtype=np.uint8)
+        lay = self.bin_layout
+        out = np.zeros((N, self._storage_cols()), dtype=np.uint8)
         indptr, indices, values = csc.indptr, csc.indices, csc.data
 
         def bin_feature(f) -> None:
@@ -730,18 +805,33 @@ class Dataset:
                 m.value_to_bin(np.zeros(1)))[0])
             if not f.collapsed_default:
                 if zero_bin != 0:
-                    out[:, f.group] = zero_bin
-                out[rows, f.group] = col.astype(np.uint8)
+                    if lay is not None:
+                        lay.fill_group(out, f.group, zero_bin)
+                    else:
+                        out[:, f.group] = zero_bin
+                cb = col.astype(np.uint8)
+                if lay is not None:
+                    lay.write_group(out, f.group, cb, rows=rows)
+                else:
+                    out[rows, f.group] = cb
             else:
                 gb = col + f.offset
                 if m.default_bin == 0:
                     gb -= 1
                 keep = col != m.default_bin
-                out[rows[keep], f.group] = gb[keep].astype(np.uint8)
+                gbk = gb[keep].astype(np.uint8)
+                if lay is not None:
+                    lay.write_group(out, f.group, gbk, rows=rows[keep])
+                else:
+                    out[rows[keep], f.group] = gbk
 
+        # task key = STORAGE byte column, not logical group: two
+        # nibble-packed groups share a byte, and the read-modify-write
+        # nibble updates need every byte single-writer under threading
         by_group: Dict[int, list] = {}
         for f in self.features:
-            by_group.setdefault(f.group, []).append(f)
+            key = lay.byte_of(f.group) if lay is not None else f.group
+            by_group.setdefault(key, []).append(f)
 
         def bin_group(feats) -> None:
             for f in feats:
@@ -840,6 +930,19 @@ class Dataset:
 
 
 # ---------------------------------------------------------------------------
+def _bundle_num_bin(ds: "Dataset", bundle: List[int]) -> int:
+    """A bundle's group bin count — the same arithmetic the
+    `_build_groups_impl` packing loop applies (shared default slot +
+    per-feature widths minus the default-at-0 removals)."""
+    if len(bundle) == 1:
+        return ds.mappers[bundle[0]].num_bin
+    total = 1
+    for fidx in bundle:
+        m = ds.mappers[fidx]
+        total += m.num_bin - (1 if m.default_bin == 0 else 0)
+    return total
+
+
 def _sample_feature_values(data: np.ndarray, sample_cnt: int, seed: int
                            ) -> Tuple[List[np.ndarray], int,
                                       List[np.ndarray]]:
@@ -907,6 +1010,13 @@ def _find_bundles(ds: Dataset, sample_nonzero: Optional[List[np.ndarray]]
             or not cfg.is_enable_bundle):
         return [[fidx] for fidx in ds.used_features]
 
+    # NOTE on packing: bundling is IDENTICAL across every bin_packing
+    # mode.  Capping bundles at a nibble's 16 bins was tried and
+    # rejected — a different bundling reconstructs default-bin mass
+    # through a different FixHistogram subtraction order, which breaks
+    # the byte-identical-trees bar by f32 ulps.  Wide bundles instead
+    # split OUT of the packed section into byte-wide storage columns
+    # (packing.py two-section layout), preserving exact parity.
     max_group_bins = 256
     max_conflict = int(cfg.max_conflict_rate * max(sample_cnt, 1))
     # order by non-zero count descending (densest placed first,

@@ -32,6 +32,13 @@ from .utils.log import Log
 BINARY_TOKEN = b"______LightGBM_TPU_Binary_File_Token______\n"
 MAGIC_V2 = b"LTPUBC2\n"
 FORMAT_VERSION = 2
+# v3 = v2 container + a ``bin_packing`` header field describing the
+# nibble-packed storage layout (packing.py).  The version only bumps
+# for datasets that ARE packed: 8-bit datasets keep writing plain v2
+# (loadable by every prior build), while a packed cache read by an
+# older build refuses on the unknown version instead of silently
+# mis-binning packed bytes as group columns.
+FORMAT_VERSION_PACKED = 3
 # hard sanity bound on the v2 header blob (mappers + metadata for even
 # a 10k-feature dataset pickle to a few MB; a length field past this is
 # a corrupted or hostile file, not a real header)
@@ -104,14 +111,27 @@ def save_binary(dataset: Dataset, filename: str,
         version = 2 if getattr(dataset.config, "binary_cache_v2", True) \
             else 1
     if version == 1:
+        if getattr(dataset, "bin_layout", None) is not None:
+            # the v1 pickle has no layout field: a packed matrix would
+            # reload as plain 8-bit group columns and silently mis-bin
+            Log.fatal(
+                f"{filename}: the v1 binary format cannot represent a "
+                "nibble-packed bin matrix "
+                f"({dataset.bin_layout!r}) — save with "
+                "binary_cache_v2=true (the default) or construct with "
+                "bin_packing=8bit")
         payload = dict(_payload(dataset, with_bins=True), version=1)
         with _open(filename, "wb") as f:
             f.write(BINARY_TOKEN)
             pickle.dump(payload, f, protocol=4)
         Log.info(f"Saved binned dataset to binary file {filename} (v1)")
         return
+    lay = getattr(dataset, "bin_layout", None)
     header = dict(_payload(dataset, with_bins=False),
-                  version=FORMAT_VERSION)
+                  version=(FORMAT_VERSION_PACKED if lay is not None
+                           else FORMAT_VERSION))
+    if lay is not None:
+        header["bin_packing"] = lay.to_state()
     gb = dataset.group_bins
     if gb is not None:
         gb = np.ascontiguousarray(gb, dtype=np.uint8)
@@ -128,7 +148,8 @@ def save_binary(dataset: Dataset, filename: str,
             # raw bytes, no pickle framing: this section is what
             # load_binary memmaps in place
             f.write(memoryview(gb).cast("B"))
-    Log.info(f"Saved binned dataset to binary file {filename} (v2)")
+    Log.info(f"Saved binned dataset to binary file {filename} "
+             f"(v{header['version']})")
 
 
 def is_binary_file(filename: str) -> bool:
@@ -162,7 +183,8 @@ def _read_v2(f, filename: str):
     except Exception as e:
         Log.fatal(f"{filename}: corrupted v2 binary dataset header "
                   f"({type(e).__name__}: {e})")
-    if payload.get("version") != FORMAT_VERSION:
+    if payload.get("version") not in (FORMAT_VERSION,
+                                      FORMAT_VERSION_PACKED):
         Log.fatal(f"{filename}: unsupported binary dataset version "
                   f"{payload.get('version')!r}")
     shape = payload.get("bins_shape")
@@ -188,7 +210,18 @@ def _read_v2(f, filename: str):
     return payload, gb
 
 
-def load_binary(filename: str) -> Dataset:
+def load_binary(filename: str, config=None) -> Dataset:
+    """Load a binary dataset cache.  With ``config``, the run's
+    resolved ``bin_packing`` is checked against the file's recorded
+    layout.  A 4bit run refuses an unpacked cache loudly (4bit is
+    never a default — if it resolved, the user asked for it).  A
+    packed cache under an 8bit config loads WITH A WARNING and keeps
+    its recorded layout: "8bit" is also the default, so a refusal
+    would lock a default-params run out of the cache it just built —
+    and no mis-binning is possible either way, because every consumer
+    reads through the dataset's self-describing ``bin_layout`` (and a
+    pre-packing build refuses the v3 version outright).  ``auto``
+    accepts whatever layout the cache carries."""
     with _open(filename, "rb") as f:
         token = f.read(len(BINARY_TOKEN))
         if token != BINARY_TOKEN:
@@ -196,7 +229,7 @@ def load_binary(filename: str) -> Dataset:
         magic = f.read(len(MAGIC_V2))
         if magic == MAGIC_V2:
             payload, group_bins = _read_v2(f, filename)
-            version = 2
+            version = int(payload.get("version", 2))
         else:
             # v1: the bytes just read are the head of the pickle stream
             Log.warning(
@@ -214,9 +247,32 @@ def load_binary(filename: str) -> Dataset:
             group_bins = payload["group_bins"]
             version = 1
     ds = _restore_dataset(payload, group_bins)
+    if config is not None:
+        _check_packing(filename, ds, config)
     Log.info(f"Loaded binned dataset from binary file {filename} "
              f"(v{version})")
     return ds
+
+
+def _check_packing(filename: str, ds: Dataset, config) -> None:
+    """Loud layout/intent mismatch handling (see load_binary)."""
+    from .packing import resolve_bin_packing
+    want = resolve_bin_packing(config)
+    lay = ds.bin_layout
+    if want == "8bit" and lay is not None:
+        Log.warning(
+            f"{filename}: cache holds a nibble-packed bin matrix "
+            f"({lay!r}); bin_packing=8bit applies to NEW "
+            "constructions — the cached layout is kept as recorded "
+            "(delete the file and re-save from an 8bit construction "
+            "for an unpacked cache)")
+    if want == "4bit" and lay is None:
+        Log.fatal(
+            f"{filename}: cache holds an 8-bit bin matrix but this "
+            "run asked for bin_packing=4bit — the cached EFB group "
+            "layout differs from a 4-bit construction; rebuild the "
+            "cache under bin_packing=4bit (delete the file) or run "
+            "with bin_packing=auto/8bit")
 
 
 def _restore_dataset(payload: dict, group_bins) -> Dataset:
@@ -226,11 +282,14 @@ def _restore_dataset(payload: dict, group_bins) -> Dataset:
 
     ds = Dataset.__new__(Dataset)
     Dataset.__init__(ds)
+    from .packing import BinLayout
     ds.num_data = payload["num_data"]
     ds.num_total_features = payload["num_total_features"]
     ds.mappers = payload["mappers"]
     ds.used_features = payload["used_features"]
     ds.group_bins = group_bins
+    # pre-packing caches carry no layout field -> 8-bit storage
+    ds.bin_layout = BinLayout.from_state(payload.get("bin_packing"))
     ds.group_num_bin = payload["group_num_bin"]
     ds.group_is_multi = payload["group_is_multi"]
     ds._bundles = payload["bundles"]

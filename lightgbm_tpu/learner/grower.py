@@ -163,6 +163,13 @@ class TreeGrower:
         self.max_feature_bin = dataset.max_feature_bin
         self.num_groups = dataset.num_groups
         self.num_features = dataset.num_features
+        # nibble-packed bin matrix (lightgbm_tpu/packing.py): the
+        # device matrix IS the storage matrix — kernels widen nibbles
+        # in-register, so HBM capacity AND the histogram read stream
+        # halve for fully packed datasets.  0 = legacy 8-bit layout
+        # (every code path lowers exactly as before).
+        _lay = getattr(dataset, "bin_layout", None)
+        self.pack_P = _lay.packed_groups if _lay is not None else 0
 
         meta = dataset.feature_meta_arrays()
         self.f_num_bin = jnp.asarray(meta["num_bin"])
@@ -374,7 +381,7 @@ class TreeGrower:
         # the G*B-byte streamed one-hot) — the cheapest formulation
         # measured on v5e
         self.use_quant_otf = self.use_quant and getattr(
-            config, "hist_quant_onthefly", True)
+            config, "hist_quant_onthefly", True) and not self.pack_P
         # streamed-one-hot histogram path: materialize the (N, G*B)
         # int8 bin one-hot once (it is constant for the whole training
         # run) and stream it through the kernel instead of rebuilding
@@ -472,6 +479,35 @@ class TreeGrower:
                 f"{self.ohb_pack}) exceeds hist_onehot_budget_mb="
                 f"{budget >> 20}; using the slower on-the-fly rebuild "
                 "(see docs/ROOFLINE.md regime table)")
+        if self.pack_P and self.use_pallas and not (
+                self.use_tiled or self.use_fused or self.use_pre_ohb):
+            # the remaining Pallas formulations (expansion-matmul /
+            # paired / on-the-fly int8) rebuild their one-hots
+            # straight from byte-wide group columns; nibble-packed
+            # datasets route to the packed-capable kernels above or —
+            # here — the XLA formulation, which widens per chunk.
+            # NOTE: this changes the histogram formulation (and drops
+            # int8 quantization if it was selected), so trees on THIS
+            # config are not guaranteed byte-identical to the same
+            # config at bin_packing=8bit — the byte-identity guarantee
+            # is scoped to the packed-capable routes (tiled / fused /
+            # streamed-one-hot / XLA), which cover every default
+            # kernel selection
+            Log.warning(
+                "bin_packing: the selected Pallas histogram kernel "
+                "has no nibble-packed input path; using the XLA "
+                "histogram formulation for this packed dataset"
+                + (" (int8 quantized training disabled — expect "
+                   "f32-accumulation trees, not byte-identical to "
+                   "this config under bin_packing=8bit)"
+                   if self.use_quant else
+                   " (different f32 accumulation order than the "
+                   "selected kernel — trees may differ in ulps from "
+                   "this config under bin_packing=8bit)"))
+            self.use_pallas = False
+            self.pallas_paired = False
+            self.use_quant = False
+            self.use_quant_otf = False
         self.ohb = None
         # transposed on DEVICE from the already-uploaded bins: a host
         # transpose + second upload of the (N, G) matrix doubles the
@@ -488,11 +524,12 @@ class TreeGrower:
         if self.use_pre_ohb:
             if self.ohb_pack == 1:
                 self.ohb = precompute_bin_onehot(
-                    self.bins, max_group_bin=self.max_group_bin)
+                    self.bins, max_group_bin=self.max_group_bin,
+                    packed_groups=self.pack_P)
             else:
                 self.ohb = precompute_bin_onehot_packed(
                     self.bins, max_group_bin=self.max_group_bin,
-                    pack=self.ohb_pack)
+                    pack=self.ohb_pack, packed_groups=self.pack_P)
         self._is_voting = (self.policy.mesh is not None
                            and config.tree_learner == "voting")
         # feature-parallel shard_map path: vertical partition with a
@@ -507,7 +544,11 @@ class TreeGrower:
             self.policy.mesh is not None
             and config.tree_learner == "feature"
             and getattr(self.policy, "bins_spec", None) is not None
-            and self.num_groups % self.policy.mesh.size == 0)
+            and self.num_groups % self.policy.mesh.size == 0
+            # vertical partition slices storage COLUMNS; a nibble-
+            # packed byte straddles two logical groups, so packed
+            # datasets take the constraint-sharded fallback instead
+            and self.pack_P == 0)
         self._train_tree = jax.jit(self._train_tree_impl)
         if TELEMETRY.on:
             # the grower's resolved kernel plan as gauges: the fused
@@ -531,6 +572,13 @@ class TreeGrower:
                 hk = "xla"
             TELEMETRY.gauge("grower.hist_kernel", hk)
             TELEMETRY.gauge("grower.quantized", int(self.use_quant))
+            # resolved device bin-matrix footprint: rows_padded x
+            # storage byte columns — THE gauge the compact-bins
+            # acceptance measures (<= 0.55x of 8-bit at max_bin=15)
+            TELEMETRY.gauge("bin_matrix_bytes",
+                            int(np.prod(self.bins.shape)))
+            TELEMETRY.gauge("grower.bin_packed_groups",
+                            int(self.pack_P))
             TELEMETRY.gauge("grower.split_finder_ladder",
                             int(self.split_ladder))
             TELEMETRY.gauge("grower.frontier_width", int(self.frontier))
@@ -718,7 +766,7 @@ class TreeGrower:
             self.bins, grad, hess, counts, leaf_id,
             num_leaves=L, max_group_bin=self.max_group_bin,
             compute_dtype=self.config.hist_compute_dtype,
-            chunk=self.chunk, slots=slots)
+            chunk=self.chunk, slots=slots, packed_groups=self.pack_P)
 
     # ------------------------------------------------------------------
     def _hist_xla_rowsharded(self, grad, hess, counts, leaf_id, slots, L):
@@ -757,7 +805,8 @@ class TreeGrower:
                 bins, g, h, c, lid, num_leaves=L,
                 max_group_bin=self.max_group_bin,
                 compute_dtype=self.config.hist_compute_dtype,
-                chunk=chunk_local, slots=sl)
+                chunk=chunk_local, slots=sl,
+                packed_groups=self.pack_P)
             return jax.lax.psum(local, axis)
 
         if slots is None:
@@ -830,7 +879,8 @@ class TreeGrower:
                         self.binsT, wT, scales, st.leaf_id,
                         st.route_tab, rights, max_group_bin=B,
                         block=self.pallas_block_tiled, strips=strips,
-                        interpret=self._interp)
+                        interpret=self._interp,
+                        packed_groups=self.pack_P)
                 else:
                     # streamed-one-hot kernel: block=2048 measured
                     # fastest on v5e (4096 fits scoped VMEM for 1-strip
@@ -841,7 +891,8 @@ class TreeGrower:
                         st.route_tab, rights, max_group_bin=B,
                         block=self.pallas_block, strips=strips, quant=q,
                         interpret=self._interp, pack=self.ohb_pack,
-                        num_groups=self.num_groups)
+                        num_groups=self.num_groups,
+                        packed_groups=self.pack_P)
                 cap = strips * PACKED_STRIP
                 if cap >= W:
                     return h[:W], leaf2
@@ -878,7 +929,8 @@ class TreeGrower:
             return compute_group_histograms_q_tiled(
                 self.binsT, wT, scales, leaf_id, slots,
                 max_group_bin=B, block=self.pallas_block_tiled,
-                strips=strips, interpret=self._interp)
+                strips=strips, interpret=self._interp,
+                packed_groups=self.pack_P)
 
         return self._packed_dispatch(full, run_packed, slots,
                                      slots.shape[0])
@@ -930,7 +982,8 @@ class TreeGrower:
             return compute_group_histograms_seg_tiled(
                 binsT_p, wT_p, scales, bs, num_out=num_out,
                 max_group_bin=self.max_group_bin,
-                block=self.leaf_part_block, interpret=self._interp)
+                block=self.leaf_part_block, interpret=self._interp,
+                packed_groups=self.pack_P)
 
         return self._packed_dispatch(
             lambda _: run(W),
@@ -1139,11 +1192,13 @@ class TreeGrower:
                     self.binsT, leaf_id, final.route_tab,
                     final.tree.leaf_value,
                     block=self.pallas_block_tiled,
-                    interpret=self._interp)
+                    interpret=self._interp,
+                    packed_groups=self.pack_P)
             else:
                 leaf_id, row_val = apply_route_table(
                     self.bins, leaf_id, final.route_tab,
-                    values=final.tree.leaf_value)
+                    values=final.tree.leaf_value,
+                    packed_groups=self.pack_P)
         tree = final.tree._replace(num_leaves=final.num_leaves)
         return tree, leaf_id, row_val
 
@@ -1184,7 +1239,8 @@ class TreeGrower:
             from ..ops.histogram import route_only_tiled
             new_leaf = route_only_tiled(
                 self.binsT, st.leaf_id, st.route_tab,
-                block=self.pallas_block_tiled, interpret=self._interp)
+                block=self.pallas_block_tiled, interpret=self._interp,
+                packed_groups=self.pack_P)
             st = st._replace(leaf_id=new_leaf)
             part = self._build_partition(new_leaf, quant)
             right_hist = self._hist_kernel_seg(part, rights)
@@ -1194,7 +1250,8 @@ class TreeGrower:
             from ..ops.histogram import route_only_tiled
             new_leaf = route_only_tiled(
                 self.binsT, st.leaf_id, st.route_tab,
-                block=self.pallas_block_tiled, interpret=self._interp)
+                block=self.pallas_block_tiled, interpret=self._interp,
+                packed_groups=self.pack_P)
             st = st._replace(leaf_id=new_leaf)
             right_hist = self._hist_kernel_q_tiled(new_leaf, rights,
                                                    quant)
@@ -1441,7 +1498,8 @@ class TreeGrower:
             leaf_id = st.leaf_id
             route_tab = build_route_table(*route_args)
         else:
-            leaf_id = apply_splits(self.bins, st.leaf_id, *route_args)
+            leaf_id = apply_splits(self.bins, st.leaf_id, *route_args,
+                                   packed_groups=self.pack_P)
             route_tab = st.route_tab
 
         num_leaves = st.num_leaves + k
@@ -1580,7 +1638,7 @@ class TreeGrower:
                 bins, g, h, c, leaf_id, num_leaves=L,
                 max_group_bin=self.max_group_bin,
                 compute_dtype=self.config.hist_compute_dtype,
-                chunk=n_local)
+                chunk=n_local, packed_groups=self.pack_P)
             local_totals = compute_leaf_totals(g, h, c, leaf_id, L)
             feat_hist = expand_feature_histograms(
                 local_hist, self.bin_map, self.fix_bin, local_totals)

@@ -112,6 +112,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int32), ctypes.c_long,
                 ctypes.c_long, ctypes.POINTER(ctypes.c_ubyte),
                 ctypes.c_long]
+        if hasattr(lib, "ltpu_pack_nibbles"):
+            lib.ltpu_pack_nibbles.restype = None
+            lib.ltpu_pack_nibbles.argtypes = [
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
+                ctypes.c_long, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
         if hasattr(lib, "ltpu_bin_bundle"):
             lib.ltpu_bin_bundle.restype = None
             lib.ltpu_bin_bundle.argtypes = [

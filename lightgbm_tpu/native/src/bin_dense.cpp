@@ -178,3 +178,27 @@ extern "C" void ltpu_scatter_cols(
     }
   }
 }
+
+// Nibble pack (bin_packing=4bit/auto, packing.py layout): row-major
+// (n, g_total) logical bin rows -> (n, out_cols) storage rows where
+// the first `packed` groups interleave two-per-byte (group 2j low
+// nibble, 2j+1 high) and the rest copy through one byte each.  The
+// numpy pack is three strided passes over the chunk; this single
+// fused pass runs at copy throughput and keeps the logical row in L1
+// while both nibbles are combined.
+extern "C" void ltpu_pack_nibbles(
+    const unsigned char* logical, long n, long g_total, long packed,
+    unsigned char* out, long out_cols) {
+  const long pb = (packed + 1) / 2;
+  const long pairs = packed / 2;
+  const long wide = g_total - packed;
+  for (long i = 0; i < n; ++i) {
+    const unsigned char* r = logical + i * g_total;
+    unsigned char* o = out + i * out_cols;
+    for (long j = 0; j < pairs; ++j)
+      o[j] = (unsigned char)(r[2 * j] | (r[2 * j + 1] << 4));
+    if (packed % 2)                 // odd tail: low nibble only
+      o[pb - 1] = r[packed - 1];
+    for (long k = 0; k < wide; ++k) o[pb + k] = r[packed + k];
+  }
+}

@@ -29,7 +29,59 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .partition import MISSING_NAN, MISSING_ZERO, ROUTE_FIXED_COLS
+from .partition import (MISSING_NAN, MISSING_ZERO, ROUTE_FIXED_COLS,
+                        packed_select_params)
+
+# ---------------------------------------------------------------------------
+# Nibble-packed bin-matrix support (lightgbm_tpu/packing.py layout):
+# the storage matrix carries the first ``packed_groups`` logical groups
+# two-per-byte (group 2j in the low nibble of storage byte j, 2j+1 in
+# the high nibble) followed by one byte per wide group.  Every kernel
+# that reads bins takes a static ``packed_groups`` (0 = legacy 8-bit
+# matrix, which keeps the EXACT pre-packing lowering) and widens
+# nibbles in-register — shift+mask VPU ops — so HBM only ever streams
+# the packed bytes.
+# ---------------------------------------------------------------------------
+
+
+# layout arithmetic lives in packing.py (the one home for the nibble
+# layout); re-exported here so kernel call sites and tests use one name
+from ..packing import logical_groups, packed_bytes  # noqa: F401
+from ..packing import storage_cols as packed_cols  # noqa: F401
+
+
+def unpack_bins_cols(bins: jax.Array, *, num_groups: int,
+                     packed_groups: int) -> jax.Array:
+    """(n, cols) storage block -> (n, G) logical bins (XLA form — the
+    Pallas kernels widen per-row/per-tile instead; see _bin_row_T).
+    Identity when ``packed_groups`` is 0."""
+    if packed_groups == 0:
+        return bins
+    pb = packed_bytes(packed_groups)
+    pk = bins[:, :pb].astype(jnp.int32)
+    lo = pk & 15
+    hi = (pk >> 4) & 15
+    inter = jnp.stack([lo, hi], axis=2).reshape(
+        bins.shape[0], 2 * pb)[:, :packed_groups]
+    wide = bins[:, pb:].astype(jnp.int32)
+    out = jnp.concatenate([inter, wide], axis=1) if wide.shape[1] \
+        else inter
+    return out.astype(bins.dtype)
+
+
+def _bin_row_T(binb, g: int, packed_groups: int):
+    """Logical group ``g``'s (1, C) bin row out of a TRANSPOSED
+    (storage_rows, C) int32 block — a static slice plus a static
+    nibble shift/mask; the Mosaic-friendly per-group access the tiled
+    kernels are built from."""
+    if packed_groups and g < packed_groups:
+        r = binb[g // 2:g // 2 + 1, :]
+        if g % 2:
+            r = r >> 4
+        return r & 15
+    j = g if not packed_groups \
+        else packed_bytes(packed_groups) + (g - packed_groups)
+    return binb[j:j + 1, :]
 
 
 def _pick_chunk(n: int, num_groups: int, max_group_bin: int,
@@ -52,14 +104,16 @@ def _pick_chunk(n: int, num_groups: int, max_group_bin: int,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_leaves", "max_group_bin", "compute_dtype", "chunk"))
+    static_argnames=("num_leaves", "max_group_bin", "compute_dtype",
+                     "chunk", "packed_groups"))
 def compute_group_histograms(bins: jax.Array, grad: jax.Array,
                              hess: jax.Array, counts: jax.Array,
                              leaf_id: jax.Array, *, num_leaves: int,
                              max_group_bin: int,
                              compute_dtype: str = "float32",
                              chunk: Optional[int] = None,
-                             slots: Optional[jax.Array] = None) -> jax.Array:
+                             slots: Optional[jax.Array] = None,
+                             packed_groups: int = 0) -> jax.Array:
     """Build per-leaf histograms for every feature group in one pass.
 
     Args:
@@ -88,7 +142,9 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
       (L|W, G, B, 3) float32: sum_grad, sum_hess, count per
       (leaf, group, bin).
     """
-    n, num_groups = bins.shape
+    n, cols = bins.shape
+    num_groups = logical_groups(cols, packed_groups) if packed_groups \
+        else cols
     cdt = jnp.dtype(compute_dtype)
     if chunk is None:
         chunk = _pick_chunk(n, num_groups, max_group_bin, cdt.itemsize)
@@ -107,6 +163,12 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
 
     def body(acc, xs):
         bins_c, grad_c, hess_c, cnt_c, leaf_c = xs
+        # nibble-packed matrix: the chunk stays packed in HBM and
+        # widens here in registers (elementwise shift/mask — no
+        # scatter, no dtype widening past int32; pinned by the
+        # compact-bins jaxpr test)
+        bins_c = unpack_bins_cols(bins_c, num_groups=num_groups,
+                                  packed_groups=packed_groups)
         # (C, L) leaf one-hot; negative leaf ids match nothing
         ohl = (leaf_c[:, None] == leaf_iota[None, :]).astype(cdt)
         w = jnp.stack([grad_c, hess_c, cnt_c], axis=1).astype(cdt)  # (C, 3)
@@ -126,7 +188,7 @@ def compute_group_histograms(bins: jax.Array, grad: jax.Array,
 
     init = jnp.zeros((num_leaves * 3, num_groups, max_group_bin),
                      dtype=jnp.float32)
-    xs = (bins.reshape(num_chunks, chunk, num_groups),
+    xs = (bins.reshape(num_chunks, chunk, cols),
           grad.reshape(num_chunks, chunk),
           hess.reshape(num_chunks, chunk),
           counts.reshape(num_chunks, chunk),
@@ -452,9 +514,10 @@ def compute_group_histograms_pallas(bins: jax.Array, grad: jax.Array,
         interpret=interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("max_group_bin",))
-def precompute_bin_onehot(bins: jax.Array, *,
-                          max_group_bin: int) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("max_group_bin",
+                                             "packed_groups"))
+def precompute_bin_onehot(bins: jax.Array, *, max_group_bin: int,
+                          packed_groups: int = 0) -> jax.Array:
     """(N, G) uint8 -> (N, G*B) int8 bin one-hot, HBM-resident.
 
     The bin matrix never changes during training, so the one-hot RHS of
@@ -463,21 +526,30 @@ def precompute_bin_onehot(bins: jax.Array, *,
     compare (the dominant non-MXU cost).  Costs N*G*B bytes of HBM;
     the grower gates usage on a memory budget and falls back to
     on-the-fly generation for datasets where it doesn't fit."""
-    n, g = bins.shape
+    n = bins.shape[0]
+    g = logical_groups(bins.shape[1], packed_groups) if packed_groups \
+        else bins.shape[1]
+    bins = unpack_bins_cols(bins, num_groups=g,
+                            packed_groups=packed_groups)
     biota = jnp.arange(max_group_bin, dtype=jnp.int32)
     oh = bins.astype(jnp.int32)[:, :, None] == biota[None, None, :]
     return oh.reshape(n, g * max_group_bin).astype(jnp.int8)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("max_group_bin", "pack", "gbp_pad"))
+                   static_argnames=("max_group_bin", "pack", "gbp_pad",
+                                    "num_groups", "packed_groups"))
 def _packed_onehot_chunk(bc: jax.Array, gsel_d: jax.Array,
                          bval_d: jax.Array, *, max_group_bin: int,
-                         pack: int, gbp_pad: int) -> jax.Array:
+                         pack: int, gbp_pad: int, num_groups: int = 0,
+                         packed_groups: int = 0) -> jax.Array:
     """One fixed-shape row chunk of the planar packing (jitted per
     CHUNK shape, not per dataset size — XLA's compile time for the
     whole-N single-program formulation grew ~linearly with N, hitting
     minutes at HIGGS scale)."""
+    if packed_groups:
+        bc = unpack_bins_cols(bc, num_groups=num_groups,
+                              packed_groups=packed_groups)
     bits = 8 // pack
     acc = None
     for p in range(pack):
@@ -489,7 +561,8 @@ def _packed_onehot_chunk(bc: jax.Array, gsel_d: jax.Array,
 
 
 def precompute_bin_onehot_packed(bins: jax.Array, *, max_group_bin: int,
-                                 pack: int) -> jax.Array:
+                                 pack: int,
+                                 packed_groups: int = 0) -> jax.Array:
     """(N, G) uint8 -> (N, G*B/pack) int8 PLANAR sub-byte one-hot.
 
     ``pack`` one-hot columns share each byte: byte j of a row carries
@@ -508,7 +581,9 @@ def precompute_bin_onehot_packed(bins: jax.Array, *, max_group_bin: int,
     zero bytes so every widened plane — and every per-plane output
     slice in the kernels — is tile-aligned (Mosaic rejects unaligned
     lane slices)."""
-    n, g = bins.shape
+    n = bins.shape[0]
+    g = logical_groups(bins.shape[1], packed_groups) if packed_groups \
+        else bins.shape[1]
     gb = g * max_group_bin
     if gb % pack:
         raise ValueError(f"pack ({pack}) must divide G*B ({gb})")
@@ -546,7 +621,8 @@ def precompute_bin_onehot_packed(bins: jax.Array, *, max_group_bin: int,
             bc = jnp.pad(bc, ((0, chunk - take), (0, 0)))
         part = _packed_onehot_chunk(
             bc, gsel_d, bval_d, max_group_bin=max_group_bin, pack=pack,
-            gbp_pad=gbp_pad)
+            gbp_pad=gbp_pad, num_groups=g,
+            packed_groups=packed_groups)
         if take < chunk:
             part = part[:take]
         out = _write_packed_chunk(out, part, i)
@@ -892,7 +968,7 @@ def tiled_hist_width(num_groups: int, max_group_bin: int) -> int:
 
 def _hist_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, slots_ref,
                               out_ref, *, strip, strips, max_group_bin,
-                              num_groups):
+                              num_groups, packed_groups=0):
     """Fast on-the-fly int8 kernel: the bin one-hot is rebuilt in VMEM
     per 128-lane TILE by a single iota compare — no expansion matmul.
 
@@ -916,25 +992,29 @@ def _hist_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, slots_ref,
 
     lhs = _tiled_lhs(leafT_ref[:], wT_ref[:], slots_ref[:], strip=strip,
                      strips=strips)
-    binb = binsT_ref[:].astype(jnp.int32)                # (G, C)
+    binb = binsT_ref[:].astype(jnp.int32)                # (G|S, C)
     _tiled_onehot_dots(lhs, binb, out_ref, max_group_bin=max_group_bin,
-                       num_groups=num_groups)
+                       num_groups=num_groups,
+                       packed_groups=packed_groups)
 
 
 @functools.partial(
     jax.jit, static_argnames=("max_group_bin", "block", "strips",
-                              "interpret"))
+                              "interpret", "packed_groups"))
 def compute_group_histograms_q_tiled(
         binsT: jax.Array, wT: jax.Array, scales: jax.Array,
         leaf_id: jax.Array, slots: jax.Array, *, max_group_bin: int,
         block: int = 2048, strips: int = 1,
-        interpret: bool = False) -> jax.Array:
+        interpret: bool = False, packed_groups: int = 0) -> jax.Array:
     """Tiled-iota on-the-fly int8 histogram: same contract as
     :func:`compute_group_histograms_q_packed` but takes TRANSPOSED
-    inputs (binsT (G, N) uint8, wT (3, N) int32 quantized).  ``slots``
-    holds at most strips*PACKED_STRIP valid entries; returns
-    (strips*PACKED_STRIP, G, B, 3) following (padded) ``slots`` order."""
-    num_groups = binsT.shape[0]
+    inputs (binsT (G, N) uint8 — or the (cols, N) nibble-packed
+    storage when ``packed_groups`` > 0 — and wT (3, N) int32
+    quantized).  ``slots`` holds at most strips*PACKED_STRIP valid
+    entries; returns (strips*PACKED_STRIP, G, B, 3) following (padded)
+    ``slots`` order."""
+    num_groups = logical_groups(binsT.shape[0], packed_groups) \
+        if packed_groups else binsT.shape[0]
     b = max_group_bin
     per_tile = max(1, 128 // b)
     tile_w = 128 if b <= 128 else _round_up(b, 128)
@@ -943,15 +1023,17 @@ def compute_group_histograms_q_tiled(
     slot_col = _pack_slot_tiles(slots, strips)[:, None]  # (m_pad, 1)
     kern = functools.partial(_hist_kernel_body_q_tiled, strip=PACKED_STRIP,
                              strips=strips, max_group_bin=b,
-                             num_groups=num_groups)
+                             num_groups=num_groups,
+                             packed_groups=packed_groups)
     n = binsT.shape[1]
     if n % block != 0:
         raise ValueError(f"N ({n}) must be a multiple of block ({block})")
+    s_rows = binsT.shape[0]              # storage rows (== G unpacked)
     out = pl.pallas_call(
         kern,
         grid=(n // block,),
         in_specs=[
-            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((s_rows, block), lambda i: (0, i)),
             pl.BlockSpec((3, block), lambda i: (0, i)),
             pl.BlockSpec((1, block), lambda i: (0, i)),
             pl.BlockSpec(slot_col.shape, lambda i: (0, 0)),
@@ -1004,7 +1086,7 @@ def compute_group_histograms_pre_packed(
 
 
 def _route_prologue_T(binb, leaf, routeT, *, num_groups, nb,
-                      with_decision=False):
+                      with_decision=False, packed_groups=0):
     """Shared transposed routing prologue of the fused kernels: apply
     the pending per-leaf route table to a block's rows.  ``binb`` is
     the (G, C) int32 bins block, ``leaf`` the (1, C) int32 leaf ids,
@@ -1039,10 +1121,24 @@ def _route_prologue_T(binb, leaf, routeT, *, num_groups, nb,
     lo, hi = irow(11), irow(12)
     shift, oor = irow(13), irow(14)
 
-    giota = jax.lax.broadcasted_iota(jnp.int32, (num_groups, c), 0)
-    gsel = giota == grp                                  # (G, C)
-    gb = jnp.sum(jnp.where(gsel, binb, 0), axis=0,
-                 keepdims=True)                          # (1, C)
+    if packed_groups:
+        # nibble-packed storage: select the chosen group's storage
+        # BYTE row, then extract its nibble with a per-row variable
+        # shift (the same vector-shift idiom as the categorical bit
+        # test below); ops/partition packed_select_params is the one
+        # jnp form of the packing.py byte_of/shift_of arithmetic
+        byte_idx, nsh, msk = packed_select_params(grp, packed_groups)
+        s_rows = binb.shape[0]
+        siota = jax.lax.broadcasted_iota(jnp.int32, (s_rows, c), 0)
+        bsel = siota == byte_idx                         # (S, C)
+        byte = jnp.sum(jnp.where(bsel, binb, 0), axis=0,
+                       keepdims=True)                    # (1, C)
+        gb = (byte >> nsh) & msk
+    else:
+        giota = jax.lax.broadcasted_iota(jnp.int32, (num_groups, c), 0)
+        gsel = giota == grp                              # (G, C)
+        gb = jnp.sum(jnp.where(gsel, binb, 0), axis=0,
+                     keepdims=True)                      # (1, C)
     fbin = jnp.where((gb >= lo) & (gb < hi), gb - shift, oor)
 
     is_nan_bin = fbin == nbin - 1
@@ -1082,7 +1178,7 @@ def _tiled_lhs(leaf, w, slot_col, *, strip, strips):
 
 
 def _tiled_onehot_dots(lhs, binb, out_ref, *, max_group_bin, num_groups,
-                       row_start=None):
+                       row_start=None, packed_groups=0):
     """Shared tiled-iota histogram accumulate: rebuild the bin one-hot
     per 128-lane tile from the (G, C) int32 bins block and dot ``lhs``
     ((m_pad, C) int8) into the tile's output slice.  See
@@ -1101,10 +1197,13 @@ def _tiled_onehot_dots(lhs, binb, out_ref, *, max_group_bin, num_groups,
         gs = min(per_tile, num_groups - g0)
         # target[s, r] = bins[r, g0 + s // B] + (s // B) * B, so a
         # single (target == siota) compare builds the whole tile
-        target = binb[g0:g0 + 1, :]
+        # (_bin_row_T widens nibble-packed group rows in-register —
+        # static shift+mask, identical code when packed_groups == 0)
+        target = _bin_row_T(binb, g0, packed_groups)
         for k in range(1, gs):
-            target = jnp.where(siota < k * b, target,
-                               binb[g0 + k:g0 + k + 1, :] + k * b)
+            target = jnp.where(
+                siota < k * b, target,
+                _bin_row_T(binb, g0 + k, packed_groups) + k * b)
         if gs * b < tile_w:
             target = jnp.where(siota < gs * b, target, -1)
         oh = (target == siota).astype(jnp.int8)          # (tile_w, C)
@@ -1120,7 +1219,8 @@ def _tiled_onehot_dots(lhs, binb, out_ref, *, max_group_bin, num_groups,
 
 def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
                        slots_ref, hist_ref, leaf_out_ref, *, strip,
-                       strips, quant, num_groups, nb, pack=1):
+                       strips, quant, num_groups, nb, pack=1,
+                       packed_groups=0):
     """Route-then-histogram kernel: one row-block applies the PENDING
     per-leaf route table (the splits selected last round) to its rows,
     writes the new leaf ids, and accumulates the frontier histogram
@@ -1149,7 +1249,7 @@ def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
     leaf = leafT_ref[:]                                  # (1, C) int32
     new_leaf = _route_prologue_T(binsT_ref[:].astype(jnp.int32), leaf,
                                  routeT_ref[:], num_groups=num_groups,
-                                 nb=nb)
+                                 nb=nb, packed_groups=packed_groups)
     leaf_out_ref[:] = new_leaf
 
     # --- histogram (channel-packed lanes along ROWS) ----------------
@@ -1180,14 +1280,15 @@ def _fused_kernel_body(ohb_ref, binsT_ref, wT_ref, leafT_ref, routeT_ref,
 
 @functools.partial(
     jax.jit, static_argnames=("max_group_bin", "block", "strips", "quant",
-                              "interpret", "pack", "num_groups"))
+                              "interpret", "pack", "num_groups",
+                              "packed_groups"))
 def compute_group_histograms_fused(
         ohb: jax.Array, binsT: jax.Array, wT: jax.Array,
         scales: Optional[jax.Array], leaf_id: jax.Array,
         route_tab: jax.Array, slots: jax.Array, *, max_group_bin: int,
         block: int = 2048, strips: int = 1, quant: bool = False,
         interpret: bool = False, pack: int = 1,
-        num_groups: Optional[int] = None):
+        num_groups: Optional[int] = None, packed_groups: int = 0):
     """Fused route+histogram: returns ``(hist, new_leaf)`` where
     ``hist`` is (strips*PACKED_STRIP, G, B, 3) following (padded)
     ``slots`` order and ``new_leaf`` the (N,) post-route leaf ids.
@@ -1223,13 +1324,15 @@ def compute_group_histograms_fused(
 
     kern = functools.partial(_fused_kernel_body, strip=PACKED_STRIP,
                              strips=strips, quant=quant,
-                             num_groups=num_groups, nb=K - 15, pack=pack)
+                             num_groups=num_groups, nb=K - 15, pack=pack,
+                             packed_groups=packed_groups)
+    s_rows = binsT.shape[0]              # storage rows (== G unpacked)
     hist, leaf_out = pl.pallas_call(
         kern,
         grid=(n // block,),
         in_specs=[
             pl.BlockSpec((block, ohb_cols), lambda i: (i, 0)),
-            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((s_rows, block), lambda i: (0, i)),
             pl.BlockSpec((3, block), lambda i: (0, i)),
             pl.BlockSpec((1, block), lambda i: (0, i)),
             pl.BlockSpec(routeT.shape, lambda i: (0, 0)),
@@ -1257,7 +1360,7 @@ def compute_group_histograms_fused(
 def _fused_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, routeT_ref,
                                slots_ref, hist_ref, leaf_out_ref, *,
                                strip, strips, num_groups, nb,
-                               max_group_bin):
+                               max_group_bin, packed_groups=0):
     """Fused route + tiled-iota histogram: the pending route table is
     applied to the block's rows, then the histogram accumulates from a
     one-hot rebuilt per 128-lane tile in VMEM — HBM traffic is just the
@@ -1275,15 +1378,17 @@ def _fused_kernel_body_q_tiled(binsT_ref, wT_ref, leafT_ref, routeT_ref,
         hist_ref[:] = jnp.zeros_like(hist_ref)
 
     leaf = leafT_ref[:]                                  # (1, C) int32
-    binb = binsT_ref[:].astype(jnp.int32)                # (G, C)
+    binb = binsT_ref[:].astype(jnp.int32)                # (G|S, C)
     new_leaf = _route_prologue_T(binb, leaf, routeT_ref[:],
-                                 num_groups=num_groups, nb=nb)
+                                 num_groups=num_groups, nb=nb,
+                                 packed_groups=packed_groups)
     leaf_out_ref[:] = new_leaf
 
     lhs = _tiled_lhs(new_leaf, wT_ref[:], slots_ref[:], strip=strip,
                      strips=strips)
     _tiled_onehot_dots(lhs, binb, hist_ref, max_group_bin=max_group_bin,
-                       num_groups=num_groups)
+                       num_groups=num_groups,
+                       packed_groups=packed_groups)
 
 
 def _tiled_out_to_hist(out: jax.Array, strips: int, num_groups: int,
@@ -1303,17 +1408,20 @@ def _tiled_out_to_hist(out: jax.Array, strips: int, num_groups: int,
 
 @functools.partial(
     jax.jit, static_argnames=("max_group_bin", "block", "strips",
-                              "interpret"))
+                              "interpret", "packed_groups"))
 def compute_group_histograms_fused_tiled(
         binsT: jax.Array, wT: jax.Array, scales: jax.Array,
         leaf_id: jax.Array, route_tab: jax.Array, slots: jax.Array, *,
         max_group_bin: int, block: int = 2048, strips: int = 1,
-        interpret: bool = False):
+        interpret: bool = False, packed_groups: int = 0):
     """Fused route + tiled-iota int8 histogram: same contract as
     :func:`compute_group_histograms_fused` minus the ``ohb`` operand —
     the one-hot is rebuilt in VMEM from ``binsT``.  Quantized path only
-    (wT is the (3, N) int32 quantized weights)."""
-    num_groups = binsT.shape[0]
+    (wT is the (3, N) int32 quantized weights).  ``packed_groups`` > 0
+    marks binsT as the (cols, N) nibble-packed storage — the HBM
+    stream halves and nibbles widen in-register per tile."""
+    num_groups = logical_groups(binsT.shape[0], packed_groups) \
+        if packed_groups else binsT.shape[0]
     b = max_group_bin
     per_tile = max(1, 128 // b)
     tile_w = 128 if b <= 128 else _round_up(b, 128)
@@ -1329,12 +1437,14 @@ def compute_group_histograms_fused_tiled(
 
     kern = functools.partial(_fused_kernel_body_q_tiled, strip=PACKED_STRIP,
                              strips=strips, num_groups=num_groups,
-                             nb=K - 15, max_group_bin=b)
+                             nb=K - 15, max_group_bin=b,
+                             packed_groups=packed_groups)
+    s_rows = binsT.shape[0]              # storage rows (== G unpacked)
     out, leaf_out = pl.pallas_call(
         kern,
         grid=(n // block,),
         in_specs=[
-            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((s_rows, block), lambda i: (0, i)),
             pl.BlockSpec((3, block), lambda i: (0, i)),
             pl.BlockSpec((1, block), lambda i: (0, i)),
             pl.BlockSpec(routeT.shape, lambda i: (0, 0)),
@@ -1356,7 +1466,8 @@ def compute_group_histograms_fused_tiled(
 
 
 def _hist_kernel_body_seg_tiled(blk_slot_ref, binsT_ref, wT_ref, out_ref,
-                                *, max_group_bin, num_groups):
+                                *, max_group_bin, num_groups,
+                                packed_groups=0):
     """Segment-addressed tiled-iota kernel — the leaf-partitioned
     formulation's histogram pass.  Rows arrive PHYSICALLY grouped by
     leaf (ops/partition.py build_leaf_partition: block-aligned
@@ -1389,19 +1500,21 @@ def _hist_kernel_body_seg_tiled(blk_slot_ref, binsT_ref, wT_ref, out_ref,
                                  jnp.where(riota == 2, w[2:3, :],
                                            jnp.zeros((), jnp.int32))))
         lhs = wl.astype(jnp.int8)                        # (8, C)
-        binb = binsT_ref[:].astype(jnp.int32)            # (G, C)
+        binb = binsT_ref[:].astype(jnp.int32)            # (G|S, C)
         _tiled_onehot_dots(lhs, binb, out_ref,
                            max_group_bin=max_group_bin,
-                           num_groups=num_groups, row_start=8 * k)
+                           num_groups=num_groups, row_start=8 * k,
+                           packed_groups=packed_groups)
 
 
 @functools.partial(
     jax.jit, static_argnames=("num_out", "max_group_bin", "block",
-                              "interpret"))
+                              "interpret", "packed_groups"))
 def compute_group_histograms_seg_tiled(
         binsT_p: jax.Array, wT_p: jax.Array, scales: jax.Array,
         blk_slot: jax.Array, *, num_out: int, max_group_bin: int,
-        block: int = 512, interpret: bool = False) -> jax.Array:
+        block: int = 512, interpret: bool = False,
+        packed_groups: int = 0) -> jax.Array:
     """Leaf-partitioned histogram: inputs are in PARTITIONED row order
     (binsT_p (G, n_cap) uint8 and wT_p (3, n_cap) int32 gathered
     through a build_leaf_partition permutation; gap rows carry zero
@@ -1414,7 +1527,8 @@ def compute_group_histograms_seg_tiled(
     way the slot-packed ladder does."""
     from jax.experimental.pallas import tpu as pltpu
 
-    num_groups = binsT_p.shape[0]
+    num_groups = logical_groups(binsT_p.shape[0], packed_groups) \
+        if packed_groups else binsT_p.shape[0]
     b = max_group_bin
     per_tile = max(1, 128 // b)
     tile_w = 128 if b <= 128 else _round_up(b, 128)
@@ -1425,12 +1539,14 @@ def compute_group_histograms_seg_tiled(
             f"n_cap ({n_cap}) must be a multiple of block ({block})")
     m_out = 8 * num_out
     kern = functools.partial(_hist_kernel_body_seg_tiled,
-                             max_group_bin=b, num_groups=num_groups)
+                             max_group_bin=b, num_groups=num_groups,
+                             packed_groups=packed_groups)
+    s_rows = binsT_p.shape[0]            # storage rows (== G unpacked)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_cap // block,),
         in_specs=[
-            pl.BlockSpec((num_groups, block), lambda i, bs: (0, i)),
+            pl.BlockSpec((s_rows, block), lambda i, bs: (0, i)),
             pl.BlockSpec((3, block), lambda i, bs: (0, i)),
         ],
         out_specs=pl.BlockSpec((m_out, num_tiles * tile_w),
@@ -1463,7 +1579,7 @@ def _transpose_pad_route(table: jax.Array) -> jax.Array:
 
 def _route_value_kernel_body(binsT_ref, leafT_ref, routeT_ref,
                              leaf_out_ref, val_out_ref, *, num_groups,
-                             nb):
+                             nb, packed_groups=0):
     """Exit-route kernel: apply the final pending route table and emit
     each row's POST-route leaf value, with the one-hot broadcast in
     VMEM — the XLA form (ops/partition.py apply_route_table)
@@ -1473,7 +1589,8 @@ def _route_value_kernel_body(binsT_ref, leafT_ref, routeT_ref,
     leaf = leafT_ref[:]                                  # (1, C) int32
     new_leaf, went_right, scal = _route_prologue_T(
         binsT_ref[:].astype(jnp.int32), leaf, routeT_ref[:],
-        num_groups=num_groups, nb=nb, with_decision=True)
+        num_groups=num_groups, nb=nb, with_decision=True,
+        packed_groups=packed_groups)
     leaf_out_ref[:] = new_leaf
     k0 = ROUTE_FIXED_COLS + nb
     vk = scal[k0:k0 + 1] + scal[k0 + 1:k0 + 2] + scal[k0 + 2:k0 + 3]
@@ -1483,24 +1600,28 @@ def _route_value_kernel_body(binsT_ref, leafT_ref, routeT_ref,
 
 
 def _route_only_kernel_body(binsT_ref, leafT_ref, routeT_ref,
-                            leaf_out_ref, *, num_groups, nb):
+                            leaf_out_ref, *, num_groups, nb,
+                            packed_groups=0):
     """Route-only kernel: the per-round split routing as its own
     stream, leaving the histogram passes to the plain (route-free)
     tiled kernel — the split-route alternative to fusing the route
     into the histogram kernel's first pass."""
     leaf_out_ref[:] = _route_prologue_T(
         binsT_ref[:].astype(jnp.int32), leafT_ref[:], routeT_ref[:],
-        num_groups=num_groups, nb=nb)
+        num_groups=num_groups, nb=nb, packed_groups=packed_groups)
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block", "interpret",
+                                             "packed_groups"))
 def route_only_tiled(binsT: jax.Array, leaf_id: jax.Array,
                      route_tab: jax.Array, *, block: int = 8192,
-                     interpret: bool = False) -> jax.Array:
+                     interpret: bool = False,
+                     packed_groups: int = 0) -> jax.Array:
     """Apply a pending route table to leaf ids via the in-VMEM
     broadcast (no histogram, no values).  Returns the (N,) post-route
     leaf ids."""
-    num_groups = binsT.shape[0]
+    num_groups = logical_groups(binsT.shape[0], packed_groups) \
+        if packed_groups else binsT.shape[0]
     if num_groups >= 65536:  # fg // 256 must stay bf16-exact
         raise ValueError(
             "route_only_tiled supports at most 65535 feature groups, "
@@ -1512,12 +1633,14 @@ def route_only_tiled(binsT: jax.Array, leaf_id: jax.Array,
     routeT = _transpose_pad_route(route_tab)
     kern = functools.partial(
         _route_only_kernel_body, num_groups=num_groups,
-        nb=route_tab.shape[1] - ROUTE_FIXED_COLS)
+        nb=route_tab.shape[1] - ROUTE_FIXED_COLS,
+        packed_groups=packed_groups)
+    s_rows = binsT.shape[0]
     leaf_out = pl.pallas_call(
         kern,
         grid=(n // block,),
         in_specs=[
-            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((s_rows, block), lambda i: (0, i)),
             pl.BlockSpec((1, block), lambda i: (0, i)),
             pl.BlockSpec(routeT.shape, lambda i: (0, 0)),
         ],
@@ -1529,17 +1652,19 @@ def route_only_tiled(binsT: jax.Array, leaf_id: jax.Array,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "interpret"))
+    jax.jit, static_argnames=("block", "interpret", "packed_groups"))
 def route_apply_tiled(binsT: jax.Array, leaf_id: jax.Array,
                       route_tab: jax.Array, values: jax.Array, *,
-                      block: int = 8192, interpret: bool = False):
+                      block: int = 8192, interpret: bool = False,
+                      packed_groups: int = 0):
     """Pallas exit-route: same contract as ops/partition.py
     apply_route_table(..., values=...) — returns ``(new_leaf,
     row_value)`` — but streams only binsT + leaf ids and builds the
     per-row table broadcast in VMEM."""
     from .partition import extend_table_with_values
 
-    num_groups = binsT.shape[0]
+    num_groups = logical_groups(binsT.shape[0], packed_groups) \
+        if packed_groups else binsT.shape[0]
     if num_groups >= 65536:  # fg // 256 must stay bf16-exact
         raise ValueError(
             "route_apply_tiled supports at most 65535 feature groups, "
@@ -1554,12 +1679,14 @@ def route_apply_tiled(binsT: jax.Array, leaf_id: jax.Array,
 
     kern = functools.partial(_route_value_kernel_body,
                              num_groups=num_groups,
-                             nb=ncols - ROUTE_FIXED_COLS)
+                             nb=ncols - ROUTE_FIXED_COLS,
+                             packed_groups=packed_groups)
+    s_rows = binsT.shape[0]
     leaf_out, val_out = pl.pallas_call(
         kern,
         grid=(n // block,),
         in_specs=[
-            pl.BlockSpec((num_groups, block), lambda i: (0, i)),
+            pl.BlockSpec((s_rows, block), lambda i: (0, i)),
             pl.BlockSpec((1, block), lambda i: (0, i)),
             pl.BlockSpec(routeT.shape, lambda i: (0, 0)),
         ],

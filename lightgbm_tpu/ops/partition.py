@@ -37,6 +37,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..packing import logical_groups, packed_bytes
+
 MISSING_NONE = 0
 MISSING_ZERO = 1
 MISSING_NAN = 2
@@ -192,8 +194,24 @@ def extend_table_with_values(table: jax.Array,
         [table] + _split3_bf16(values) + _split3_bf16(v_right), axis=1)
 
 
+def packed_select_params(grp, packed_groups: int):
+    """Storage-byte index, nibble shift and width mask for logical
+    group ids ``grp`` (any int32 array) under the packing.py layout —
+    the ONE jnp form of ``BinLayout.byte_of/shift_of/width_mask``,
+    shared by every device gather site (``apply_route_table`` here,
+    ``ops/predict.predict_binned``, ``ops/histogram
+    _route_prologue_T``).  Extract with ``(byte >> shift) & mask``."""
+    pb = packed_bytes(packed_groups)
+    is_p = grp < packed_groups
+    byte_idx = jnp.where(is_p, grp // 2, pb + grp - packed_groups)
+    shift = jnp.where(is_p, (grp % 2) * 4, 0)
+    mask = jnp.where(is_p, 15, 255)
+    return byte_idx, shift, mask
+
+
 def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
-                      table: jax.Array, values=None):
+                      table: jax.Array, values=None,
+                      packed_groups: int = 0):
     """Re-label rows from a packed (L, 15+nb) route table (XLA form:
     the one-hot broadcast dot materializes; the fused Pallas histogram
     kernel runs the same table in VMEM).
@@ -203,8 +221,15 @@ def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
     and right-child variants), fusing the score update's separate
     (N, L) leaf_value_broadcast into this pass — one (N, L) one-hot
     materialization instead of two per tree.  Returns
-    ``(new_leaf, row_value)`` then (row_value 0.0 on padded rows)."""
-    n, num_groups = bins.shape
+    ``(new_leaf, row_value)`` then (row_value 0.0 on padded rows).
+
+    ``packed_groups`` > 0 marks ``bins`` as the nibble-packed storage
+    matrix (lightgbm_tpu/packing.py): the chosen group's storage BYTE
+    is selected, then its nibble extracted with a per-row variable
+    shift — the packed matrix is never widened in HBM."""
+    n, cols = bins.shape
+    num_groups = logical_groups(cols, packed_groups) if packed_groups \
+        else cols
     if num_groups >= 65536:  # fg // 256 must stay bf16-exact
         raise ValueError(
             "apply_route_table (split routing) supports at most 65535 "
@@ -223,10 +248,19 @@ def apply_route_table(bins: jax.Array, leaf_id: jax.Array,
 
     grp_row = (rows[:, 0].astype(jnp.int32) * 256
                + rows[:, 1].astype(jnp.int32))
-    # chosen-group bin per row (masked sum instead of a gather; G small)
-    gsel = grp_row[:, None] == jnp.arange(num_groups,
-                                          dtype=jnp.int32)[None, :]
-    gb = jnp.sum(jnp.where(gsel, bins.astype(jnp.int32), 0), axis=1)
+    if packed_groups:
+        byte_idx, shift, mask = packed_select_params(grp_row,
+                                                     packed_groups)
+        bsel = byte_idx[:, None] == jnp.arange(cols,
+                                               dtype=jnp.int32)[None, :]
+        byte = jnp.sum(jnp.where(bsel, bins.astype(jnp.int32), 0),
+                       axis=1)
+        gb = (byte >> shift) & mask
+    else:
+        # chosen-group bin per row (masked sum, not a gather; G small)
+        gsel = grp_row[:, None] == jnp.arange(num_groups,
+                                              dtype=jnp.int32)[None, :]
+        gb = jnp.sum(jnp.where(gsel, bins.astype(jnp.int32), 0), axis=1)
     if values is None:
         return route_rows(rows, leaf_id, gb)
     new_leaf, went_right = route_rows(rows, leaf_id, gb,
@@ -335,7 +369,8 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
                  threshold: jax.Array, default_left: jax.Array,
                  missing_type: jax.Array, default_bin: jax.Array,
                  num_bin: jax.Array, cat_mask: jax.Array,
-                 right_slot: jax.Array) -> jax.Array:
+                 right_slot: jax.Array,
+                 packed_groups: int = 0) -> jax.Array:
     """Re-label rows of splitting leaves.
 
     Args:
@@ -357,5 +392,6 @@ def apply_splits(bins: jax.Array, leaf_id: jax.Array,
         split_mask, feat_group, fb_lo, fb_hi, fb_shift, fb_oor, is_cat,
         threshold, default_left, missing_type, default_bin, num_bin,
         cat_mask, right_slot)
-    return apply_route_table(bins, leaf_id, table)
+    return apply_route_table(bins, leaf_id, table,
+                             packed_groups=packed_groups)
 

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .partition import packed_select_params
+
 MISSING_NONE = 0
 MISSING_ZERO = 1
 MISSING_NAN = 2
@@ -69,12 +71,15 @@ def unpack_tree_records_device(records: jax.Array, num_leaves: int,
 def predict_binned(tree, bins: jax.Array, f_group: jax.Array,
                    g2f_lut: jax.Array, f_missing: jax.Array,
                    f_default_bin: jax.Array, f_num_bin: jax.Array,
-                   max_steps: int) -> jax.Array:
+                   max_steps: int, packed_groups: int = 0) -> jax.Array:
     """Evaluate one grown tree on a binned matrix.
 
     Args:
       tree: TreeArrays (bin-space thresholds/cat masks).
-      bins: (N, G) uint8.
+      bins: (N, G) uint8 — or the (N, cols) nibble-packed storage
+        matrix when ``packed_groups`` > 0 (lightgbm_tpu/packing.py):
+        the chosen group's storage byte is gathered and its nibble
+        extracted in-register.
       f_group/(F,): group column per inner feature.
       g2f_lut: (F, GB) group-bin -> feature-bin map.
       f_missing/f_default_bin/f_num_bin: (F,) metadata.
@@ -92,8 +97,16 @@ def predict_binned(tree, bins: jax.Array, f_group: jax.Array,
         nid = jnp.maximum(node, 0)
         feat = tree.node_feature[nid]
         grp = f_group[feat]
-        gb = jnp.take_along_axis(bins, grp[:, None].astype(jnp.int32),
-                                 axis=1)[:, 0].astype(jnp.int32)
+        if packed_groups:
+            byte_idx, shift, mask = packed_select_params(
+                grp.astype(jnp.int32), packed_groups)
+            byte = jnp.take_along_axis(
+                bins, byte_idx[:, None], axis=1)[:, 0].astype(jnp.int32)
+            gb = (byte >> shift) & mask
+        else:
+            gb = jnp.take_along_axis(bins,
+                                     grp[:, None].astype(jnp.int32),
+                                     axis=1)[:, 0].astype(jnp.int32)
         fb = g2f_lut[feat, gb]
         thr = tree.node_threshold[nid]
         dleft = tree.node_default_left[nid]

@@ -1,0 +1,321 @@
+"""Sub-byte bin-matrix packing: the nibble (4-bit) storage layout.
+
+``max_bin <= 16`` means every bin index of a feature group fits in 4
+bits, so the HBM-resident ``(N, G)`` uint8 bin matrix wastes half its
+bytes — and the bandwidth-bound histogram kernels read twice the HBM
+they need (the LiteMORT compact-binning lever, PAPERS.md arxiv
+2001.09419, on top of the GPU-histogram bandwidth analysis, arxiv
+1706.08359).  This module is the ONE home for the packed layout every
+layer shares: host-side construction (dataset.py), the binary/shard
+caches (dataset_io.py, sharded/cache.py), the quality profile's
+bincounts (quality/profile.py), and the static layout parameters the
+device kernels unpack by (ops/histogram.py, ops/partition.py,
+ops/predict.py).
+
+Layout — **nibble-interleaved, two sections**:
+
+* groups are ordered PACKABLE-FIRST at construction
+  (``Dataset._build_groups``): the first ``P`` groups each have
+  ``num_bin <= 16``, the remaining ``G - P`` are wide;
+* storage byte ``j < ceil(P/2)`` carries group ``2j`` in its LOW
+  nibble and group ``2j+1`` in its HIGH nibble (the interleave keeps
+  a bundle-adjacent pair of groups inside one byte);
+* wide groups follow one byte each: group ``P + k`` lives in storage
+  byte ``ceil(P/2) + k``.
+
+So storage column arithmetic is pure and static — ``byte_of(g)`` /
+``shift_of(g)`` below — which is what lets the Pallas kernels unpack
+nibbles in-register with static shifts instead of carrying an
+indirection table.
+
+Modes (``Config.bin_packing``):
+
+* ``8bit`` (default): no packed section — the legacy one-byte-per-
+  group matrix, bit-compatible with every existing cache;
+* ``4bit``: requires ``max_bin <= 16`` (config-level hard error).  A
+  single feature too wide for a nibble is a loud construction error
+  naming the group; a wide multi-feature EFB bundle splits out into
+  the byte-wide section with a warning ("EFB-aware group re-packing"
+  — the bundle keeps its 8-bit-identical membership and moves to the
+  wide section, because re-forming bundles at nibble width was
+  measured to break byte-exact tree parity: a different bundling
+  reconstructs default-bin mass through a different FixHistogram
+  subtraction order, f32-ulp different from direct accumulation);
+* ``auto``: adaptive precision — groups that fit pack, wide groups
+  stay byte-wide (the two-section layout).  Mixed-width datasets get
+  exactly the savings their narrow features earn.
+
+Trees are byte-identical across modes: packing changes the STORAGE of
+bin indices, never their values, bundling is identical in every mode,
+and the grower/partition/split layers stay bin-index-native (pinned
+by tests/test_compact_bins.py on the interpret seam).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .utils.log import Log
+
+#: bins-per-group bound for a nibble-packed group
+NIBBLE_MAX_BIN = 16
+
+_MODES = ("auto", "8bit", "4bit")
+
+
+def resolve_bin_packing(config) -> str:
+    """Normalize ``Config.bin_packing`` to one of ``auto|8bit|4bit``
+    (``None`` config — e.g. legacy cache restore — resolves 8bit)."""
+    if config is None:
+        return "8bit"
+    spec = str(config.bin_packing).lower() if hasattr(config,
+                                                      "bin_packing") \
+        else "8bit"
+    if spec not in _MODES:
+        Log.warning(f"unknown bin_packing={spec!r}; using '8bit'")
+        return "8bit"
+    return spec
+
+
+def packed_bytes(packed_groups: int) -> int:
+    """Storage bytes of the packed section (two groups per byte)."""
+    return (packed_groups + 1) // 2
+
+
+def storage_cols(num_groups: int, packed_groups: int) -> int:
+    """Total storage byte columns for ``num_groups`` logical groups of
+    which the first ``packed_groups`` are nibble-packed."""
+    return packed_bytes(packed_groups) + (num_groups - packed_groups)
+
+
+def logical_groups(cols: int, packed_groups: int) -> int:
+    """Inverse of :func:`storage_cols` — logical G from storage width."""
+    return cols - packed_bytes(packed_groups) + packed_groups
+
+
+class BinLayout:
+    """Resolved packing layout of one dataset's bin matrix.
+
+    A dataset whose matrix has NO packed section carries
+    ``bin_layout = None`` instead (the storage is then the plain
+    logical ``(N, G)`` matrix and every consumer takes its legacy
+    path untouched)."""
+
+    __slots__ = ("mode", "num_groups", "packed_groups")
+
+    def __init__(self, mode: str, num_groups: int, packed_groups: int):
+        if not (0 < packed_groups <= num_groups):
+            raise ValueError(
+                f"BinLayout needs 0 < packed_groups ({packed_groups}) "
+                f"<= num_groups ({num_groups}); use bin_layout=None "
+                "for an unpacked matrix")
+        self.mode = mode
+        self.num_groups = int(num_groups)
+        self.packed_groups = int(packed_groups)
+
+    # ------------------------------------------------------------------
+    @property
+    def packed_bytes(self) -> int:
+        return packed_bytes(self.packed_groups)
+
+    @property
+    def cols(self) -> int:
+        return storage_cols(self.num_groups, self.packed_groups)
+
+    def byte_of(self, g: int) -> int:
+        if g < self.packed_groups:
+            return g // 2
+        return self.packed_bytes + (g - self.packed_groups)
+
+    def shift_of(self, g: int) -> int:
+        return 4 * (g % 2) if g < self.packed_groups else 0
+
+    def width_mask(self, g: int) -> int:
+        return 0xF if g < self.packed_groups else 0xFF
+
+    def __repr__(self):
+        return (f"BinLayout({self.mode}, groups={self.num_groups}, "
+                f"packed={self.packed_groups}, cols={self.cols})")
+
+    # ------------------------------------------------------------------
+    def to_state(self) -> dict:
+        """Cache-header form (binary cache v3 / shard-cache manifest)."""
+        return {"mode": self.mode, "num_groups": int(self.num_groups),
+                "packed_groups": int(self.packed_groups)}
+
+    @classmethod
+    def from_state(cls, state: Optional[dict]) -> Optional["BinLayout"]:
+        if not state or not int(state.get("packed_groups", 0)):
+            return None
+        return cls(str(state.get("mode", "auto")),
+                   int(state["num_groups"]), int(state["packed_groups"]))
+
+    # ------------------------------------------------------------------
+    # host-side pack / unpack (vectorized numpy; the native
+    # ``ltpu_pack_nibbles`` kernel takes the pack when available)
+    # ------------------------------------------------------------------
+    def pack_rows(self, logical: np.ndarray, out: Optional[np.ndarray]
+                  = None, lib=None) -> np.ndarray:
+        """(n, G) logical uint8 -> (n, cols) storage.  ``out`` writes in
+        place (the construction pipeline packs chunk scratch straight
+        into the resident storage matrix)."""
+        logical = np.ascontiguousarray(logical, dtype=np.uint8)
+        n = logical.shape[0]
+        if logical.shape[1] != self.num_groups:
+            raise ValueError(f"pack_rows expects {self.num_groups} "
+                             f"group columns, got {logical.shape[1]}")
+        if out is None:
+            out = np.empty((n, self.cols), dtype=np.uint8)
+        P, Pb = self.packed_groups, self.packed_bytes
+        if lib is not None and n and _native_pack(lib, logical, P, out):
+            return out
+        lo = logical[:, 0:P:2]
+        hi = logical[:, 1:P:2]
+        out[:, :Pb] = lo
+        out[:, :hi.shape[1]] |= hi << np.uint8(4)
+        if hi.shape[1] < Pb:            # odd P: top nibble of the last
+            out[:, Pb - 1] &= np.uint8(0x0F)  # packed byte stays zero
+        out[:, Pb:] = logical[:, P:]
+        return out
+
+    def unpack_rows(self, storage: np.ndarray) -> np.ndarray:
+        """(n, cols) storage -> (n, G) logical uint8 (a fresh array)."""
+        storage = np.asarray(storage, dtype=np.uint8)
+        if storage.shape[1] != self.cols:
+            raise ValueError(f"unpack_rows expects {self.cols} storage "
+                             f"columns, got {storage.shape[1]}")
+        n = storage.shape[0]
+        P, Pb = self.packed_groups, self.packed_bytes
+        logical = np.empty((n, self.num_groups), dtype=np.uint8)
+        pk = storage[:, :Pb]
+        logical[:, 0:P:2] = pk & np.uint8(0x0F)
+        logical[:, 1:P:2] = (pk >> np.uint8(4))[:, :P // 2]
+        logical[:, P:] = storage[:, Pb:]
+        return logical
+
+    def unpack_group(self, storage: np.ndarray, g: int) -> np.ndarray:
+        """One logical group column's bin values, (n,) uint8."""
+        b, sh = self.byte_of(g), self.shift_of(g)
+        col = np.asarray(storage[:, b], dtype=np.uint8)
+        if g < self.packed_groups:
+            return (col >> np.uint8(sh)) & np.uint8(0x0F)
+        return col
+
+    def write_group(self, storage: np.ndarray, g: int,
+                    values: np.ndarray, rows=None) -> None:
+        """Read-modify-write one group's bin values into its nibble
+        (or byte) — the sparse/CSR push write.  Caller must keep each
+        storage BYTE single-writer (two packed groups share one)."""
+        b, sh = self.byte_of(g), self.shift_of(g)
+        vals = np.asarray(values, dtype=np.uint8)
+        if g >= self.packed_groups:
+            if rows is None:
+                storage[:, b] = vals
+            else:
+                storage[rows, b] = vals
+            return
+        keep = np.uint8(0xF0 >> sh)     # the OTHER nibble's mask
+        if rows is None:
+            storage[:, b] = (storage[:, b] & keep) | (vals << np.uint8(sh))
+        else:
+            cur = storage[rows, b]
+            storage[rows, b] = (cur & keep) | (vals << np.uint8(sh))
+
+    def fill_group(self, storage: np.ndarray, g: int, value: int) -> None:
+        """Fill one group's nibble/byte across every row (prefill of
+        implicit-zero bins for the streaming CSR push protocol) —
+        scalar broadcast, no N-element temp."""
+        b, sh = self.byte_of(g), self.shift_of(g)
+        v = np.uint8(value)
+        if g >= self.packed_groups:
+            storage[:, b] = v
+            return
+        keep = np.uint8(0xF0 >> sh)     # the OTHER nibble's mask
+        storage[:, b] &= keep
+        storage[:, b] |= np.uint8(v << sh)
+
+
+def _native_pack(lib, logical: np.ndarray, packed_groups: int,
+                 out: np.ndarray) -> bool:
+    """Native nibble pack (``ltpu_pack_nibbles``); False -> numpy path
+    (stale prebuilt libltpu.so without the entry point)."""
+    import ctypes
+    fn = getattr(lib, "ltpu_pack_nibbles", None)
+    if fn is None or not getattr(fn, "argtypes", None):
+        return False
+    if not (logical.flags.c_contiguous and out.flags.c_contiguous):
+        return False
+    n, g = logical.shape
+    fn(logical.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+       n, g, packed_groups,
+       out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+       out.shape[1])
+    return True
+
+
+# ---------------------------------------------------------------------------
+# layout construction (called from Dataset._build_groups once bundles
+# and per-group bin counts are known)
+# ---------------------------------------------------------------------------
+def build_layout(mode: str, group_num_bin: Sequence[int],
+                 group_features: Optional[List[List[int]]] = None,
+                 feature_names: Optional[Sequence[str]] = None
+                 ) -> Optional[BinLayout]:
+    """Resolve the layout for a group list ALREADY ordered
+    packable-first.  ``mode`` is the resolved ``bin_packing``; returns
+    None when nothing packs (8bit mode, or auto with no narrow group).
+
+    ``4bit`` strictness: a wide SINGLE-FEATURE group is a hard error
+    naming the group and its feature (it means max_bin > 16 reached
+    construction — a silently-wide "4-bit" matrix would defeat the
+    capacity math the caller asked for).  A wide multi-feature EFB
+    bundle only warns: it keeps its 8-bit-identical membership and
+    stores byte-wide, preserving byte-exact tree parity (see the
+    module docstring)."""
+    G = len(group_num_bin)
+    if mode == "8bit" or G == 0:
+        return None
+    P = 0
+    while P < G and group_num_bin[P] <= NIBBLE_MAX_BIN:
+        P += 1
+    if mode == "4bit" and P < G:
+        def _label(g: int) -> str:
+            feats = group_features[g] if group_features else []
+            labels = [feature_names[f] if feature_names
+                      and f < len(feature_names) else f"feature {f}"
+                      for f in feats]
+            names = (f" (features: {', '.join(map(str, labels))})"
+                     if labels else "")
+            return (f"group {g} ({group_num_bin[g]} bins){names}")
+
+        # EVERY wide group is inspected, not just the widest: a wide
+        # single-feature group is a hard error even when an even wider
+        # EFB bundle exists beside it
+        wide_single = [g for g in range(P, G)
+                       if not group_features
+                       or len(group_features[g]) == 1]
+        wide_multi = [g for g in range(P, G) if g not in wide_single]
+        if wide_multi:
+            Log.warning(
+                "bin_packing=4bit: EFB bundle(s) wider than the "
+                f"{NIBBLE_MAX_BIN} bins a nibble holds — "
+                + "; ".join(_label(g) for g in wide_multi)
+                + " — each bundle keeps its layout and stores "
+                "byte-wide (two-section matrix) so trees stay "
+                "byte-identical to the 8-bit path; disable "
+                "enable_bundle for a fully packed matrix")
+        if wide_single:
+            # a categorical feature can exceed max_bin even when
+            # max_bin <= 16 (its bin count follows the fitted category
+            # table), so "lower max_bin" is not always the way out
+            Log.fatal(
+                "bin_packing=4bit: feature group(s) too wide for the "
+                f"{NIBBLE_MAX_BIN} bins a nibble holds — "
+                + "; ".join(_label(g) for g in wide_single)
+                + " — lower max_bin (<= 16; a categorical feature "
+                "needs <= 15 distinct categories) or use "
+                "bin_packing=auto to keep wide groups byte-wide")
+    if P == 0:
+        return None
+    return BinLayout(mode, G, P)

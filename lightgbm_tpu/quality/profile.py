@@ -162,8 +162,18 @@ def feature_bin_counts(core) -> Dict[int, np.ndarray]:
     what the training kernels actually saw."""
     gb = np.asarray(core.group_bins)
     n = int(gb.shape[0])
+    lay = getattr(core, "bin_layout", None)
+
+    def group_col(g: int) -> np.ndarray:
+        # nibble-packed storage (packing.py): a group's bin values
+        # live in one nibble of its storage byte — extract before the
+        # bincount so packed datasets profile identically to 8-bit
+        # ones (pinned equal to the per-feature value_to_bin bincount
+        # by tests/test_compact_bins.py)
+        return lay.unpack_group(gb, g) if lay is not None else gb[:, g]
+
     group_counts = [
-        np.bincount(gb[:, g], minlength=int(core.group_num_bin[g]))
+        np.bincount(group_col(g), minlength=int(core.group_num_bin[g]))
         .astype(np.int64)
         for g in range(core.num_groups)]
     out: Dict[int, np.ndarray] = {}

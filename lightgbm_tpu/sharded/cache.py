@@ -73,6 +73,7 @@ def save_shard_cache(sds: ShardedDataset, cache_dir: str) -> str:
         a, b = sds.shard_ranges[i]
         shards.append({"file": _shard_file(i), "rows": int(b - a),
                        "bytes": int(os.path.getsize(path))})
+    lay = getattr(sds, "bin_layout", None)
     manifest = {
         "schema": SHARD_CACHE_SCHEMA,
         "world_size": int(sds.world_size),
@@ -81,6 +82,10 @@ def save_shard_cache(sds: ShardedDataset, cache_dir: str) -> str:
         "max_bin": int(sds.max_bin),
         "row_ranges": [[int(a), int(b)] for a, b in sds.shard_ranges],
         "mapper_fingerprint": sds.bin_fingerprint,
+        # storage layout of every shard's bin matrix (packing.py);
+        # absent/None = 8-bit.  Recorded here so a loader can refuse a
+        # width mismatch BEFORE interpreting any shard's bytes
+        "bin_packing": lay.to_state() if lay is not None else None,
         "shards": shards,
     }
     mpath = os.path.join(cache_dir, MANIFEST_NAME)
@@ -144,6 +149,26 @@ def load_shard_cache(cache_dir: str,
             f"{mpath}: row ranges cover {pos} rows, manifest says "
             f"{man['num_data']}")
 
+    from ..packing import BinLayout, resolve_bin_packing
+    man_lay = BinLayout.from_state(man.get("bin_packing"))
+    if config is not None:
+        want = resolve_bin_packing(config)
+        if want == "8bit" and man_lay is not None:
+            # "8bit" is also the DEFAULT, so this cannot refuse — a
+            # default-params run must be able to reload the packed
+            # cache it just built.  The recorded layout is kept (every
+            # consumer reads through bin_layout; no mis-bin path)
+            Log.warning(
+                f"{cache_dir}: shard cache holds nibble-packed bin "
+                f"matrices ({man_lay!r}); bin_packing=8bit applies "
+                "to new constructions — the cached layout is kept "
+                "(reconstruct the cache for unpacked shards)")
+        elif want == "4bit" and man_lay is None:
+            raise ShardCacheError(
+                f"{cache_dir}: shard cache holds 8-bit bin matrices "
+                "but this run asked for bin_packing=4bit — "
+                "reconstruct the cache under bin_packing=4bit")
+
     cores = []
     for i, rec in enumerate(man["shards"]):
         path = os.path.join(cache_dir, rec["file"])
@@ -160,6 +185,15 @@ def load_shard_cache(cache_dir: str,
             raise ShardCacheError(
                 f"{path}: shard holds {core.num_data} rows, manifest "
                 f"recorded {rec['rows']}")
+        shard_lay = getattr(core, "bin_layout", None)
+        if (shard_lay is None) != (man_lay is None) or (
+                shard_lay is not None
+                and shard_lay.to_state() != man_lay.to_state()):
+            raise ShardCacheError(
+                f"{path}: shard storage layout "
+                f"({shard_lay!r}) disagrees with the manifest "
+                f"({man_lay!r}) — stale shard next to a newer "
+                "manifest (or vice versa); reconstruct the cache")
         fp = binfind.mapper_fingerprint(core.mappers, core._bundles,
                                         core.max_bin)
         if fp != man["mapper_fingerprint"]:
@@ -182,6 +216,7 @@ def load_shard_cache(cache_dir: str,
     sds.features = tpl.features
     sds.group_num_bin = tpl.group_num_bin
     sds.group_is_multi = tpl.group_is_multi
+    sds.bin_layout = man_lay
     sds._bundles = tpl._bundles
     sds.feature_names = tpl.feature_names
     sds._categorical_features = tpl._categorical_features

@@ -137,6 +137,11 @@ test -s /tmp/lgbtpu_smoke/serve.json
 # participants, merged-mapper + bin parity vs the single-matrix route,
 # shard-cache v2 manifest round trip — its JSON block is asserted by
 # tests/test_bench_smoke.py
+# BENCH_COMPACT pins the round-18 compact_bins probe on: 8bit vs
+# 4bit construct rows/s on the same max_bin=15 draw, host + device
+# bin-matrix bytes with the >=2x packing-ratio gate, and the
+# byte-identical-trees parity gate — its JSON block is asserted by
+# tests/test_bench_smoke.py
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \
@@ -152,5 +157,6 @@ BENCH_LOCAL_REF=0 \
 BENCH_SKIP_F32=1 \
 BENCH_SHARD=1 \
 BENCH_SHARD_PARTICIPANTS=${BENCH_SHARD_PARTICIPANTS:-2} \
+BENCH_COMPACT=1 \
 BENCH_BUDGET_S=${BENCH_BUDGET_S:-600} \
 exec python bench.py

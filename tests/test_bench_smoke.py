@@ -83,6 +83,32 @@ def test_bench_smoke_json_contract():
     assert sc["shards"] == 2, "smoke runs 2 simulated participants"
     assert sc["parity"] == "pass"
     assert sc["manifest_reject"] == "pass"
+    # compact-bins probe (round 18): nibble-packed (bin_packing=4bit)
+    # pipeline vs 8-bit on the same max_bin=15 draw — >=2x packing
+    # ratio (host AND gauge-measured device matrix), construct rows/s
+    # per mode, the histogram bytes-read model, byte-identical trees
+    assert "compact_bins" in out, \
+        "compact_bins probe must run in the smoke"
+    cb = out["compact_bins"]
+    for field in ("rows", "max_bin", "construct_rows_per_s_8bit",
+                  "construct_rows_per_s_4bit",
+                  "construct_ratio_4bit_vs_8bit",
+                  "host_matrix_bytes_8bit", "host_matrix_bytes_4bit",
+                  "bin_matrix_bytes_8bit", "bin_matrix_bytes_4bit",
+                  "packing_ratio", "device_packing_ratio",
+                  "hist_bytes_per_row_8bit", "hist_bytes_per_row_4bit",
+                  "hist_stream_ratio", "parity"):
+        assert field in cb, f"compact_bins block missing {field}"
+    assert cb["max_bin"] == 15
+    assert cb["packing_ratio"] >= 2.0, \
+        "4-bit matrix must halve the 8-bit bytes at max_bin=15"
+    # acceptance: device matrix <= 0.55x the 8-bit bytes, gauge-measured
+    # (a zero gauge would make the ratio assert pass vacuously)
+    assert cb["bin_matrix_bytes_8bit"] > 0, \
+        "bin_matrix_bytes gauge must be measured, not defaulted"
+    assert cb["bin_matrix_bytes_4bit"] <= \
+        0.55 * cb["bin_matrix_bytes_8bit"]
+    assert cb["parity"] == "pass"
     # reliability probe (round 12): checkpoint save overhead measured
     # and the smoke fault-plan recovery (SIGKILL mid-train -> resume)
     # byte-identical — scripts/reliability_probe.py, run in-line by

@@ -1,0 +1,412 @@
+"""Sub-byte (nibble-packed) bin matrix: end-to-end parity suite.
+
+The bin_packing=4bit/auto storage layouts (lightgbm_tpu/packing.py)
+change HOW bin indices are stored — never their values — so every
+route must produce byte-identical trees to the 8-bit path: serial
+(XLA), the Pallas interpret seam, streaming pushes at every chunk
+size, and the sharded construction.  Caches must round-trip the
+layout and refuse width mismatches loudly, and the quality profile's
+bincounts must read nibbles correctly.
+"""
+import glob
+import os
+import re
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Dataset as CoreDataset
+from lightgbm_tpu.packing import BinLayout
+from lightgbm_tpu.utils.log import LightGBMError
+
+SEED = 7
+
+
+def _strip(model_text: str) -> str:
+    """Model text minus the bin_packing parameter echo (the ONLY
+    permitted difference between modes)."""
+    return re.sub(r"\[bin_packing: \w+\]", "", model_text)
+
+
+def _data(n=900, f=6, seed=SEED):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.8).astype(np.float64)
+    return X, y
+
+
+def _base_params(**kw):
+    p = {"objective": "binary", "max_bin": 15, "num_iterations": 3,
+         "num_leaves": 6, "min_data_in_leaf": 5, "verbose": -1}
+    p.update(kw)
+    return p
+
+
+def _train_text(params, X, y, **dkw):
+    return lgb.train(params, lgb.Dataset(X, label=y, **dkw)) \
+        .model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# construction-layer parity
+# ---------------------------------------------------------------------------
+def test_packed_storage_halves_and_unpacks_exactly():
+    X, y = _data()
+    d8 = CoreDataset.from_matrix(X, label=y, config=Config.from_params(
+        _base_params(bin_packing="8bit")))
+    d4 = CoreDataset.from_matrix(X, label=y, config=Config.from_params(
+        _base_params(bin_packing="4bit")))
+    assert d8.bin_layout is None
+    lay = d4.bin_layout
+    assert lay is not None and lay.packed_groups == d8.num_groups
+    assert d4.group_bins.shape[1] == (d8.num_groups + 1) // 2
+    assert np.array_equal(d4.logical_group_bins(), d8.group_bins)
+    # every packed byte's nibbles hold bins < 16
+    assert int(np.asarray(d4.group_bins).max()) <= 0xFF
+    assert np.all(lay.unpack_rows(np.asarray(d4.group_bins)) < 16)
+
+
+def test_auto_mode_two_section_layout():
+    # 3 narrow features (few distinct values) + 3 continuous wide ones
+    X, y = _data(n=1200)
+    X = np.concatenate([np.round(X[:, :3] * 3) / 3, X[:, 3:]], axis=1)
+    cfg = _base_params(max_bin=255, bin_packing="auto")
+    da = CoreDataset.from_matrix(X, label=y,
+                                 config=Config.from_params(cfg))
+    d8 = CoreDataset.from_matrix(X, label=y, config=Config.from_params(
+        dict(cfg, bin_packing="8bit")))
+    lay = da.bin_layout
+    assert lay is not None and 0 < lay.packed_groups < da.num_groups
+    # packable groups lead, wide groups trail (two-section order)
+    widths = da.group_num_bin
+    assert all(w <= 16 for w in widths[:lay.packed_groups])
+    assert all(w > 16 for w in widths[lay.packed_groups:])
+    # same trees despite the group reorder
+    ta = _train_text(cfg, X, y)
+    t8 = _train_text(dict(cfg, bin_packing="8bit"), X, y)
+    assert _strip(ta) == _strip(t8)
+
+
+@pytest.mark.parametrize("corner", ["nan", "zero_missing", "categorical",
+                                    "efb"])
+def test_corner_tree_parity(corner):
+    rng = np.random.RandomState(11)
+    n = 1000
+    dkw = {}
+    if corner == "efb":
+        X = np.zeros((n, 8))
+        X[np.arange(n), rng.randint(0, 8, n)] = rng.rand(n) + 0.5
+        y = (X.sum(1) > 1.0).astype(np.float64)
+        p = _base_params()
+    else:
+        X = rng.rand(n, 5)
+        y = (X[:, 0] > 0.5).astype(np.float64)
+        p = _base_params()
+        if corner == "nan":
+            X[rng.rand(n) < 0.15, 1] = np.nan
+        elif corner == "zero_missing":
+            X[rng.rand(n) < 0.3, 1] = 0.0
+            p["zero_as_missing"] = True
+        else:
+            X[:, 2] = rng.randint(0, 9, n)
+            dkw = {"categorical_feature": [2]}
+    t8 = _train_text(dict(p, bin_packing="8bit"), X, y, **dkw)
+    for mode in ("4bit", "auto"):
+        tm = _train_text(dict(p, bin_packing=mode), X, y, **dkw)
+        assert _strip(tm) == _strip(t8), f"{corner} differs under {mode}"
+
+
+# ---------------------------------------------------------------------------
+# interpret seam: the Pallas kernels the real chip runs
+# ---------------------------------------------------------------------------
+def test_interpret_seam_tree_parity_quantized():
+    X, y = _data(n=700)
+    p = _base_params(force_pallas_interpret=True, quantized_grad=True)
+    t8 = _train_text(dict(p, bin_packing="8bit"), X, y)
+    t4 = _train_text(dict(p, bin_packing="4bit"), X, y)
+    assert _strip(t8) == _strip(t4)
+
+
+@pytest.mark.slow
+def test_interpret_seam_tree_parity_streamed_onehot():
+    X, y = _data(n=700)
+    p = _base_params(force_pallas_interpret=True,
+                     hist_compute_dtype="bfloat16")
+    t8 = _train_text(dict(p, bin_packing="8bit"), X, y)
+    t4 = _train_text(dict(p, bin_packing="4bit"), X, y)
+    assert _strip(t8) == _strip(t4)
+
+
+# ---------------------------------------------------------------------------
+# streaming + sharded ingest routes
+# ---------------------------------------------------------------------------
+def test_streaming_push_chunk_invariant(tmp_path):
+    X, y = _data(n=1500)
+    csv = tmp_path / "d.csv"
+    np.savetxt(csv, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    base = {"max_bin": 15, "bin_packing": "4bit", "label_column": "0",
+            "use_two_round_loading": True, "verbose": -1}
+    mats = []
+    for chunk in (128, 700, 65536):
+        ds = lgb.Dataset(str(csv), params=dict(
+            base, streaming_chunk_rows=chunk)).construct()
+        assert ds.bin_layout is not None
+        mats.append(np.asarray(ds.group_bins))
+    assert all(np.array_equal(m, mats[0]) for m in mats[1:])
+    # streamed packed storage == in-RAM packed storage
+    din = CoreDataset.from_matrix(X, label=y, config=Config.from_params(
+        {"max_bin": 15, "bin_packing": "4bit", "verbose": -1}))
+    assert np.array_equal(din.group_bins, mats[0])
+    # == the 8-bit route, logically
+    d8 = lgb.Dataset(str(csv), params=dict(
+        base, bin_packing="8bit")).construct()
+    assert np.array_equal(ds.bin_layout.unpack_rows(mats[0]),
+                          d8.group_bins)
+
+
+def test_csr_push_matches_dense():
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(13)
+    n = 1200
+    Xs = sp.random(n, 10, density=0.15, random_state=rng, format="csc")
+    cfg = Config.from_params({"max_bin": 15, "bin_packing": "4bit",
+                              "verbose": -1})
+    dense = CoreDataset.from_matrix(np.asarray(Xs.todense()), config=cfg)
+    push = CoreDataset.from_reference_for_push(dense, n)
+    csr = Xs.tocsr()
+    for i in range(0, n, 500):
+        sub = csr[i:min(n, i + 500)]
+        push.push_rows_csr(sub.indptr, sub.indices, sub.data, i)
+    push.finish_load()
+    assert np.array_equal(np.asarray(push.group_bins),
+                          np.asarray(dense.group_bins))
+
+
+def test_sharded_route_parity_and_cache(tmp_path):
+    from lightgbm_tpu.sharded import (ShardCacheError, ShardedDataset,
+                                      load_shard_cache, save_shard_cache)
+    X, y = _data(n=1400)
+    cfg = Config.from_params({"max_bin": 15, "bin_packing": "4bit",
+                              "sharded_shards": 3, "verbose": -1})
+    single = CoreDataset.from_matrix(X, label=y, config=cfg)
+    sds = ShardedDataset.construct_sharded(X, label=y, config=cfg)
+    assert sds.bin_layout is not None
+    assert np.array_equal(sds.assembled_group_bins(), single.group_bins)
+
+    cache_dir = str(tmp_path / "shards")
+    save_shard_cache(sds, cache_dir)
+    re_sds = load_shard_cache(cache_dir, expect_world_size=3, config=cfg)
+    assert re_sds.bin_layout is not None \
+        and re_sds.bin_layout.to_state() == sds.bin_layout.to_state()
+    assert np.array_equal(re_sds.assembled_group_bins(),
+                          single.group_bins)
+    # a 4-bit shard cache under an 8-bit config (which is ALSO the
+    # default — a default-params rerun must reload the cache it just
+    # built) loads with the recorded layout kept, warning logged
+    re8 = load_shard_cache(cache_dir, expect_world_size=3,
+                           config=Config.from_params(
+                               {"max_bin": 15, "bin_packing": "8bit",
+                                "sharded_shards": 3, "verbose": -1}))
+    assert re8.bin_layout is not None \
+        and re8.bin_layout.to_state() == sds.bin_layout.to_state()
+    # the converse — explicit 4bit intent over an 8-bit cache — is
+    # unambiguous (4bit is never a default) and refuses loudly
+    save_shard_cache(ShardedDataset.construct_sharded(
+        X, label=y, config=Config.from_params(
+            {"max_bin": 15, "bin_packing": "8bit",
+             "sharded_shards": 3, "verbose": -1})),
+        str(tmp_path / "shards8"))
+    with pytest.raises(ShardCacheError, match="bin_packing=4bit"):
+        load_shard_cache(str(tmp_path / "shards8"),
+                         expect_world_size=3, config=cfg)
+
+
+# ---------------------------------------------------------------------------
+# binary cache round trip + mismatch refusal
+# ---------------------------------------------------------------------------
+def test_binary_cache_roundtrip_and_refusal(tmp_path):
+    from lightgbm_tpu.dataset_io import load_binary, save_binary
+    X, y = _data()
+    cfg4 = Config.from_params({"max_bin": 15, "bin_packing": "4bit",
+                               "verbose": -1})
+    cfg8 = Config.from_params({"max_bin": 15, "bin_packing": "8bit",
+                               "verbose": -1})
+    d4 = CoreDataset.from_matrix(X, label=y, config=cfg4)
+    d8 = CoreDataset.from_matrix(X, label=y, config=cfg8)
+    f4, f8 = str(tmp_path / "d4.bin"), str(tmp_path / "d8.bin")
+    save_binary(d4, f4)
+    save_binary(d8, f8)
+    # packed cache: round-trips layout + bytes; auto accepts it
+    r4 = load_binary(f4, config=cfg4)
+    assert r4.bin_layout.to_state() == d4.bin_layout.to_state()
+    assert np.array_equal(np.asarray(r4.group_bins), d4.group_bins)
+    load_binary(f4, config=Config.from_params(
+        {"max_bin": 15, "bin_packing": "auto", "verbose": -1}))
+    # a 4-bit cache under an 8-bit config (also the DEFAULT — a
+    # default-params rerun must reload the cache it just built) loads
+    # with the recorded layout kept, not refused
+    r48 = load_binary(f4, config=cfg8)
+    assert r48.bin_layout is not None \
+        and r48.bin_layout.to_state() == d4.bin_layout.to_state()
+    # explicit 4-bit intent over an 8-bit cache is unambiguous
+    # (4bit is never a default) and refuses loudly
+    with pytest.raises(LightGBMError, match="8-bit bin matrix"):
+        load_binary(f8, config=cfg4)
+    # 8-bit v2 files keep loading unchanged (no layout recorded)
+    r8 = load_binary(f8)
+    assert r8.bin_layout is None
+    assert np.array_equal(np.asarray(r8.group_bins), d8.group_bins)
+    # the version field: packed files bump to v3, 8-bit files stay v2
+    # (an older reader refuses v3 instead of silently mis-binning)
+    import pickle
+    import struct
+
+    from lightgbm_tpu.dataset_io import BINARY_TOKEN, MAGIC_V2
+
+    def _version(path):
+        with open(path, "rb") as f:
+            f.read(len(BINARY_TOKEN) + len(MAGIC_V2))
+            (blob_len,) = struct.unpack("<Q", f.read(8))
+            return pickle.loads(f.read(blob_len))["version"]
+
+    assert _version(f4) == 3
+    assert _version(f8) == 2
+
+
+# ---------------------------------------------------------------------------
+# quality profile: nibble-aware bincounts
+# ---------------------------------------------------------------------------
+def test_quality_bincount_matches_value_to_bin():
+    from lightgbm_tpu.quality.profile import feature_bin_counts
+    rng = np.random.RandomState(17)
+    n = 1100
+    X = rng.rand(n, 5)
+    X[:, 3] = rng.randint(0, 7, n)
+    X[rng.rand(n) < 0.1, 0] = np.nan
+    y = (np.nan_to_num(X[:, 0]) > 0.5).astype(np.float64)
+    cfg = Config.from_params({"max_bin": 15, "bin_packing": "4bit",
+                              "verbose": -1})
+    core = CoreDataset.from_matrix(X, label=y, config=cfg,
+                                   categorical_features=[3])
+    counts = feature_bin_counts(core)
+    for f in core.features:
+        m = core.mappers[f.feature_idx]
+        direct = np.bincount(
+            np.asarray(m.value_to_bin(X[:, f.feature_idx])),
+            minlength=m.num_bin)
+        assert np.array_equal(counts[f.feature_idx], direct), \
+            f"feature {f.feature_idx} bincount diverges on packed data"
+
+
+# ---------------------------------------------------------------------------
+# lowering pins: the packed path adds no scatter and no wide dtypes
+# ---------------------------------------------------------------------------
+def test_packed_histogram_lowering_clean():
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import (compute_group_histograms,
+                                            packed_cols)
+    G, P = 7, 5
+    cols = packed_cols(G, P)
+    n = 512
+    args = (
+        jax.ShapeDtypeStruct((n, cols), jnp.uint8),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+    )
+    text = compute_group_histograms.lower(
+        *args, num_leaves=4, max_group_bin=16, chunk=256,
+        packed_groups=P).as_text()
+    assert "stablehlo.scatter" not in text, \
+        "nibble unpack must not introduce scatters"
+    assert "f64" not in text, \
+        "nibble unpack must not widen any dtype to f64"
+
+
+def test_packed_unpack_numerics():
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import unpack_bins_cols
+    lay = BinLayout("auto", 5, 3)  # 3 packed + 2 wide -> 4 cols
+    rng = np.random.RandomState(3)
+    logical = rng.randint(0, 16, size=(64, 5)).astype(np.uint8)
+    logical[:, 3:] = rng.randint(0, 256, size=(64, 2))
+    storage = lay.pack_rows(logical)
+    assert storage.shape == (64, 4)
+    # host unpack, per-group reads, and the device widen all agree
+    assert np.array_equal(lay.unpack_rows(storage), logical)
+    for g in range(5):
+        assert np.array_equal(lay.unpack_group(storage, g),
+                              logical[:, g])
+    dev = np.asarray(unpack_bins_cols(jnp.asarray(storage),
+                                      num_groups=5, packed_groups=3))
+    assert np.array_equal(dev, logical)
+
+
+def test_valid_set_layout_mismatch_refused():
+    # equal feature_infos no longer imply an equal matrix layout: the
+    # same data constructed under a different bin_packing packs (and
+    # group-reorders) differently, and _predict_valid walks the valid
+    # matrix with the TRAINING set's packed_groups — the gbdt gate
+    # must refuse instead of silently scoring garbage eval metrics
+    X, y = _data()
+    p4 = _base_params(bin_packing="4bit")
+    v8 = lgb.Dataset(X, label=y,
+                     params=_base_params(bin_packing="8bit")).construct()
+    with pytest.raises(LightGBMError, match="storage layout"):
+        lgb.train(p4, lgb.Dataset(X, label=y), valid_sets=[v8])
+    # reference-aligned valid sets share the layout and train fine
+    d4 = lgb.Dataset(X, label=y)
+    lgb.train(p4, d4, valid_sets=[lgb.Dataset(X, label=y,
+                                              reference=d4)])
+
+
+def test_v1_cache_refuses_packed_dataset(tmp_path):
+    # the v1 pickle has no layout field — saving a packed matrix
+    # through it would reload as 8-bit columns and silently mis-bin
+    from lightgbm_tpu.dataset_io import save_binary
+    X, y = _data()
+    d4 = CoreDataset.from_matrix(X, label=y, config=Config.from_params(
+        _base_params(bin_packing="4bit")))
+    with pytest.raises(LightGBMError, match="v1 binary format"):
+        save_binary(d4, str(tmp_path / "p1.bin"), version=1)
+
+
+def test_wide_single_feature_is_hard_error():
+    # a categorical feature can out-grow a nibble even at max_bin<=16;
+    # 4bit must refuse loudly naming the feature (auto keeps it wide)
+    rng = np.random.RandomState(5)
+    X = rng.rand(600, 4)
+    X[:, 2] = rng.randint(0, 40, 600)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    with pytest.raises(LightGBMError, match="Column_2"):
+        CoreDataset.from_matrix(
+            X, label=y, config=Config.from_params(_base_params(
+                max_bin=16, bin_packing="4bit")),
+            categorical_features=[2])
+    da = CoreDataset.from_matrix(
+        X, label=y, config=Config.from_params(_base_params(
+            max_bin=16, bin_packing="auto")),
+        categorical_features=[2])
+    lay = da.bin_layout
+    assert lay is not None and lay.packed_groups == da.num_groups - 1
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+def test_config_validation():
+    with pytest.raises(ValueError, match="bin_packing"):
+        Config.from_params({"bin_packing": "2bit"})
+    with pytest.raises(ValueError, match="max_bin <= 16"):
+        Config.from_params({"bin_packing": "4bit", "max_bin": 63})
+    # the 8-bit message is packing-aware now
+    with pytest.raises(ValueError, match="bin_packing=4bit/auto"):
+        Config.from_params({"max_bin": 300})
+    Config.from_params({"bin_packing": "4bit", "max_bin": 16})
+    Config.from_params({"bin_packing": "auto", "max_bin": 255})

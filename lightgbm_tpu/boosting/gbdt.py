@@ -677,7 +677,8 @@ class GBDT:
         try:
             with tm.span("host_dispatch"):
                 scores, vscores, bag, trees, nls = retry_call(
-                    _enqueue, policy=self._retry_policy(),
+                    self._dispatch_guard(_enqueue, "gbdt.train_chunk"),
+                    policy=self._retry_policy(),
                     seam="gbdt.train_chunk")
             if tm.on:
                 # the r7 bench split, now first-class counters: time-
@@ -854,7 +855,9 @@ class GBDT:
         try:
             with tm.span("host_dispatch"):
                 scores, vscores, bag, trees, nl = retry_call(
-                    _enqueue, policy=self._retry_policy(),
+                    self._dispatch_guard(_enqueue,
+                                         "gbdt.train_one_iter"),
+                    policy=self._retry_policy(),
                     seam="gbdt.train_one_iter")
             if tm.on:
                 tm.add("host_dispatch_ms",
@@ -1070,6 +1073,27 @@ class GBDT:
             p = RetryPolicy.from_config(self.config)
             self._retry_policy_cache = p
         return p
+
+    def _dispatch_guard(self, fn, seam: str):
+        """Deadline-bound a dispatch enqueue under
+        ``watchdog_dispatch_s`` (docs/RELIABILITY.md, deadline
+        watchdog): an enqueue that has not returned within the
+        deadline — a wedged backend RPC, a ``hang`` fault — dumps
+        all-thread stacks and raises a classified ``StallError``,
+        which the surrounding ``retry_call`` treats as transient
+        (the enqueue precedes any state mutation, so re-entering is
+        exact).  Disarmed (the default 0) this returns ``fn``
+        untouched — zero overhead, identical programs."""
+        wd = float(getattr(self.config, "watchdog_dispatch_s", 0.0)
+                   or 0.0)
+        if wd <= 0:
+            return fn
+        from ..reliability.watchdog import run_with_deadline
+
+        def _bounded():
+            return run_with_deadline(fn, wd, phase="dispatch",
+                                     seam=seam)
+        return _bounded
 
     def can_checkpoint(self) -> bool:
         """Whether full-state checkpointing covers this booster: plain

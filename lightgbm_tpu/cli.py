@@ -216,9 +216,27 @@ def _task_serve(params, config: Config) -> None:
     if not config.input_model:
         Log.fatal("No model file: set input_model=<file>")
     import os
+    import signal
     import threading
 
     from .serving import ModelRegistry, ServingFrontend
+    # graceful SIGTERM drain (docs/RELIABILITY.md): the orchestrator's
+    # polite shutdown (kubectl delete, systemd stop) must not look
+    # like a crash — on SIGTERM the process stops admission (routes
+    # unmounted), drains every in-flight coalesced batch, lets the
+    # continuous lane finish its phase (the ledger commit is the
+    # phase boundary), and exits 0.  Only SIGKILL is a crash, and the
+    # r12 checkpoint/ledger machinery owns that path.  Installed
+    # BEFORE the first publish so a shutdown during warm-up is
+    # graceful too.
+    stop = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        Log.info("SIGTERM: stopping admission and draining in-flight "
+                 "work (serving queues + continuous lane)")
+        stop.set()
+
+    prev_term = signal.signal(signal.SIGTERM, _on_sigterm)
     name = os.path.splitext(
         os.path.basename(config.input_model))[0] or "model"
     registry = ModelRegistry(config)
@@ -253,13 +271,18 @@ def _task_serve(params, config: Config) -> None:
                  f"{config.continuous_poll_s:g}s; GET/POST "
                  f"http://127.0.0.1:{port}/continuous)")
     try:
-        threading.Event().wait()      # serve until SIGINT
+        stop.wait()                   # serve until SIGTERM or SIGINT
     except KeyboardInterrupt:
         Log.info("interrupt: draining serving queues")
     finally:
+        if prev_term is not None:
+            # None = the previous disposition was installed outside
+            # Python (embedding host); signal.signal(None) would raise
+            signal.signal(signal.SIGTERM, prev_term)
         if lane is not None:
             lane.stop()
         frontend.stop(drain=True)
+        Log.info("serving drained cleanly; exiting 0")
 
 
 def _task_refit(params, config: Config) -> None:

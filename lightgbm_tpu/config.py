@@ -575,6 +575,15 @@ class Config:
     # candidate sample quota for distributed bin finding; 0 derives
     # bin_construct_sample_cnt / sharded_shards (so the merged sample
     # matches the single-host sample budget)
+    sharded_allow_degraded: bool = False  # degraded-mode continuation
+    # for sharded construction: when a participant's binfind/ingest
+    # seam dies (or hangs past watchdog_collective_s), EXCLUDE it —
+    # log loudly, count sharded_degraded_exclusions — and continue on
+    # the surviving participants with quota-rebalanced shards; the
+    # degraded run's trees are byte-identical to a from-scratch run
+    # on the surviving world (pinned by tests/test_chaos.py).  false
+    # (default) keeps today's fail-fast: any participant failure
+    # fails the construction loudly
 
     # -- serving (new; no reference analog) --
     serve_batch_deadline_ms: float = 2.0  # micro-batching scheduler
@@ -731,9 +740,42 @@ class Config:
     # counted in the oom_downshifts telemetry counter)
     fault_plan: str = ""        # deterministic fault-injection plan
     # (config-file form of the LTPU_FAULT_PLAN env var):
-    # "seam:nth:action[:xCount];..." raises/kills on the Nth call at a
-    # registered seam — the mechanism every recovery test drives its
-    # failures through (docs/RELIABILITY.md, fault-plan grammar)
+    # "seam:nth:action[:xCount];..." raises/kills/hangs on the Nth
+    # call at a registered seam (actions: kill, oom, hang:<ms>,
+    # slow:<ms>, or a builtin exception name) — the mechanism every
+    # recovery test drives its failures through; the seeded
+    # "chaos:<seed>:<n_faults>[:<seam_glob>]" form draws randomized
+    # multi-fault plans replayable from the seed
+    # (docs/RELIABILITY.md, fault-plan grammar + chaos testing)
+    watchdog_dispatch_s: float = 0.0  # deadline watchdog
+    # (reliability/watchdog.py): bound on the fused-chunk /
+    # per-iteration dispatch enqueue — a dispatch that has not
+    # returned within this many seconds dumps ALL-thread stacks to
+    # the flight recorder and surfaces a classified StallError
+    # through the retry machinery (transient: bounded retries apply).
+    # 0 (default) leaves the dispatch unbounded
+    watchdog_collective_s: float = 0.0  # deadline on blocking host
+    # collectives (distributed._allgather, HostCollectives gathers)
+    # and on each sharded-construct participant's binfind/ingest work
+    # — the Network time_out analog for every collective op; with
+    # sharded_allow_degraded=true a participant stalled past it is
+    # EXCLUDED and construction continues on the surviving world.
+    # 0 = unbounded
+    watchdog_checkpoint_s: float = 0.0  # deadline on checkpoint/
+    # ledger file IO (atomic writes + checkpoint reads): a wedged
+    # filesystem surfaces as a StallError instead of freezing
+    # training silently.  0 = unbounded
+    watchdog_serve_s: float = 0.0  # deadline on each coalesced
+    # serving dispatch (serving/batcher.py): a stalled dispatch fails
+    # its batch with a StallError — the HTTP frontend answers 503 +
+    # Retry-After (stall-classified, counted in ltpu_stalls_total /
+    # serve_stalls) instead of letting every client time out
+    # together.  0 = unbounded
+    watchdog_continuous_s: float = 0.0  # deadline on each
+    # continuous-lane cycle PHASE (ingest/train/eval/publish): the
+    # monitor thread dumps all-thread stacks and counts a stall when
+    # a phase exceeds it (observability — the phase itself is not
+    # interrupted).  0 = unbounded
 
     # free-form passthrough of unrecognized params (warned, kept for
     # echo; consumed wholesale through to_dict/model-file echo, never
@@ -755,6 +797,8 @@ class Config:
         _telemetry_apply(self)
         from .reliability.faults import apply_config as _faults_apply
         _faults_apply(self)
+        from .reliability.watchdog import apply_config as _wd_apply
+        _wd_apply(self)
 
     # ------------------------------------------------------------------
     def check(self):
@@ -890,6 +934,12 @@ class Config:
             raise ValueError("dispatch_retries must be >= 0")
         if self.retry_backoff_s < 0:
             raise ValueError("retry_backoff_s must be >= 0")
+        for _wd_phase in ("dispatch", "collective", "checkpoint",
+                          "serve", "continuous"):
+            if getattr(self, f"watchdog_{_wd_phase}_s") < 0:
+                raise ValueError(
+                    f"watchdog_{_wd_phase}_s must be >= 0 "
+                    "(0 = no deadline)")
         if self.fault_plan:
             # parse NOW so a typo'd plan fails the run instead of
             # silently never injecting (a vacuous recovery test)

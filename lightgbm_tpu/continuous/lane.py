@@ -145,6 +145,14 @@ class ContinuousLane:
         self._thread: Optional[threading.Thread] = None
         self._routes_mounted = False
         self.last_cycle: Optional[dict] = None
+        # per-phase deadline watch (docs/RELIABILITY.md, deadline
+        # watchdog): each cycle phase re-arms a one-shot monitor
+        # token; a phase stalled past watchdog_continuous_s dumps
+        # all-thread stacks + counts a stall (observability — the
+        # phase is not interrupted).  0 (default) = unwatched
+        self._watchdog_s = float(getattr(
+            config, "watchdog_continuous_s", 0.0) or 0.0)
+        self._watch_token = None
 
     # -- paths / ledger ------------------------------------------------
     def _p(self, *parts: str) -> str:
@@ -282,7 +290,14 @@ class ContinuousLane:
     def _phase(self, phase: str, cycle: int) -> None:
         """Enter a cycle phase: the ``continuous.cycle`` fault seam
         fires BEFORE the phase's side effects (kill/OOM injection
-        lands between commits, where recovery must replay)."""
+        lands between commits, where recovery must replay), and the
+        deadline watchdog re-arms for the new phase (the previous
+        phase's token is cancelled — it completed by reaching here)."""
+        from ..reliability.watchdog import WATCHDOG
+        WATCHDOG.cancel(self._watch_token)
+        self._watch_token = WATCHDOG.watch(
+            f"continuous_{phase}", self._watchdog_s,
+            seam="continuous.cycle")
         FAULTS.fault_point("continuous.cycle")
         TELEMETRY.gauge("continuous_phase", f"{phase}@{cycle}")
 
@@ -760,6 +775,9 @@ class ContinuousLane:
                     TELEMETRY.add("continuous_cycle_failures", 1)
                 raise
             finally:
+                from ..reliability.watchdog import WATCHDOG
+                WATCHDOG.cancel(self._watch_token)
+                self._watch_token = None
                 TELEMETRY.end_span(cycle_span)
 
     def _run_phases(self, cycle: int, names: List[str],

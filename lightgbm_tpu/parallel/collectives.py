@@ -154,9 +154,24 @@ class HostCollectives(Collectives):
         return np.array_split(total, self.shards, axis=axis)
 
     def simulate_allgather(self, per_shard_arrays, axis: int = 0):
-        for a in per_shard_arrays:
-            _note_collective("allgather", a)
-        return np.concatenate(per_shard_arrays, axis=axis)
+        # the simulated gather carries the SAME reliability seam and
+        # deadline as the real host collective (distributed._allgather):
+        # sharded-construct merges route through here, so a chaos plan
+        # naming collectives.allgather — including a hang bounded by
+        # watchdog_collective_s — exercises the simulated participants
+        # exactly like a pod would see it
+        from ..reliability import watchdog as _watchdog
+        from ..reliability.faults import FAULTS
+
+        def _gather():
+            FAULTS.fault_point("collectives.allgather")
+            for a in per_shard_arrays:
+                _note_collective("allgather", a)
+            return np.concatenate(per_shard_arrays, axis=axis)
+
+        return _watchdog.run_with_deadline(
+            _gather, _watchdog.deadline("collective"),
+            phase="host_collective", seam="collectives.allgather")
 
 
 class ExternalCollectives(HostCollectives):

@@ -100,17 +100,28 @@ def _allgather(arr: np.ndarray) -> np.ndarray:
     Unlike the in-program collectives (trace-time byte accounting
     only), this call BLOCKS the host, so its wall is a true fenced
     collective latency: counted in ``collective_host_allgather_*``
-    and observed into the ``collective_host_allgather_ms``
-    histogram."""
+    and observed into the ``collective_host_allgather_ms`` histogram
+    — and, with ``watchdog_collective_s`` armed, deadline-bounded:
+    a gather wedged past the deadline (a peer that HANGS instead of
+    dying leaves this call blocked forever otherwise) dumps all-thread
+    stacks and raises a classified ``StallError``, the ``Network``
+    ``time_out`` semantic the reference puts on every socket op."""
     import time
 
+    from ..reliability import watchdog as _watchdog
     from ..reliability.faults import FAULTS
     from ..telemetry import TELEMETRY as tm
-    FAULTS.fault_point("collectives.allgather")
-    from jax.experimental import multihost_utils
+
+    def _gather() -> np.ndarray:
+        FAULTS.fault_point("collectives.allgather")
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(arr))
+
     t0 = time.perf_counter() if tm.on else 0.0
     with tm.span("collective_allgather"):
-        out = np.asarray(multihost_utils.process_allgather(arr))
+        out = _watchdog.run_with_deadline(
+            _gather, _watchdog.deadline("collective"),
+            phase="host_collective", seam="collectives.allgather")
     if tm.on:
         # bytes as a counter; latency ONLY as the histogram — its
         # _sum/_count already carry total wall and call count, and a

@@ -1,22 +1,37 @@
 """Fault-tolerance subsystem (docs/RELIABILITY.md).
 
-Four pieces, one package:
+Seven pieces, one package:
 
 - ``checkpoint``  — crash-safe full-training-state checkpoints
   (versioned container, atomic writes, rolling retention,
   fingerprinted resume; ``engine.train(resume=...)``).
 - ``faults``      — deterministic fault-injection harness: registered
-  seams + the ``LTPU_FAULT_PLAN`` plan grammar; every recovery test
-  drives its failure through this, never through sleeps or races.
+  seams + the ``LTPU_FAULT_PLAN`` plan grammar (kill/oom/exception
+  plus the ``hang:<ms>``/``slow:<ms>`` stall shapes); every recovery
+  test drives its failure through this, never through sleeps or races.
+- ``chaos``       — seeded chaos scheduler: ``chaos:<seed>:<n>`` plan
+  entries draw randomized multi-fault combinations from the seam
+  table with a deterministic PRNG, replayable from the seed.
+- ``watchdog``    — per-phase deadline watchdog: bounded stalls
+  (all-thread stack flight dumps + classified ``StallError`` through
+  the retry machinery) instead of silent hangs.
+- ``invariants``  — machine-checked postconditions evaluated after
+  every chaos run (byte-identical resume, no partial artifacts,
+  ledger convergence, serving parity, loud failure).
 - ``retry``       — bounded exponential backoff + jitter around
   transient-classified errors (dispatch + distributed-init seams).
 - OOM degradation lives at the call sites (``booster.py`` serving
   ladder, ``engine.py`` chunk downshift) keyed on ``retry.is_oom``.
 """
+from .chaos import chaos_entries, chaos_spec  # noqa: F401
 from .checkpoint import (CheckpointError, atomic_write_text,  # noqa: F401
                          find_resume, list_checkpoints, prune_snapshots,
                          read_checkpoint, save_checkpoint, save_rolling,
                          training_fingerprint)
 from .faults import FAULTS, FaultInjected, parse_plan  # noqa: F401
+from .invariants import (ChaosContext, run_invariants,  # noqa: F401
+                         violations)
 from .retry import (RetryPolicy, is_oom, is_transient,  # noqa: F401
                     retry_call)
+from .watchdog import (WATCHDOG, StallError,  # noqa: F401
+                       run_with_deadline)

@@ -1,0 +1,156 @@
+"""Seeded chaos scheduler: randomized multi-fault plans, replayable
+from one integer.
+
+The r12 harness proves the system survives one SCRIPTED fault at one
+seam; production failures are compound and unscripted — a slow
+collective plus a mid-ingest kill, an OOM during a publish, a
+participant that hangs instead of dying.  This module extends the
+fault-plan grammar (``reliability/faults.py``) with randomized plans
+drawn from the registered seam table by a DETERMINISTIC PRNG, so the
+space of compound failures gets explored without sacrificing the
+harness's core property: any failing run replays exactly from its
+printed seed.
+
+Grammar extension (``LTPU_FAULT_PLAN`` / ``Config.fault_plan``)::
+
+    chaos:<seed>:<n_faults>[:<seam_glob>]
+
+``chaos:7:3`` draws three (seam, nth-call, action) tuples over every
+registered seam; ``chaos:7:3:gbdt.*`` restricts the draw to seams
+matching the glob (comma-separated patterns compose:
+``gbdt.*,checkpoint.io``).  A chaos entry expands at parse time into
+ordinary plan entries — the expansion is logged, and
+:func:`chaos_spec` renders the same draw as a plain
+``seam:nth:action`` plan for replay or bisection.  Chaos entries
+compose with scripted ones: ``chaos:7:2;predict.dispatch:1:oom``.
+
+Actions drawn (weighted uniformly): ``kill``, ``oom``, the transient
+builtin exceptions (ConnectionError / TimeoutError / OSError), and
+the two stall shapes the deadline watchdog exists for — ``hang:<ms>``
+(blocks past any sane deadline, then errors: the op never completed)
+and ``slow:<ms>`` (delays, then proceeds — must stay UNDER deadlines).
+In-process callers (``scripts/chaos_probe.py`` serve/continuous
+workloads) restrict the action set via :func:`chaos_entries`'s
+``actions=`` so a drawn ``kill`` cannot take the probe down with the
+workload.
+"""
+from __future__ import annotations
+
+import fnmatch
+import random
+from typing import List, Sequence, Tuple
+
+# the full drawable action set; "hang"/"slow" get a drawn duration
+DEFAULT_ACTIONS = ("kill", "oom", "ConnectionError", "TimeoutError",
+                   "OSError", "hang", "slow")
+# hang durations default WELL past any test deadline (the watchdog is
+# supposed to fire first); slow durations stay small (tolerated)
+DEFAULT_HANG_MS = (2000, 8000)
+DEFAULT_SLOW_MS = (5, 50)
+
+
+def chaos_seams(seam_glob: str = "*") -> List[str]:
+    """Registered seams matching ``seam_glob`` (comma-separated
+    fnmatch patterns).  An empty match is a hard error — a typo'd
+    glob must not silently draw zero faults and turn a chaos run into
+    a vacuous pass (the same contract as unknown seam names)."""
+    from .faults import SEAMS
+    pats = [p.strip() for p in str(seam_glob or "*").split(",")
+            if p.strip()]
+    out = [s for s in SEAMS
+           if any(fnmatch.fnmatchcase(s, p) for p in pats)]
+    if not out:
+        raise ValueError(
+            f"chaos seam glob {seam_glob!r} matches no registered "
+            f"seam (registered: {', '.join(SEAMS)})")
+    return out
+
+
+def chaos_entries(seed: int, n_faults: int, seam_glob: str = "*",
+                  actions: Sequence[str] = DEFAULT_ACTIONS,
+                  max_nth: int = 4,
+                  hang_ms: Tuple[int, int] = DEFAULT_HANG_MS,
+                  slow_ms: Tuple[int, int] = DEFAULT_SLOW_MS
+                  ) -> List[Tuple[str, int, str]]:
+    """Draw ``n_faults`` deterministic (seam, nth, action) tuples.
+    Same arguments -> byte-identical plan, always (``random.Random``
+    is a stable, versioned PRNG) — that determinism IS the replay
+    guarantee.  ``(seam, nth)`` pairs are deduplicated so two draws
+    cannot shadow each other at the same call."""
+    if n_faults < 1:
+        raise ValueError(f"chaos plan needs n_faults >= 1, got "
+                         f"{n_faults}")
+    rng = random.Random(int(seed))
+    seams = chaos_seams(seam_glob)
+    actions = tuple(actions)
+    if int(n_faults) > len(seams) * max(1, int(max_nth)):
+        # fault_point fires only the FIRST matching entry, so a
+        # duplicate (seam, nth) draw would silently shadow another —
+        # an overdrawn plan must error loudly, not quietly inject
+        # fewer faults than it claims
+        raise ValueError(
+            f"chaos plan asks for {n_faults} faults but only "
+            f"{len(seams) * max(1, int(max_nth))} distinct "
+            f"(seam, nth) pairs exist for glob {seam_glob!r} with "
+            f"max_nth={max_nth}")
+    entries: List[Tuple[str, int, str]] = []
+    used = set()
+    for _ in range(int(n_faults)):
+        seam, nth = None, None
+        while True:
+            seam = rng.choice(seams)
+            nth = rng.randint(1, max(1, int(max_nth)))
+            if (seam, nth) not in used:
+                break
+        used.add((seam, nth))
+        action = rng.choice(actions)
+        if action == "hang":
+            action = f"hang:{rng.randint(*hang_ms)}"
+        elif action == "slow":
+            action = f"slow:{rng.randint(*slow_ms)}"
+        entries.append((seam, nth, action))
+    return entries
+
+
+def chaos_spec(seed: int, n_faults: int, seam_glob: str = "*",
+               **kwargs) -> str:
+    """The drawn plan rendered in the PLAIN grammar
+    (``seam:nth:action;...``) — what a failing chaos run prints for
+    replay/bisection, and what in-process probes feed
+    ``FAULTS.configure`` directly."""
+    return ";".join(f"{seam}:{nth}:{action}" for seam, nth, action
+                    in chaos_entries(seed, n_faults, seam_glob,
+                                     **kwargs))
+
+
+def parse_chaos_entry(parts: List[str]):
+    """Expand one ``chaos:<seed>:<n>[:<glob>]`` plan entry (already
+    colon-split) into concrete ``faults._Entry`` objects.  Called by
+    ``faults.parse_plan``; malformed specs raise ValueError like every
+    other grammar violation."""
+    from ..utils.log import Log
+    from .faults import _Entry
+    if len(parts) not in (3, 4):
+        raise ValueError(
+            "chaos plan entry must be chaos:<seed>:<n_faults>"
+            f"[:<seam_glob>], got {':'.join(parts)!r}")
+    seed_s, n_s = parts[1].strip(), parts[2].strip()
+    try:
+        seed = int(seed_s)
+    except ValueError:
+        raise ValueError(f"chaos seed {seed_s!r} must be an integer") \
+            from None
+    if not n_s.isdigit() or int(n_s) < 1:
+        raise ValueError(f"chaos fault count {n_s!r} must be a "
+                         "positive integer")
+    glob = parts[3].strip() if len(parts) == 4 else "*"
+    drawn = chaos_entries(seed, int(n_s), glob)
+    Log.info(
+        f"chaos plan seed={seed} n={n_s} glob={glob!r} expanded to: "
+        + "; ".join(f"{s}:{n}:{a}" for s, n, a in drawn)
+        + f" — replay with chaos:{seed}:{n_s}"
+        + (f":{glob}" if glob != "*" else ""))
+    return [_Entry(seam, nth, action.split(":")[0], 1,
+                   duration_ms=int(action.split(":")[1])
+                   if ":" in action else 0)
+            for seam, nth, action in drawn]

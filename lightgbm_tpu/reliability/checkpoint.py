@@ -43,6 +43,7 @@ import struct
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.log import Log
+from . import watchdog as _watchdog
 from .faults import FAULTS
 
 MAGIC = b"LTPUCKPT1\n"
@@ -74,22 +75,40 @@ def _fsync_dir(path: str) -> None:
 
 def atomic_write_bytes(path: str, data: bytes) -> None:
     """tmp-write -> flush -> fsync -> rename: a crash leaves either
-    the old file or the new file, never a torn hybrid."""
-    FAULTS.fault_point("checkpoint.io")
-    tmp = f"{path}.tmp-{os.getpid()}"
-    try:
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
+    the old file or the new file, never a torn hybrid.  With
+    ``watchdog_checkpoint_s`` armed the whole write is deadline-
+    bounded: a wedged filesystem (hung NFS, dead disk) surfaces as a
+    classified ``StallError`` with all-thread stacks dumped instead
+    of freezing training silently.  The tmp name carries the WRITER
+    THREAD's id beside the pid: a deadline-abandoned writer may still
+    be mid-write when the caller retries the same path on a fresh
+    worker, and a shared tmp name would let the two interleave into a
+    torn file that one of them renames into place.  (A slow-but-alive
+    abandoned writer can still late-rename its own COMPLETE, stale
+    bytes over a newer write — each renamed file stays internally
+    consistent, and the checkpoint/ledger machinery already tolerates
+    falling back to an older consistent state by replay.)"""
+    def _write():
+        import threading
+        FAULTS.fault_point("checkpoint.io")
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    _fsync_dir(path)
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _fsync_dir(path)
+
+    _watchdog.run_with_deadline(_write, _watchdog.deadline("checkpoint"),
+                                phase="checkpoint_io",
+                                seam="checkpoint.io")
 
 
 def atomic_write_text(path: str, text: str) -> None:
@@ -123,10 +142,25 @@ def read_checkpoint(path: str,
                     ) -> Tuple[str, dict]:
     """Validate and load one checkpoint file.  Raises
     ``CheckpointError`` naming the first violated invariant."""
-    FAULTS.fault_point("checkpoint.io")
-    try:
+    def _read() -> bytes:
+        FAULTS.fault_point("checkpoint.io")
         with open(path, "rb") as f:
-            blob = f.read()
+            return f.read()
+
+    try:
+        # deadline-bounded like the writes: a read that hangs raises
+        # StallError (NOT CheckpointError — a stalled filesystem is an
+        # environment failure, not a corrupt file, so the resume scan
+        # must not silently "fall back" past a checkpoint it never read)
+        blob = _watchdog.run_with_deadline(
+            _read, _watchdog.deadline("checkpoint"),
+            phase="checkpoint_io", seam="checkpoint.io")
+    except _watchdog.StallError:
+        # re-raise BEFORE the OSError arm: StallError subclasses
+        # TimeoutError (hence OSError), and letting it convert to
+        # CheckpointError would hand find_resume license to silently
+        # skip a valid newer checkpoint it never actually read
+        raise
     except OSError as e:
         raise CheckpointError(f"cannot read checkpoint {path}: {e}") \
             from e
@@ -272,7 +306,8 @@ _FP_EXCLUDE_PREFIX = ("telemetry", "predict_", "is_predict_",
                       "pred_early_stop", "snapshot_", "checkpoint_",
                       "resume", "fault_plan", "dispatch_retries",
                       "retry_backoff", "oom_downshift", "serve_",
-                      "flight_recorder", "continuous_")
+                      "flight_recorder", "continuous_", "watchdog_",
+                      "sharded_allow_degraded")
 
 
 def training_fingerprint(config, dataset, num_valid: int = 0,

@@ -14,6 +14,7 @@ Plan grammar (``LTPU_FAULT_PLAN`` env var or ``Config.fault_plan``)::
 
     plan   := entry (';' entry)*
     entry  := seam ':' nth ':' action [':x' count]
+            | 'chaos' ':' seed ':' n_faults [':' seam_glob]
     seam   := registered seam name (see SEAMS below)
     nth    := 1-based call index at that seam
     action := 'kill'            -- SIGKILL the process (no cleanup,
@@ -22,6 +23,15 @@ Plan grammar (``LTPU_FAULT_PLAN`` env var or ``Config.fault_plan``)::
             | 'oom'             -- raise FaultInjected with a
                                    RESOURCE_EXHAUSTED message (what
                                    the OOM-degradation ladders key on)
+            | 'hang' ':' ms     -- the seam BLOCKS for ms milliseconds
+                                   and then errors (the op never
+                                   completed) — the deadline watchdog
+                                   (reliability/watchdog.py) is
+                                   supposed to fire first and surface
+                                   a classified StallError
+            | 'slow' ':' ms     -- the seam DELAYS ms milliseconds and
+                                   then proceeds normally (must stay
+                                   under any armed deadline)
             | ExceptionName     -- a builtin exception class, e.g.
                                    ConnectionError, TimeoutError,
                                    OSError, RuntimeError
@@ -33,7 +43,14 @@ Example: ``gbdt.train_chunk:3:kill`` SIGKILLs the process the third
 time a fused training chunk is about to be dispatched;
 ``predict.dispatch:1:oom;dataset.cache_io:2:OSError`` injects an OOM
 into the first serving dispatch and an OSError into the second
-binary-cache file open.
+binary-cache file open; ``collectives.allgather:1:hang:5000`` wedges
+the first host collective for five seconds.
+
+The ``chaos:<seed>:<n_faults>[:<seam_glob>]`` form draws n randomized
+(seam, nth, action) tuples from the registered seam table with a
+DETERMINISTIC PRNG (``reliability/chaos.py``) — compound, unscripted
+failure combinations, yet any failing run replays exactly from its
+seed.  The expansion is logged at parse time.
 
 Call counting starts when a plan is configured and is per-process;
 ``FAULTS.reset()`` clears both plan and counters (tests).  With no
@@ -46,6 +63,7 @@ import builtins
 import os
 import signal
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ..utils.log import Log
@@ -99,21 +117,30 @@ class FaultInjected(Exception):
 
 
 class _Entry:
-    __slots__ = ("seam", "nth", "action", "count", "exc_type")
+    __slots__ = ("seam", "nth", "action", "count", "exc_type",
+                 "duration_ms")
 
-    def __init__(self, seam: str, nth: int, action: str, count: int):
+    def __init__(self, seam: str, nth: int, action: str, count: int,
+                 duration_ms: int = 0):
         self.seam = seam
         self.nth = nth
         self.action = action
         self.count = count
         self.exc_type = None
-        if action not in ("kill", "oom"):
+        self.duration_ms = int(duration_ms)
+        if action in ("hang", "slow"):
+            if self.duration_ms < 1:
+                raise ValueError(
+                    f"fault plan action {action!r} needs a positive "
+                    "millisecond duration (hang:<ms> / slow:<ms>)")
+        elif action not in ("kill", "oom"):
             exc = getattr(builtins, action, None)
             if not (isinstance(exc, type)
                     and issubclass(exc, BaseException)):
                 raise ValueError(
-                    f"fault plan action {action!r} is not 'kill', 'oom' "
-                    "or a builtin exception name")
+                    f"fault plan action {action!r} is not 'kill', "
+                    "'oom', 'hang:<ms>', 'slow:<ms>' or a builtin "
+                    "exception name")
             self.exc_type = exc
 
     def matches(self, n: int) -> bool:
@@ -123,26 +150,48 @@ class _Entry:
 def parse_plan(spec: str) -> List[_Entry]:
     """Parse the plan grammar; raises ValueError on malformed specs
     (a silently-dropped fault plan would turn an injection test into
-    a vacuous pass)."""
+    a vacuous pass).  ``chaos:*`` entries expand through
+    ``reliability/chaos.py`` at parse time."""
     entries: List[_Entry] = []
     for raw in spec.split(";"):
         raw = raw.strip()
         if not raw:
             continue
         parts = raw.split(":")
-        if len(parts) not in (3, 4):
+        if parts[0].strip().lower() == "chaos":
+            # seeded randomized plan: deterministic expansion, logged
+            # for replay (lazy import — chaos.py reads SEAMS here)
+            from .chaos import parse_chaos_entry
+            entries.extend(parse_chaos_entry([p.strip()
+                                              for p in parts]))
+            continue
+        if len(parts) < 3:
             raise ValueError(
                 f"fault plan entry {raw!r} must be "
                 "seam:nth:action[:xCount]")
         seam, nth_s, action = parts[0].strip(), parts[1].strip(), \
             parts[2].strip()
+        idx = 3
+        duration_ms = 0
+        if action in ("hang", "slow"):
+            if len(parts) < 4 or not parts[3].strip().isdigit():
+                raise ValueError(
+                    f"fault plan entry {raw!r}: {action} needs a "
+                    "millisecond duration (seam:nth:"
+                    f"{action}:<ms>[:xCount])")
+            duration_ms = int(parts[3].strip())
+            idx = 4
         count = 1
-        if len(parts) == 4:
-            rep = parts[3].strip().lower()
+        if len(parts) == idx + 1:
+            rep = parts[idx].strip().lower()
             if not rep.startswith("x") or not rep[1:].isdigit():
                 raise ValueError(
-                    f"fault plan repeat {parts[3]!r} must be xN")
+                    f"fault plan repeat {parts[idx]!r} must be xN")
             count = int(rep[1:])
+        elif len(parts) > idx + 1:
+            raise ValueError(
+                f"fault plan entry {raw!r} has trailing fields "
+                "(expected seam:nth:action[:<ms>][:xCount])")
         if not nth_s.isdigit() or int(nth_s) < 1:
             raise ValueError(
                 f"fault plan call index {nth_s!r} must be a 1-based "
@@ -155,7 +204,8 @@ def parse_plan(spec: str) -> List[_Entry]:
             raise ValueError(
                 f"fault plan names unknown seam {seam!r} (registered: "
                 f"{', '.join(SEAMS)})")
-        entries.append(_Entry(seam, int(nth_s), action, max(1, count)))
+        entries.append(_Entry(seam, int(nth_s), action, max(1, count),
+                              duration_ms=duration_ms))
     return entries
 
 
@@ -232,6 +282,27 @@ class FaultInjector:
             raise FaultInjected(
                 f"RESOURCE_EXHAUSTED: out of memory (injected at seam "
                 f"{seam}, call {n})")
+        if entry.action == "slow":
+            # delay, then PROCEED: models a slow-but-healthy op — an
+            # armed deadline must tolerate it (the watchdog fires only
+            # past the deadline, so slow durations are drawn under it)
+            Log.debug(f"fault plan: slow {entry.duration_ms} ms at "
+                      f"seam {seam} call {n}")
+            time.sleep(entry.duration_ms / 1e3)
+            return
+        if entry.action == "hang":
+            # block, then ERROR: the op never completed.  With a
+            # deadline armed the watchdog fires FIRST (the caller
+            # already holds a StallError and abandoned this thread);
+            # without one, the release error is the loud evidence a
+            # hang-shaped failure went unwatched.
+            Log.debug(f"fault plan: hang {entry.duration_ms} ms at "
+                      f"seam {seam} call {n}")
+            time.sleep(entry.duration_ms / 1e3)
+            raise FaultInjected(
+                f"hang released after {entry.duration_ms} ms at seam "
+                f"{seam}, call {n} (fault plan; a deadline watchdog "
+                "should have fired before this)")
         raise entry.exc_type(
             f"injected at seam {seam}, call {n} (fault plan)")
 

@@ -1,0 +1,212 @@
+"""System-wide invariant registry: machine-checked postconditions for
+chaos runs.
+
+A chaos run is only evidence if something CHECKS the wreckage.  Every
+invariant here is a named predicate over a :class:`ChaosContext` —
+the artifacts a faulted run left behind (model files, work
+directories, ledgers, served responses, exit codes, flight dumps) —
+returning a list of human-readable violations (empty = holds).  The
+chaos probe (``scripts/chaos_probe.py``) and ``tests/test_chaos.py``
+evaluate the full registry after every run; a violation fails the run
+with the seed printed, so the exact fault combination replays.
+
+The catalog (docs/RELIABILITY.md, "Chaos testing"):
+
+``resume_byte_identical``
+    A killed-then-resumed run's final model is byte-identical to an
+    uninterrupted reference (the r12 checkpoint contract, now held
+    under RANDOMIZED fault combinations).
+``no_partial_artifacts``
+    No orphaned tmp/partial files anywhere in the work directory —
+    the atomic writers (tmp + fsync + rename) must never leak a torn
+    hybrid, no matter where the fault landed.
+``ledger_converges``
+    The continuous lane's ledger parses, carries a known schema and a
+    replayable phase — a crash replays FROM the ledger, so a ledger
+    the state machine cannot re-enter is lost work.
+``serving_parity``
+    Every successful serving response is byte-identical to a direct
+    ``Booster.predict`` of the same rows — degraded or faulted
+    serving must never be SILENTLY wrong (mixed-version or corrupted
+    slices).
+``loud_failure``
+    Whenever work was lost, the process exited nonzero AND a flight
+    dump names the seam that fired — no silent partial success.
+
+Invariants skip (return no violations) when their inputs are absent
+from the context, so one registry serves train, serve and continuous
+workloads.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# patterns an atomic writer's crash could conceivably leak — the
+# checkpoint/ledger/model writers use ``<name>.tmp-<pid>``, the
+# flight recorder ``<name>.tmp``
+PARTIAL_PATTERNS = ("*.tmp", "*.tmp-*")
+
+INVARIANTS: Dict[str, Callable] = {}
+
+
+def invariant(name: str):
+    def _wrap(fn):
+        INVARIANTS[name] = fn
+        return fn
+    return _wrap
+
+
+class ChaosContext:
+    """The artifacts one chaos run left behind.  Every field is
+    optional; an invariant whose inputs are missing skips.
+
+    Fields: ``workdir`` (scanned for partial artifacts),
+    ``reference_model`` / ``final_model`` (paths compared byte-wise),
+    ``ledger_path``, ``served`` / ``expected`` (prediction arrays),
+    ``exit_code`` + ``work_lost`` + ``flight_dumps`` (loud-failure
+    evidence), ``seed`` + ``plan`` (replay identity, echoed into
+    violations)."""
+
+    def __init__(self, workdir: Optional[str] = None,
+                 reference_model: Optional[str] = None,
+                 final_model: Optional[str] = None,
+                 ledger_path: Optional[str] = None,
+                 served=None, expected=None,
+                 exit_code: Optional[int] = None,
+                 work_lost: bool = False,
+                 flight_dumps: Optional[Sequence[str]] = None,
+                 seed: Optional[int] = None, plan: str = ""):
+        self.workdir = workdir
+        self.reference_model = reference_model
+        self.final_model = final_model
+        self.ledger_path = ledger_path
+        self.served = served
+        self.expected = expected
+        self.exit_code = exit_code
+        self.work_lost = bool(work_lost)
+        self.flight_dumps = list(flight_dumps or [])
+        self.seed = seed
+        self.plan = plan
+
+
+@invariant("resume_byte_identical")
+def _resume_byte_identical(ctx: ChaosContext) -> List[str]:
+    if not ctx.reference_model or not ctx.final_model:
+        return []
+    if not os.path.exists(ctx.final_model):
+        return [f"final model {ctx.final_model} missing after "
+                "resume"]
+    with open(ctx.reference_model, "rb") as a, \
+            open(ctx.final_model, "rb") as b:
+        ra, rb = a.read(), b.read()
+    if ra != rb:
+        return [f"resumed model {ctx.final_model} differs from the "
+                f"uninterrupted reference {ctx.reference_model} "
+                f"({len(rb)} vs {len(ra)} bytes)"]
+    return []
+
+
+@invariant("no_partial_artifacts")
+def _no_partial_artifacts(ctx: ChaosContext) -> List[str]:
+    if not ctx.workdir or not os.path.isdir(ctx.workdir):
+        return []
+    leaked: List[str] = []
+    for pat in PARTIAL_PATTERNS:
+        leaked.extend(_glob.glob(os.path.join(
+            _glob.escape(ctx.workdir), "**", pat), recursive=True))
+    return [f"orphaned partial artifact: {p}"
+            for p in sorted(set(leaked))]
+
+
+@invariant("ledger_converges")
+def _ledger_converges(ctx: ChaosContext) -> List[str]:
+    if not ctx.ledger_path:
+        return []
+    if not os.path.exists(ctx.ledger_path):
+        return [f"ledger {ctx.ledger_path} missing"]
+    try:
+        with open(ctx.ledger_path) as f:
+            led = json.load(f)
+    except ValueError as e:
+        return [f"ledger {ctx.ledger_path} does not parse: {e} — a "
+                "crash cannot replay from it"]
+    out: List[str] = []
+    from ..continuous.lane import LEDGER_SCHEMA, PHASES
+    if led.get("schema") != LEDGER_SCHEMA:
+        out.append(f"ledger schema {led.get('schema')!r} is not "
+                   f"{LEDGER_SCHEMA}")
+    if led.get("phase") not in PHASES + ("idle",):
+        out.append(f"ledger phase {led.get('phase')!r} is not "
+                   "re-enterable by the cycle state machine")
+    for field in ("cycle", "processed", "published", "quarantined",
+                  "last_good"):
+        if field not in led:
+            out.append(f"ledger lacks the {field!r} field a replay "
+                       "reads")
+    return out
+
+
+@invariant("serving_parity")
+def _serving_parity(ctx: ChaosContext) -> List[str]:
+    if ctx.served is None or ctx.expected is None:
+        return []
+    served = np.asarray(ctx.served)
+    expected = np.asarray(ctx.expected)
+    if served.shape != expected.shape:
+        return [f"served shape {served.shape} != direct-predict "
+                f"shape {expected.shape}"]
+    if not np.array_equal(served, expected):
+        bad = int(np.sum(served != expected))
+        return [f"{bad} served value(s) differ from direct predict — "
+                "serving went silently wrong under faults"]
+    return []
+
+
+@invariant("loud_failure")
+def _loud_failure(ctx: ChaosContext) -> List[str]:
+    if not ctx.work_lost:
+        return []
+    out: List[str] = []
+    if ctx.exit_code == 0:
+        out.append("work was lost but the process exited 0 — a "
+                   "silent partial success")
+    seams = set()
+    for path in ctx.flight_dumps:
+        try:
+            with open(path) as f:
+                seams.add(json.load(f).get("seam", ""))
+        except (OSError, ValueError):
+            continue
+    if not any(seams - {""}):
+        out.append("work was lost but no flight dump names the seam "
+                   f"that fired (dumps scanned: {len(ctx.flight_dumps)})")
+    return out
+
+
+def run_invariants(ctx: ChaosContext,
+                   names: Optional[Sequence[str]] = None
+                   ) -> Dict[str, List[str]]:
+    """Evaluate the registry (or the named subset) against ``ctx``;
+    returns {invariant: violations} with every registered name
+    present (empty list = holds/skipped)."""
+    todo = list(names) if names is not None else list(INVARIANTS)
+    unknown = [n for n in todo if n not in INVARIANTS]
+    if unknown:
+        raise ValueError(f"unknown invariant(s): {unknown} "
+                         f"(registered: {sorted(INVARIANTS)})")
+    return {name: INVARIANTS[name](ctx) for name in todo}
+
+
+def violations(ctx: ChaosContext,
+               names: Optional[Sequence[str]] = None) -> List[str]:
+    """Flattened violation list, each prefixed with its invariant
+    name (and the replay seed when the context carries one)."""
+    tag = f"[seed {ctx.seed}] " if ctx.seed is not None else ""
+    return [f"{tag}{name}: {v}"
+            for name, vs in run_invariants(ctx, names).items()
+            for v in vs]

@@ -110,11 +110,17 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
                 raise
             if policy.budget_s is None:
                 if attempt >= policy.max_retries:
-                    # retry budget exhausted: flight-dump the last-N
-                    # telemetry events before re-raising the original
-                    # error (no-op unless the recorder is armed)
+                    # retry budget exhausted: count it (Prometheus
+                    # ltpu_retry_exhausted_total — exhaustion used to
+                    # re-raise with no metric trail) and flight-dump
+                    # the last-N telemetry events naming the seam
+                    # before re-raising the original error (the dump
+                    # is a no-op unless the recorder is armed)
+                    TELEMETRY.add("retry_exhausted_total", 1)
                     TELEMETRY.flight.dump("retry_exhausted", seam=seam,
                                           attempts=attempt + 1,
+                                          budget="max_retries="
+                                          f"{policy.max_retries}",
                                           error=repr(e)[:300])
                     raise
                 d = policy.delay(attempt, rng)
@@ -124,8 +130,11 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
                 # cannot hot-spin the budget away
                 d = max(policy.delay(attempt, rng), 0.05)
                 if spent + d > policy.budget_s:
+                    TELEMETRY.add("retry_exhausted_total", 1)
                     TELEMETRY.flight.dump("retry_exhausted", seam=seam,
                                           attempts=attempt + 1,
+                                          budget=f"{policy.budget_s:g}s"
+                                          f" (spent {spent:.2f}s)",
                                           error=repr(e)[:300])
                     raise
             TELEMETRY.add("retries", 1)

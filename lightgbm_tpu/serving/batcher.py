@@ -108,6 +108,13 @@ class MicroBatcher:
             config, "serve_max_batch_rows", 1024)))
         self.min_bucket = max(1, int(getattr(
             config, "predict_min_bucket_rows", 16)))
+        # deadline on each coalesced dispatch (docs/RELIABILITY.md,
+        # deadline watchdog): a dispatch wedged past it fails its
+        # batch with a classified StallError (all-thread stacks
+        # flight-dumped) instead of freezing the dispatcher thread —
+        # and with it every queued request — forever.  0 = unbounded
+        self.watchdog_s = float(getattr(
+            config, "watchdog_serve_s", 0.0) or 0.0)
         # mirror the predictor's bucket policy for the fill metric:
         # with predict_bucket=off dispatches are exact-shaped, so the
         # fill denominator is the batch itself
@@ -300,15 +307,27 @@ class MicroBatcher:
                 [r.rows for r in batch], axis=0)
             with tm.span("serve_dispatch", requests=len(batch),
                          rows=rows):
-                out = np.asarray(self.predict(x))
+                if self.watchdog_s > 0:
+                    from ..reliability.watchdog import run_with_deadline
+                    out = np.asarray(run_with_deadline(
+                        self.predict, self.watchdog_s,
+                        "serve_dispatch", "predict.dispatch", x))
+                else:
+                    out = np.asarray(self.predict(x))
         except Exception as e:
             # per-request failure propagation: the whole coalesced
-            # batch shares the dispatch, so it shares the error
+            # batch shares the dispatch, so it shares the error.  A
+            # watchdog StallError is additionally stall-classified
+            # (serve_stalls) — the frontend maps it to 503 +
+            # Retry-After rather than a generic 500
+            from ..reliability.watchdog import StallError
             for r in batch:
                 r.error = e
                 r.done.set()
             if tm.on:
                 tm.add("serve_errors", len(batch))
+                if isinstance(e, StallError):
+                    tm.add("serve_stalls", 1)
             return
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:

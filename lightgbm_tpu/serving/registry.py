@@ -280,6 +280,7 @@ class ModelRegistry:
         rows = np.asarray(rows, dtype=np.float64)
         if rows.ndim == 1:
             rows = rows[None, :]
+        from ..reliability.watchdog import StallError
         for _ in range(64):
             entry = self.get(name)
             nf = entry.booster.num_feature()
@@ -289,6 +290,18 @@ class ModelRegistry:
                 return entry, entry.batcher.submit(rows)
             except BatcherClosed:
                 continue
+            except StallError as e:
+                # stall classification (docs/RELIABILITY.md): the
+                # version's dispatch blew its watchdog_serve_s
+                # deadline.  NOT retried here — the same wedged
+                # program would stall again and multiply the damage;
+                # the error names the model so ops can correlate the
+                # flight dump, and the frontend answers 503
+                TELEMETRY.flight.note(
+                    "stall", f"serve:{name}", version=entry.version)
+                raise StallError(
+                    f"serving {name!r} v{entry.version}", e.seam,
+                    e.deadline_s, e.elapsed_s) from e
         raise RuntimeError(
             f"model {name!r}: current version kept closing underneath "
             "the request (registry shutting down?)")

@@ -41,6 +41,7 @@ from typing import Optional
 import numpy as np
 
 from ..reliability.faults import FAULTS
+from ..reliability.watchdog import StallError
 from ..telemetry import TELEMETRY
 from ..utils.log import Log
 from .batcher import ShedLoad
@@ -222,6 +223,15 @@ class ServingFrontend:
             return _json_response(
                 503, {"error": str(e)},
                 {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))})
+        except StallError as e:
+            # stall-classified (the watchdog blew a serve-dispatch
+            # deadline, stacks already flight-dumped): 503, not 500 —
+            # the model may recover or be rolled back, so the client
+            # should retry elsewhere/later rather than treat it as a
+            # bug in its request
+            return _json_response(
+                503, {"error": f"serving stalled: {e}", "stall": True},
+                {"Retry-After": "1"})
         except Exception as e:
             # dispatch failure, not a handler crash: the batcher
             # already counted serve_errors per affected request and

@@ -32,6 +32,7 @@ from ..config import Config
 from ..dataset import Dataset as CoreDataset
 from ..dataset import Metadata
 from ..reliability.faults import FAULTS
+from ..reliability.watchdog import run_with_deadline
 from ..telemetry import TELEMETRY
 from ..utils.log import Log
 from . import binfind
@@ -153,12 +154,46 @@ class ShardedDataset(CoreDataset):
             f"Column_{i}" for i in range(num_features)]
         cat_set = set(categorical_features or [])
 
+        # degraded-mode continuation (docs/RELIABILITY.md): with
+        # sharded_allow_degraded on, a participant whose binfind or
+        # ingest seam dies — or hangs past watchdog_collective_s —
+        # is EXCLUDED and construction restarts on the surviving
+        # participants' rows with quota-rebalanced shards (byte-
+        # identical to a from-scratch run on the surviving world,
+        # because it IS one).  Default off = today's fail-fast.  The
+        # per-participant deadline only arms in degraded mode: in
+        # fail-fast mode a long ingest must not spuriously stall-error
+        # under a deadline sized for collective ops.
+        allow_degraded = bool(getattr(config, "sharded_allow_degraded",
+                                      False))
+        part_deadline = float(getattr(config, "watchdog_collective_s",
+                                      0.0) or 0.0) \
+            if allow_degraded else 0.0
+
         # ---- distributed bin finding (binfind.py) ----
         with TELEMETRY.span("shard_binfind", shards=world,
                             rows=num_data):
-            cands = [binfind.collect_candidates(X[a:b], config,
-                                                rank=i, world=world)
-                     for i, (a, b) in enumerate(ranges)]
+            cands = []
+            dead: List[int] = []
+            for i, (a, b) in enumerate(ranges):
+                try:
+                    cands.append(run_with_deadline(
+                        binfind.collect_candidates, part_deadline,
+                        "shard_binfind", "sharded.binfind",
+                        X[a:b], config, rank=i, world=world))
+                except Exception as e:  # noqa: BLE001 - mode decides
+                    if not allow_degraded:
+                        raise
+                    Log.warning(
+                        f"sharded participant {i} FAILED during bin "
+                        f"finding ({type(e).__name__}: {e}) — "
+                        "excluding it (sharded_allow_degraded=true)")
+                    dead.append(i)
+            if dead:
+                return cls._construct_degraded(
+                    X, label, weight, init_score, config, ranges,
+                    dead, categorical_features, feature_names,
+                    collective)
             binfind.warn_if_quota_truncated(cands)
             sample_vals, sample_rows, total_sample = \
                 binfind.merge_candidates(cands, collective)
@@ -179,13 +214,31 @@ class ShardedDataset(CoreDataset):
         # ---- per-shard streaming ingest ----
         chunk_rows = max(1, int(config.streaming_chunk_rows))
         for i, (a, b) in enumerate(ranges):
-            FAULTS.fault_point("sharded.ingest")
-            with TELEMETRY.span("shard_ingest", shard=i, rows=b - a):
+            def _ingest(a=a, b=b):
+                FAULTS.fault_point("sharded.ingest")
                 sd = CoreDataset.from_reference_for_push(self, b - a)
                 for start in range(0, b - a, chunk_rows):
                     stop = min(b - a, start + chunk_rows)
                     sd.push_rows(X[a + start:a + stop], start)
                 sd.finish_load()
+                return sd
+            try:
+                with TELEMETRY.span("shard_ingest", shard=i,
+                                    rows=b - a):
+                    sd = run_with_deadline(
+                        _ingest, part_deadline, "shard_ingest",
+                        "sharded.ingest")
+            except Exception as e:  # noqa: BLE001 - mode decides
+                if not allow_degraded:
+                    raise
+                Log.warning(
+                    f"sharded participant {i} FAILED during ingest "
+                    f"({type(e).__name__}: {e}) — excluding it "
+                    "(sharded_allow_degraded=true)")
+                return cls._construct_degraded(
+                    X, label, weight, init_score, config, ranges,
+                    [i], categorical_features, feature_names,
+                    collective, seam="sharded.ingest")
             self.shard_bins.append(sd.group_bins)
             if TELEMETRY.on:
                 TELEMETRY.add("sharded_rows_ingested", int(b - a))
@@ -198,3 +251,68 @@ class ShardedDataset(CoreDataset):
         self.metadata.set_weight(weight)
         self.metadata.set_init_score(init_score)
         return self
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def _construct_degraded(cls, X, label, weight, init_score, config,
+                            ranges, dead: List[int],
+                            categorical_features, feature_names,
+                            collective,
+                            seam: str = "sharded.binfind"
+                            ) -> "ShardedDataset":
+        """Degraded-mode restart (``sharded_allow_degraded``): drop
+        the dead participants' row ranges and rebuild FROM SCRATCH on
+        the surviving rows with a quota-rebalanced world — the
+        degraded dataset is literally a from-scratch construction on
+        the surviving world, which is what makes its trees
+        byte-identical to one (pinned by ``tests/test_chaos.py``).
+        The excluded rows are LOST — logged loudly per participant
+        and counted (``sharded_degraded_exclusions``) so the loss is
+        never silent."""
+        dead_set = set(dead)
+        survivors = [i for i in range(len(ranges))
+                     if i not in dead_set]
+        if not survivors:
+            Log.fatal(
+                "sharded degraded mode: every participant failed — "
+                "nothing left to continue on (replay the fault plan "
+                "seed to reproduce)")
+        lost_rows = sum(b - a for i, (a, b) in enumerate(ranges)
+                        if i in dead_set)
+        keep = np.concatenate([np.arange(a, b, dtype=np.int64)
+                               for i, (a, b) in enumerate(ranges)
+                               if i not in dead_set])
+
+        def _slice(arr, what: str):
+            if arr is None:
+                return None
+            arr = np.asarray(arr)
+            if arr.ndim >= 1 and arr.shape[0] == X.shape[0]:
+                return arr[keep]
+            Log.fatal(
+                f"sharded degraded mode cannot re-slice {what} of "
+                f"shape {arr.shape} to the surviving "
+                f"{len(keep)}-row world — disable "
+                "sharded_allow_degraded or drop the metadata")
+
+        if TELEMETRY.on:
+            TELEMETRY.add("sharded_degraded_exclusions", len(dead))
+            TELEMETRY.gauge("sharded_degraded_world", len(survivors))
+        TELEMETRY.flight.dump(
+            "sharded_degraded", seam=seam,
+            excluded=sorted(dead_set), surviving=len(survivors),
+            lost_rows=int(lost_rows))
+        Log.warning(
+            f"sharded DEGRADED continuation: excluded participant(s) "
+            f"{sorted(dead_set)} ({lost_rows} rows lost), continuing "
+            f"on the surviving {len(survivors)}-participant world "
+            "with rebalanced sample quotas "
+            "(sharded_allow_degraded=true; trees are byte-identical "
+            "to a from-scratch run on the survivors)")
+        return cls.construct_sharded(
+            X[keep], label=_slice(label, "label"),
+            weight=_slice(weight, "weight"),
+            init_score=_slice(init_score, "init_score"),
+            config=config, num_shards=len(survivors),
+            categorical_features=categorical_features,
+            feature_names=feature_names, collective=collective)

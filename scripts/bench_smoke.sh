@@ -45,12 +45,28 @@ assert np.array_equal(np.asarray(load_binary(p).group_bins),
                       np.asarray(core.group_bins))
 print("construct cache-v2 smoke ok")
 EOF
+# seam-coverage lint (round 19, TEL001-style two-way): every fault
+# seam registered in reliability/faults.py must be exercised by at
+# least one test/probe AND documented in docs/RELIABILITY.md, and the
+# doc must not carry stale seams — fails loudly when a new seam lands
+# untested
+python scripts/check_seam_coverage.py >&2
 # reliability probe (round 12): checkpoint save overhead + one smoke
 # fault-plan recovery — a child run SIGKILLed mid-train through the
 # fault harness, auto-resumed, asserted byte-identical vs the cold
 # run; writes /tmp/lgbtpu_smoke/reliability.json for test_bench_smoke
 python scripts/reliability_probe.py /tmp/lgbtpu_smoke/reliability.json >&2
 test -s /tmp/lgbtpu_smoke/reliability.json
+# chaos probe (round 19): a fixed budget of SEEDED multi-fault plans
+# across train/serve/continuous — kills, OOMs, transient errors, and
+# the hang/slow stall shapes bounded by the deadline watchdog — every
+# plan gated by the invariant registry (byte-identical resume, no
+# partial artifacts, ledger convergence, serving parity, loud
+# failure) and replayable from its printed seed.  CHAOS_SEEDS /
+# CHAOS_BUDGET_S widen the sweep for a nightly job without touching
+# the tier-1 wall; asserted by test_bench_smoke on the JSON
+python scripts/chaos_probe.py /tmp/lgbtpu_smoke/chaos.json >&2
+test -s /tmp/lgbtpu_smoke/chaos.json
 # distributed-observability probe (round 13): serving latency
 # histograms exported as a Prometheus textfile, plus a crash
 # flight-recorder smoke — one fault injected through the plan

@@ -1,0 +1,363 @@
+"""Chaos probe: seeded randomized multi-fault survival across the
+train / serve / continuous stacks, gated by the invariant registry.
+
+Run by ``scripts/bench_smoke.sh`` and asserted by
+``tests/test_bench_smoke.py``.  For each seed in ``CHAOS_SEEDS``
+(default 4) it runs one chaos plan per workload (>= 12 plans at the
+default budget), every plan drawn by the deterministic chaos
+scheduler (``reliability/chaos.py``) so ANY red run replays exactly
+from the seed it prints:
+
+- **train** (subprocess): two faults drawn over the ``gbdt.*`` +
+  ``checkpoint.io`` seams — kills, OOMs, transient errors, hangs
+  (bounded by the dispatch/checkpoint watchdogs), slowdowns — then
+  the same command reruns clean and must auto-resume to a model
+  BYTE-IDENTICAL to an uninterrupted reference, with no orphaned
+  partial artifacts and (whenever work was lost) a nonzero exit plus
+  a flight dump naming the seam.
+- **serve** (in-process): two faults over ``predict.dispatch``
+  (no kills — the probe must survive its own workload); every
+  successful response must be byte-identical to a direct
+  ``Booster.predict``, every failure must surface loudly, hangs are
+  cut by ``watchdog_serve_s``.
+- **continuous** (in-process): two faults over ``continuous.cycle``;
+  the lane retries from its ledger until the cycle lands, and the
+  candidate must be byte-identical to a fault-free reference lane
+  over the same slices, with the ledger still replayable.
+
+Env knobs: ``CHAOS_SEEDS`` (how many seeds per workload),
+``CHAOS_BUDGET_S`` (wall budget — on excess the sweep stops with a
+note instead of blowing the smoke wall; a nightly job widens both
+without touching tier-1).
+
+Usage: python scripts/chaos_probe.py [out_json]
+       python scripts/chaos_probe.py --child <model_out>
+"""
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEEDS = int(os.environ.get("CHAOS_SEEDS", "4"))
+BUDGET_S = float(os.environ.get("CHAOS_BUDGET_S", "420"))
+TRAIN_ITERS = 8
+
+
+# ---------------------------------------------------------------------------
+# train workload child (subprocess — kills must take only the child)
+# ---------------------------------------------------------------------------
+def child(out_model: str) -> None:
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.telemetry import TELEMETRY
+    TELEMETRY.configure("counters")
+    rng = np.random.RandomState(13)
+    X = rng.randn(500, 7)
+    y = (X[:, 0] + 0.3 * rng.randn(500) > 0).astype(float)
+    params = dict(
+        objective="binary", num_leaves=15, max_bin=63, verbose=1,
+        dispatch_chunk=2, checkpoint_freq=2, output_model=out_model,
+        retry_backoff_s=0.0, dispatch_retries=0,
+        # the dispatch deadline must clear a COLD XLA compile (the
+        # first enqueue traces + compiles the fused chunk) while
+        # staying under the drawn hang durations (8-15 s below)
+        watchdog_dispatch_s=6.0, watchdog_checkpoint_s=2.0,
+        flight_recorder_out=os.path.join(
+            os.path.dirname(out_model), "flight"))
+    bst = lgb.train(params, lgb.Dataset(X, label=y), TRAIN_ITERS,
+                    verbose_eval=False)
+    bst.save_model(out_model)
+
+
+def run_child(out_model: str, fault_plan: str = ""):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("LTPU_FAULT_PLAN", None)
+    if fault_plan:
+        env["LTPU_FAULT_PLAN"] = fault_plan
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         out_model],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=300).returncode
+
+
+def train_plan(seed: int, workroot: str, ref_model: str) -> dict:
+    from lightgbm_tpu.reliability.chaos import chaos_spec
+    from lightgbm_tpu.reliability.invariants import (ChaosContext,
+                                                     violations)
+    spec = chaos_spec(seed, 2, "gbdt.*,checkpoint.io",
+                      hang_ms=(8000, 15000), slow_ms=(5, 30))
+    wd = os.path.join(workroot, f"train_seed{seed}")
+    shutil.rmtree(wd, ignore_errors=True)
+    os.makedirs(wd)
+    model = os.path.join(wd, "model.txt")
+    rc1 = run_child(model, fault_plan=spec)
+    rc2 = run_child(model)                      # clean rerun: resume
+    ctx = ChaosContext(
+        workdir=wd, reference_model=ref_model, final_model=model,
+        exit_code=rc1, work_lost=(rc1 != 0),
+        flight_dumps=glob.glob(os.path.join(wd, "flight-*.flight.json")),
+        seed=seed, plan=spec)
+    viol = violations(ctx, ["resume_byte_identical",
+                            "no_partial_artifacts", "loud_failure"])
+    if rc2 != 0:
+        viol.append(f"[seed {seed}] clean rerun exited {rc2} — "
+                    "resume did not recover")
+    return {"workload": "train", "seed": seed, "plan": spec,
+            "fault_rc": rc1, "resume_rc": rc2,
+            "violations": viol, "green": not viol}
+
+
+# ---------------------------------------------------------------------------
+# serve workload (in-process; action set excludes kill)
+# ---------------------------------------------------------------------------
+def serve_plan(seed: int, setup: dict) -> dict:
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.reliability.chaos import chaos_spec
+    from lightgbm_tpu.reliability.faults import FAULTS
+    from lightgbm_tpu.reliability.invariants import (ChaosContext,
+                                                     violations)
+    from lightgbm_tpu.serving import ModelRegistry
+    bst, X, expected = setup["bst"], setup["X"], setup["expected"]
+    spec = chaos_spec(
+        seed, 2, "predict.dispatch",
+        actions=("oom", "ConnectionError", "OSError", "TimeoutError",
+                 "hang", "slow"),
+        max_nth=6, hang_ms=(1500, 2500), slow_ms=(2, 15))
+    cfg = Config.from_params({
+        "verbose": -1, "watchdog_serve_s": 0.5,
+        "serve_batch_deadline_ms": 0.0, "dispatch_retries": 0,
+        "retry_backoff_s": 0.0})
+    registry = ModelRegistry(cfg)
+    registry.publish("chaos", bst, warm=(),
+                     predict_kwargs={"device": True})
+    FAULTS.configure(spec)
+    served, matched, failures = [], [], []
+    try:
+        for k in range(10):
+            rows = X[k * 6:(k + 1) * 6]
+            try:
+                _entry, out = registry.predict("chaos", rows)
+            except Exception as e:  # noqa: BLE001 - loud by design
+                failures.append(f"req{k}:{type(e).__name__}")
+                continue
+            served.append(np.asarray(out))
+            matched.append(expected[k * 6:(k + 1) * 6])
+    finally:
+        FAULTS.reset()
+        registry.close()
+    ctx = ChaosContext(
+        served=np.concatenate(served) if served else None,
+        expected=np.concatenate(matched) if matched else None,
+        seed=seed, plan=spec)
+    viol = violations(ctx, ["serving_parity"])
+    if not served:
+        viol.append(f"[seed {seed}] every request failed — the "
+                    "serving plane did not survive the plan")
+    return {"workload": "serve", "seed": seed, "plan": spec,
+            "requests_ok": len(served) * 6, "failures": failures,
+            "violations": viol, "green": not viol}
+
+
+# ---------------------------------------------------------------------------
+# continuous workload (in-process; ledger replay until the cycle lands)
+# ---------------------------------------------------------------------------
+def continuous_setup(workroot: str) -> dict:
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(5)
+    X0 = rng.randn(350, 5)
+    y0 = X0[:, 0] - 0.25 * X0[:, 1]
+    params = {"objective": "regression", "verbose": -1,
+              "num_leaves": 7, "min_data_in_leaf": 5, "max_bin": 31}
+    bst = lgb.train(params, lgb.Dataset(X0, label=y0), 4,
+                    verbose_eval=False)
+    base_path = os.path.join(workroot, "cont_base.txt")
+    bst.save_model(base_path)
+    slices = []
+    for i, sd in enumerate((21, 22)):
+        r2 = np.random.RandomState(sd)
+        Xs = r2.randn(100, 5)
+        ys = Xs[:, 0] - 0.25 * Xs[:, 1]
+        slices.append((f"s{i}.csv",
+                       np.column_stack([ys, Xs])))
+    return {"X0": X0, "y0": y0, "params": params,
+            "base_path": base_path, "slices": slices}
+
+
+def _run_lane(state_dir: str, ingest_dir: str, setup: dict,
+              fault_spec: str = "", max_attempts: int = 8):
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.continuous import ContinuousLane
+    from lightgbm_tpu.reliability.faults import FAULTS
+    os.makedirs(ingest_dir, exist_ok=True)
+    for name, arr in setup["slices"]:
+        np.savetxt(os.path.join(ingest_dir, name), arr,
+                   delimiter=",")
+    cfg = Config.from_params(dict(
+        setup["params"], continuous_ingest_dir=ingest_dir,
+        continuous_state_dir=state_dir, continuous_iterations=3,
+        continuous_eval_holdout=0.25, watchdog_continuous_s=10.0))
+    lane = ContinuousLane(cfg, None, name="chaos",
+                          base_model=setup["base_path"],
+                          base_data=setup["X0"],
+                          base_label=setup["y0"],
+                          train_params=dict(setup["params"]))
+    lane._base_model_path()
+    if fault_spec:
+        FAULTS.configure(fault_spec)
+    attempts, done, errors = 0, None, []
+    try:
+        while attempts < max_attempts and done is None:
+            attempts += 1
+            try:
+                done = lane.run_cycle()
+            except Exception as e:  # noqa: BLE001 - ledger replays
+                errors.append(type(e).__name__)
+    finally:
+        FAULTS.reset()
+    return done, attempts, errors
+
+
+def continuous_plan(seed: int, workroot: str, setup: dict,
+                    ref_model: str) -> dict:
+    from lightgbm_tpu.reliability.chaos import chaos_spec
+    from lightgbm_tpu.reliability.invariants import (ChaosContext,
+                                                     violations)
+    spec = chaos_spec(
+        seed, 2, "continuous.cycle",
+        actions=("oom", "ConnectionError", "OSError", "RuntimeError",
+                 "hang", "slow"),
+        max_nth=4, hang_ms=(200, 500), slow_ms=(2, 15))
+    sdir = os.path.join(workroot, f"cont_seed{seed}")
+    idir = os.path.join(sdir, "ingest")
+    shutil.rmtree(sdir, ignore_errors=True)
+    os.makedirs(sdir)
+    done, attempts, errors = _run_lane(sdir, idir, setup,
+                                       fault_spec=spec)
+    ctx = ChaosContext(
+        workdir=sdir, ledger_path=os.path.join(sdir, "ledger.json"),
+        reference_model=ref_model,
+        final_model=os.path.join(sdir, "model_cycle_1.txt"),
+        seed=seed, plan=spec)
+    viol = violations(ctx, ["resume_byte_identical",
+                            "no_partial_artifacts",
+                            "ledger_converges"])
+    if done is None:
+        viol.append(f"[seed {seed}] cycle never completed in "
+                    f"{attempts} ledger replays ({errors})")
+    return {"workload": "continuous", "seed": seed, "plan": spec,
+            "attempts": attempts, "cycle_errors": errors,
+            "violations": viol, "green": not viol}
+
+
+# ---------------------------------------------------------------------------
+def main() -> int:
+    out_json = sys.argv[1] if len(sys.argv) > 1 \
+        else "/tmp/lgbtpu_smoke/chaos.json"
+    os.makedirs(os.path.dirname(out_json), exist_ok=True)
+    workroot = os.path.join(os.path.dirname(out_json), "chaos_work")
+    shutil.rmtree(workroot, ignore_errors=True)
+    os.makedirs(workroot)
+    t0 = time.perf_counter()
+
+    from lightgbm_tpu.telemetry import TELEMETRY
+    TELEMETRY.configure("counters")
+    TELEMETRY.flight.arm(os.path.join(workroot, "probe_flight"))
+
+    # fault-free references, built once and shared by every seed
+    ref_dir = os.path.join(workroot, "train_ref")
+    os.makedirs(ref_dir)
+    ref_model = os.path.join(ref_dir, "model.txt")
+    rc = run_child(ref_model)
+    if rc != 0:
+        sys.stderr.write("chaos probe: reference train child failed\n")
+        return 1
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    Xs = rng.randn(300, 5)
+    ys = (Xs[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7, "min_data_in_leaf": 5},
+                    lgb.Dataset(Xs, label=ys), 5, verbose_eval=False)
+    bst.predict(Xs[:6], device=True)   # warm the 16-row bucket the
+    # 6-row chaos requests land on: cold compiles must not masquerade
+    # as stalls under watchdog_serve_s
+    serve_setup = {"bst": bst, "X": Xs,
+                   "expected": np.asarray(
+                       bst.predict(Xs[:60], device=True))}
+    cont_setup = continuous_setup(workroot)
+    cont_ref_dir = os.path.join(workroot, "cont_ref")
+    done, _, _ = _run_lane(cont_ref_dir,
+                           os.path.join(cont_ref_dir, "ingest"),
+                           cont_setup)
+    if done is None:
+        sys.stderr.write("chaos probe: reference lane cycle failed\n")
+        return 1
+    cont_ref_model = os.path.join(cont_ref_dir, "model_cycle_1.txt")
+
+    plans, budget_exceeded = [], False
+    for seed in range(1, SEEDS + 1):
+        for run in (lambda: train_plan(seed, workroot, ref_model),
+                    lambda: serve_plan(seed, serve_setup),
+                    lambda: continuous_plan(seed, workroot,
+                                            cont_setup,
+                                            cont_ref_model)):
+            if time.perf_counter() - t0 > BUDGET_S:
+                budget_exceeded = True
+                break
+            plans.append(run())
+            p = plans[-1]
+            sys.stderr.write(
+                f"chaos[{p['workload']} seed={p['seed']}] "
+                f"{'green' if p['green'] else 'RED'} plan={p['plan']}"
+                + (f" violations={p['violations']}"
+                   if p["violations"] else "") + "\n")
+        if budget_exceeded:
+            break
+
+    counters = TELEMETRY.counters()
+    green = sum(1 for p in plans if p["green"])
+    out = {
+        "seeds": SEEDS,
+        "budget_s": BUDGET_S,
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+        "budget_exceeded": budget_exceeded,
+        "plans_run": len(plans),
+        "plans_green": green,
+        "invariants": ["resume_byte_identical", "no_partial_artifacts",
+                       "ledger_converges", "serving_parity",
+                       "loud_failure"],
+        "stalls_total": int(counters.get("stalls_total", 0)),
+        "faults_injected": int(counters.get("faults_injected", 0)),
+        "plans": plans,
+        "status": "pass" if green == len(plans) and plans else "fail",
+    }
+    with open(out_json, "w") as f:
+        json.dump(out, f, indent=1)
+    sys.stderr.write(
+        f"chaos probe: {green}/{len(plans)} plans green in "
+        f"{out['elapsed_s']}s (budget {BUDGET_S:g}s"
+        + (", EXCEEDED — sweep truncated" if budget_exceeded else "")
+        + f"); faults_injected={out['faults_injected']}\n")
+    return 0 if out["status"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+        sys.exit(0)
+    sys.exit(main())

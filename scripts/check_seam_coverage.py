@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Seam-coverage lint (TEL001-style, two directions): every fault
+seam registered in ``lightgbm_tpu/reliability/faults.py`` must be
+
+1. EXERCISED — named by at least one test (``tests/*.py``) or probe
+   (``scripts/*.py``, this lint excluded): a seam nothing injects
+   into is a recovery path nothing has ever proven, and
+2. DOCUMENTED — present in the docs/RELIABILITY.md seam-registry
+   table: an undocumented seam is un-runbook-able at 3am,
+
+and conversely every seam the RELIABILITY.md table documents must
+still be registered — a documented-but-deleted seam means the doc
+(and any chaos glob built on it) silently rotted.
+
+Runs in ``scripts/bench_smoke.sh`` before the bench; rc 0 clean,
+rc 1 drift (findings on stderr), matching the check_carry_layout /
+check_telemetry_coverage contract.  The seam registry is parsed
+straight from the faults.py source (no package import — the lint
+must stay sub-second with no jax in sight).
+"""
+import glob
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAULTS_PY = os.path.join(REPO, "lightgbm_tpu", "reliability",
+                         "faults.py")
+DOC = os.path.join(REPO, "docs", "RELIABILITY.md")
+SELF = os.path.abspath(__file__)
+
+
+def registered_seams():
+    """The SEAMS tuple literal, parsed from source: quoted strings
+    between ``SEAMS = (`` and the closing ``)`` at column 0."""
+    with open(FAULTS_PY) as f:
+        src = f.read()
+    m = re.search(r"^SEAMS = \(\n(.*?)^\)\n", src, re.S | re.M)
+    if not m:
+        print("DRIFT: cannot locate the SEAMS registry tuple in "
+              f"{FAULTS_PY}", file=sys.stderr)
+        sys.exit(1)
+    return re.findall(r'"([a-z_.]+)"', m.group(1))
+
+
+def exercised_in():
+    """{seam: [files naming it]} over tests/ + scripts/ (this lint
+    and __pycache__ excluded)."""
+    sources = {}
+    for pat in ("tests/*.py", "scripts/*.py"):
+        for path in glob.glob(os.path.join(REPO, pat)):
+            if os.path.abspath(path) == SELF:
+                continue
+            with open(path) as f:
+                sources[os.path.relpath(path, REPO)] = f.read()
+    return sources
+
+
+def documented_seams():
+    """First-column backticked names of the RELIABILITY.md
+    seam-registry table (rows like ``| `gbdt.train_chunk` | ... |``,
+    dotted names only — other tables in the doc use knob names)."""
+    with open(DOC) as f:
+        text = f.read()
+    return set(re.findall(r"^\|\s*`([a-z_]+\.[a-z_]+)`\s*\|", text,
+                          re.M))
+
+
+def main() -> int:
+    seams = registered_seams()
+    sources = exercised_in()
+    documented = documented_seams()
+    drift = []
+    for seam in seams:
+        users = [rel for rel, src in sources.items() if seam in src]
+        if not users:
+            drift.append(
+                f"seam {seam!r} is registered but exercised by no "
+                "test or probe — its recovery path is unproven "
+                "(add a fault-plan test, or a chaos glob covering it)")
+        if seam not in documented:
+            drift.append(
+                f"seam {seam!r} is registered but missing from the "
+                "docs/RELIABILITY.md seam-registry table")
+    for name in sorted(documented - set(seams)):
+        drift.append(
+            f"docs/RELIABILITY.md documents seam {name!r} which is "
+            "not registered in reliability/faults.py — stale doc row")
+    for d in drift:
+        print(f"DRIFT: {d}", file=sys.stderr)
+    if drift:
+        print(f"check_seam_coverage: {len(drift)} drift error(s)",
+              file=sys.stderr)
+        return 1
+    print(f"check_seam_coverage: {len(seams)} seams all exercised "
+          "and documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,6 +125,41 @@ def test_bench_smoke_json_contract():
     assert r["byte_identical"] is True
     assert r["checkpoint_saves"] >= 2
     assert r["save_ms_per_snapshot"] > 0
+    # chaos probe (round 19): seeded randomized multi-fault plans
+    # across train/serve/continuous, gated by the invariant registry
+    # — scripts/chaos_probe.py, run in-line by bench_smoke.sh
+    with open("/tmp/lgbtpu_smoke/chaos.json") as f:
+        ch = json.load(f)
+    for field in ("plans_run", "plans_green", "plans", "invariants",
+                  "faults_injected", "status"):
+        assert field in ch, f"chaos probe missing {field}"
+    assert ch["status"] == "pass"
+    assert ch["plans_green"] == ch["plans_run"]
+    if ch["budget_exceeded"]:
+        # CHAOS_BUDGET_S tripped on a slow machine: the sweep stops
+        # with a note INSTEAD of blowing the smoke wall — whatever ran
+        # must still be green, but the floor below is waived
+        assert ch["plans_run"] >= 1
+    else:
+        # the acceptance floor: >= 12 seeded plans across all three
+        # workloads, every one green, every plan carrying its seed +
+        # expanded spec for replay
+        assert ch["plans_run"] >= 12, \
+            f"chaos sweep ran only {ch['plans_run']} plans"
+        # in-process workloads (serve/continuous) count into the
+        # probe's own faults_injected; train faults fire in
+        # subprocesses.  A zero here would mean the draws never hit a
+        # live seam — vacuous plans
+        assert ch["faults_injected"] >= 4
+        workloads = {p["workload"] for p in ch["plans"]}
+        assert workloads == {"train", "serve", "continuous"}
+    for p in ch["plans"]:
+        assert p["green"] and not p["violations"], p
+        assert isinstance(p["seed"], int) and p["plan"], \
+            "a chaos plan must be replayable from its seed"
+    assert set(ch["invariants"]) >= {
+        "resume_byte_identical", "no_partial_artifacts",
+        "ledger_converges", "serving_parity", "loud_failure"}
     # distributed-observability probe (round 13): the Prometheus
     # textfile was written and scrape-parsed (bucket monotonicity is
     # asserted inside bench_smoke.sh), and the flight-recorder smoke

@@ -1,0 +1,628 @@
+"""Chaos harness + deadline watchdog + degraded-mode continuation
+(docs/RELIABILITY.md, "Chaos testing" / "Deadline watchdog").
+
+Acceptance pins for the round-19 robustness layer:
+
+- seeded chaos plans are deterministic (same seed -> same draw),
+  glob-filtered, and parse through the ``chaos:<seed>:<n>[:glob]``
+  grammar; ``hang:<ms>`` / ``slow:<ms>`` actions block/delay seams;
+- an injected ``hang`` at a COLLECTIVE seam and at a DISPATCH seam is
+  caught by the watchdog within its configured deadline, produces an
+  all-thread stack flight dump naming the seam, and surfaces as a
+  classified ``StallError`` that rides the existing retry machinery;
+- exhausted retries and stalls leave a metric trail
+  (``retry_exhausted_total`` / ``stalls_total``);
+- degraded-mode sharded construction (``sharded_allow_degraded=on``,
+  one participant dead or hung past deadline) completes with trees
+  BYTE-IDENTICAL to a from-scratch run on the surviving world, while
+  the default-off path still fails fast;
+- the invariant registry catches torn artifacts, diverging ledgers,
+  silent serving corruption and quiet partial successes;
+- a torn/bit-flipped checkpoint at every container boundary is
+  rejected loudly and ``resume=auto`` falls back to the next-newest
+  valid file, never a partial restore;
+- ``task=serve`` drains and exits 0 on a REAL SIGTERM.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.reliability import chaos
+from lightgbm_tpu.reliability import checkpoint as ck
+from lightgbm_tpu.reliability import invariants as inv
+from lightgbm_tpu.reliability import watchdog as wd
+from lightgbm_tpu.reliability.faults import (FAULTS, FaultInjected,
+                                             SEAMS, parse_plan)
+from lightgbm_tpu.reliability.retry import (RetryPolicy, is_transient,
+                                            retry_call)
+from lightgbm_tpu.reliability.watchdog import (WATCHDOG, StallError,
+                                               run_with_deadline)
+from lightgbm_tpu.telemetry import TELEMETRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    """No armed plan, no armed deadline, clean telemetry — before AND
+    after every test (all three are process globals)."""
+    FAULTS.reset()
+    for p in wd.PHASES:
+        wd.set_deadline(p, 0.0)
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    yield
+    FAULTS.reset()
+    for p in wd.PHASES:
+        wd.set_deadline(p, 0.0)
+    TELEMETRY.flight.disarm()
+    TELEMETRY.configure("off")
+    TELEMETRY.reset()
+
+
+def _data(n=240, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.25 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+BASE = dict(objective="binary", num_leaves=7, max_bin=31, verbose=-1,
+            min_data_in_leaf=5, dispatch_chunk=4, retry_backoff_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# chaos scheduler: deterministic draws, glob filter, grammar
+# ---------------------------------------------------------------------------
+def test_chaos_draw_deterministic_and_replayable():
+    a = chaos.chaos_entries(7, 5)
+    b = chaos.chaos_entries(7, 5)
+    assert a == b, "same seed must draw the identical plan"
+    assert chaos.chaos_entries(8, 5) != a
+    for seam, nth, action in a:
+        assert seam in SEAMS
+        assert nth >= 1
+    assert chaos.chaos_spec(7, 5) == ";".join(
+        f"{s}:{n}:{x}" for s, n, x in a)
+
+
+def test_chaos_glob_filter_and_action_set():
+    assert chaos.chaos_seams("gbdt.*") == ["gbdt.train_chunk",
+                                           "gbdt.train_one_iter"]
+    assert set(chaos.chaos_seams("gbdt.*,checkpoint.io")) == {
+        "gbdt.train_chunk", "gbdt.train_one_iter", "checkpoint.io"}
+    with pytest.raises(ValueError, match="matches no registered"):
+        chaos.chaos_seams("nope.*")
+    drawn = chaos.chaos_entries(3, 20, "predict.dispatch",
+                                actions=("slow",), max_nth=20,
+                                slow_ms=(5, 9))
+    assert len({(s, n) for s, n, _ in drawn}) == 20, \
+        "draws must never shadow each other at one (seam, nth)"
+    for seam, _nth, action in drawn:
+        assert seam == "predict.dispatch"
+        assert action.startswith("slow:")
+        assert 5 <= int(action.split(":")[1]) <= 9
+    # an overdrawn plan (more faults than distinct pairs) errors
+    # loudly instead of silently injecting fewer than it claims
+    with pytest.raises(ValueError, match="distinct"):
+        chaos.chaos_entries(3, 20, "predict.dispatch")
+
+
+def test_chaos_grammar_parses_and_rejects():
+    entries = parse_plan("chaos:11:4:gbdt.*")
+    assert len(entries) == 4
+    assert all(e.seam.startswith("gbdt.") for e in entries)
+    # composes with scripted entries
+    mixed = parse_plan("chaos:11:2;predict.dispatch:1:oom")
+    assert len(mixed) == 3
+    with pytest.raises(ValueError, match="seed"):
+        parse_plan("chaos:x:4")
+    with pytest.raises(ValueError, match="count"):
+        parse_plan("chaos:3:0")
+    with pytest.raises(ValueError, match="matches no registered"):
+        parse_plan("chaos:3:2:bogus.*")
+
+
+def test_hang_slow_actions_parse_and_fire():
+    e = parse_plan("gbdt.train_chunk:2:hang:400;"
+                   "predict.dispatch:1:slow:20:x3")
+    assert (e[0].action, e[0].duration_ms) == ("hang", 400)
+    assert (e[1].action, e[1].duration_ms, e[1].count) == \
+        ("slow", 20, 3)
+    with pytest.raises(ValueError, match="millisecond"):
+        parse_plan("gbdt.train_chunk:1:hang")
+    with pytest.raises(ValueError, match="millisecond"):
+        parse_plan("gbdt.train_chunk:1:slow:abc")
+    # slow: delays, then proceeds
+    FAULTS.configure("predict.dispatch:1:slow:40")
+    t0 = time.perf_counter()
+    FAULTS.fault_point("predict.dispatch")
+    assert time.perf_counter() - t0 >= 0.03
+    # hang: blocks, then errors (the op never completed)
+    FAULTS.configure("predict.dispatch:1:hang:40")
+    t0 = time.perf_counter()
+    with pytest.raises(FaultInjected, match="hang released"):
+        FAULTS.fault_point("predict.dispatch")
+    assert time.perf_counter() - t0 >= 0.03
+
+
+# ---------------------------------------------------------------------------
+# watchdog core
+# ---------------------------------------------------------------------------
+def test_run_with_deadline_semantics(tmp_path):
+    assert run_with_deadline(lambda a, b: a + b, 0.0, "p", "s",
+                             1, 2) == 3       # disarmed = inline
+    assert run_with_deadline(lambda: "ok", 5.0, "p", "s") == "ok"
+    with pytest.raises(KeyError):              # exceptions relay
+        run_with_deadline(lambda: {}["x"], 5.0, "p", "s")
+    TELEMETRY.flight.arm(str(tmp_path / "flight"))
+    t0 = time.perf_counter()
+    with pytest.raises(StallError, match="deadline exceeded"):
+        run_with_deadline(lambda: time.sleep(1.0), 0.1,
+                          "unit_phase", "predict.dispatch")
+    assert time.perf_counter() - t0 < 0.8, \
+        "the stall must surface AT the deadline, not after the hang"
+    assert TELEMETRY.counters().get("stalls_total") == 1
+    dump = json.load(open(TELEMETRY.flight.dumps[-1]))
+    assert dump["reason"] == "stall"
+    assert dump["seam"] == "predict.dispatch"
+    assert dump["stacks"], "the dump must carry all-thread stacks"
+    assert any("time.sleep" in ln or "sleep" in ln
+               for frames in dump["stacks"].values()
+               for ln in frames), "the stalled frame must be visible"
+    # the classification contract: StallError rides the retry
+    # machinery as a transient error
+    assert is_transient(StallError("p", "s", 0.1))
+
+
+def test_watchdog_monitor_watch_and_cancel(tmp_path):
+    TELEMETRY.flight.arm(str(tmp_path / "flight"))
+    token = WATCHDOG.watch("unit_watch", 0.08, seam="continuous.cycle")
+    deadline = time.perf_counter() + 5.0
+    while not TELEMETRY.flight.dumps:
+        assert time.perf_counter() < deadline, "watch never fired"
+        time.sleep(0.02)
+    assert TELEMETRY.counters().get("stalls_total") == 1
+    dump = json.load(open(TELEMETRY.flight.dumps[-1]))
+    assert dump["phase"] == "unit_watch"
+    assert dump["seam"] == "continuous.cycle"
+    # a cancelled token must never fire
+    TELEMETRY.reset()
+    token = WATCHDOG.watch("unit_watch2", 0.08)
+    WATCHDOG.cancel(token)
+    time.sleep(0.2)
+    assert not TELEMETRY.counters().get("stalls_total")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: hang at a dispatch seam / a collective seam
+# ---------------------------------------------------------------------------
+def test_dispatch_hang_caught_by_watchdog(tmp_path):
+    TELEMETRY.flight.arm(str(tmp_path / "flight"))
+    X, y = _data()
+    # the hang fires at the FIRST dispatch call, BEFORE the enqueue
+    # traces/compiles anything — so a 1 s deadline under a 6 s hang
+    # pins 'caught within the configured deadline' without cold
+    # compile noise
+    params = dict(BASE, watchdog_dispatch_s=1.0, dispatch_retries=0)
+    FAULTS.configure("gbdt.train_chunk:1:hang:6000")
+    t0 = time.perf_counter()
+    with pytest.raises(StallError, match="gbdt.train_chunk"):
+        lgb.train(params, lgb.Dataset(X, label=y), 4,
+                  verbose_eval=False)
+    assert time.perf_counter() - t0 < 5.0, \
+        "caught at the deadline, not at hang release"
+    assert TELEMETRY.counters().get("stalls_total", 0) >= 1
+    # the flight trail: a stall dump naming the seam, with stacks,
+    # AND the retry-exhaustion dump (dispatch_retries=0)
+    dumps = [json.load(open(p)) for p in TELEMETRY.flight.dumps]
+    stall = [d for d in dumps if d["reason"] == "stall"]
+    assert stall and stall[-1]["seam"] == "gbdt.train_chunk"
+    assert stall[-1]["stacks"]
+    assert any(d["reason"] == "retry_exhausted" for d in dumps)
+    assert TELEMETRY.counters().get("retry_exhausted_total") == 1
+
+
+def test_dispatch_stall_retried_to_success():
+    """StallError is TRANSIENT: with retries left, a one-shot hang is
+    absorbed and training completes — the 'through the existing retry
+    machinery' half of the acceptance criterion."""
+    X, y = _data()
+    # deadline sized ABOVE the retry attempt's trace+compile wall
+    # (the docs' sizing rule) but under the 20 s hang
+    params = dict(BASE, watchdog_dispatch_s=4.0, dispatch_retries=2)
+    FAULTS.configure("gbdt.train_chunk:1:hang:15000")
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 4,
+                    verbose_eval=False)
+    assert bst.num_trees() == 4
+    c = TELEMETRY.counters()
+    assert c.get("stalls_total", 0) >= 1
+    assert c.get("retries", 0) >= 1
+    assert not c.get("retry_exhausted_total")
+
+
+def test_collective_hang_caught_by_watchdog(tmp_path):
+    TELEMETRY.flight.arm(str(tmp_path / "flight"))
+    wd.set_deadline("collective", 0.15)
+    from lightgbm_tpu.parallel.distributed import _allgather
+    FAULTS.configure("collectives.allgather:1:hang:2000")
+    t0 = time.perf_counter()
+    with pytest.raises(StallError, match="collectives.allgather"):
+        _allgather(np.arange(4.0))
+    assert time.perf_counter() - t0 < 1.5
+    dump = json.load(open(TELEMETRY.flight.dumps[-1]))
+    assert dump["seam"] == "collectives.allgather"
+    assert dump["stacks"]
+    FAULTS.reset()
+    out = _allgather(np.arange(4.0))   # the plane survives
+    assert out.reshape(-1).shape[0] >= 4
+
+
+def test_host_collective_backend_carries_seam_and_deadline():
+    from lightgbm_tpu.parallel.collectives import HostCollectives
+    hc = HostCollectives(shards=2)
+    FAULTS.configure("collectives.allgather:1:ConnectionError")
+    with pytest.raises(ConnectionError, match="injected at seam"):
+        hc.simulate_allgather([np.arange(2.0), np.arange(2.0)])
+    FAULTS.reset()
+    wd.set_deadline("collective", 0.1)
+    FAULTS.configure("collectives.allgather:1:hang:1500")
+    with pytest.raises(StallError):
+        hc.simulate_allgather([np.arange(2.0), np.arange(2.0)])
+
+
+def test_checkpoint_io_hang_caught(tmp_path):
+    wd.set_deadline("checkpoint", 0.1)
+    FAULTS.configure("checkpoint.io:1:hang:1500")
+    with pytest.raises(StallError, match="checkpoint.io"):
+        ck.atomic_write_text(str(tmp_path / "x.txt"), "hello")
+    FAULTS.reset()
+    ck.atomic_write_text(str(tmp_path / "x.txt"), "hello")
+    assert open(tmp_path / "x.txt").read() == "hello"
+
+
+def test_resume_scan_never_falls_back_past_a_stalled_read(tmp_path):
+    """A hung checkpoint READ must surface as StallError, NOT convert
+    to CheckpointError: find_resume swallows CheckpointError to fall
+    back to older files, and a stalled filesystem must not let it
+    silently resume from stale state it 'fell back' to without ever
+    reading the newer checkpoint."""
+    prefix = str(tmp_path / "m.ckpt")
+    fp = "a" * 64
+    ck.save_checkpoint(ck.checkpoint_file(prefix, 2), {"it": 2}, fp)
+    ck.save_checkpoint(ck.checkpoint_file(prefix, 4), {"it": 4}, fp)
+    wd.set_deadline("checkpoint", 0.1)
+    # the scan's FIRST read (the newest file, iteration 4) hangs
+    FAULTS.configure("checkpoint.io:1:hang:1500")
+    with pytest.raises(StallError):
+        ck.find_resume(prefix, fp)
+    FAULTS.reset()
+    assert ck.find_resume(prefix, fp)[0] == 4
+
+
+def test_train_one_iter_seam_fires_on_unchunked_path():
+    """2 iterations under dispatch_chunk=4 take the per-iteration
+    path — the gbdt.train_one_iter seam must be live there."""
+    X, y = _data()
+    FAULTS.configure("gbdt.train_one_iter:1:slow:20")
+    bst = lgb.train(dict(BASE), lgb.Dataset(X, label=y), 2,
+                    verbose_eval=False)
+    assert bst.num_trees() == 2
+    assert FAULTS.call_count("gbdt.train_one_iter") == 2
+    assert TELEMETRY.counters().get("faults_injected") == 1
+
+
+def test_distributed_init_seam_fails_loud_without_retry():
+    """A non-transient error at the rendezvous seam propagates
+    immediately (no retry burn) — and never reaches the real
+    jax.distributed.initialize on this single-process backend."""
+    from lightgbm_tpu.parallel import distributed
+    FAULTS.configure("distributed.init:1:ValueError")
+    with pytest.raises(ValueError, match="injected at seam"):
+        distributed.initialize()
+    assert not TELEMETRY.counters().get("retries")
+
+
+# ---------------------------------------------------------------------------
+# serving: stall classification
+# ---------------------------------------------------------------------------
+def test_batcher_stall_classified_and_counted():
+    from lightgbm_tpu.serving.batcher import MicroBatcher, _Request
+    cfg = Config.from_params({"verbose": -1, "watchdog_serve_s": 0.1})
+    mb = MicroBatcher(lambda x: (time.sleep(1.0), x.sum(1))[1],
+                      cfg, start=False)
+    req = _Request(np.ones((2, 3)), 0.0)
+    mb._run_batch([req])
+    assert isinstance(req.error, StallError)
+    c = TELEMETRY.counters()
+    assert c.get("serve_stalls") == 1
+    assert c.get("stalls_total") == 1
+    assert c.get("serve_errors") == 1
+    # unstalled dispatches still flow
+    req2 = _Request(np.ones((2, 3)), 0.0)
+    mb.predict = lambda x: x.sum(1)
+    mb._run_batch([req2])
+    assert req2.error is None and req2.result.shape == (2,)
+
+
+def test_frontend_maps_stall_to_503():
+    from lightgbm_tpu.serving.server import ServingFrontend
+
+    class _Stub:
+        def predict(self, name, rows):
+            raise StallError("serve_dispatch", "predict.dispatch", 0.1)
+
+        def names(self):
+            return ["m"]
+
+    fe = ServingFrontend(_Stub(), None)
+    status, ctype, body, extra = fe._handle_predict(
+        "POST", "/predict/m", b'{"rows": [[1.0, 2.0]]}', {})
+    assert status == 503
+    assert extra and "Retry-After" in extra
+    payload = json.loads(body)
+    assert payload.get("stall") is True
+
+
+def test_retry_exhausted_counter_on_plain_transient():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise ConnectionError("connection reset")
+
+    with pytest.raises(ConnectionError):
+        retry_call(flaky, policy=RetryPolicy(max_retries=2,
+                                             base_delay_s=0.0),
+                   seam="unit", sleep=lambda s: None)
+    assert len(calls) == 3
+    c = TELEMETRY.counters()
+    assert c.get("retries") == 2
+    assert c.get("retry_exhausted_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode sharded continuation
+# ---------------------------------------------------------------------------
+def _sharded_cfg(**over):
+    return Config.from_params(dict(
+        {"verbose": -1, "max_bin": 31, "min_data_in_leaf": 5}, **over))
+
+
+def _survivor_slice(n, world, dead):
+    from lightgbm_tpu.sharded.dataset import shard_row_ranges
+    ranges = shard_row_ranges(n, world)
+    return np.concatenate([np.arange(a, b)
+                           for i, (a, b) in enumerate(ranges)
+                           if i != dead])
+
+
+def test_degraded_binfind_byte_identical_vs_surviving_world():
+    from lightgbm_tpu.sharded.dataset import ShardedDataset
+    X, y = _data(n=210)
+    # default OFF: fail fast, unchanged semantics
+    FAULTS.configure("sharded.binfind:2:RuntimeError")
+    with pytest.raises(RuntimeError, match="injected at seam"):
+        ShardedDataset.construct_sharded(X, label=y,
+                                         config=_sharded_cfg(),
+                                         num_shards=3)
+    # degraded ON: participant 1 excluded, construction continues
+    FAULTS.configure("sharded.binfind:2:RuntimeError")
+    ds = ShardedDataset.construct_sharded(
+        X, label=y, config=_sharded_cfg(sharded_allow_degraded=True),
+        num_shards=3)
+    FAULTS.reset()
+    assert ds.world_size == 2
+    keep = _survivor_slice(210, 3, dead=1)
+    assert ds.num_data == len(keep)
+    assert TELEMETRY.counters().get("sharded_degraded_exclusions") == 1
+    ref = ShardedDataset.construct_sharded(
+        X[keep], label=y[keep], config=_sharded_cfg(), num_shards=2)
+    params = dict(BASE)
+    m_deg = lgb.train(params, ds, 4, verbose_eval=False)
+    m_ref = lgb.train(params, ref, 4, verbose_eval=False)
+    assert m_deg.model_to_string() == m_ref.model_to_string(), \
+        "degraded trees must be byte-identical to a from-scratch " \
+        "run on the surviving world"
+
+
+def test_degraded_participant_hang_excluded_past_deadline():
+    from lightgbm_tpu.sharded.dataset import ShardedDataset
+    X, y = _data(n=180)
+    cfg = _sharded_cfg(sharded_allow_degraded=True,
+                       watchdog_collective_s=0.15)
+    FAULTS.configure("sharded.binfind:2:hang:2500")
+    t0 = time.perf_counter()
+    ds = ShardedDataset.construct_sharded(X, label=y, config=cfg,
+                                          num_shards=3)
+    assert ds.world_size == 2
+    assert time.perf_counter() - t0 < 2.0, \
+        "the hung participant must be cut at the deadline"
+    assert TELEMETRY.counters().get("stalls_total", 0) >= 1
+
+
+def test_degraded_ingest_exclusion():
+    from lightgbm_tpu.sharded.dataset import ShardedDataset
+    X, y = _data(n=180)
+    FAULTS.configure("sharded.ingest:2:OSError")
+    with pytest.raises(OSError):
+        ShardedDataset.construct_sharded(X, label=y,
+                                         config=_sharded_cfg(),
+                                         num_shards=3)
+    FAULTS.configure("sharded.ingest:2:OSError")
+    ds = ShardedDataset.construct_sharded(
+        X, label=y, config=_sharded_cfg(sharded_allow_degraded=True),
+        num_shards=3)
+    assert ds.world_size == 2
+    assert ds.num_data == len(_survivor_slice(180, 3, dead=1))
+
+
+# ---------------------------------------------------------------------------
+# invariant registry
+# ---------------------------------------------------------------------------
+def test_invariant_no_partial_artifacts(tmp_path):
+    d = str(tmp_path)
+    assert not inv.run_invariants(
+        inv.ChaosContext(workdir=d))["no_partial_artifacts"]
+    open(os.path.join(d, "ckpt.tmp-1234"), "w").write("torn")
+    v = inv.run_invariants(
+        inv.ChaosContext(workdir=d))["no_partial_artifacts"]
+    assert v and "ckpt.tmp-1234" in v[0]
+
+
+def test_invariant_resume_byte_identical(tmp_path):
+    a, b = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    open(a, "w").write("model")
+    open(b, "w").write("model")
+    ctx = inv.ChaosContext(reference_model=a, final_model=b)
+    assert not inv.run_invariants(ctx)["resume_byte_identical"]
+    open(b, "w").write("model2")
+    assert inv.run_invariants(ctx)["resume_byte_identical"]
+    ctx2 = inv.ChaosContext(reference_model=a,
+                            final_model=str(tmp_path / "gone.txt"))
+    assert inv.run_invariants(ctx2)["resume_byte_identical"]
+
+
+def test_invariant_ledger_converges(tmp_path):
+    led = str(tmp_path / "ledger.json")
+    good = {"schema": 1, "cycle": 2, "phase": "idle",
+            "cycle_slices": [], "cycle_decision": None,
+            "processed": [], "last_good": "model_base.txt",
+            "published": [], "quarantined": []}
+    open(led, "w").write(json.dumps(good))
+    assert not inv.run_invariants(
+        inv.ChaosContext(ledger_path=led))["ledger_converges"]
+    open(led, "w").write("{torn json")
+    assert inv.run_invariants(
+        inv.ChaosContext(ledger_path=led))["ledger_converges"]
+    open(led, "w").write(json.dumps(dict(good, phase="exploded")))
+    v = inv.run_invariants(
+        inv.ChaosContext(ledger_path=led))["ledger_converges"]
+    assert v and "re-enterable" in v[0]
+
+
+def test_invariant_serving_parity_and_loud_failure(tmp_path):
+    ctx = inv.ChaosContext(served=np.array([1.0, 2.0]),
+                           expected=np.array([1.0, 2.0]))
+    assert not inv.run_invariants(ctx)["serving_parity"]
+    ctx.served = np.array([1.0, 2.5])
+    assert inv.run_invariants(ctx)["serving_parity"]
+    # loud failure: work lost + rc 0 = violation; rc != 0 + a dump
+    # naming the seam = holds
+    silent = inv.ChaosContext(work_lost=True, exit_code=0)
+    v = inv.run_invariants(silent)["loud_failure"]
+    assert len(v) == 2          # silent exit AND no seam-naming dump
+    dump = str(tmp_path / "x.flight.json")
+    open(dump, "w").write(json.dumps({"seam": "gbdt.train_chunk"}))
+    loud = inv.ChaosContext(work_lost=True, exit_code=-9,
+                            flight_dumps=[dump])
+    assert not inv.run_invariants(loud)["loud_failure"]
+    with pytest.raises(ValueError, match="unknown invariant"):
+        inv.run_invariants(loud, ["nope"])
+
+
+# ---------------------------------------------------------------------------
+# checkpoint torn-write fuzz (satellite)
+# ---------------------------------------------------------------------------
+def test_checkpoint_torn_write_fuzz(tmp_path):
+    """Truncations and bit-flips at every container boundary (magic /
+    schema / fingerprint / payload length / payload / trailing hash)
+    must be rejected loudly, and resume=auto must fall back to the
+    next-newest VALID checkpoint — never a silent partial restore."""
+    prefix = str(tmp_path / "m.ckpt")
+    fp = "f" * 64
+    ck.save_checkpoint(ck.checkpoint_file(prefix, 2),
+                       {"iteration": 2, "blob": b"x" * 256}, fp)
+    newest = ck.checkpoint_file(prefix, 4)
+    ck.save_checkpoint(newest, {"iteration": 4, "blob": b"y" * 256},
+                       fp)
+    pristine = open(newest, "rb").read()
+    L = len(pristine)
+    header = len(ck.MAGIC)                      # 10
+    cases = []
+    # truncations at: empty file, inside magic, inside schema, inside
+    # the fingerprint, inside payload-length, inside the payload, and
+    # inside the trailing hash
+    for cut in (0, 5, header + 2, header + 8 + 30, header + 8 + 66,
+                L - 40, L - 10):
+        cases.append(("truncate@%d" % cut, pristine[:cut]))
+    # single-bit flips at the same boundaries
+    for flip in (2, header + 1, header + 4 + 1, header + 8 + 5,
+                 header + 8 + 64 + 4, L - 40, L - 5):
+        b = bytearray(pristine)
+        b[flip] ^= 0x40
+        cases.append(("bitflip@%d" % flip, bytes(b)))
+    for name, blob in cases:
+        with open(newest, "wb") as f:
+            f.write(blob)
+        with pytest.raises(ck.CheckpointError):
+            ck.read_checkpoint(newest, fp)
+        res = ck.find_resume(prefix, fp)
+        assert res is not None, f"{name}: resume found nothing"
+        assert res[0] == 2, \
+            f"{name}: resume must fall back to iteration 2"
+        assert res[1]["iteration"] == 2
+    with open(newest, "wb") as f:
+        f.write(pristine)                        # pristine again
+    assert ck.find_resume(prefix, fp)[0] == 4
+
+
+# ---------------------------------------------------------------------------
+# graceful SIGTERM drain (satellite; a REAL signal, a real subprocess)
+# ---------------------------------------------------------------------------
+def test_serve_sigterm_drains_and_exits_zero(tmp_path):
+    X, y = _data()
+    bst = lgb.train(dict(BASE), lgb.Dataset(X, label=y), 3,
+                    verbose_eval=False)
+    model = str(tmp_path / "model.txt")
+    bst.save_model(model)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("LTPU_FAULT_PLAN", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+         f"input_model={model}", "serve_port=0", "verbose=1"],
+        cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        lines = []
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            lines.append(line)
+            if "serving model" in line:
+                break
+        else:
+            pytest.fail("serve task never came up: "
+                        + "".join(lines)[-2000:])
+        proc.send_signal(signal.SIGTERM)
+        _, rest = "", proc.communicate(timeout=60)[1] or ""
+        stderr = "".join(lines) + rest
+        assert proc.returncode == 0, \
+            f"SIGTERM must exit 0, got {proc.returncode}: " \
+            + stderr[-2000:]
+        assert "SIGTERM: stopping admission and draining" in stderr
+        assert "serving drained cleanly; exiting 0" in stderr
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# seam-coverage lint (satellite) — the two-way contract stays green
+# ---------------------------------------------------------------------------
+def test_seam_coverage_lint_green():
+    run = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_seam_coverage.py")],
+        capture_output=True, text=True, timeout=60)
+    assert run.returncode == 0, run.stderr
+    assert "all exercised and documented" in run.stdout

@@ -612,10 +612,8 @@ class Booster:
                 # predict covers all models; so does this)
                 raw, used = self._device_predict_loaded(data,
                                                         num_iteration)
-                raw = self._add_init_and_average(raw, used)
-                if not raw_score and not self.average_output:
-                    raw = self._convert_output(raw)
-                return raw[:, 0] if k == 1 else raw
+                return self._finish_device_scores(raw, used,
+                                                  raw_score=raw_score)
 
         models = self._used_models(num_iteration)
 
@@ -821,24 +819,40 @@ class Booster:
         k = str(getattr(self.config, "predict_kernel", "auto")).lower()
         return "level" if k in ("auto", "") else k
 
+    @staticmethod
+    def _predict_device():
+        """The CURRENT default device (thread-local: the serving lane
+        pool pins each lane's worker via ``jax.default_device``), or
+        None outside any pinning context.  Part of the serving
+        predictor cache key so each lane device gets its own resident
+        ensemble stack."""
+        import jax
+        try:
+            return jax.config.jax_default_device
+        except AttributeError:
+            return None
+
     def _serving_predictor(self, count: int) -> _ServingPredictor:
-        """Per-(model revision, tree count) serving predictor cache —
-        the ensemble stack uploads once; compiled programs are shared
-        process-wide by the module-level jit underneath."""
+        """Per-(model revision, tree count, pinned device) serving
+        predictor cache — the ensemble stack uploads once per lane
+        device; compiled programs are shared process-wide by the
+        module-level jit underneath."""
         cache = getattr(self, "_predictor_cache", None)
         if cache is None or cache[0] != len(self.models):
             cache = (len(self.models), {})
             self._predictor_cache = cache
-        by_count = cache[1]
-        if count not in by_count:
-            by_count[count] = _ServingPredictor(
+        by_key = cache[1]
+        key = (count, self._predict_device())
+        if key not in by_key:
+            by_key[key] = _ServingPredictor(
                 self.models[:count],
                 max(self.num_tree_per_iteration, 1), self.config)
-        return by_count[count]
+        return by_key[key]
 
     def warm_predictor(self, batch_sizes=(1,),
                        num_iteration: int = -1,
-                       log: bool = False) -> "Booster":
+                       log: bool = False,
+                       devices=None) -> "Booster":
         """Serving warm-up: compile the bucketed device predictor for
         the given batch sizes at deploy time instead of on the first
         request (with compile_cache_dir wired this is a disk hit in
@@ -847,7 +861,14 @@ class Booster:
         through the binned scan instead, warming the wrong programs.
         Wired to `predict_warm_buckets` in engine.train(); the CLI
         predict/serve tasks pass ``log=True`` so deploy scripts see
-        the per-bucket warm compile wall before taking traffic."""
+        the per-bucket warm compile wall before taking traffic.
+
+        ``devices`` (an iterable of jax devices, or None entries for
+        the unpinned default) warms every listed device's buckets —
+        the lane-pool fix: warming only the default device would
+        leave lanes 2..N eating a cold compile on their first
+        request.  None keeps the single default-device warm."""
+        import contextlib
         import time
         self._sync_models()
         if not self.models:
@@ -855,18 +876,30 @@ class Booster:
         count = self._resolve_tree_count(len(self.models), num_iteration)
         if count == 0 or self._predict_impl() == "scan":
             return self
-        pred = self._serving_predictor(count)
         f = self.max_feature_idx + 1
-        for b in batch_sizes:
-            m = max(int(b), 1)
-            t0 = time.perf_counter()
-            pred(np.zeros((m, f)))
-            if log:
-                bucket = pred._bucket(m, pred._chunk_cap(2 * f))
-                Log.info(
-                    f"warm_predictor: batch {m} -> bucket {bucket} "
-                    f"warmed in {(time.perf_counter() - t0) * 1e3:.1f} "
-                    "ms")
+        devs = tuple(devices) if devices else (None,)
+        for dev in devs:
+            if dev is not None:
+                import jax
+                ctx = jax.default_device(dev)
+            else:
+                ctx = contextlib.nullcontext()
+            with ctx:
+                # fetched INSIDE the device context: the per-device
+                # cache key pins this lane's resident stack
+                pred = self._serving_predictor(count)
+                for b in batch_sizes:
+                    m = max(int(b), 1)
+                    t0 = time.perf_counter()
+                    pred(np.zeros((m, f)))
+                    if log:
+                        bucket = pred._bucket(m, pred._chunk_cap(2 * f))
+                        Log.info(
+                            f"warm_predictor: batch {m} -> bucket "
+                            f"{bucket}"
+                            + (f" on {dev}" if dev is not None else "")
+                            + " warmed in "
+                            f"{(time.perf_counter() - t0) * 1e3:.1f} ms")
         return self
 
     def _device_predict_loaded(self, data: np.ndarray,
@@ -915,6 +948,20 @@ class Booster:
         self._sync_models()
         return self.models[:self._resolve_tree_count(len(self.models),
                                                      num_iteration)]
+
+    def _finish_device_scores(self, raw: np.ndarray, used: int,
+                              raw_score: bool = False) -> np.ndarray:
+        """Host-side finish of a device raw-score block: RF
+        averaging, objective conversion, single-class squeeze — the
+        ONE post-dispatch pipeline shared by ``predict()``'s
+        level-descent route and the serving co-batcher's per-model
+        segment finish, so a fused dispatch's slice goes through
+        byte-identical postprocessing to a direct predict."""
+        k = max(self.num_tree_per_iteration, 1)
+        raw = self._add_init_and_average(raw, used)
+        if not raw_score and not self.average_output:
+            raw = self._convert_output(raw)
+        return raw[:, 0] if k == 1 else raw
 
     def _add_init_and_average(self, raw, num_models):
         if self.average_output and num_models:

@@ -246,8 +246,12 @@ def _task_serve(params, config: Config) -> None:
     port = srv.server_address[1]
     Log.info(f"serving model {name!r} at "
              f"http://127.0.0.1:{port}/predict/{name} "
-             '(POST JSON {"rows": [[...]]} or CSV rows; '
-             "GET /models /metrics /healthz)")
+             '(POST JSON {"rows": [[...]]} or CSV rows, or binary '
+             "application/x-ltpu-f32; GET /models /metrics /healthz)")
+    if registry.pool is not None:
+        Log.info(f"lane fleet: {registry.pool.n_lanes} dispatch "
+                 f"lanes (serve_lanes={config.serve_lanes}); per-lane "
+                 "state on GET /models under '_fleet'")
     if entry.monitor is not None:
         # model-quality drift monitors (docs/MODEL_MONITORING.md):
         # armed from the <input_model>.quality.json sidecar a

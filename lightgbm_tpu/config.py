@@ -611,6 +611,26 @@ class Config:
     # telemetry /metrics + /healthz daemon).  0 binds an ephemeral
     # port (logged at startup); when telemetry_http_port is set the
     # serving routes mount on that already-running listener instead
+    serve_lanes: str = "auto"       # device lane fleet
+    # (lightgbm_tpu/serving/lanes.py): how many parallel dispatch
+    # streams the registry runs.  "auto" = one lane per local device
+    # on accelerator backends (TPU/axon) and 1 on host backends; an
+    # explicit N forces N lanes (sharing devices round-robin past the
+    # device count — on a single device the N lanes are simulated,
+    # unpinned workers, the CPU test seam).  1 lane keeps the r14
+    # inline dispatch exactly; >= 2 builds the LanePool: round-robin
+    # routing with work stealing, per-lane stall isolation, and
+    # warm-before-cutover on EVERY lane's device (docs/SERVING.md)
+    serve_cobatch: str = "off"      # multi-model co-batching
+    # (lightgbm_tpu/serving/cobatch.py): "on" fuses served models
+    # that share a feature width and bucket ladder into ONE compiled
+    # program and one coalescing window — concurrent requests for
+    # ANY member dispatch together, each request's answer is its
+    # model's column segment of the fused output, byte-identical to
+    # that model's solo predict (pinned by tests/test_serve_lanes.py).
+    # Only level-descent-routed entries with no custom predict
+    # kwargs fuse; everything else keeps its solo batcher.  "off"
+    # (default) serves every model on its own batcher as before
 
     # -- model-quality observability (new; no reference analog) --
     quality: str = "auto"           # model-quality observability
@@ -885,6 +905,20 @@ class Config:
         if not (0 <= self.serve_port <= 65535):
             raise ValueError("serve_port must be in [0, 65535] "
                              "(0 = ephemeral)")
+        _lanes = str(self.serve_lanes).strip().lower()
+        if _lanes not in ("auto", ""):
+            try:
+                _n = int(_lanes)
+            except ValueError:
+                raise ValueError("serve_lanes must be 'auto' or an "
+                                 f"integer >= 1, got "
+                                 f"{self.serve_lanes!r}")
+            if _n < 1:
+                raise ValueError("serve_lanes must be >= 1 when "
+                                 f"numeric, got {_n}")
+        if str(self.serve_cobatch).lower() not in ("off", "on"):
+            raise ValueError("serve_cobatch must be off/on, got "
+                             f"{self.serve_cobatch!r}")
         if str(self.quality).lower() not in ("off", "auto", "on"):
             raise ValueError("quality must be off/auto/on, got "
                              f"{self.quality!r}")

@@ -254,6 +254,48 @@ def predict_level_ensemble(stack: LevelEnsemble, X2: jax.Array, *,
     return jnp.dot(vals, stack.cls_onehot)              # (N, K)
 
 
+@functools.partial(jax.jit, static_argnames=("depth", "segments",
+                                             "unroll"))
+def predict_level_ensemble_cobatch(stack: LevelEnsemble, X2: jax.Array,
+                                   *, depth: int,
+                                   segments: tuple,
+                                   unroll: int = 1) -> jax.Array:
+    """Multi-model co-batched level descent: ``stack`` holds SEVERAL
+    ensembles' trees concatenated along the tree axis, ``segments``
+    is a static tuple of ``(tree_offset, tree_count, class_offset,
+    class_count)`` — one per member model — and the output is the
+    (N, sum K_g) column-stacked raw scores of every member on every
+    row.  ONE compiled program per (group composition, row bucket)
+    replaces one program per member model.
+
+    Byte-identity contract (the co-batch parity pin): the descent is
+    exact integer walking — running a shallow member's trees for the
+    fused max depth is a no-op because settled (negative) node ids
+    stay settled — and each member's class accumulation is a SEPARATE
+    ``jnp.dot`` over exactly its own (N, T_g) x (T_g, K_g) slice, the
+    same reduction shape its solo program runs, so per-member columns
+    are byte-identical to that member's own
+    :func:`predict_level_ensemble`."""
+    PREDICT_TELEMETRY["traces"] += 1
+    from ..telemetry import TELEMETRY
+    TELEMETRY.note_trace("predict.level_cobatch",
+                         (X2.shape, stack.root.shape[0], segments))
+    T = stack.root.shape[0]
+    W = stack.cat_words.shape[0] // stack.feat2.shape[0]
+    n = X2.shape[0]
+    node = jnp.broadcast_to(stack.root[None, :], (n, T))
+    if depth > 0:
+        node = jax.lax.fori_loop(
+            0, depth, lambda i, nd: _level_step(stack, X2, nd, T, W),
+            node, unroll=unroll)
+    leaf = jnp.clip(-node - 1, 0, stack.leaf_value.shape[0] - 1)
+    vals = stack.leaf_value[leaf]                       # (N, T_total)
+    outs = [jnp.dot(vals[:, t0:t0 + tn],
+                    stack.cls_onehot[t0:t0 + tn, k0:k0 + kn])
+            for (t0, tn, k0, kn) in segments]
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("depth", "tile", "interpret"))
 def predict_level_ensemble_pallas(stack: LevelEnsemble, X2: jax.Array,

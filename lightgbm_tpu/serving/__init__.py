@@ -6,19 +6,32 @@ Three layers over the r8 shape-bucketed compiled predictor
 - :mod:`.batcher` — micro-batching scheduler: concurrent requests
   coalesce into one power-of-two-bucket dispatch under a deadline
   knob, with bounded-queue admission control (load shedding).
+- :mod:`.lanes` — the device lane fleet: N parallel dispatch streams
+  (``serve_lanes=auto|N``), round-robin routing with work stealing,
+  per-lane stall isolation.
+- :mod:`.cobatch` — multi-model co-batching: compatible served
+  models fuse into ONE compiled program and one coalescing window
+  (``serve_cobatch=on``), with a per-request segment finish.
 - :mod:`.registry` — named, versioned Boosters with atomic hot swap:
-  buckets warm BEFORE cutover, the old version drains then releases,
-  rollback is a pointer flip.
+  buckets warm BEFORE cutover (on every lane device), the old
+  version drains then releases, rollback is a pointer flip.
 - :mod:`.server` — stdlib HTTP frontend sharing one listener with
-  the telemetry ``/metrics`` + ``/healthz`` daemon.
+  the telemetry ``/metrics`` + ``/healthz`` daemon; JSON/CSV bodies
+  plus the zero-copy ``application/x-ltpu-f32`` binary frame.
 
 CLI: ``python -m lightgbm_tpu task=serve input_model=model.txt``;
 load generator: ``scripts/serve_bench.py``.
 """
 from .batcher import BatcherClosed, MicroBatcher, ShedLoad
+from .cobatch import CoBatchGroup, cobatch_key
+from .lanes import Lane, LanePool, resolve_lanes
 from .registry import FeatureWidthMismatch, ModelEntry, ModelRegistry
-from .server import ServingFrontend, parse_rows, serve
+from .server import (BINARY_F32, BINARY_F64, ServingFrontend,
+                     parse_binary_rows, parse_rows, serve)
 
 __all__ = ["MicroBatcher", "ShedLoad", "BatcherClosed",
            "FeatureWidthMismatch", "ModelEntry", "ModelRegistry",
-           "ServingFrontend", "parse_rows", "serve"]
+           "ServingFrontend", "parse_rows", "serve",
+           "Lane", "LanePool", "resolve_lanes",
+           "CoBatchGroup", "cobatch_key",
+           "BINARY_F32", "BINARY_F64", "parse_binary_rows"]

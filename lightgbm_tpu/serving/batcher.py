@@ -67,15 +67,19 @@ class BatcherClosed(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("rows", "n", "t_enq", "done", "result", "error")
+    __slots__ = ("rows", "n", "t_enq", "done", "result", "error",
+                 "tag")
 
-    def __init__(self, rows: np.ndarray, t_enq: float):
+    def __init__(self, rows: np.ndarray, t_enq: float, tag=None):
         self.rows = rows
         self.n = int(rows.shape[0])
         self.t_enq = t_enq
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # co-batching identity: which member model this request
+        # belongs to (None on a single-model batcher)
+        self.tag = tag
 
 
 class MicroBatcher:
@@ -86,17 +90,25 @@ class MicroBatcher:
     def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
                  config=None, clock: Optional[Callable[[], float]] = None,
                  start: bool = True, name: str = "",
-                 observer: Optional[Callable] = None):
+                 observer: Optional[Callable] = None, pool=None):
         self.predict = predict_fn
         self.name = name
         # read-only post-dispatch hook fed (rows, results) of every
         # successful coalesced dispatch — the serving quality monitor
         # (lightgbm_tpu/quality/).  None (quality=off) costs one
-        # attribute check; the hook sees rows in dispatch order on the
-        # dispatcher thread, which is what makes the monitor's
-        # counter-strided sampler replay-stable.  A hook crash is
-        # counted + warned once, never surfaced to the request.
+        # attribute check; the hook runs after the batch's requests
+        # are released, on whichever thread ran the dispatch (the
+        # dispatcher inline, or a lane worker — with a lane pool the
+        # monitor samples every lane's traffic; its own lock makes
+        # cross-lane observation safe).  A hook crash is counted +
+        # warned once, never surfaced to the request.
         self.observer = observer
+        # lane pool (lightgbm_tpu/serving/lanes.py): when set, the
+        # dispatcher thread only coalesces and routes — the batch
+        # runs on a pool lane, so N models x N lanes dispatch
+        # concurrently.  None keeps the r14 inline single stream.
+        self.pool = pool
+        self._jobs_out = 0
         self._observer_warned = False
         self.deadline_ms = float(getattr(
             config, "serve_batch_deadline_ms", 2.0))
@@ -160,6 +172,14 @@ class MicroBatcher:
             t.join(timeout_s)
         elif drain:
             self.drain_pending()
+        if self.pool is not None:
+            # batches already handed to lanes still belong to this
+            # version: the hot-swap "old version drains" semantic
+            # includes its in-flight lane work
+            end = time.monotonic() + timeout_s
+            with self._cond:
+                while self._jobs_out > 0 and time.monotonic() < end:
+                    self._cond.wait(0.1)
         return self
 
     @property
@@ -179,10 +199,16 @@ class MicroBatcher:
         if self._dispatch_ewma_ms <= 0.0 or not self._pending:
             return 0.0
         batches_ahead = -(-self._pending_rows // self.max_rows)
-        return batches_ahead * self._dispatch_ewma_ms
+        wait = batches_ahead * self._dispatch_ewma_ms
+        if self.pool is not None:
+            # lanes drain batches concurrently: the projected wait a
+            # NEW request sees divides by the healthy fleet width
+            wait /= max(1, self.pool.healthy_count())
+        return wait
 
     def submit(self, rows: np.ndarray,
-               timeout_s: Optional[float] = None) -> np.ndarray:
+               timeout_s: Optional[float] = None,
+               tag=None) -> np.ndarray:
         """Queue ``rows`` (1D = one row) for the next coalesced
         dispatch; blocks until its slice of the batch result is ready.
         Raises :class:`ShedLoad` when admission control rejects, and
@@ -216,7 +242,7 @@ class MicroBatcher:
                     f"projected queue wait {wait:.0f} ms exceeds "
                     f"serve_shed_deadline_ms={self.shed_ms:g}",
                     retry_after_s=wait / 1e3)
-            req = _Request(rows, self._clock())
+            req = _Request(rows, self._clock(), tag=tag)
             self._pending.append(req)
             self._pending_rows += req.n
             self._cond.notify_all()
@@ -242,12 +268,27 @@ class MicroBatcher:
     def _take_batch(self) -> List[_Request]:
         """Pop the longest request prefix within ``max_rows`` (lock
         held).  A single over-cap request dispatches alone — the
-        predictor chunk-streams it internally."""
+        predictor chunk-streams it internally.
+
+        With a lane pool the prefix is additionally capped at a
+        per-lane SHARE of the pending requests (ceil(pending /
+        healthy lanes)): one greedy batch would swallow the whole
+        backlog into a single lane and idle the rest of the fleet —
+        splitting the window across lanes is where the N-lane
+        throughput scaling comes from.  Per-row scores are
+        independent of batch composition, so the split never changes
+        results."""
+        share = None
+        if self.pool is not None and len(self._pending) > 1:
+            lanes = max(1, self.pool.healthy_count())
+            share = -(-len(self._pending) // lanes)
         batch: List[_Request] = []
         rows = 0
         while self._pending:
             r = self._pending[0]
             if batch and rows + r.n > self.max_rows:
+                break
+            if share is not None and len(batch) >= share:
                 break
             batch.append(self._pending.popleft())
             rows += r.n
@@ -270,7 +311,38 @@ class MicroBatcher:
                 if self._closed and not self._pending:
                     return
                 batch = self._take_batch()
-            self._run_batch(batch)
+            if self.pool is not None:
+                self._dispatch_to_pool(batch)
+            else:
+                self._run_batch(batch)
+
+    def _dispatch_to_pool(self, batch: List[_Request]) -> None:
+        """Hand one coalesced batch to a pool lane.  The pool blocks
+        while every healthy lane is full (backpressure into this
+        queue, where admission control sheds); with no healthy lane
+        left the batch fails loudly with the fleet-wide stall."""
+        def job(lane, batch=batch):
+            try:
+                self._run_batch(batch, lane)
+            finally:
+                with self._cond:
+                    self._jobs_out -= 1
+                    self._cond.notify_all()
+
+        def abort(err, batch=batch):
+            self._fail_batch(batch, err)
+            with self._cond:
+                self._jobs_out -= 1
+                self._cond.notify_all()
+
+        with self._cond:
+            self._jobs_out += 1
+        try:
+            self.pool.submit(job, abort)
+        except Exception as e:
+            # no healthy lane (fleet-wide stall) or pool shutdown:
+            # fail the batch on the dispatcher thread, keep coalescing
+            abort(e)
 
     def drain_pending(self) -> int:
         """Dispatch everything pending inline (deadline ignored) on
@@ -297,7 +369,31 @@ class MicroBatcher:
         from ..booster import round_up_bucket
         return round_up_bucket(m, self.min_bucket)
 
-    def _run_batch(self, batch: List[_Request]) -> None:
+    def _fail_batch(self, batch: List[_Request],
+                    e: BaseException) -> None:
+        """Per-request failure propagation: the whole coalesced batch
+        shares the dispatch, so it shares the error.  A watchdog
+        StallError is additionally stall-classified (serve_stalls) —
+        the frontend maps it to 503 + Retry-After rather than a
+        generic 500."""
+        from ..reliability.watchdog import StallError
+        for r in batch:
+            r.error = e
+            r.done.set()
+        tm = TELEMETRY
+        if tm.on:
+            tm.add("serve_errors", len(batch))
+            if isinstance(e, StallError):
+                tm.add("serve_stalls", 1)
+
+    def _finish_request(self, r: _Request, out: np.ndarray,
+                        s: int) -> None:
+        """Assign one request its slice of the batch result (the
+        co-batcher overrides this with the per-model segment
+        finish)."""
+        r.result = out[s:s + r.n]
+
+    def _run_batch(self, batch: List[_Request], lane=None) -> None:
         tm = TELEMETRY
         now = self._clock()
         t0 = time.perf_counter()
@@ -315,35 +411,33 @@ class MicroBatcher:
                 else:
                     out = np.asarray(self.predict(x))
         except Exception as e:
-            # per-request failure propagation: the whole coalesced
-            # batch shares the dispatch, so it shares the error.  A
-            # watchdog StallError is additionally stall-classified
-            # (serve_stalls) — the frontend maps it to 503 +
-            # Retry-After rather than a generic 500
             from ..reliability.watchdog import StallError
-            for r in batch:
-                r.error = e
-                r.done.set()
-            if tm.on:
-                tm.add("serve_errors", len(batch))
-                if isinstance(e, StallError):
-                    tm.add("serve_stalls", 1)
+            if (lane is not None and self.pool is not None
+                    and isinstance(e, StallError)):
+                # the LANE is wedged, not the fleet: brown it out
+                # (aborts its queued batches with the stall), route
+                # around it from the next dispatch on
+                self.pool.mark_stalled(lane, e)
+            self._fail_batch(batch, e)
             return
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
             self._dispatch_ewma_ms = dt_ms if not self._dispatch_ewma_ms \
                 else 0.8 * self._dispatch_ewma_ms + 0.2 * dt_ms
+        if lane is not None and self.pool is not None:
+            self.pool.note_dispatch(lane, dt_ms)
         s = 0
         for r in batch:
-            r.result = out[s:s + r.n]
+            self._finish_request(r, out, s)
             s += r.n
             r.done.set()
         if self.observer is not None:
             # AFTER the waiting requests are released: the monitor's
             # host-side binning/PSI work (and a drift report's ledger
             # write) must never sit on the request critical path —
-            # it still runs on the dispatcher thread in dispatch
-            # order, which is what the sampler's determinism needs
+            # it runs on the thread that ran the dispatch (inline
+            # dispatcher, or a lane worker: the monitor samples each
+            # lane's traffic under its own lock)
             try:
                 self.observer(x, out)
             except Exception as e:

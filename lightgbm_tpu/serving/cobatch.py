@@ -1,0 +1,242 @@
+"""Multi-model co-batching: one fused compiled program per model
+*group*, one dispatch per coalescing window across every member.
+
+Under mixed-model load the per-model micro-batchers each hold their
+own window and dispatch their own (mostly empty) bucket — N models
+at low per-model rates pay N compiled programs and N small
+dispatches.  When models share a feature width and bucket ladder
+(``serve_cobatch=on``) the registry instead forms a
+:class:`CoBatchGroup`: the members' tree ensembles are concatenated
+into ONE :class:`FusedPredictor` stack with a block-diagonal
+tree->class accumulator, concurrent requests for ANY member coalesce
+into one dispatch, and each request's result is its model's column
+segment of the fused output (the per-row model-id segment finish) —
+cutting compile count and small-batch p99 (the Booster-paper
+ensemble-aware inference scheduling argument, arXiv 2011.02022).
+
+Byte-identity contract (pinned by ``tests/test_serve_lanes.py``):
+the fused level descent is exact integer walking, running a shallow
+member's settled rows for the group's max depth is a no-op, each
+member's class accumulation is a separate dot over exactly its own
+tree slice (``ops/predict.predict_level_ensemble_cobatch``), and the
+host-side finish goes through the member Booster's own
+``_finish_device_scores`` — so co-batched predictions are
+byte-identical to a direct ``Booster.predict`` of the same rows.
+
+Eligibility: only entries whose predict calls route to the bucketed
+level-descent predictor can be fused — file-loaded (or otherwise
+non-scan-routed) models with no extra predict kwargs.  An in-session
+single-class Booster's ``device=True`` call routes through the
+binned scan, a DIFFERENT numeric path, so fusing it would break the
+parity pin; such entries simply keep their solo batcher.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from ..booster import _ServingPredictor
+from ..telemetry import TELEMETRY
+from .batcher import MicroBatcher
+
+
+def cobatch_key(booster, predict_kwargs: dict, config,
+                routes_device: bool):
+    """The group a served entry may fuse into, or None when the entry
+    is ineligible.  Models fuse when they share this key: identical
+    feature width (the dispatch matrix concatenates rows across
+    members) and the one bucket ladder the shared config defines."""
+    if str(getattr(config, "serve_cobatch", "off")).lower() not in (
+            "on", "true", "1"):
+        return None
+    if not routes_device:
+        return None                     # host-walk entries never fuse
+    if set(predict_kwargs or {}) - {"device"}:
+        return None                     # custom kwargs: solo batcher
+    b = booster
+    b._sync_models()
+    if not b.models:
+        return None
+    if b._predict_impl() != "level":
+        return None                     # scan/pallas A-B paths: solo
+    if b._can_device_predict(1, -1, predict_kwargs.get("device")):
+        # in-session fast path routes the binned scan, not the level
+        # descent the fused program replicates — fusing would break
+        # byte parity with direct predict
+        return None
+    if not b._can_device_predict_loaded(1, -1,
+                                        predict_kwargs.get("device")):
+        return None
+    return ("cobatch", int(b.num_feature()))
+
+
+class FusedPredictor(_ServingPredictor):
+    """A :class:`_ServingPredictor` over SEVERAL members' concatenated
+    trees: same bucket ladder, chunk streaming and OOM downshift as a
+    solo predictor, but the class accumulator is block-diagonal and
+    the dispatch runs the co-batch kernel — output columns
+    ``[k0_g : k0_g + k_g)`` are member g's raw scores."""
+
+    def __init__(self, member_models: List[list],
+                 num_classes: List[int], config):
+        import jax.numpy as jnp
+        all_models = [t for ms in member_models for t in ms]
+        super().__init__(all_models, 1, config)
+        segments = []
+        k_total = sum(num_classes)
+        onehot = np.zeros((len(all_models), k_total), np.float32)
+        t0 = k0 = 0
+        for ms, k in zip(member_models, num_classes):
+            for j in range(len(ms)):
+                # the member's own flatten_ensemble layout: tree j of
+                # a k-class ensemble accumulates into class j % k
+                onehot[t0 + j, k0 + (j % k)] = 1.0
+            segments.append((t0, len(ms), k0, k))
+            t0 += len(ms)
+            k0 += k
+        self.stack = self.stack._replace(cls_onehot=jnp.asarray(onehot))
+        self.segments = tuple(segments)
+        self.num_class = max(k_total, 1)
+        self.kernel = "level"           # the co-batch kernel IS level
+
+    def _dispatch(self, x2_dev):
+        from ..ops import predict as P
+        from ..reliability.faults import FAULTS
+        FAULTS.fault_point("predict.dispatch")
+        return P.predict_level_ensemble_cobatch(
+            self.stack, x2_dev, depth=self.depth,
+            segments=self.segments)
+
+
+class _Member(NamedTuple):
+    name: str
+    booster: object
+    used: int                   # tree count the fused slice carries
+    k0: int                     # first output column
+    k: int                      # output column count
+    observer: Optional[Callable]
+
+
+class CoBatcher(MicroBatcher):
+    """A :class:`MicroBatcher` whose requests carry a member tag:
+    one queue, one coalescing window, one fused dispatch across every
+    member — then a per-request segment finish through the member
+    Booster's own postprocess."""
+
+    def __init__(self, predict_fn, members: Dict[str, _Member],
+                 config=None, pool=None, name: str = "cobatch",
+                 clock=None, start: bool = True):
+        self.members = members
+        super().__init__(predict_fn, config, clock=clock, start=start,
+                         name=name, pool=pool)
+
+    def _finish_request(self, r, out, s):
+        m = self.members[r.tag]
+        raw = np.ascontiguousarray(
+            out[s:s + r.n, m.k0:m.k0 + m.k], dtype=np.float64)
+        r.result = m.booster._finish_device_scores(raw, m.used)
+
+    def _run_batch(self, batch, lane=None):
+        super()._run_batch(batch, lane)
+        if not batch or batch[0].error is not None:
+            return
+        tags = list(dict.fromkeys(r.tag for r in batch))
+        tm = TELEMETRY
+        if tm.on:
+            tm.add("serve_cobatch_dispatches", 1)
+            # sum of per-model dispatches this ONE dispatch replaced:
+            # the amortization lint compares serve_cobatch_dispatches
+            # against this (fused < sum means fusion actually paid)
+            tm.add("serve_cobatch_fused_models", len(tags))
+        for tag in tags:
+            obs = self.members[tag].observer
+            if obs is None:
+                continue
+            part = [r for r in batch if r.tag == tag]
+            try:
+                rows_m = (part[0].rows if len(part) == 1
+                          else np.concatenate([r.rows for r in part]))
+                preds_m = (part[0].result if len(part) == 1
+                           else np.concatenate([np.atleast_1d(r.result)
+                                                for r in part]))
+                obs(rows_m, preds_m)
+            except Exception as e:
+                if tm.on:
+                    tm.add("quality_observe_errors", 1)
+                if not self._observer_warned:
+                    self._observer_warned = True
+                    from ..utils.log import Log
+                    Log.warning(
+                        "co-batch quality observer crashed "
+                        f"({type(e).__name__}: {e}); requests are "
+                        "unaffected, monitoring may undercount")
+
+
+class CoBatchGroup:
+    """One fused serving unit over >= 2 compatible entries.  Built and
+    warmed OFF the registry lock, installed by pointer flip (each
+    member entry's ``cobatch`` attribute), drained like any batcher
+    when membership changes."""
+
+    def __init__(self, entries: List, config, pool=None):
+        # stable member order: by name — the fused program's segment
+        # layout (and its jit cache key) is deterministic across
+        # rebuilds with the same membership
+        entries = sorted(entries, key=lambda e: e.name)
+        member_models = []
+        num_classes = []
+        metas = []
+        for e in entries:
+            b = e.booster
+            b._sync_models()
+            used = b._resolve_tree_count(len(b.models), -1)
+            member_models.append(b.models[:used])
+            num_classes.append(max(b.num_tree_per_iteration, 1))
+            metas.append((e, used))
+        self.predictor = FusedPredictor(member_models, num_classes,
+                                        config)
+        members: Dict[str, _Member] = {}
+        for (e, used), (t0, tn, k0, k) in zip(
+                metas, self.predictor.segments):
+            members[e.name] = _Member(
+                e.name, e.booster, used, k0, k,
+                e.monitor.observe if e.monitor is not None else None)
+        self.names = [e.name for e in entries]
+        self.versions = {e.name: e.version for e in entries}
+        self._lock = threading.Lock()
+        self.batcher = CoBatcher(
+            self.predictor, members, config, pool=pool,
+            name="cobatch:" + "+".join(self.names))
+
+    def submit(self, name: str, rows: np.ndarray) -> np.ndarray:
+        return self.batcher.submit(rows, tag=name)
+
+    def warm(self, batch_sizes, devices=(None,)) -> None:
+        """Compile the fused program's bucket ladder on every lane
+        device BEFORE the group goes live (warm-before-cutover for
+        the group pointer flip)."""
+        import contextlib
+        nf = None
+        for m in self.batcher.members.values():
+            nf = m.booster.num_feature()
+            break
+        if nf is None:
+            return
+        for dev in devices or (None,):
+            if dev is not None:
+                import jax
+                ctx = jax.default_device(dev)
+            else:
+                ctx = contextlib.nullcontext()
+            with ctx:
+                for b in batch_sizes or ():
+                    self.predictor(np.zeros((max(int(b), 1), nf)))
+
+    def describe(self) -> dict:
+        return {"models": list(self.names),
+                "queue_depth": self.batcher.depth()}
+
+    def close(self, drain: bool = True) -> None:
+        self.batcher.close(drain=drain)

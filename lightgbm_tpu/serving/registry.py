@@ -30,6 +30,8 @@ import numpy as np
 from ..telemetry import TELEMETRY
 from ..utils.log import Log
 from .batcher import BatcherClosed, MicroBatcher
+from .cobatch import CoBatchGroup, cobatch_key
+from .lanes import LanePool, resolve_lanes
 
 
 class FeatureWidthMismatch(ValueError):
@@ -52,10 +54,11 @@ class ModelEntry:
     when, and at what eval metric — what a rollback decision reads)."""
 
     __slots__ = ("name", "version", "booster", "batcher", "_predict_fn",
-                 "meta", "monitor")
+                 "meta", "monitor", "cobatch", "cobatch_k")
 
     def __init__(self, name: str, version: int, booster, predict_fn,
-                 batcher: MicroBatcher, meta=None, monitor=None):
+                 batcher: MicroBatcher, meta=None, monitor=None,
+                 cobatch_k=None):
         self.name = name
         self.version = int(version)
         self.booster = booster
@@ -66,8 +69,18 @@ class ModelEntry:
         # or None when quality=off / no profile — the off-mode cost is
         # this one attribute staying None
         self.monitor = monitor
+        # co-batching (lightgbm_tpu/serving/cobatch.py): the fusion
+        # key this entry is eligible under (None = never fuses), and
+        # the live group pointer the registry flips when membership
+        # changes — requests route to the group's fused batcher while
+        # set, to this entry's solo batcher otherwise
+        self.cobatch_k = cobatch_k
+        self.cobatch = None
 
     def predict(self, rows: np.ndarray) -> np.ndarray:
+        group = self.cobatch
+        if group is not None:
+            return group.submit(self.name, rows)
         return self.batcher.submit(rows)
 
 
@@ -90,6 +103,40 @@ class ModelRegistry:
         # publish order (after rollback-then-republish, the previous
         # SERVING version is not the previously PUBLISHED one)
         self._history: Dict[str, List[ModelEntry]] = {}
+        # lane fleet (lightgbm_tpu/serving/lanes.py): built lazily at
+        # first publish from serve_lanes; None when the config
+        # resolves to a single lane (today's inline dispatch)
+        self._pool: Optional[LanePool] = None
+        self._pool_init = False
+        # co-batch groups (serving/cobatch.py) by fusion key; control
+        # -plane swaps (publish/rollback) serialize on _swap_lock so
+        # group membership never races a concurrent publish
+        self._groups: Dict[tuple, CoBatchGroup] = {}
+        self._swap_lock = threading.Lock()
+
+    # -- lane fleet ----------------------------------------------------
+    def _ensure_pool(self) -> Optional[LanePool]:
+        """Build the lane pool on first use (``serve_lanes=auto|N``).
+        None when the config resolves to one lane — requests then run
+        on each batcher's own dispatcher thread exactly as before the
+        fleet existed."""
+        with self._lock:
+            if not self._pool_init:
+                self._pool_init = True
+                n, devices = resolve_lanes(self.config)
+                if n >= 2:
+                    self._pool = LanePool(devices, name="serve")
+                    Log.info(
+                        f"serving lane pool: {n} lanes"
+                        + (" (simulated on one device)"
+                           if all(d is None for d in devices)
+                           else f" on {len(set(map(str, devices)))} "
+                                "device(s)"))
+            return self._pool
+
+    @property
+    def pool(self) -> Optional[LanePool]:
+        return self._pool
 
     # -- publish / swap ------------------------------------------------
     @staticmethod
@@ -154,6 +201,17 @@ class ModelRegistry:
         timestamp), ``eval_metric`` the gate metric the candidate
         scored at publish, and ``source`` who published it
         (``manual`` | ``continuous``)."""
+        with self._swap_lock:
+            return self._publish_locked(
+                name, model, version=version, warm=warm,
+                predict_kwargs=predict_kwargs, log_warm=log_warm,
+                published_unix=published_unix,
+                eval_metric=eval_metric, source=source)
+
+    def _publish_locked(self, name, model, version=None, warm=None,
+                        predict_kwargs=None, log_warm=False,
+                        published_unix=None, eval_metric=None,
+                        source="manual") -> ModelEntry:
         from ..booster import Booster
         if source not in ("manual", "continuous"):
             raise ValueError(
@@ -170,6 +228,7 @@ class ModelRegistry:
         if eval_metric is not None:
             meta["eval_metric"] = float(eval_metric)
         kw = dict(predict_kwargs or {})
+        pool = self._ensure_pool()
 
         def predict_fn(rows, _b=booster, _kw=kw):
             return _b.predict(rows, **_kw)
@@ -177,8 +236,13 @@ class ModelRegistry:
         warm = self._default_warm(kw) if warm is None else tuple(warm)
         if warm:
             # warm-before-cutover: compile (or disk-hit) every
-            # declared bucket while the OLD version still serves
-            booster.warm_predictor(warm, log=log_warm)
+            # declared bucket while the OLD version still serves —
+            # on EVERY lane's device, so no lane takes a cold compile
+            # after the pointer flip
+            booster.warm_predictor(
+                warm, log=log_warm,
+                devices=pool.warm_devices if pool is not None
+                else None)
         # serving quality monitor (lightgbm_tpu/quality/): armed when
         # the knobs allow it AND a fingerprint-matching profile rides
         # the model (sidecar file for a path publish, the in-memory
@@ -201,8 +265,11 @@ class ModelRegistry:
                 MicroBatcher(predict_fn, cfg,
                              name=f"{name}@v{version}",
                              observer=monitor.observe
-                             if monitor is not None else None),
-                meta=meta, monitor=monitor)
+                             if monitor is not None else None,
+                             pool=pool),
+                meta=meta, monitor=monitor,
+                cobatch_k=cobatch_key(booster, kw, cfg,
+                                      self._routes_to_device(kw)))
             versions.append(entry)
             old = self._current.get(name)
             if old is not None:
@@ -216,10 +283,60 @@ class ModelRegistry:
         if old is not None:
             # new version already serves; finish the old one's queue
             old.batcher.close(drain=True)
+        self._refresh_cobatch()
         Log.info(f"serving registry: {name!r} -> v{version}"
                  + (f" (replaced v{old.version})" if old else "")
                  + (f", warmed buckets {list(warm)}" if warm else ""))
         return entry
+
+    def _refresh_cobatch(self) -> None:
+        """Recompute fused groups from the current pointers (runs
+        under ``_swap_lock`` after every publish/rollback flip).  Each
+        fusion key with >= 2 eligible current entries gets one
+        :class:`CoBatchGroup`; a new group is built and warmed OFF the
+        registry lock, installed by pointer flip on every member
+        entry, and only then is the replaced group drained — the same
+        warm-before-cutover / drain-after discipline as a version
+        swap, so membership changes lose zero requests."""
+        with self._lock:
+            current = dict(self._current)
+        desired: Dict[tuple, list] = {}
+        for entry in current.values():
+            if entry.cobatch_k is not None:
+                desired.setdefault(entry.cobatch_k, []).append(entry)
+        desired = {k: es for k, es in desired.items() if len(es) >= 2}
+        retired = []
+        for key, entries in desired.items():
+            old = self._groups.get(key)
+            versions = {e.name: e.version for e in entries}
+            if old is not None and old.versions == versions:
+                continue                 # membership unchanged
+            group = CoBatchGroup(entries, self.config,
+                                 pool=self._pool)
+            devs = (self._pool.warm_devices
+                    if self._pool is not None else (None,))
+            group.warm(self._default_warm({}) or (1,), devices=devs)
+            with self._lock:
+                self._groups[key] = group
+                for e in entries:
+                    e.cobatch = group
+            if old is not None:
+                retired.append(old)
+            Log.info("serving registry: co-batch group "
+                     + "+".join(group.names) + " live "
+                     + f"({len(group.names)} models, one fused "
+                     "program)")
+        for key in [k for k in self._groups if k not in desired]:
+            retired.append(self._groups.pop(key))
+        with self._lock:
+            live = set(map(id, self._groups.values()))
+            for entry in current.values():
+                g = entry.cobatch
+                if g is not None and (id(g) not in live
+                                      or entry.name not in g.names):
+                    entry.cobatch = None
+        for g in retired:
+            g.close(drain=True)
 
     def rollback(self, name: str) -> ModelEntry:
         """Pointer-flip ``name`` back to the version that was SERVING
@@ -228,6 +345,10 @@ class ModelRegistry:
         publish may be the very version ops already rolled back as
         bad).  The restored version's compiled programs are still
         resident, so rollback serves warm immediately."""
+        with self._swap_lock:
+            return self._rollback_locked(name)
+
+    def _rollback_locked(self, name: str) -> ModelEntry:
         with self._lock:
             if name not in self._current:
                 raise KeyError(f"no model named {name!r}")
@@ -243,13 +364,15 @@ class ModelRegistry:
                     prev._predict_fn, self.config,
                     name=f"{name}@v{prev.version}",
                     observer=prev.monitor.observe
-                    if prev.monitor is not None else None)
+                    if prev.monitor is not None else None,
+                    pool=self._pool)
             self._current[name] = prev
         tm = TELEMETRY
         if tm.on:
             tm.add("serve_rollbacks", 1)
             tm.gauge(f"serve_version.{name}", prev.version)
         cur.batcher.close(drain=True)
+        self._refresh_cobatch()
         Log.warning(f"serving registry: rolled {name!r} back "
                     f"v{cur.version} -> v{prev.version}")
         return prev
@@ -287,7 +410,12 @@ class ModelRegistry:
             if rows.shape[1] != nf:
                 raise FeatureWidthMismatch(nf, rows.shape[1])
             try:
-                return entry, entry.batcher.submit(rows)
+                # entry.predict routes to the fused co-batch group
+                # when one is live, the solo batcher otherwise; a
+                # group drained by a membership change raises
+                # BatcherClosed like any swap race and retries against
+                # the refreshed pointers
+                return entry, entry.predict(rows)
             except BatcherClosed:
                 continue
             except StallError as e:
@@ -322,9 +450,11 @@ class ModelRegistry:
             # held through a whole observation pass) are built after
             # release — a /models poll must never park /predict
             # requests behind a monitoring refresh
-            snap = {name: (entry, list(self._versions.get(name, [])))
+            snap = {name: (entry, list(self._versions.get(name, [])),
+                           entry.cobatch)
                     for name, entry in self._current.items()}
-        return {
+            pool = self._pool
+        body: Dict[str, dict] = {
             name: {
                 "version": entry.version,
                 "versions": [
@@ -333,19 +463,47 @@ class ModelRegistry:
                      **({"quality": e.monitor.summary()}
                         if e.monitor is not None else {})}
                     for e in versions],
-                "queue_depth": entry.batcher.depth(),
+                # group-aware: a fused entry's in-flight work lives in
+                # the GROUP's queue, not the (idle) solo batcher's
+                "queue_depth": (group.batcher.depth()
+                                if group is not None
+                                else entry.batcher.depth()),
+                **({"cobatch": group.describe()}
+                   if group is not None else {}),
                 "quality": (entry.monitor.summary()
                             if entry.monitor is not None else None),
             }
-            for name, (entry, versions) in snap.items()
+            for name, (entry, versions, group) in snap.items()
         }
+        if pool is not None:
+            # per-lane state (snapshot-and-release inside the pool:
+            # a /models poll never parks dispatch routing)
+            body["_fleet"] = {
+                "n_lanes": pool.n_lanes,
+                "healthy_lanes": pool.healthy_count(),
+                "lanes": pool.snapshot(),
+            }
+        return body
 
     def close(self) -> None:
-        """Drain and release every entry (process shutdown)."""
-        with self._lock:
-            entries = [e for vs in self._versions.values() for e in vs]
-            self._current.clear()
-            self._versions.clear()
-            self._history.clear()
-        for e in entries:
-            e.batcher.close(drain=True)
+        """Drain and release every entry (process shutdown): fused
+        groups first (they feed the lanes), then solo batchers, then
+        the lane pool itself."""
+        with self._swap_lock:
+            with self._lock:
+                entries = [e for vs in self._versions.values()
+                           for e in vs]
+                groups = list(self._groups.values())
+                self._current.clear()
+                self._versions.clear()
+                self._history.clear()
+                self._groups.clear()
+                for e in entries:
+                    e.cobatch = None
+            for g in groups:
+                g.close(drain=True)
+            for e in entries:
+                e.batcher.close(drain=True)
+            pool, self._pool, self._pool_init = self._pool, None, False
+            if pool is not None:
+                pool.close()

@@ -10,7 +10,14 @@ Request surface::
 
     POST /predict/<model>
         body: JSON {"rows": [[...], ...]} (or a bare array), or CSV
-              rows (Content-Type text/csv, one row per line)
+              rows (Content-Type text/csv, one row per line), or the
+              zero-copy binary frame (Content-Type
+              application/x-ltpu-f32: little-endian float32,
+              row-major, width = the model's feature count — no
+              framing bytes, no text parse; docs/SERVING.md wire spec)
+        Accept: application/x-ltpu-f64 answers raw little-endian
+              float64 predictions (X-Model-Version /
+              X-Prediction-Shape headers) instead of JSON
         200: {"model": ..., "version": ..., "predictions": [...]}
         400 bad body / 404 unknown model / 405 non-POST
         503 + Retry-After: admission control shed the request
@@ -46,6 +53,33 @@ from ..telemetry import TELEMETRY
 from ..utils.log import Log
 from .batcher import ShedLoad
 from .registry import FeatureWidthMismatch, ModelRegistry
+
+
+# zero-copy binary wire types (docs/SERVING.md): request rows as
+# packed little-endian f32 row-major, responses as packed LE f64
+BINARY_F32 = "application/x-ltpu-f32"
+BINARY_F64 = "application/x-ltpu-f64"
+
+
+def parse_binary_rows(body: bytes, num_features: int) -> np.ndarray:
+    """Decode the binary wire format: packed little-endian float32,
+    row-major, row width = the served model's feature count (carried
+    by the URL, not the payload — no per-row framing, no text parse,
+    no float repr round-trip).  ``np.frombuffer`` is a zero-copy view
+    over the request body; the only copy before dispatch is the exact
+    f32->f64 widening, so binary requests keep the byte-identity
+    parity pin."""
+    if num_features <= 0:
+        raise ValueError("model reports no features")
+    n = len(body)
+    if n == 0:
+        raise ValueError("empty request body")
+    row_bytes = 4 * int(num_features)
+    if n % row_bytes:
+        raise ValueError(
+            f"binary body is {n} bytes — not a multiple of "
+            f"{row_bytes} (f32 x {num_features} features per row)")
+    return np.frombuffer(body, dtype="<f4").reshape(-1, num_features)
 
 
 def parse_rows(body: bytes, content_type: str = "") -> np.ndarray:
@@ -198,13 +232,30 @@ class ServingFrontend:
             return _json_response(
                 404, {"error": "no model in path; POST "
                                "/predict/<model>"})
-        try:
-            rows = parse_rows(bytes(body),
-                              headers.get("Content-Type", "")
-                              if headers is not None else "")
-        except (ValueError, json.JSONDecodeError,
-                UnicodeDecodeError) as e:
-            return _json_response(400, {"error": str(e)[:300]})
+        ctype = (headers.get("Content-Type", "")
+                 if headers is not None else "")
+        if BINARY_F32 in ctype.lower():
+            # binary frame width comes from the served model; a hot
+            # swap to a different width between this read and submit
+            # is caught by the registry's per-attempt width check
+            try:
+                nf = self.registry.get(name).booster.num_feature()
+            except KeyError:
+                return _json_response(
+                    404, {"error": f"no model named {name!r}",
+                          "models": self.registry.names()})
+            try:
+                rows = parse_binary_rows(bytes(body), nf)
+            except ValueError as e:
+                return _json_response(400, {"error": str(e)[:300]})
+            if TELEMETRY.on:
+                TELEMETRY.add("serve_binary_requests", 1)
+        else:
+            try:
+                rows = parse_rows(bytes(body), ctype)
+            except (ValueError, json.JSONDecodeError,
+                    UnicodeDecodeError) as e:
+                return _json_response(400, {"error": str(e)[:300]})
         try:
             entry, out = self.registry.predict(name, rows)
         except KeyError:
@@ -240,6 +291,18 @@ class ServingFrontend:
             # every dispatch error
             return _json_response(
                 500, {"error": f"prediction failed: {repr(e)[:300]}"})
+        accept = (headers.get("Accept", "")
+                  if headers is not None else "")
+        if BINARY_F64 in accept.lower():
+            # binary response: the float64 scores exactly as the
+            # predictor produced them, packed little-endian — no repr
+            # formatting, no JSON escape pass
+            arr = np.ascontiguousarray(np.asarray(out), dtype="<f8")
+            return (200, BINARY_F64, arr.tobytes(), {
+                "X-Model-Version": str(entry.version),
+                "X-Prediction-Shape":
+                    "x".join(str(d) for d in arr.shape),
+            })
         return _json_response(200, {
             "model": name,
             "version": entry.version,

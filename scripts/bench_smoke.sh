@@ -144,9 +144,18 @@ test -s /tmp/lgbtpu_smoke/quality.json
 # frontend under concurrent single-row clients through real HTTP —
 # parity vs direct predict, coalescing actually occurring
 # (dispatches < requests), a generous p99 bound and clean queue
-# drain on shutdown are asserted by test_bench_smoke on the JSON
+# drain on shutdown are asserted by test_bench_smoke on the JSON.
+# Round 20 adds the fleet probes to the same JSON: lane_scaling (the
+# SAME closed-loop load on 1 then 2 simulated lanes over a per-row
+# simulated device wall, gated at 2-lane rows/s >= 1.5x single-lane)
+# and mixed_model (3 co-batched models under open-loop traffic,
+# fused dispatches strictly fewer than the per-model dispatches
+# they replaced, per-member parity)
 SERVE_CLIENTS=${SERVE_CLIENTS:-8} \
 SERVE_REQUESTS=${SERVE_REQUESTS:-12} \
+SERVE_LANE_PROBE=${SERVE_LANE_PROBE:-1} \
+SERVE_LANE_N=${SERVE_LANE_N:-2} \
+SERVE_MIXED_PROBE=${SERVE_MIXED_PROBE:-1} \
 python scripts/serve_bench.py /tmp/lgbtpu_smoke/serve.json >&2
 test -s /tmp/lgbtpu_smoke/serve.json
 # BENCH_SHARD pins the round-16 shard_construct probe on: 2 simulated

@@ -24,7 +24,21 @@ comma list of request row counts cycled per request; SERVE_MODE
 (closed) closed|open; SERVE_RATE (200) open-loop offered requests/s
 across all clients; SERVE_DEADLINE_MS (5) serve_batch_deadline_ms;
 SERVE_MODEL ("") model file to serve instead of the built-in tiny
-model (needs SERVE_FEATURES for row width).
+model (needs SERVE_FEATURES for row width); SERVE_LANES ("1")
+serve_lanes for the base run; SERVE_BODY (json) json|binary request
+wire format (binary = the zero-copy application/x-ltpu-f32 frame).
+
+Fleet probes (round 20), each appended as a block in the output JSON:
+
+- ``lane_scaling`` (SERVE_LANE_PROBE=1, default on): the SAME
+  closed-loop load run on 1 lane then SERVE_LANE_N (2) simulated
+  lanes, with a per-ROW simulated device wall (SERVE_LANE_SIM_MS,
+  1.0 ms) standing in for the accelerator so the CPU seam exposes
+  real dispatch concurrency; gate: N-lane rows/s >= 1.5x single-lane.
+- ``mixed_model`` (SERVE_MIXED_PROBE=1, default on): open-loop
+  clients spread across SERVE_MIXED_MODELS (3) co-batched models
+  (serve_cobatch=on); lint: fused dispatches < the per-model
+  dispatches they replaced, parity per member model.
 """
 import http.client
 import json
@@ -45,37 +59,67 @@ def _env_int(name, default):
     return int(os.environ.get(name, default))
 
 
-def build_model(features=8, rows=400, iters=6):
+def build_model(features=8, rows=400, iters=6, seed=7, label_col=0):
     import lightgbm_tpu as lgb
-    rng = np.random.RandomState(7)
+    rng = np.random.RandomState(seed)
     X = rng.randn(rows, features)
-    y = X[:, 0] - 0.3 * X[:, 1]
+    y = X[:, label_col] - 0.3 * X[:, (label_col + 1) % features]
     bst = lgb.train({"objective": "regression", "verbose": -1,
                      "num_leaves": 15, "min_data_in_leaf": 5},
                     lgb.Dataset(X, label=y), iters, verbose_eval=False)
     return bst, X
 
 
+def _with_sim_wall(bst, sim_row_ms):
+    """Wrap the booster's predict with a per-ROW simulated device
+    wall (the sleep releases the GIL, exactly like a real dispatch
+    blocking on the accelerator) — the seam that lets the CPU smoke
+    measure lane CONCURRENCY instead of host-walk arithmetic.  A
+    per-dispatch-constant sleep would be useless here: one lane
+    coalescing 8 requests into 1 dispatch would pay the same wall as
+    2 lanes running 2 dispatches of 4, hiding the scaling entirely."""
+    if not sim_row_ms:
+        return bst
+    orig = bst.predict
+
+    def predict(rows, **kw):
+        time.sleep(sim_row_ms * rows.shape[0] / 1e3)
+        return orig(rows, **kw)
+
+    bst.predict = predict
+    return bst
+
+
 def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
-              mode="closed", rate=200.0, deadline_ms=5.0) -> dict:
+              mode="closed", rate=200.0, deadline_ms=5.0,
+              lanes="1", sim_row_ms=0.0, body_format="json",
+              predict_kwargs=None, shed_ms=None) -> dict:
     """Serve ``bst`` in-process and drive it with ``clients``
     concurrent threads; returns the result record (latencies from the
     clients, amortization/fill from the telemetry counters, parity
     vs direct predict, drain state)."""
     from lightgbm_tpu.config import Config
-    from lightgbm_tpu.serving import ModelRegistry, ServingFrontend
+    from lightgbm_tpu.serving import (BINARY_F32, ModelRegistry,
+                                      ServingFrontend)
     from lightgbm_tpu.telemetry import TELEMETRY, hist_quantile
 
     TELEMETRY.configure("counters")
     TELEMETRY.reset()
-    cfg = Config.from_params({
+    params = {
         "verbose": -1,
         "serve_batch_deadline_ms": deadline_ms,
-    })
+        "serve_lanes": str(lanes),
+    }
+    if shed_ms is not None:
+        params["serve_shed_deadline_ms"] = float(shed_ms)
+    cfg = Config.from_params(params)
+    kw = dict(predict_kwargs or {})
+    _with_sim_wall(bst, sim_row_ms)
     registry = ModelRegistry(cfg)
-    registry.publish("bench", bst)
+    registry.publish("bench", bst, predict_kwargs=kw or None)
     frontend = ServingFrontend(registry, cfg)
     port = frontend.start(0).server_address[1]
+    binary = body_format == "binary"
 
     rows_spec = tuple(int(r) for r in rows_spec) or (1,)
     lat_ms = [[] for _ in range(clients)]
@@ -93,7 +137,13 @@ def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
             n = rows_spec[(ci + k) % len(rows_spec)]
             lo = (ci * requests + k * n) % max(X.shape[0] - n, 1)
             rows = X[lo:lo + n]
-            body = json.dumps({"rows": rows.tolist()}).encode()
+            if binary:
+                body = np.ascontiguousarray(rows,
+                                            dtype="<f4").tobytes()
+                ctype = "application/x-ltpu-f32"
+            else:
+                body = json.dumps({"rows": rows.tolist()}).encode()
+                ctype = "application/json"
             if mode == "open" and k:
                 # open loop: hold the offered rate regardless of
                 # response latency (sleep off the schedule, not the
@@ -106,8 +156,7 @@ def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
             t0 = time.perf_counter()
             try:
                 conn.request("POST", "/predict/bench", body=body,
-                             headers={"Content-Type":
-                                      "application/json"})
+                             headers={"Content-Type": ctype})
                 resp = conn.getresponse()
                 payload = resp.read()
             except Exception as e:
@@ -127,7 +176,12 @@ def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
             lat_ms[ci].append(wall)
             if k == 0:
                 got = json.loads(payload)["predictions"]
-                want = bst.predict(rows).tolist()
+                # reference matched to the served route: same predict
+                # kwargs, and for binary bodies the f32 wire rows the
+                # server actually saw (f32->f64 widening is exact)
+                ref_rows = (rows.astype("<f4").astype(np.float64)
+                            if binary else rows)
+                want = bst.predict(ref_rows, **kw).tolist()
                 if got != want:
                     parity_bad.append((ci, got, want))
         conn.close()
@@ -160,6 +214,8 @@ def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
     out = {
         "mode": mode,
         "clients": clients,
+        "lanes": int(lanes) if str(lanes).isdigit() else str(lanes),
+        "body": body_format,
         "requests": reqs,
         "requests_ok": total_ok,
         "shed": total_shed,
@@ -181,10 +237,182 @@ def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
         "batch_fill_mean": round(fill["sum"] / fill["count"], 3)
         if fill and fill["count"] else None,
         "queue_wait_p99_ms": qwait_p99,
+        "rows_per_s": round(int(c.get("serve_rows", 0)) / wall_s, 1)
+        if wall_s else None,
+        "lane_dispatches": int(c.get("serve_lane_dispatches", 0)),
+        "steals": int(c.get("serve_steals", 0)),
+        "lane_stalls": int(c.get("serve_lane_stalls", 0)),
         "parity": "fail" if (parity_bad or failures) else "pass",
         "drain": "clean" if drained else "dirty",
     }
     return out
+
+
+def lane_scaling_probe(lane_n=2, sim_row_ms=1.0, clients=8,
+                       requests=8, rows=8) -> dict:
+    """The 2-lane throughput gate: the SAME closed-loop load through
+    1 lane then ``lane_n`` simulated lanes, with the per-row device
+    wall standing in for the accelerator.  Per-row scores never
+    depend on lane routing (the parity field re-checks), so the only
+    thing allowed to change is the wall clock."""
+    results = {}
+    for n in (1, lane_n):
+        bst, X = build_model()
+        r = run_bench(bst, X, clients=clients, requests=requests,
+                      rows_spec=(rows,), mode="closed",
+                      deadline_ms=2.0, lanes=str(n),
+                      sim_row_ms=sim_row_ms, shed_ms=60_000.0)
+        results[n] = r
+    r1, rn = results[1], results[lane_n]
+    ratio = (rn["rows_per_s"] / r1["rows_per_s"]
+             if r1["rows_per_s"] else None)
+    return {
+        "lanes": lane_n,
+        "sim_row_ms": sim_row_ms,
+        "single_lane_rows_per_s": r1["rows_per_s"],
+        "multi_lane_rows_per_s": rn["rows_per_s"],
+        "scaling_x": round(ratio, 2) if ratio else None,
+        "steals": rn["steals"],
+        "parity": ("pass" if r1["parity"] == rn["parity"] == "pass"
+                   else "fail"),
+        "drain": ("clean" if r1["drain"] == rn["drain"] == "clean"
+                  else "dirty"),
+        # the scale-out gate (docs/SERVING.md): 2 lanes must buy at
+        # least 1.5x rows/s on the simulated device wall
+        "gate": ("pass" if ratio is not None and ratio >= 1.5
+                 else "fail"),
+    }
+
+
+def run_mixed_bench(n_models=3, clients=6, requests=10, rate=300.0,
+                    deadline_ms=10.0, lanes="1") -> dict:
+    """Open-loop mixed-model co-batching probe: ``n_models``
+    compatible models published with ``serve_cobatch=on``, clients
+    spreading requests across ALL of them.  Reads the fused-dispatch
+    counters for the amortization lint (fused dispatches < the
+    per-model dispatches they replaced) and parity-checks each
+    member against its own direct predict."""
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serving import ModelRegistry, ServingFrontend
+    from lightgbm_tpu.telemetry import TELEMETRY
+
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    cfg = Config.from_params({
+        "verbose": -1,
+        "serve_batch_deadline_ms": deadline_ms,
+        "serve_lanes": str(lanes),
+        "serve_cobatch": "on",
+        "predict_warm_buckets": (1, 16),
+    })
+    registry = ModelRegistry(cfg)
+    frontend = ServingFrontend(registry, cfg)
+    names = []
+    X = None
+    with tempfile.TemporaryDirectory() as td:
+        for i in range(n_models):
+            bst, Xi = build_model(seed=7 + i, label_col=i % 4,
+                                  iters=4 + i)
+            X = Xi if X is None else X
+            path = os.path.join(td, f"m{i}.txt")
+            bst.save_model(path)
+            # file-loaded + device-pinned: the level-descent route
+            # the fused program replicates byte-for-byte
+            registry.publish(f"m{i}", path,
+                             predict_kwargs={"device": True})
+            names.append(f"m{i}")
+    entries = {n: registry.get(n) for n in names}
+    fused_members = sorted(
+        entries[names[0]].cobatch.names) if \
+        entries[names[0]].cobatch is not None else []
+    port = frontend.start(0).server_address[1]
+
+    lat_ms = []
+    failures = []
+    parity_bad = []
+    t_start = time.perf_counter()
+
+    def client(ci):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        interval = clients / rate
+        checked = set()
+        for k in range(requests):
+            name = names[(ci + k) % len(names)]
+            n = 1 + (ci + k) % 3
+            lo = (ci * requests + k) % max(X.shape[0] - n, 1)
+            rows = X[lo:lo + n]
+            next_t = t_start + ci * (interval / clients) + k * interval
+            dt = next_t - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            t0 = time.perf_counter()
+            try:
+                conn.request(
+                    "POST", f"/predict/{name}",
+                    body=json.dumps({"rows": rows.tolist()}).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = resp.read()
+            except Exception as e:
+                failures.append(repr(e))
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                continue
+            if resp.status != 200:
+                failures.append(f"HTTP {resp.status}: "
+                                f"{payload[:200]!r}")
+                continue
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+            if name not in checked:
+                checked.add(name)
+                got = json.loads(payload)["predictions"]
+                want = entries[name].booster.predict(
+                    rows, device=True).tolist()
+                if got != want:
+                    parity_bad.append((name, ci))
+        conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_start
+    frontend.stop(drain=True)
+    c = TELEMETRY.counters()
+    lats = sorted(lat_ms)
+    fused_disp = int(c.get("serve_cobatch_dispatches", 0))
+    fused_models = int(c.get("serve_cobatch_fused_models", 0))
+    return {
+        "mode": "open",
+        "models": len(names),
+        "fused_group": fused_members,
+        "clients": clients,
+        "rate_rps": rate,
+        "lanes": int(lanes) if str(lanes).isdigit() else str(lanes),
+        "requests": int(c.get("serve_requests", 0)),
+        "requests_ok": len(lats),
+        "failures": failures[:5],
+        "wall_s": round(wall_s, 3),
+        "p50_ms": round(float(np.percentile(lats, 50)), 3)
+        if lats else None,
+        "p99_ms": round(float(np.percentile(lats, 99)), 3)
+        if lats else None,
+        "cobatch_dispatches": fused_disp,
+        "cobatch_fused_models": fused_models,
+        # the amortization lint: one fused dispatch answered traffic
+        # that solo batchers would have paid `fused_models` dispatches
+        # for — strictly fewer means the fusion actually amortized
+        "cobatch_amortized": bool(fused_disp
+                                  and fused_disp < fused_models),
+        "parity": "fail" if (parity_bad or failures) else "pass",
+    }
 
 
 def main(argv=None) -> int:
@@ -209,7 +437,18 @@ def main(argv=None) -> int:
         mode=os.environ.get("SERVE_MODE", "closed"),
         rate=float(os.environ.get("SERVE_RATE", "200")),
         deadline_ms=float(os.environ.get("SERVE_DEADLINE_MS", "5")),
+        lanes=os.environ.get("SERVE_LANES", "1"),
+        body_format=os.environ.get("SERVE_BODY", "json"),
     )
+    if os.environ.get("SERVE_LANE_PROBE", "1") != "0":
+        out["lane_scaling"] = lane_scaling_probe(
+            lane_n=_env_int("SERVE_LANE_N", 2),
+            sim_row_ms=float(os.environ.get("SERVE_LANE_SIM_MS",
+                                            "1.0")))
+    if os.environ.get("SERVE_MIXED_PROBE", "1") != "0":
+        out["mixed_model"] = run_mixed_bench(
+            n_models=_env_int("SERVE_MIXED_MODELS", 3),
+            rate=float(os.environ.get("SERVE_MIXED_RATE", "300")))
     text = json.dumps(out, indent=1)
     if argv:
         with open(argv[0], "w") as fh:
@@ -219,9 +458,31 @@ def main(argv=None) -> int:
               f"(amortization {out['amortization']}), "
               f"p50 {out['p50_ms']} ms p99 {out['p99_ms']} ms, "
               f"parity {out['parity']} -> {argv[0]}", file=sys.stderr)
+        ls = out.get("lane_scaling")
+        if ls:
+            print(f"serve_bench lane_scaling: 1 lane "
+                  f"{ls['single_lane_rows_per_s']} rows/s -> "
+                  f"{ls['lanes']} lanes "
+                  f"{ls['multi_lane_rows_per_s']} rows/s "
+                  f"({ls['scaling_x']}x, gate {ls['gate']})",
+                  file=sys.stderr)
+        mm = out.get("mixed_model")
+        if mm:
+            print(f"serve_bench mixed_model: {mm['models']} models, "
+                  f"{mm['cobatch_dispatches']} fused dispatches for "
+                  f"{mm['cobatch_fused_models']} model-dispatches "
+                  f"(amortized={mm['cobatch_amortized']}, parity "
+                  f"{mm['parity']})", file=sys.stderr)
     else:
         print(text)
-    return 0 if out["parity"] == "pass" else 1
+    ok = out["parity"] == "pass"
+    ls = out.get("lane_scaling")
+    if ls is not None:
+        ok = ok and ls["gate"] == "pass" and ls["parity"] == "pass"
+    mm = out.get("mixed_model")
+    if mm is not None:
+        ok = ok and mm["parity"] == "pass" and mm["cobatch_amortized"]
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -243,6 +243,29 @@ def test_bench_smoke_json_contract():
     # generous tail bound: the smoke runs on CPU with cold jit
     assert s["p99_ms"] < 30000
     assert s["drain"] == "clean", "serving queues not drained at stop"
+    # lane fleet probe (round 20): the same closed-loop load through
+    # 1 then 2 simulated lanes over a per-row simulated device wall —
+    # the scale-out tentpole gate is 2-lane rows/s >= 1.5x single
+    ls = s["lane_scaling"]
+    assert ls["parity"] == "pass" and ls["drain"] == "clean"
+    assert ls["gate"] == "pass", (
+        f"2-lane scaling {ls['scaling_x']}x below the 1.5x gate "
+        f"({ls['single_lane_rows_per_s']} -> "
+        f"{ls['multi_lane_rows_per_s']} rows/s)")
+    assert ls["scaling_x"] >= 1.5
+    # co-batching probe (round 20): mixed-model open-loop traffic
+    # over one fused program — fused dispatches must be strictly
+    # fewer than the per-model dispatches they replaced, at full
+    # per-member parity
+    mm = s["mixed_model"]
+    assert mm["parity"] == "pass" and not mm["failures"]
+    assert mm["fused_group"] == ["m0", "m1", "m2"]
+    assert mm["cobatch_dispatches"] > 0
+    assert mm["cobatch_dispatches"] < mm["cobatch_fused_models"], (
+        f"{mm['cobatch_dispatches']} fused dispatches for "
+        f"{mm['cobatch_fused_models']} model-dispatches — "
+        "co-batching amortized nothing")
+    assert mm["cobatch_amortized"] is True
 
 
 @pytest.mark.slow

@@ -1,0 +1,585 @@
+"""Serving fleet scale-out (lane pool + co-batching + binary wire).
+
+Pins the fleet contracts (docs/SERVING.md):
+
+- predictions through 1, 2 and 4 simulated lanes are byte-identical
+  to a direct ``Booster.predict`` of the same rows — lane routing,
+  work stealing and the fleet batch split never touch values;
+- the router steals from a deep candidate to the shallowest healthy
+  lane (``serve_steals``), and per-lane accounting lands in
+  ``serve_lane_dispatches`` / the ``GET /models`` ``_fleet`` block;
+- a wedged lane browns out ALONE: its in-flight batch stall-fails
+  (503 material), the router excludes it, survivors keep answering,
+  and only an all-lane stall fails the fleet;
+- co-batched mixed-model traffic (``serve_cobatch=on``) answers each
+  request byte-identically to that model's solo predict, with fused
+  dispatches strictly fewer than the per-model dispatches they
+  replaced; membership rebuilds across hot swaps;
+- the zero-copy binary frame (``application/x-ltpu-f32`` in,
+  ``application/x-ltpu-f64`` out) round-trips exact float64 scores,
+  and a malformed frame is a 400, not a batch poison.
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.reliability.faults import FAULTS
+from lightgbm_tpu.reliability.watchdog import StallError
+from lightgbm_tpu.serving import (BINARY_F32, BINARY_F64, LanePool,
+                                  MicroBatcher, ModelRegistry,
+                                  ServingFrontend, cobatch_key,
+                                  parse_binary_rows, resolve_lanes)
+from lightgbm_tpu.telemetry import TELEMETRY
+
+
+def _train(f=6, leaves=15, iters=4, n=200, seed=0, label_col=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, label_col] - 0.4 * X[:, (label_col + 1) % f]
+    p = {"objective": "regression", "verbose": -1,
+         "num_leaves": leaves, "min_data_in_leaf": 5}
+    return lgb.train(p, lgb.Dataset(X, label=y), iters,
+                     verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    """Two compatible model files (same feature width, different
+    ensembles) — file-loaded publishes route the level-descent
+    predictor, the path lanes and co-batching replicate."""
+    d = tmp_path_factory.mktemp("fleet_models")
+    pa, pb = str(d / "a.txt"), str(d / "b.txt")
+    _train(seed=0).save_model(pa)
+    _train(seed=1, label_col=2, iters=6).save_model(pb)
+    return pa, pb
+
+
+def _cfg(**over):
+    base = {"verbose": -1, "serve_batch_deadline_ms": 5.0,
+            "predict_warm_buckets": (1, 8)}
+    base.update(over)
+    return Config.from_params(base)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    TELEMETRY.configure("counters")
+    TELEMETRY.reset()
+    yield
+    FAULTS.reset()
+    TELEMETRY.stop_metrics_server()
+
+
+# ---------------------------------------------------------------------------
+# lane resolution
+# ---------------------------------------------------------------------------
+def test_resolve_lanes_auto_is_single_on_host_backend():
+    n, devices = resolve_lanes(_cfg())
+    assert n == 1 and devices == [None]
+
+
+def test_resolve_lanes_explicit_simulated():
+    n, devices = resolve_lanes(_cfg(serve_lanes="4"))
+    assert n == 4
+    # one local device: lanes are unpinned (shared compiled programs)
+    assert devices == [None] * 4
+
+
+def test_serve_lanes_validation():
+    with pytest.raises(ValueError, match="serve_lanes"):
+        _cfg(serve_lanes="0")
+    with pytest.raises(ValueError, match="serve_lanes"):
+        _cfg(serve_lanes="sideways")
+    with pytest.raises(ValueError, match="serve_cobatch"):
+        _cfg(serve_cobatch="maybe")
+
+
+# ---------------------------------------------------------------------------
+# lane parity: N lanes == direct predict, byte for byte
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("lanes", [1, 2, 4])
+def test_lane_parity_byte_identical(model_files, lanes):
+    pa, _ = model_files
+    reg = ModelRegistry(_cfg(serve_lanes=str(lanes)))
+    try:
+        entry = reg.publish("m", pa, predict_kwargs={"device": True})
+        if lanes == 1:
+            assert reg.pool is None      # 1 lane == inline dispatch
+        else:
+            assert reg.pool is not None
+            assert reg.pool.n_lanes == lanes
+        rng = np.random.RandomState(7)
+        batches = [rng.randn(1 + i % 4, 6) for i in range(12)]
+        results = {}
+
+        def client(i):
+            _, out = reg.predict("m", batches[i])
+            results[i] = out
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(len(batches))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert len(results) == len(batches)
+        for i, rows in enumerate(batches):
+            ref = entry.booster.predict(rows, device=True)
+            assert np.array_equal(results[i], ref), f"batch {i}"
+        if lanes > 1:
+            c = TELEMETRY.counters()
+            assert c.get("serve_lane_dispatches", 0) >= 1
+            assert c.get("serve_lane_dispatches", 0) == \
+                c.get("serve_dispatches", 0)
+    finally:
+        reg.close()
+
+
+def test_fleet_splits_backlog_across_lanes(model_files):
+    """With a pool, one coalescing window splits its backlog into
+    per-lane shares instead of one greedy batch — the mechanism the
+    2-lane throughput gate measures."""
+    cfg = _cfg(serve_lanes="2", serve_batch_deadline_ms=30.0)
+    reg = ModelRegistry(cfg)
+    try:
+        entry = reg.publish("m", model_files[0],
+                            predict_kwargs={"device": True})
+        rng = np.random.RandomState(3)
+        barrier = threading.Barrier(8)
+        results = {}
+
+        def client(i):
+            rows = rng_rows[i]
+            barrier.wait(10)
+            _, out = reg.predict("m", rows)
+            results[i] = out
+
+        rng_rows = [rng.randn(1, 6) for _ in range(8)]
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert len(results) == 8
+        for i in range(8):
+            assert np.array_equal(
+                results[i],
+                entry.booster.predict(rng_rows[i], device=True))
+        # 8 requests entering one 30ms window must NOT collapse into
+        # a single dispatch: the fleet share caps each batch at
+        # ceil(pending/2), so at least 2 dispatches happen
+        assert TELEMETRY.counters().get("serve_dispatches", 0) >= 2
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# lane pool unit: routing, stealing, stall isolation
+# ---------------------------------------------------------------------------
+def _wait_inflight(pool, lane, timeout=10.0):
+    import time as _t
+    end = _t.monotonic() + timeout
+    while _t.monotonic() < end:
+        with pool._lock:
+            if lane.inflight:
+                return
+        _t.sleep(0.005)
+    raise AssertionError("lane never picked its job up")
+
+
+def _wait_others_idle(pool, busy, timeout=10.0):
+    import time as _t
+    end = _t.monotonic() + timeout
+    while _t.monotonic() < end:
+        with pool._lock:
+            if all(ln.depth() == 0
+                   for ln in pool._lanes if ln is not busy):
+                return
+        _t.sleep(0.005)
+    raise AssertionError("idle lanes never drained")
+
+
+def test_lanepool_round_robin_and_steal():
+    pool = LanePool([None, None], max_inflight=4)
+    try:
+        gate0 = threading.Event()
+
+        # rr: first two submits alternate lanes 0, 1
+        l0 = pool.submit(lambda lane: gate0.wait(30), lambda e: None)
+        l1 = pool.submit(lambda lane: gate0.wait(30), lambda e: None)
+        assert {l0.index, l1.index} == {0, 1}
+        gate0.set()
+        assert pool.drain(30)
+        # wedge exactly one lane, then keep submitting instant jobs:
+        # whenever the rr candidate lands on the wedged lane (depth 1
+        # vs the idle lane's 0) the router must steal to the idle one
+        wedge = threading.Event()
+        wl = pool.submit(lambda lane: wedge.wait(30), lambda e: None)
+        _wait_inflight(pool, wl)
+        before = TELEMETRY.counters().get("serve_steals", 0)
+        for _ in range(4):
+            _wait_others_idle(pool, wl)
+            got = pool.submit(lambda lane: None, lambda e: None)
+            # NOTHING routes to the wedged lane while an idle
+            # neighbor exists: rr candidates on it are stolen away
+            assert got.index != wl.index
+        assert TELEMETRY.counters().get("serve_steals", 0) > before
+        wedge.set()
+        assert pool.drain(30)
+        snap = pool.snapshot()
+        assert [s["lane"] for s in snap] == [0, 1]
+        assert sum(s["dispatches"] for s in snap) == 0  # batcher-owned
+    finally:
+        pool.close()
+
+
+def test_lanepool_stall_isolation_and_fleet_brownout():
+    pool = LanePool([None, None], max_inflight=4)
+    try:
+        wedge = threading.Event()
+        wl = pool.submit(lambda lane: wedge.wait(30), lambda e: None)
+        _wait_inflight(pool, wl)
+        aborted = []
+        # queue a second batch behind the wedged one on the SAME lane
+        with pool._lock:
+            wl.jobs.append((lambda lane: None,
+                            lambda e: aborted.append(e)))
+        err = StallError("serve_dispatch(test)", "predict.dispatch",
+                         0.1, 0.2)
+        n = pool.mark_stalled(wl, err)
+        assert n == 1 and aborted == [err]    # queued job 503'd now
+        assert pool.healthy_count() == 1
+        snap = {s["lane"]: s for s in pool.snapshot()}
+        assert snap[wl.index]["stalled"] is True
+        assert snap[wl.index]["stalls"] == 1
+        # routing excludes the wedged lane from now on
+        for _ in range(4):
+            assert pool.submit(lambda lane: None,
+                               lambda e: None).index != wl.index
+        assert TELEMETRY.counters().get("serve_lane_stalls", 0) == 1
+        # second stall: the fleet is dead — submit itself raises
+        other = next(ln for ln in pool._lanes if ln is not wl)
+        pool.mark_stalled(other, err)
+        with pytest.raises(StallError):
+            pool.submit(lambda lane: None, lambda e: None)
+        wedge.set()
+    finally:
+        pool.close(timeout_s=5)
+
+
+def test_lane_stall_survivors_keep_serving(model_files):
+    """Mid-stream stall through the REAL batcher path: the wedged
+    lane's in-flight batch fails with the classified stall (the 503),
+    the lane browns out, and later requests succeed on the survivor."""
+    hang = threading.Event()
+    calls = []
+    bst = lgb.Booster(model_file=model_files[0],
+                      config=_cfg())
+
+    def predict_fn(rows):
+        calls.append(rows.shape)
+        if hang.is_set():
+            hang.clear()            # wedge exactly one dispatch
+            import time
+            time.sleep(1.2)
+        return bst.predict(rows)
+
+    cfg = _cfg(serve_lanes="2", watchdog_serve_s=0.25,
+               serve_batch_deadline_ms=0.0)
+    pool = LanePool([None, None], max_inflight=4)
+    mb = MicroBatcher(predict_fn, cfg, name="stall", pool=pool)
+    try:
+        rows = np.random.RandomState(1).randn(2, 6)
+        ok = mb.submit(rows)            # healthy warm-up dispatch
+        assert np.array_equal(ok, bst.predict(rows))
+        hang.set()
+        with pytest.raises(StallError):
+            mb.submit(rows)             # in-flight on the wedged lane
+        assert pool.healthy_count() == 1
+        c = TELEMETRY.counters()
+        assert c.get("serve_lane_stalls", 0) == 1
+        assert c.get("serve_stalls", 0) == 1
+        # survivors: the fleet still answers, byte-identically
+        for _ in range(3):
+            out = mb.submit(rows)
+            assert np.array_equal(out, bst.predict(rows))
+    finally:
+        mb.close(drain=True, timeout_s=10)
+        pool.close(timeout_s=5)
+
+
+# ---------------------------------------------------------------------------
+# co-batching
+# ---------------------------------------------------------------------------
+def test_cobatch_eligibility(model_files, monkeypatch):
+    cfg_on = _cfg(serve_cobatch="on")
+    bst = lgb.Booster(model_file=model_files[0], config=cfg_on)
+    # file-loaded level-descent model with only a device kwarg: fuses
+    assert cobatch_key(bst, {"device": True}, cfg_on, True) == \
+        ("cobatch", 6)
+    # host-walk routing never fuses
+    assert cobatch_key(bst, {"device": True}, cfg_on, False) is None
+    # custom predict kwargs keep the solo batcher
+    assert cobatch_key(bst, {"device": True, "raw_score": True},
+                       cfg_on, True) is None
+    # off by default
+    assert cobatch_key(bst, {"device": True}, _cfg(), True) is None
+    # a booster whose device=True routes the in-session binned scan
+    # runs a DIFFERENT numeric path than the fused level descent —
+    # it must keep its solo batcher (the parity pin)
+    monkeypatch.setattr(type(bst), "_can_device_predict",
+                        lambda self, n, it, dev: True)
+    assert cobatch_key(bst, {"device": True}, cfg_on, True) is None
+
+
+def test_cobatch_mixed_model_parity_and_amortization(model_files):
+    # single lane: the fleet share otherwise splits a 2-request
+    # window into per-lane batches (parallelism beats fusion at
+    # depth 2) and the fused-dispatch assertion would race it
+    pa, pb = model_files
+    cfg = _cfg(serve_cobatch="on", serve_batch_deadline_ms=25.0)
+    reg = ModelRegistry(cfg)
+    try:
+        ea = reg.publish("a", pa, predict_kwargs={"device": True})
+        eb = reg.publish("b", pb, predict_kwargs={"device": True})
+        assert ea.cobatch is not None and ea.cobatch is eb.cobatch
+        assert ea.cobatch.names == ["a", "b"]
+        rng = np.random.RandomState(11)
+        fused = False
+        for _attempt in range(5):
+            rows_a = rng.randn(2, 6)
+            rows_b = rng.randn(3, 6)
+            barrier = threading.Barrier(2)
+            outs = {}
+
+            def client(name, rows):
+                barrier.wait(10)
+                _, out = reg.predict(name, rows)
+                outs[name] = out
+
+            ta = threading.Thread(target=client, args=("a", rows_a))
+            tb = threading.Thread(target=client, args=("b", rows_b))
+            ta.start(); tb.start()
+            ta.join(60); tb.join(60)
+            assert np.array_equal(
+                outs["a"], ea.booster.predict(rows_a, device=True))
+            assert np.array_equal(
+                outs["b"], eb.booster.predict(rows_b, device=True))
+            c = TELEMETRY.counters()
+            if (c.get("serve_cobatch_fused_models", 0)
+                    > c.get("serve_cobatch_dispatches", 0)):
+                fused = True            # >= 1 dispatch carried BOTH
+                break
+        assert fused, "no dispatch ever fused both models"
+        # the amortization the fusion exists for: fused dispatches <
+        # the per-model dispatches they replaced
+        c = TELEMETRY.counters()
+        assert c["serve_cobatch_dispatches"] \
+            < c["serve_cobatch_fused_models"]
+        desc = reg.describe()
+        assert desc["a"]["cobatch"]["models"] == ["a", "b"]
+        assert desc["b"]["cobatch"]["models"] == ["a", "b"]
+    finally:
+        reg.close()
+
+
+def test_cobatch_parity_under_lane_fleet(model_files):
+    """Co-batching and the lane fleet composed: mixed-model traffic
+    through 2 lanes stays byte-identical per member."""
+    pa, pb = model_files
+    cfg = _cfg(serve_lanes="2", serve_cobatch="on",
+               serve_batch_deadline_ms=10.0)
+    reg = ModelRegistry(cfg)
+    try:
+        ea = reg.publish("a", pa, predict_kwargs={"device": True})
+        eb = reg.publish("b", pb, predict_kwargs={"device": True})
+        assert ea.cobatch is eb.cobatch is not None
+        rng = np.random.RandomState(21)
+        jobs = [("a" if i % 2 else "b", rng.randn(1 + i % 3, 6))
+                for i in range(10)]
+        outs = {}
+
+        def client(i):
+            name, rows = jobs[i]
+            _, out = reg.predict(name, rows)
+            outs[i] = out
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(len(jobs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        for i, (name, rows) in enumerate(jobs):
+            ref = (ea if name == "a" else eb).booster.predict(
+                rows, device=True)
+            assert np.array_equal(outs[i], ref), f"job {i} ({name})"
+    finally:
+        reg.close()
+
+
+def test_cobatch_group_rebuilds_on_hot_swap(model_files):
+    pa, pb = model_files
+    cfg = _cfg(serve_cobatch="on")
+    reg = ModelRegistry(cfg)
+    try:
+        ea = reg.publish("a", pa, predict_kwargs={"device": True})
+        eb = reg.publish("b", pb, predict_kwargs={"device": True})
+        g1 = ea.cobatch
+        assert g1 is not None and g1.versions == {"a": 1, "b": 1}
+        ea2 = reg.publish("a", pb, predict_kwargs={"device": True})
+        g2 = ea2.cobatch
+        assert g2 is not None and g2 is not g1
+        assert g2.versions == {"a": 2, "b": 1}
+        assert reg.get("b").cobatch is g2
+        assert g1.batcher.closed      # replaced group drained
+        rows = np.random.RandomState(5).randn(4, 6)
+        _, out = reg.predict("a", rows)
+        assert np.array_equal(out,
+                              ea2.booster.predict(rows, device=True))
+        # rollback dissolves v2's membership back to v1
+        reg.rollback("a")
+        e_back = reg.get("a")
+        assert e_back.version == 1
+        assert e_back.cobatch is not None
+        assert e_back.cobatch.versions == {"a": 1, "b": 1}
+        _, out = reg.predict("a", rows)
+        assert np.array_equal(out,
+                              e_back.booster.predict(rows,
+                                                     device=True))
+    finally:
+        reg.close()
+
+
+def test_cobatch_off_keeps_solo_batchers(model_files):
+    pa, pb = model_files
+    reg = ModelRegistry(_cfg())          # serve_cobatch defaults off
+    try:
+        ea = reg.publish("a", pa, predict_kwargs={"device": True})
+        eb = reg.publish("b", pb, predict_kwargs={"device": True})
+        assert ea.cobatch is None and eb.cobatch is None
+        assert ea.cobatch_k is None
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# binary wire format
+# ---------------------------------------------------------------------------
+def test_parse_binary_rows_roundtrip_and_errors():
+    rows = np.random.RandomState(2).randn(5, 6).astype("<f4")
+    got = parse_binary_rows(rows.tobytes(), 6)
+    assert got.shape == (5, 6)
+    assert np.array_equal(got, rows)
+    with pytest.raises(ValueError, match="multiple"):
+        parse_binary_rows(rows.tobytes()[:-3], 6)
+    with pytest.raises(ValueError, match="empty"):
+        parse_binary_rows(b"", 6)
+
+
+def test_http_binary_request_and_response_parity(model_files):
+    cfg = _cfg(serve_lanes="2")
+    reg = ModelRegistry(cfg)
+    fe = ServingFrontend(reg, cfg)
+    try:
+        entry = reg.publish("m", model_files[0],
+                            predict_kwargs={"device": True})
+        port = fe.start(0).server_address[1]
+        rows32 = np.random.RandomState(8).randn(6, 6).astype("<f4")
+        ref = entry.booster.predict(
+            rows32.astype(np.float64), device=True)
+
+        # binary in, JSON out
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict/m",
+            data=rows32.tobytes(),
+            headers={"Content-Type": BINARY_F32})
+        body = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert np.array_equal(np.asarray(body["predictions"]), ref)
+
+        # binary in, binary out: raw little-endian f64, exact
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict/m",
+            data=rows32.tobytes(),
+            headers={"Content-Type": BINARY_F32,
+                     "Accept": BINARY_F64})
+        resp = urllib.request.urlopen(req, timeout=60)
+        assert resp.headers.get("Content-Type") == BINARY_F64
+        assert resp.headers.get("X-Model-Version") == "1"
+        assert resp.headers.get("X-Prediction-Shape") == "6"
+        got = np.frombuffer(resp.read(), dtype="<f8")
+        assert np.array_equal(got, ref)
+        assert TELEMETRY.counters().get("serve_binary_requests",
+                                        0) == 2
+
+        # malformed frame: 400 for the one bad client, no batch harm
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict/m",
+            data=rows32.tobytes()[:-2],
+            headers={"Content-Type": BINARY_F32})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 400
+        # JSON clients still fine afterwards
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict/m",
+            data=json.dumps(
+                {"rows": rows32.astype(float).tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert np.array_equal(np.asarray(body["predictions"]), ref)
+    finally:
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+def test_models_endpoint_reports_fleet_state(model_files):
+    cfg = _cfg(serve_lanes="2")
+    reg = ModelRegistry(cfg)
+    fe = ServingFrontend(reg, cfg)
+    try:
+        reg.publish("m", model_files[0],
+                    predict_kwargs={"device": True})
+        port = fe.start(0).server_address[1]
+        reg.predict("m", np.random.RandomState(0).randn(2, 6))
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/models", timeout=30).read())
+        fleet = body["_fleet"]
+        assert fleet["n_lanes"] == 2
+        assert fleet["healthy_lanes"] == 2
+        assert [ln["lane"] for ln in fleet["lanes"]] == [0, 1]
+        for ln in fleet["lanes"]:
+            assert set(ln) == {"lane", "device", "queue_depth",
+                               "dispatches", "stalls", "stalled"}
+        assert sum(ln["dispatches"] for ln in fleet["lanes"]) >= 1
+    finally:
+        fe.stop()
+
+
+def test_no_fleet_block_without_pool(model_files):
+    reg = ModelRegistry(_cfg())
+    try:
+        reg.publish("m", model_files[0])
+        assert "_fleet" not in reg.describe()
+    finally:
+        reg.close()
+
+
+def test_warm_predictor_devices_param(model_files):
+    import jax
+    bst = lgb.Booster(model_file=model_files[0], config=_cfg())
+    dev = jax.local_devices()[0]
+    bst.warm_predictor((1, 8), devices=(dev,))
+    rows = np.random.RandomState(6).randn(3, 6)
+    with jax.default_device(dev):
+        out = bst.predict(rows, device=True)
+    assert np.array_equal(out, bst.predict(rows, device=True))

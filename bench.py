@@ -1249,6 +1249,87 @@ def run_compact_bins(params, rows=None):
     if trees["8bit"] != trees["4bit"]:
         raise SystemExit("compact_bins parity gate failed: trees "
                          "differ between bin_packing=8bit and 4bit")
+
+    # --- crumb tier (round 21): the same pipeline on a max_bin=4
+    # sub-draw, where bin_packing=2bit stores FOUR groups per byte.
+    # Gate: the measured host ratio must meet the layout-predicted
+    # read-stream reduction G / ceil(G/4) exactly (same rows, the
+    # packed matrix IS the kernels' read stream at max_bin <= 4).
+    base4 = dict(base, max_bin=4)
+    host4 = {}
+    dev4 = {}
+    trees4 = {}
+    for mode in ("8bit", "2bit"):
+        p = dict(base4, bin_packing=mode)
+        gc.collect()
+        t0 = time.time()
+        dset = lgb.Dataset(X, label=y).construct(
+            lgb.config.Config.from_params(p))
+        construct_s = time.time() - t0
+        host4[mode] = int(np.asarray(dset.group_bins).nbytes)
+        out[f"construct_rows_per_s_{mode}_mb4"] = round(
+            rows / max(construct_s, 1e-9))
+        wrapped = lgb.Dataset(X, label=y, params=p)
+        wrapped._core = dset
+        booster = lgb.train(p, wrapped)
+        g = TELEMETRY.snapshot().get("gauges", {})
+        dev4[mode] = int(g.get("bin_matrix_bytes", 0))
+        trees4[mode] = _re.sub(r"\[bin_packing: \w+\]", "",
+                               booster.model_to_string())
+        del dset, wrapped, booster
+        gc.collect()
+    g2 = (BENCH_FEATURES + 3) // 4
+    out["host_matrix_bytes_8bit_mb4"] = host4["8bit"]
+    out["host_matrix_bytes_2bit"] = host4["2bit"]
+    out["bin_matrix_bytes_2bit"] = dev4["2bit"]
+    out["crumb_packing_ratio"] = round(
+        host4["8bit"] / max(host4["2bit"], 1), 3)
+    out["crumb_predicted_ratio"] = round(BENCH_FEATURES / g2, 3)
+    out["crumb_device_ratio"] = round(
+        dev4["8bit"] / max(dev4["2bit"], 1), 3)
+    out["hist_bytes_per_row_2bit"] = g2 + 16
+    out["crumb_stream_ratio"] = round(
+        (BENCH_FEATURES + 16) / (g2 + 16), 3)
+    if out["crumb_packing_ratio"] < out["crumb_predicted_ratio"] - 1e-9:
+        raise SystemExit(
+            "compact_bins crumb gate failed: host ratio "
+            f"{out['crumb_packing_ratio']} below the layout-predicted "
+            f"{out['crumb_predicted_ratio']} at max_bin=4")
+    if trees4["8bit"] != trees4["2bit"]:
+        raise SystemExit("compact_bins parity gate failed: trees "
+                         "differ between bin_packing=8bit and 2bit")
+
+    # --- compressed histogram exchange (round 21): the q16/q8 codec's
+    # measured wire bytes through the SAME host collective path the
+    # sharded windows ride, via its telemetry counters.  Gate: q16
+    # halves and q8 quarters the f32 payload.
+    from lightgbm_tpu.parallel.collectives import host_exchange_histograms
+    TELEMETRY.configure("counters")
+    rng_h = np.random.RandomState(47)
+    shard_hists = [
+        np.cumsum(rng_h.randint(-15, 16,
+                                size=(params["num_leaves"],
+                                      BENCH_FEATURES, 16, 3)),
+                  axis=-2).astype(np.float32)
+        for _ in range(2)]
+    for mode in ("f32", "q16", "q8"):
+        TELEMETRY.reset()
+        host_exchange_histograms(shard_hists, mode=mode)
+        c = TELEMETRY.snapshot().get("counters", {})
+        out[f"hist_exchange_bytes_{mode}"] = int(
+            c.get("collective_hist_exchange_bytes", 0))
+    out["hist_exchange_ratio_q16"] = round(
+        out["hist_exchange_bytes_f32"]
+        / max(out["hist_exchange_bytes_q16"], 1), 3)
+    out["hist_exchange_ratio_q8"] = round(
+        out["hist_exchange_bytes_f32"]
+        / max(out["hist_exchange_bytes_q8"], 1), 3)
+    if out["hist_exchange_ratio_q16"] < 2.0 - 1e-9 \
+            or out["hist_exchange_ratio_q8"] < 4.0 - 1e-9:
+        raise SystemExit(
+            "compact_bins hist_exchange gate failed: byte reduction "
+            f"q16 {out['hist_exchange_ratio_q16']}x / q8 "
+            f"{out['hist_exchange_ratio_q8']}x (need 2x / 4x)")
     out["parity"] = "pass"
     return out
 

@@ -1,4 +1,4 @@
-"""Program-invariant rules HLO001-HLO008.
+"""Program-invariant rules HLO001-HLO009.
 
 Each rule encodes one hard-won compiled-program guarantee as a check
 over the registered entry points' lowered artifacts (see
@@ -19,6 +19,9 @@ Incident index (docs/STATIC_ANALYSIS.md carries the full glossary):
 - standing TPU discipline: f32 accumulation everywhere (HLO001), no
   host round-trips inside hot programs (HLO002), fully static shapes
   (HLO007).
+- r21: the histogram compression programs (precision-tiered
+  accumulation, compressed histogram exchange) re-assert both
+  standing disciplines at their own probe surfaces (HLO009).
 """
 from __future__ import annotations
 
@@ -304,3 +307,26 @@ def _hlo008(ctx) -> List[Finding]:
     ctx.programs.all_programs()      # force every probe build first
     return check_retrace_surface(ctx.programs.retrace_delta(),
                                  RETRACE_BOUNDS)
+
+
+@rule("HLO009", "tiered accumulation f32-clean; exchange codec "
+                "device-resident",
+      incident="r21 histogram compression arc",
+      needs_programs=True)
+def _hlo009(ctx) -> List[Finding]:
+    """The round-21 compression programs uphold the standing
+    disciplines at their own probe surfaces: the precision-tiered
+    tree step (int32 accumulation + f32 fix-up) must introduce no
+    f64 promotion, and the ``hist_exchange`` codec's quantize /
+    pmax-scale / psum / reconstruct chain must lower with no host
+    callback — a callback inside the exchange would serialize every
+    per-pass histogram sum on the host."""
+    probes = [ctx.programs.hist_tiered(),
+              ctx.programs.hist_exchange("q16"),
+              ctx.programs.hist_exchange("q8")]
+    out: List[Finding] = []
+    for p in probes:
+        for f in check_no_f64(p) + check_no_host_callback(p):
+            out.append(Finding(rule="HLO009", file=f.file,
+                               line=f.line, message=f.message))
+    return out

@@ -316,6 +316,74 @@ class ProgramSet:
                            meta={"multi_shape": True})
         return self._memo("predict_scan", build)
 
+    def hist_tiered(self) -> Program:
+        """The precision-tiered histogram tree step (round 21): a
+        probe grower planned with ``hist_precision=tiered`` — the
+        int32 quantized-weight accumulation plus its f32 fix-up.
+        HLO009's no-f64 / no-callback surface; NOT in
+        ``all_programs`` so the HLO003-008 scope is unchanged."""
+        def build():
+            import jax
+            import numpy as np
+
+            g = build_probe_gbdt(hist_precision="tiered",
+                                 hist_kernel="pallas",
+                                 force_pallas_interpret=True,
+                                 max_bin=15).grower
+            assert g.use_quant, (
+                "tiered probe did not plan onto the quantized "
+                "kernels — HLO009 would be checking the wrong program")
+            zeros = np.zeros(g.n_padded, np.float32)
+            fmask = np.ones(g.num_features, bool)
+            args = (zeros, zeros, zeros, fmask, g.ohb, g.bins,
+                    g.binsT, g._row_valid)
+            jaxpr = jax.make_jaxpr(g._train_tree_impl)(*args).jaxpr
+            lowered = jax.jit(g._train_tree_impl).lower(*args)
+            return Program("hist_tiered_step",
+                           "lightgbm_tpu/learner/grower.py",
+                           jaxpr=jaxpr, lowered=lowered,
+                           meta={"multi_shape": False})
+        return self._memo("hist_tiered_step", build)
+
+    def hist_exchange(self, mode: str = "q16") -> Program:
+        """The compressed histogram exchange codec (round 21) lowered
+        under a shard_map mesh — delta coding, pmax'd scale payload,
+        narrow-int psum, cumsum reconstruction.  HLO009 asserts the
+        codec stays device-resident (no host callback) and f32-clean;
+        NOT in ``all_programs`` (same scoping as hist_tiered)."""
+        def build():
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh
+            from jax.sharding import PartitionSpec as P
+
+            from lightgbm_tpu.learner.grower import _get_shard_map
+            from lightgbm_tpu.parallel.collectives import \
+                exchange_histograms
+
+            devs = jax.devices()
+            world = 2 if len(devs) >= 2 else 1
+            mesh = Mesh(np.array(devs[:world]), ("data",))
+
+            @functools.partial(_get_shard_map(), mesh=mesh,
+                               in_specs=(P(),), out_specs=P())
+            def fn(h):
+                return exchange_histograms(h, "data", mode=mode,
+                                           world=world)
+
+            h = jnp.zeros((6, 4, 16, 3), jnp.float32)
+            jaxpr = jax.make_jaxpr(fn)(h).jaxpr
+            lowered = jax.jit(fn).lower(h)
+            return Program(f"hist_exchange@{mode}",
+                           "lightgbm_tpu/parallel/collectives.py",
+                           jaxpr=jaxpr, lowered=lowered,
+                           meta={"multi_shape": False,
+                                 "world": world})
+        return self._memo(f"hist_exchange@{mode}", build)
+
     def unpack_records(self) -> Program:
         def build():
             import jax

@@ -335,8 +335,11 @@ class Config:
     # otf-int8, fall back to XLA with a loud warning and only
     # f32-level parity); "auto" is adaptive precision — groups whose
     # fitted bin count fits 4 bits pack even when others don't, via a
-    # two-section (packed + wide) layout.  The resolved device matrix
-    # size is the bin_matrix_bytes telemetry gauge
+    # two-section (packed + wide) layout, and <=2-bit groups tighten
+    # further to crumbs; "2bit" crumb-packs four <=4-bin groups per
+    # byte (requires max_bin <= 4) for a 4x read-stream cut — the
+    # three-section (crumb + nibble + wide) layout.  The resolved
+    # device matrix size is the bin_matrix_bytes telemetry gauge
     binary_cache_v2: bool = True  # save_binary writes the v2 container
     # (magic + schema version + pickled mapper/metadata header + a raw
     # np.memmap-able group_bins section): load_binary maps the bin
@@ -403,6 +406,27 @@ class Config:
     # MS-LTR shape, while binary/regression gradients are well-spread
     # and skip the ~7% per-tree RNG cost), 0 = always deterministic,
     # 1 = always stochastic
+    hist_precision: str = "auto"  # histogram accumulation precision
+    # tier (the Booster-accelerator narrow-accumulate + late-widen
+    # recipe, arXiv 2011.02022): "f32" always accumulates float32
+    # (quantized_grad is ignored); "tiered" forces the int32
+    # quantized-weight kernel path with its f32 fix-up (dequantize)
+    # pass before split finding — a loud kernel-plan error when the
+    # row count could overflow the int32 accumulator (rows * 127 >=
+    # 2^31) or no quantized kernel route exists; "auto" selects from
+    # the row count exactly like quantized_grad alone does today, so
+    # trees stay byte-identical to the pre-tier behavior.  The chosen
+    # tier is the grower.hist_precision telemetry gauge; fix-up passes
+    # count in hist_quant_fixup
+    hist_exchange: str = "f32"  # cross-shard histogram exchange codec
+    # (data-parallel row sharding): "f32" psums raw float32 histograms
+    # (legacy lowering, byte-identical trees); "q16"/"q8" delta-code
+    # each (leaf, group) histogram along the bin axis and quantize to
+    # int16/int8 with per-(leaf, group, channel) scales riding the
+    # payload — the ICI exchange stream drops ~2x/4x (the
+    # collective_hist_exchange_bytes counter) at bounded
+    # reconstruction error; scales are psum'd exactly, int sums get
+    # world-size headroom so the integer psum can never overflow
     histogram_pool_size: float = -1.0  # MB bound on the per-leaf
     # histogram cache (reference config.h:216 + the LRU HistogramPool,
     # feature_histogram.hpp:653-823).  -1 = unbounded.  When the
@@ -840,12 +864,13 @@ class Config:
         if self.max_bin > 256:
             raise ValueError(
                 "max_bin must be <= 256 (bin_packing=8bit stores one "
-                "group bin per uint8 byte; bin_packing=4bit/auto packs "
-                "two <=16-bin groups per byte but never widens past a "
-                "byte)")
-        if str(self.bin_packing).lower() not in ("auto", "8bit", "4bit"):
-            raise ValueError("bin_packing must be auto/8bit/4bit, got "
-                             f"{self.bin_packing!r}")
+                "group bin per uint8 byte; bin_packing=4bit/2bit/auto "
+                "packs two <=16-bin (four <=4-bin) groups per byte but "
+                "never widens past a byte)")
+        if str(self.bin_packing).lower() not in ("auto", "8bit", "4bit",
+                                                 "2bit"):
+            raise ValueError("bin_packing must be auto/8bit/4bit/2bit, "
+                             f"got {self.bin_packing!r}")
         if str(self.bin_packing).lower() == "4bit" and self.max_bin > 16:
             raise ValueError(
                 f"bin_packing=4bit requires max_bin <= 16 (a nibble "
@@ -853,6 +878,20 @@ class Config:
                 "max_bin or use bin_packing=auto, which packs only the "
                 "feature groups that fit and keeps wide groups "
                 "byte-wide")
+        if str(self.bin_packing).lower() == "2bit" and self.max_bin > 4:
+            raise ValueError(
+                f"bin_packing=2bit requires max_bin <= 4 (a crumb "
+                f"holds 4 bins), got max_bin={self.max_bin} — lower "
+                "max_bin or use bin_packing=auto, which crumb-packs "
+                "only the feature groups that fit and keeps wider "
+                "groups nibble- or byte-wide")
+        if str(self.hist_precision).lower() not in ("auto", "f32",
+                                                    "tiered"):
+            raise ValueError("hist_precision must be auto/f32/tiered, "
+                             f"got {self.hist_precision!r}")
+        if str(self.hist_exchange).lower() not in ("f32", "q16", "q8"):
+            raise ValueError("hist_exchange must be f32/q16/q8, got "
+                             f"{self.hist_exchange!r}")
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError(f"num_class must be >= 2 for {self.objective}")
         if self.objective not in ("multiclass", "multiclassova") and self.num_class != 1:

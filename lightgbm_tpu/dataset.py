@@ -23,8 +23,8 @@ from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
                       MISSING_NONE, MISSING_ZERO, BinMapper,
                       find_bin_mappers, resolve_construct_threads)
 from .config import Config
-from .packing import (NIBBLE_MAX_BIN, BinLayout, build_layout,
-                      resolve_bin_packing)
+from .packing import (CRUMB_MAX_BIN, NIBBLE_MAX_BIN, BinLayout,
+                      build_layout, resolve_bin_packing)
 from .utils.log import Log
 
 
@@ -489,16 +489,26 @@ class Dataset:
         bundles = _find_bundles(self, sample_nonzero, sample_cnt)
         pack_mode = resolve_bin_packing(self.config)
         if pack_mode != "8bit" and bundles:
-            # packable-first group order (packing.py two-section
-            # layout): groups whose bin count fits a nibble come
-            # first, wide groups follow.  Stable within each section
-            # (by first feature index, the legacy order), so the
-            # reorder is deterministic; trees are invariant to group
-            # numbering — histograms expand to per-FEATURE space
-            # before the split finder ever sees them
-            bundles.sort(key=lambda b: (
-                0 if _bundle_num_bin(self, b) <= NIBBLE_MAX_BIN else 1,
-                b[0]))
+            # narrowest-first group order (packing.py layout): groups
+            # whose bin count fits a crumb come first (auto/2bit — the
+            # three-section layout), then nibble-narrow groups, wide
+            # groups follow.  4bit keeps the two-section sort so its
+            # matrices stay byte-for-byte what r18 caches hold.  Stable
+            # within each section (by first feature index, the legacy
+            # order), so the reorder is deterministic; trees are
+            # invariant to group numbering — histograms expand to
+            # per-FEATURE space before the split finder ever sees them
+            if pack_mode in ("auto", "2bit"):
+                bundles.sort(key=lambda b: (
+                    0 if _bundle_num_bin(self, b) <= CRUMB_MAX_BIN
+                    else (1 if _bundle_num_bin(self, b) <= NIBBLE_MAX_BIN
+                          else 2),
+                    b[0]))
+            else:
+                bundles.sort(key=lambda b: (
+                    0 if _bundle_num_bin(self, b) <= NIBBLE_MAX_BIN
+                    else 1,
+                    b[0]))
         self._bundles = bundles
         self.features = [None] * 0
         feats: List[FeatureView] = []

@@ -39,6 +39,13 @@ FORMAT_VERSION = 2
 # older build refuses on the unknown version instead of silently
 # mis-binning packed bytes as group columns.
 FORMAT_VERSION_PACKED = 3
+# v4 = v3 + a ``crumb_groups`` field in the ``bin_packing`` layout
+# state (the 2-bit crumb section, packing.py three-section layout).
+# Same refusal shape one tier up: nibble-only packed caches keep
+# writing v3 (loadable by every r18+ build), while a crumb-carrying
+# cache read by a pre-crumb build refuses on the unknown version
+# instead of silently mis-widening crumb bytes as nibble pairs.
+FORMAT_VERSION_CRUMB = 4
 # hard sanity bound on the v2 header blob (mappers + metadata for even
 # a 10k-feature dataset pickle to a few MB; a length field past this is
 # a corrupted or hostile file, not a real header)
@@ -128,8 +135,10 @@ def save_binary(dataset: Dataset, filename: str,
         return
     lay = getattr(dataset, "bin_layout", None)
     header = dict(_payload(dataset, with_bins=False),
-                  version=(FORMAT_VERSION_PACKED if lay is not None
-                           else FORMAT_VERSION))
+                  version=(FORMAT_VERSION if lay is None
+                           else (FORMAT_VERSION_CRUMB
+                                 if lay.crumb_groups
+                                 else FORMAT_VERSION_PACKED)))
     if lay is not None:
         header["bin_packing"] = lay.to_state()
     gb = dataset.group_bins
@@ -184,7 +193,8 @@ def _read_v2(f, filename: str):
         Log.fatal(f"{filename}: corrupted v2 binary dataset header "
                   f"({type(e).__name__}: {e})")
     if payload.get("version") not in (FORMAT_VERSION,
-                                      FORMAT_VERSION_PACKED):
+                                      FORMAT_VERSION_PACKED,
+                                      FORMAT_VERSION_CRUMB):
         Log.fatal(f"{filename}: unsupported binary dataset version "
                   f"{payload.get('version')!r}")
     shape = payload.get("bins_shape")
@@ -273,6 +283,14 @@ def _check_packing(filename: str, ds: Dataset, config) -> None:
             "layout differs from a 4-bit construction; rebuild the "
             "cache under bin_packing=4bit (delete the file) or run "
             "with bin_packing=auto/8bit")
+    if want == "2bit" and (lay is None or lay.crumb_groups == 0):
+        Log.fatal(
+            f"{filename}: cache holds "
+            + ("an 8-bit" if lay is None else "a crumb-free packed")
+            + " bin matrix but this run asked for bin_packing=2bit — "
+            "the cached group layout differs from a 2-bit "
+            "construction; rebuild the cache under bin_packing=2bit "
+            "(delete the file) or run with bin_packing=auto/8bit")
 
 
 def _restore_dataset(payload: dict, group_bins) -> Dataset:

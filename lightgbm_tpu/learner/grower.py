@@ -48,7 +48,8 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
+from ..ops.histogram import (PACKED_STRIP, check_quant_rows,
+                             compute_group_histograms,
                              compute_group_histograms_fused,
                              compute_group_histograms_pallas,
                              compute_group_histograms_pallas_paired,
@@ -59,7 +60,7 @@ from ..ops.histogram import (PACKED_STRIP, compute_group_histograms,
                              compute_leaf_totals, expand_feature_histograms,
                              precompute_bin_onehot,
                              precompute_bin_onehot_packed,
-                             quantize_gradients)
+                             quant_rows_ok, quantize_gradients)
 from ..ops.partition import (apply_route_table, apply_splits,
                              build_route_table)
 from ..ops.split import (CAND_CAT_DIR, CAND_COLS, CAND_DEFAULT_LEFT,
@@ -163,13 +164,16 @@ class TreeGrower:
         self.max_feature_bin = dataset.max_feature_bin
         self.num_groups = dataset.num_groups
         self.num_features = dataset.num_features
-        # nibble-packed bin matrix (lightgbm_tpu/packing.py): the
-        # device matrix IS the storage matrix — kernels widen nibbles
-        # in-register, so HBM capacity AND the histogram read stream
-        # halve for fully packed datasets.  0 = legacy 8-bit layout
-        # (every code path lowers exactly as before).
+        # sub-byte-packed bin matrix (lightgbm_tpu/packing.py): the
+        # device matrix IS the storage matrix — kernels widen crumbs
+        # and nibbles in-register, so HBM capacity AND the histogram
+        # read stream shrink 2-4x for fully packed datasets.
+        # ``pack_P`` carries the static PACK SPEC (pack_spec(P, C));
+        # a crumb-free layout encodes to plain P and a 0 means the
+        # legacy 8-bit layout — every pre-crumb code path (and its
+        # compiled-cache key) lowers exactly as before.
         _lay = getattr(dataset, "bin_layout", None)
-        self.pack_P = _lay.packed_groups if _lay is not None else 0
+        self.pack_P = _lay.device_spec if _lay is not None else 0
 
         meta = dataset.feature_meta_arrays()
         self.f_num_bin = jnp.asarray(meta["num_bin"])
@@ -366,16 +370,45 @@ class TreeGrower:
             if cand <= self.n_padded and self.n_padded % cand == 0:
                 self.pallas_block_tiled = cand
                 break
+        # precision tier (hist_precision): "tiered" forces the int32
+        # quantized-weight accumulation path (narrow per-leaf
+        # accumulators + the f32 dequantize fix-up before split
+        # finding), "f32" forces full-precision accumulation, "auto"
+        # follows quantized_grad exactly as before (byte-identical
+        # trees by construction).  The overflow bound lives in ONE
+        # place — ops/histogram.check_quant_rows, next to the kernel
+        # it protects — and "tiered" turns it into a loud kernel-plan
+        # error instead of a silent fallback.
+        self.hist_precision = str(getattr(config, "hist_precision",
+                                          "auto")).lower()
+        # cross-shard histogram exchange codec (the _hist_xla_rowsharded
+        # psum window); resolved here so the compiled step's lowering is
+        # fixed at plan time
+        self.hist_exchange = str(getattr(config, "hist_exchange",
+                                         "f32")).lower()
+        if self.hist_precision == "tiered":
+            check_quant_rows(self.n_padded, what="hist_precision=tiered")
+        want_quant = (getattr(config, "quantized_grad", False)
+                      or self.hist_precision == "tiered")
+        if self.hist_precision == "f32":
+            if want_quant:
+                Log.warning("hist_precision=f32: quantized_grad ignored "
+                            "— histograms accumulate float32")
+            want_quant = False
         # int8 quantized training (see _hist_kernel_body_q): histogram
         # matmuls on the int8 MXU with one grad/hess scale per tree.
         # The int32 accumulator bounds rows at N*127 < 2^31.
         self.use_quant = self.use_pallas and not self.pallas_paired \
-            and getattr(config, "quantized_grad", False) \
-            and self.n_padded * 127 < 2**31
-        if getattr(config, "quantized_grad", False) and self.use_pallas \
+            and want_quant and quant_rows_ok(self.n_padded)
+        if want_quant and self.use_pallas \
                 and not self.use_quant and not self.pallas_paired:
             Log.warning("quantized_grad disabled: dataset exceeds the "
                         "int32 histogram accumulator bound (~16.9M rows)")
+        if self.hist_precision == "tiered" and not self.use_quant:
+            Log.warning(
+                "hist_precision=tiered unavailable here (the quantized "
+                "accumulation tier needs a Pallas-capable single-device "
+                "setup); accumulating float32 for this run")
         # quantized frontier kernels rebuild the bin one-hot in VMEM
         # from the packed bins (~G bytes/row of HBM traffic instead of
         # the G*B-byte streamed one-hot) — the cheapest formulation
@@ -572,13 +605,19 @@ class TreeGrower:
                 hk = "xla"
             TELEMETRY.gauge("grower.hist_kernel", hk)
             TELEMETRY.gauge("grower.quantized", int(self.use_quant))
+            TELEMETRY.gauge("grower.hist_precision",
+                            "tiered" if self.use_quant else "f32")
             # resolved device bin-matrix footprint: rows_padded x
             # storage byte columns — THE gauge the compact-bins
-            # acceptance measures (<= 0.55x of 8-bit at max_bin=15)
+            # acceptance measures (<= 0.55x of 8-bit at max_bin=15,
+            # <= 0.30x for a fully crumb-packed 2-bit matrix)
             TELEMETRY.gauge("bin_matrix_bytes",
                             int(np.prod(self.bins.shape)))
+            from ..packing import spec_crumb, spec_packed
             TELEMETRY.gauge("grower.bin_packed_groups",
-                            int(self.pack_P))
+                            spec_packed(self.pack_P))
+            TELEMETRY.gauge("grower.bin_crumb_groups",
+                            spec_crumb(self.pack_P))
             TELEMETRY.gauge("grower.split_finder_ladder",
                             int(self.split_ladder))
             TELEMETRY.gauge("grower.frontier_width", int(self.frontier))
@@ -734,6 +773,12 @@ class TreeGrower:
     def _hist_kernel_impl(self, grad, hess, counts, leaf_id, slots=None,
                           num_leaves=None, quant=None):
         L = self.num_leaves if num_leaves is None else num_leaves
+        if quant is not None and TELEMETRY.on:
+            # trace-time accounting (the _note_collective pattern):
+            # every quantized histogram pass ends in an f32 dequantize
+            # fix-up before split finding — inside jit this counts
+            # once per trace, i.e. "fix-up passes per compiled step"
+            TELEMETRY.add("hist_quant_fixup", 1)
         if quant is not None and self.use_tiled:
             return self._hist_kernel_q_tiled(leaf_id, slots, quant)
         if quant is not None and self.use_quant_otf:
@@ -807,7 +852,15 @@ class TreeGrower:
                 compute_dtype=self.config.hist_compute_dtype,
                 chunk=chunk_local, slots=sl,
                 packed_groups=self.pack_P)
-            return jax.lax.psum(local, axis)
+            # per-pass cross-shard sum under the hist_exchange codec
+            # (parallel/collectives.py): "f32" lowers to the exact
+            # legacy psum; "q16"/"q8" ship delta-coded integers and
+            # reconstruct the f32 histogram here, BEFORE the
+            # FixHistogram / parent-subtraction step downstream
+            from ..parallel.collectives import exchange_histograms
+            return exchange_histograms(local, axis,
+                                       mode=self.hist_exchange,
+                                       world=int(nshards))
 
         if slots is None:
             slots = jnp.arange(L, dtype=jnp.int32)
@@ -865,6 +918,9 @@ class TreeGrower:
         W = rights.shape[0]
         ohb = self._ohb_arg if self._ohb_arg is not None else self.ohb
         if quant is not None:
+            if TELEMETRY.on:
+                # per-trace fix-up accounting (see _hist_kernel_impl)
+                TELEMETRY.add("hist_quant_fixup", 1)
             wT, scales, q = quant[0], quant[1], True    # (3, N) int32
         else:
             wT = jnp.stack([grad, hess, counts], axis=0)

@@ -33,20 +33,23 @@ from .partition import (MISSING_NAN, MISSING_ZERO, ROUTE_FIXED_COLS,
                         packed_select_params)
 
 # ---------------------------------------------------------------------------
-# Nibble-packed bin-matrix support (lightgbm_tpu/packing.py layout):
-# the storage matrix carries the first ``packed_groups`` logical groups
-# two-per-byte (group 2j in the low nibble of storage byte j, 2j+1 in
-# the high nibble) followed by one byte per wide group.  Every kernel
-# that reads bins takes a static ``packed_groups`` (0 = legacy 8-bit
-# matrix, which keeps the EXACT pre-packing lowering) and widens
-# nibbles in-register — shift+mask VPU ops — so HBM only ever streams
-# the packed bytes.
+# Sub-byte-packed bin-matrix support (lightgbm_tpu/packing.py layout):
+# the storage matrix carries the first ``C`` logical groups four-per-
+# byte (2-bit crumbs), groups ``C..P`` two-per-byte (group C+2j in the
+# low nibble of its storage byte, C+2j+1 in the high nibble), followed
+# by one byte per wide group.  Every kernel that reads bins takes a
+# static ``packed_groups`` PACK SPEC (``packing.pack_spec(P, C)`` —
+# numerically the plain packed-group count when there is no crumb
+# section; 0 = legacy 8-bit matrix, which keeps the EXACT pre-packing
+# lowering) and widens crumbs/nibbles in-register — shift+mask VPU
+# ops — so HBM only ever streams the packed bytes.
 # ---------------------------------------------------------------------------
 
 
-# layout arithmetic lives in packing.py (the one home for the nibble
+# layout arithmetic lives in packing.py (the one home for the packed
 # layout); re-exported here so kernel call sites and tests use one name
-from ..packing import logical_groups, packed_bytes  # noqa: F401
+from ..packing import (logical_groups, packed_bytes, spec_crumb,  # noqa: F401
+                       spec_packed)
 from ..packing import storage_cols as packed_cols  # noqa: F401
 
 
@@ -54,33 +57,52 @@ def unpack_bins_cols(bins: jax.Array, *, num_groups: int,
                      packed_groups: int) -> jax.Array:
     """(n, cols) storage block -> (n, G) logical bins (XLA form — the
     Pallas kernels widen per-row/per-tile instead; see _bin_row_T).
-    Identity when ``packed_groups`` is 0."""
+    ``packed_groups`` is the static pack spec; identity when 0."""
     if packed_groups == 0:
         return bins
+    P, C = spec_packed(packed_groups), spec_crumb(packed_groups)
+    cb = (C + 3) // 4
     pb = packed_bytes(packed_groups)
-    pk = bins[:, :pb].astype(jnp.int32)
-    lo = pk & 15
-    hi = (pk >> 4) & 15
-    inter = jnp.stack([lo, hi], axis=2).reshape(
-        bins.shape[0], 2 * pb)[:, :packed_groups]
+    parts = []
+    if C:
+        ck = bins[:, :cb].astype(jnp.int32)
+        planes = [(ck >> (2 * k)) & 3 for k in range(4)]
+        parts.append(jnp.stack(planes, axis=2).reshape(
+            bins.shape[0], 4 * cb)[:, :C])
+    if P > C:
+        pk = bins[:, cb:pb].astype(jnp.int32)
+        lo = pk & 15
+        hi = (pk >> 4) & 15
+        parts.append(jnp.stack([lo, hi], axis=2).reshape(
+            bins.shape[0], 2 * (pb - cb))[:, :P - C])
     wide = bins[:, pb:].astype(jnp.int32)
-    out = jnp.concatenate([inter, wide], axis=1) if wide.shape[1] \
-        else inter
+    if wide.shape[1]:
+        parts.append(wide)
+    out = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
     return out.astype(bins.dtype)
 
 
 def _bin_row_T(binb, g: int, packed_groups: int):
     """Logical group ``g``'s (1, C) bin row out of a TRANSPOSED
     (storage_rows, C) int32 block — a static slice plus a static
-    nibble shift/mask; the Mosaic-friendly per-group access the tiled
-    kernels are built from."""
-    if packed_groups and g < packed_groups:
-        r = binb[g // 2:g // 2 + 1, :]
-        if g % 2:
+    crumb/nibble shift/mask; the Mosaic-friendly per-group access the
+    tiled kernels are built from.  ``packed_groups`` is the static
+    pack spec."""
+    P, C = spec_packed(packed_groups), spec_crumb(packed_groups)
+    if packed_groups and g < C:
+        r = binb[g // 4:g // 4 + 1, :]
+        sh = 2 * (g % 4)
+        if sh:
+            r = r >> sh
+        return r & 3
+    if packed_groups and g < P:
+        cb = (C + 3) // 4
+        r = binb[cb + (g - C) // 2:cb + (g - C) // 2 + 1, :]
+        if (g - C) % 2:
             r = r >> 4
         return r & 15
     j = g if not packed_groups \
-        else packed_bytes(packed_groups) + (g - packed_groups)
+        else packed_bytes(packed_groups) + (g - P)
     return binb[j:j + 1, :]
 
 
@@ -400,6 +422,34 @@ def _hist_kernel_body_q(bins_ref, wq_ref, leaf_ref, emat_ref, bcol_ref,
         preferred_element_type=jnp.int32)
 
 
+#: int32 histogram-accumulator headroom: quantized weights are int8
+#: (|q| <= 127), so a bin that swallowed every row accumulates at most
+#: N * 127 — the bound every quantized-path selector shares.
+QUANT_WEIGHT_MAX = 127
+
+
+def quant_rows_ok(n_rows: int) -> bool:
+    """True when ``n_rows`` rows can NEVER overflow the int32 quantized
+    histogram accumulator (``n_rows * 127 < 2^31``, ~16.9M rows)."""
+    return int(n_rows) * QUANT_WEIGHT_MAX < 2 ** 31
+
+
+def check_quant_rows(n_rows: int, what: str = "quantized histogram"
+                     ) -> None:
+    """Loud kernel-plan-time form of the :func:`quantize_gradients`
+    caller contract: raises when ``n_rows`` could overflow the int32
+    accumulator.  Shared by the grower's ``use_quant`` gate and the
+    ``hist_precision`` tier selector so the bound lives in ONE place
+    next to the kernel it protects."""
+    if not quant_rows_ok(n_rows):
+        raise ValueError(
+            f"{what}: {int(n_rows)} rows can overflow the int32 "
+            f"histogram accumulator (requires rows * "
+            f"{QUANT_WEIGHT_MAX} < 2^31, i.e. <= "
+            f"{(2 ** 31 - 1) // QUANT_WEIGHT_MAX} rows); use "
+            "hist_precision=f32 or shard the rows")
+
+
 def quantize_gradients(grad: jax.Array, hess: jax.Array, counts: jax.Array,
                        key=None):
     """Per-channel symmetric int8 quantization (one scale per tree).
@@ -452,7 +502,9 @@ def compute_group_histograms_pallas_q(
     output with the per-channel scales.
 
     Caller contract: N * 127 must stay below 2^31 (int32 accumulator;
-    ~16.9M rows) — the grower gates use_quant accordingly."""
+    ~16.9M rows) — checked loudly at kernel-plan time via
+    :func:`check_quant_rows`, which the grower's use_quant gate and
+    the hist_precision tier selector both call."""
     num_groups = bins.shape[1]
     num_leaves, m_leaf, m_pad, slot_row = _slot_prep(num_leaves, slots)
     int8_bins = max_group_bin <= 127

@@ -37,7 +37,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..packing import logical_groups, packed_bytes
+from ..packing import logical_groups, packed_bytes, spec_crumb, spec_packed
 
 MISSING_NONE = 0
 MISSING_ZERO = 1
@@ -195,17 +195,32 @@ def extend_table_with_values(table: jax.Array,
 
 
 def packed_select_params(grp, packed_groups: int):
-    """Storage-byte index, nibble shift and width mask for logical
-    group ids ``grp`` (any int32 array) under the packing.py layout —
-    the ONE jnp form of ``BinLayout.byte_of/shift_of/width_mask``,
-    shared by every device gather site (``apply_route_table`` here,
+    """Storage-byte index, crumb/nibble shift and width mask for
+    logical group ids ``grp`` (any int32 array) under the packing.py
+    layout — the ONE jnp form of
+    ``BinLayout.byte_of/shift_of/width_mask``, shared by every device
+    gather site (``apply_route_table`` here,
     ``ops/predict.predict_binned``, ``ops/histogram
-    _route_prologue_T``).  Extract with ``(byte >> shift) & mask``."""
+    _route_prologue_T``).  ``packed_groups`` is the static pack spec
+    (plain P when crumb-free — the legacy two-way select below is then
+    emitted unchanged).  Extract with ``(byte >> shift) & mask``."""
+    P, C = spec_packed(packed_groups), spec_crumb(packed_groups)
     pb = packed_bytes(packed_groups)
-    is_p = grp < packed_groups
-    byte_idx = jnp.where(is_p, grp // 2, pb + grp - packed_groups)
-    shift = jnp.where(is_p, (grp % 2) * 4, 0)
-    mask = jnp.where(is_p, 15, 255)
+    if C == 0:
+        is_p = grp < P
+        byte_idx = jnp.where(is_p, grp // 2, pb + grp - P)
+        shift = jnp.where(is_p, (grp % 2) * 4, 0)
+        mask = jnp.where(is_p, 15, 255)
+        return byte_idx, shift, mask
+    cb = (C + 3) // 4
+    is_c = grp < C
+    is_n = jnp.logical_and(grp >= C, grp < P)
+    byte_idx = jnp.where(
+        is_c, grp // 4,
+        jnp.where(is_n, cb + (grp - C) // 2, pb + grp - P))
+    shift = jnp.where(is_c, (grp % 4) * 2,
+                      jnp.where(is_n, ((grp - C) % 2) * 4, 0))
+    mask = jnp.where(is_c, 3, jnp.where(is_n, 15, 255))
     return byte_idx, shift, mask
 
 

@@ -174,6 +174,142 @@ class HostCollectives(Collectives):
             phase="host_collective", seam="collectives.allgather")
 
 
+# ---------------------------------------------------------------------------
+# Compressed histogram exchange (Config.hist_exchange): the data-
+# parallel per-pass histogram psum is the largest recurring ICI
+# payload (the MULTICHIP gate's byte window), and histogram bins are
+# SMOOTH along the bin axis — neighboring bins hold similar mass — so
+# a delta code along bins concentrates values near zero and a shared
+# per-(leaf, group, channel) scale quantizes the deltas to int16/int8
+# at bounded reconstruction error.  Delta-coding is linear, so it
+# COMMUTES with the cross-shard sum: shards quantize against one
+# pmax'd scale, psum the narrow integers (with world-size headroom so
+# the integer sum can never overflow), and every shard reconstructs
+# the identical f32 histogram by cumsum BEFORE the FixHistogram /
+# parent-subtraction step.
+# ---------------------------------------------------------------------------
+HIST_EXCHANGE_MODES = ("f32", "q16", "q8")
+
+
+def _exchange_qparams(mode: str, world: int):
+    """(qmax, int dtype) for a codec mode: the quantization ceiling
+    leaves ``world``-way summation headroom inside the wire dtype."""
+    bits = 16 if mode == "q16" else 8
+    qmax = (2 ** (bits - 1) - 1) // max(int(world), 1)
+    if qmax < 1:
+        raise ValueError(
+            f"hist_exchange={mode}: world size {world} leaves no "
+            f"quantization levels inside int{bits}; use "
+            + ("hist_exchange=q16 or f32" if mode == "q8" else
+               "hist_exchange=f32"))
+    return qmax, (jnp.int16 if mode == "q16" else jnp.int8)
+
+
+def exchange_histograms(hist, axis_name, mode: str = "f32",
+                        world: int = 1):
+    """Cross-shard histogram sum over ``axis_name`` under the
+    ``hist_exchange`` codec.  ``hist`` is the local (L, G, B, 3) f32
+    histogram (bin axis -2); returns the reconstructed f32 global sum
+    on every shard.
+
+    "f32" is the legacy raw psum — identical lowering, byte-identical
+    trees.  "q16"/"q8" ship delta-coded integers plus a tiny f32
+    scale payload; wire bytes land in the
+    ``collective_hist_exchange_bytes`` counter (the int payload) and
+    ``collective_hist_exchange_scale_bytes`` (the scales), so the
+    MULTICHIP gate reads the compressed stream directly."""
+    if mode not in HIST_EXCHANGE_MODES:
+        raise ValueError(f"hist_exchange must be one of "
+                         f"{HIST_EXCHANGE_MODES}, got {mode!r}")
+    if axis_name is None:
+        return hist
+    if mode == "f32":
+        _note_collective("hist_exchange", hist)
+        return jax.lax.psum(hist, axis_name)
+    qmax, qdt = _exchange_qparams(mode, world)
+    first = hist[..., :1, :]
+    delta = jnp.concatenate([first, jnp.diff(hist, axis=-2)], axis=-2)
+    # ONE scale per (leaf, group, channel), shared across shards via
+    # pmax so every shard quantizes against the same grid and the
+    # integer sum dequantizes exactly once.  The non-integrality
+    # residual rides the same pmax payload (bin axis, position 1):
+    # channels whose deltas are integral on EVERY shard and fit qmax
+    # (the count channel always; grad/hess too under the unit-gradient
+    # objectives, e.g. regression_l1) ship verbatim on the unit grid —
+    # the reconstruction is then bit-exact against the f32 psum
+    amax = jnp.max(jnp.abs(delta), axis=-2, keepdims=True)
+    frac = jnp.max(jnp.abs(delta - jnp.round(delta)), axis=-2,
+                   keepdims=True)
+    stat = jax.lax.pmax(jnp.concatenate([amax, frac], axis=-2),
+                        axis_name)
+    amax, frac = stat[..., :1, :], stat[..., 1:, :]
+    _note_collective("hist_exchange_scale", stat)
+    exact = (frac == 0) & (amax <= qmax)
+    denom = jnp.where(exact, jnp.float32(qmax),
+                      jnp.maximum(amax, 1e-30))
+    q = jnp.clip(jnp.round(delta / denom * qmax),
+                 -qmax, qmax).astype(qdt)
+    _note_collective("hist_exchange", q)
+    qsum = jax.lax.psum(q, axis_name)
+    deq = qsum.astype(jnp.float32) * (denom / qmax)
+    return jnp.cumsum(deq, axis=-2)
+
+
+def host_exchange_histograms(per_shard_hists, mode: str = "f32"):
+    """Single-process analog of :func:`exchange_histograms` over
+    caller-provided per-shard numpy histograms — the
+    HostCollectives.simulate_* pattern, so the codec path (and its
+    byte counters) is unit-testable and benchable without devices.
+    Carries the ``collectives.hist_exchange`` fault seam and the
+    collective watchdog deadline exactly like the simulated
+    allgather."""
+    if mode not in HIST_EXCHANGE_MODES:
+        raise ValueError(f"hist_exchange must be one of "
+                         f"{HIST_EXCHANGE_MODES}, got {mode!r}")
+    from ..reliability import watchdog as _watchdog
+    from ..reliability.faults import FAULTS
+
+    def _exchange():
+        FAULTS.fault_point("collectives.hist_exchange")
+        world = len(per_shard_hists)
+        stack = np.stack([np.asarray(a, dtype=np.float32)
+                          for a in per_shard_hists])
+        if mode == "f32":
+            for a in per_shard_hists:
+                _note_collective("hist_exchange", a)
+            return np.sum(stack, axis=0)
+        bits = 16 if mode == "q16" else 8
+        qmax = (2 ** (bits - 1) - 1) // world
+        if qmax < 1:
+            raise ValueError(
+                f"hist_exchange={mode}: world size {world} leaves no "
+                f"quantization levels inside int{bits}")
+        npdt = np.int16 if mode == "q16" else np.int8
+        delta = np.concatenate(
+            [stack[..., :1, :], np.diff(stack, axis=-2)], axis=-2)
+        amax = np.max(np.abs(delta), axis=(0, -2), keepdims=True)[0]
+        # exact-integer fast path (see exchange_histograms): integral
+        # channels that fit qmax ship verbatim on the unit grid
+        frac = np.max(np.abs(delta - np.round(delta)), axis=(0, -2),
+                      keepdims=True)[0]
+        exact = (frac == 0) & (amax <= qmax)
+        denom = np.where(exact, np.float32(qmax),
+                         np.maximum(amax, np.float32(1e-30)))
+        q = np.clip(np.round(delta / denom * qmax),
+                    -qmax, qmax).astype(npdt)
+        stat = np.concatenate([amax, frac], axis=-2)
+        for s in range(world):
+            _note_collective("hist_exchange", q[s])
+            _note_collective("hist_exchange_scale", stat)
+        qsum = np.sum(q.astype(np.int32), axis=0)
+        deq = qsum.astype(np.float32) * (denom / np.float32(qmax))
+        return np.cumsum(deq, axis=-2, dtype=np.float32)
+
+    return _watchdog.run_with_deadline(
+        _exchange, _watchdog.deadline("collective"),
+        phase="host_collective", seam="collectives.hist_exchange")
+
+
 class ExternalCollectives(HostCollectives):
     """User-injected reduce-scatter/allgather callables — the direct
     analog of LGBM_NetworkInitWithFunctions (reference c_api.h:760-762,

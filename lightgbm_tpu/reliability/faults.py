@@ -93,6 +93,14 @@ SEAMS = (
                              # tests/test_continuous.py)
     "distributed.init",      # multi-machine rendezvous / network init
     "collectives.allgather", # host-side collective backend calls
+    "collectives.hist_exchange",  # host-side compressed histogram
+                             # exchange (parallel/collectives.py
+                             # host_exchange_histograms — fires BEFORE
+                             # any shard's histogram is coded or
+                             # summed, so a killed exchange leaves no
+                             # partially-reconstructed histogram; the
+                             # q16/q8 codec and its byte counters ride
+                             # the same entry)
     "sharded.binfind",       # sharded-construct boundary-candidate
                              # collection, once per participant
                              # (sharded/binfind.py — fires BEFORE the

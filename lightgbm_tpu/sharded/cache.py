@@ -168,6 +168,14 @@ def load_shard_cache(cache_dir: str,
                 f"{cache_dir}: shard cache holds 8-bit bin matrices "
                 "but this run asked for bin_packing=4bit — "
                 "reconstruct the cache under bin_packing=4bit")
+        elif want == "2bit" and (man_lay is None
+                                 or man_lay.crumb_groups == 0):
+            raise ShardCacheError(
+                f"{cache_dir}: shard cache holds "
+                + ("8-bit" if man_lay is None else "crumb-free packed")
+                + " bin matrices but this run asked for "
+                "bin_packing=2bit — reconstruct the cache under "
+                "bin_packing=2bit")
 
     cores = []
     for i, rec in enumerate(man["shards"]):

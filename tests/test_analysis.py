@@ -50,7 +50,7 @@ def _prog(name="fixture", jaxpr=None, lowered=None, text=None,
 
 def test_hlo_rules_pass_on_registered_entry_points(analysis_programs):
     ctx = Context(programs=analysis_programs)
-    ids = [f"HLO00{i}" for i in range(1, 9)]
+    ids = [f"HLO00{i}" for i in range(1, 10)]
     findings = run_rules(ids, ctx=ctx, check_suppressions=False)
     assert not unsuppressed(findings), "\n".join(
         f"{f.rule} {f.location()}: {f.message}"
@@ -377,14 +377,14 @@ def test_json_report_schema():
 def test_rule_registry_has_issue_contract():
     run_rules(["CFG001"], Context(sources={}))   # force registration
     ids = set(RULES)
-    expected = {f"HLO00{i}" for i in range(1, 9)} \
+    expected = {f"HLO00{i}" for i in range(1, 10)} \
         | {"TRC001", "TRC002", "CFG001", "CFG002",
            "CARRY001", "TEL001"}
     assert expected <= ids
     for rid in expected:
         assert RULES[rid].title
     # every HLO rule declares the incident it encodes
-    assert all(RULES[f"HLO00{i}"].incident for i in range(1, 9))
+    assert all(RULES[f"HLO00{i}"].incident for i in range(1, 10))
 
 
 def test_rehomed_lints_pass_on_real_repo():

@@ -97,7 +97,15 @@ def test_bench_smoke_json_contract():
                   "bin_matrix_bytes_8bit", "bin_matrix_bytes_4bit",
                   "packing_ratio", "device_packing_ratio",
                   "hist_bytes_per_row_8bit", "hist_bytes_per_row_4bit",
-                  "hist_stream_ratio", "parity"):
+                  "hist_stream_ratio", "parity",
+                  # round-21 crumb tier + compressed exchange fields
+                  "construct_rows_per_s_2bit_mb4",
+                  "host_matrix_bytes_2bit", "bin_matrix_bytes_2bit",
+                  "crumb_packing_ratio", "crumb_predicted_ratio",
+                  "crumb_device_ratio", "hist_bytes_per_row_2bit",
+                  "crumb_stream_ratio", "hist_exchange_bytes_f32",
+                  "hist_exchange_bytes_q16", "hist_exchange_bytes_q8",
+                  "hist_exchange_ratio_q16", "hist_exchange_ratio_q8"):
         assert field in cb, f"compact_bins block missing {field}"
     assert cb["max_bin"] == 15
     assert cb["packing_ratio"] >= 2.0, \
@@ -108,6 +116,14 @@ def test_bench_smoke_json_contract():
         "bin_matrix_bytes gauge must be measured, not defaulted"
     assert cb["bin_matrix_bytes_4bit"] <= \
         0.55 * cb["bin_matrix_bytes_8bit"]
+    # crumb tier: the measured host ratio meets the layout-predicted
+    # G / ceil(G/4) read-stream reduction on the max_bin=4 sub-draw
+    assert cb["crumb_packing_ratio"] >= cb["crumb_predicted_ratio"]
+    assert cb["bin_matrix_bytes_2bit"] > 0
+    # compressed exchange: the wire payload genuinely shrinks 2x / 4x
+    assert cb["hist_exchange_bytes_f32"] > 0
+    assert cb["hist_exchange_ratio_q16"] >= 2.0
+    assert cb["hist_exchange_ratio_q8"] >= 4.0
     assert cb["parity"] == "pass"
     # reliability probe (round 12): checkpoint save overhead measured
     # and the smoke fault-plan recovery (SIGKILL mid-train -> resume)

@@ -398,15 +398,176 @@ def test_wide_single_feature_is_hard_error():
 
 
 # ---------------------------------------------------------------------------
+# crumb tier (2-bit): three-section layout, cache v4, parity
+# ---------------------------------------------------------------------------
+def _crumb_params(**kw):
+    p = {"objective": "binary", "max_bin": 4, "num_iterations": 3,
+         "num_leaves": 6, "min_data_in_leaf": 5, "verbose": -1}
+    p.update(kw)
+    return p
+
+
+def test_crumb_storage_quarters_and_unpacks_exactly():
+    X, y = _data()
+    d8 = CoreDataset.from_matrix(X, label=y, config=Config.from_params(
+        _crumb_params(bin_packing="8bit")))
+    d2 = CoreDataset.from_matrix(X, label=y, config=Config.from_params(
+        _crumb_params(bin_packing="2bit")))
+    lay = d2.bin_layout
+    assert lay is not None
+    assert lay.crumb_groups == lay.packed_groups == d8.num_groups
+    assert d2.group_bins.shape[1] == (d8.num_groups + 3) // 4
+    assert np.array_equal(d2.logical_group_bins(), d8.group_bins)
+    assert np.all(lay.unpack_rows(np.asarray(d2.group_bins)) < 4)
+
+
+def test_auto_mode_three_section_layout():
+    # 2 crumb-narrow features (<= 4 bins) + 2 nibble-narrow (+ rounding
+    # to ~8 values) + 2 continuous wide ones under max_bin=255
+    X, y = _data(n=1200)
+    X = np.concatenate([np.round(X[:, :2] * 2) / 2,
+                        np.round(X[:, 2:4] * 7) / 7, X[:, 4:]], axis=1)
+    cfg = _base_params(max_bin=255, bin_packing="auto")
+    da = CoreDataset.from_matrix(X, label=y,
+                                 config=Config.from_params(cfg))
+    lay = da.bin_layout
+    assert lay is not None
+    assert 0 < lay.crumb_groups < lay.packed_groups < da.num_groups
+    widths = da.group_num_bin
+    assert all(w <= 4 for w in widths[:lay.crumb_groups])
+    assert all(4 < w <= 16 for w in
+               widths[lay.crumb_groups:lay.packed_groups])
+    assert all(w > 16 for w in widths[lay.packed_groups:])
+    # same trees despite the three-section group reorder
+    ta = _train_text(cfg, X, y)
+    t8 = _train_text(dict(cfg, bin_packing="8bit"), X, y)
+    assert _strip(ta) == _strip(t8)
+
+
+def test_crumb_tree_parity_all_routes(tmp_path):
+    from lightgbm_tpu.sharded import ShardedDataset
+    X, y = _data(n=1000)
+    p8 = _crumb_params(bin_packing="8bit")
+    p2 = _crumb_params(bin_packing="2bit")
+    t8 = _train_text(p8, X, y)
+    # in-RAM route
+    assert _strip(_train_text(p2, X, y)) == _strip(t8)
+    # streaming route: chunked CSV ingest emits the packed matrix
+    # natively and matches the in-RAM bytes
+    csv = tmp_path / "d.csv"
+    np.savetxt(csv, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    din = CoreDataset.from_matrix(X, label=y,
+                                  config=Config.from_params(p2))
+    ds = lgb.Dataset(str(csv), params=dict(
+        p2, label_column="0", use_two_round_loading=True,
+        streaming_chunk_rows=256)).construct()
+    assert ds.bin_layout is not None and ds.bin_layout.crumb_groups > 0
+    assert np.array_equal(np.asarray(ds.group_bins), din.group_bins)
+    # sharded-construct route assembles the same packed matrix
+    sds = ShardedDataset.construct_sharded(
+        X, label=y, config=Config.from_params(
+            dict(p2, sharded_shards=3)))
+    assert sds.bin_layout is not None and sds.bin_layout.crumb_groups > 0
+    assert np.array_equal(sds.assembled_group_bins(), din.group_bins)
+
+
+def test_crumb_binary_cache_v4_roundtrip_and_refusal(tmp_path):
+    import pickle
+    import struct
+
+    from lightgbm_tpu.dataset_io import (BINARY_TOKEN, MAGIC_V2,
+                                         load_binary, save_binary)
+    X, y = _data()
+    cfg2 = Config.from_params(_crumb_params(bin_packing="2bit"))
+    cfg8 = Config.from_params(_crumb_params(bin_packing="8bit"))
+    d2 = CoreDataset.from_matrix(X, label=y, config=cfg2)
+    d8 = CoreDataset.from_matrix(X, label=y, config=cfg8)
+    f2, f8 = str(tmp_path / "d2.bin"), str(tmp_path / "d8.bin")
+    save_binary(d2, f2)
+    save_binary(d8, f8)
+    # crumb-carrying cache: layout + bytes round-trip exactly
+    r2 = load_binary(f2, config=cfg2)
+    assert r2.bin_layout.to_state() == d2.bin_layout.to_state()
+    assert r2.bin_layout.crumb_groups > 0
+    assert np.array_equal(np.asarray(r2.group_bins), d2.group_bins)
+    # explicit 2-bit intent over an 8-bit cache refuses loudly
+    with pytest.raises(LightGBMError, match="bin_packing=2bit"):
+        load_binary(f8, config=cfg2)
+    # ... and over a crumb-FREE packed cache too (a nibble matrix is
+    # not a crumb matrix; reinterpreting it would mis-bin)
+    cfg4 = Config.from_params(_base_params(bin_packing="4bit"))
+    f4 = str(tmp_path / "d4.bin")
+    save_binary(CoreDataset.from_matrix(X, label=y, config=cfg4), f4)
+    with pytest.raises(LightGBMError, match="bin_packing=2bit"):
+        load_binary(f4, config=Config.from_params(
+            _base_params(bin_packing="2bit", max_bin=4)))
+    # crumb matrices bump the header to v4 (a pre-crumb reader refuses
+    # instead of silently mis-binning); crumb-free files stay v3/v2
+    def _version(path):
+        with open(path, "rb") as f:
+            f.read(len(BINARY_TOKEN) + len(MAGIC_V2))
+            (blob_len,) = struct.unpack("<Q", f.read(8))
+            return pickle.loads(f.read(blob_len))["version"]
+
+    assert _version(f2) == 4
+    assert _version(f4) == 3
+    assert _version(f8) == 2
+
+
+def test_crumb_shard_cache_refusal(tmp_path):
+    from lightgbm_tpu.sharded import (ShardCacheError, ShardedDataset,
+                                      load_shard_cache, save_shard_cache)
+    X, y = _data(n=900)
+    cfg4 = Config.from_params(_base_params(bin_packing="4bit",
+                                           sharded_shards=2))
+    save_shard_cache(ShardedDataset.construct_sharded(
+        X, label=y, config=cfg4), str(tmp_path / "shards4"))
+    with pytest.raises(ShardCacheError, match="bin_packing=2bit"):
+        load_shard_cache(str(tmp_path / "shards4"), expect_world_size=2,
+                         config=Config.from_params(_crumb_params(
+                             bin_packing="2bit", sharded_shards=2)))
+
+
+def test_crumb_wide_single_feature_is_hard_error():
+    # a categorical feature can out-grow a crumb even at max_bin<=4;
+    # 2bit must refuse loudly naming the feature (auto keeps it wide)
+    rng = np.random.RandomState(5)
+    X = rng.rand(600, 4)
+    X[:, 2] = rng.randint(0, 9, 600)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    with pytest.raises(LightGBMError, match="Column_2"):
+        CoreDataset.from_matrix(
+            X, label=y, config=Config.from_params(_crumb_params(
+                bin_packing="2bit")),
+            categorical_features=[2])
+    da = CoreDataset.from_matrix(
+        X, label=y, config=Config.from_params(_crumb_params(
+            bin_packing="auto")),
+        categorical_features=[2])
+    lay = da.bin_layout
+    # auto keeps the over-wide categorical OUT of the crumb section
+    # (it still fits a nibble, so the whole matrix stays packed)
+    assert lay is not None and lay.crumb_groups < da.num_groups
+    assert da.group_num_bin[lay.crumb_groups] > 4
+
+
+# ---------------------------------------------------------------------------
 # validation
 # ---------------------------------------------------------------------------
 def test_config_validation():
-    with pytest.raises(ValueError, match="bin_packing"):
-        Config.from_params({"bin_packing": "2bit"})
+    with pytest.raises(ValueError, match="max_bin <= 4"):
+        Config.from_params({"bin_packing": "2bit"})   # default max_bin
     with pytest.raises(ValueError, match="max_bin <= 16"):
         Config.from_params({"bin_packing": "4bit", "max_bin": 63})
     # the 8-bit message is packing-aware now
-    with pytest.raises(ValueError, match="bin_packing=4bit/auto"):
+    with pytest.raises(ValueError, match="bin_packing=4bit/2bit/auto"):
         Config.from_params({"max_bin": 300})
     Config.from_params({"bin_packing": "4bit", "max_bin": 16})
+    Config.from_params({"bin_packing": "2bit", "max_bin": 4})
     Config.from_params({"bin_packing": "auto", "max_bin": 255})
+    # round-21 knobs: histogram accumulation precision + exchange codec
+    with pytest.raises(ValueError, match="hist_precision"):
+        Config.from_params({"hist_precision": "f16"})
+    with pytest.raises(ValueError, match="hist_exchange"):
+        Config.from_params({"hist_exchange": "q4"})
+    Config.from_params({"hist_precision": "tiered", "hist_exchange": "q8"})

@@ -1159,6 +1159,121 @@ def run_shard_construct(params):
     }
 
 
+_DIST_EXCHANGE_WORKER = r"""
+import json, os, sys, time
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+leaves, groups, bins, reps = (int(a) for a in sys.argv[4:8])
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel import transport as T
+from lightgbm_tpu.parallel.collectives import host_exchange_histograms
+from lightgbm_tpu.telemetry import TELEMETRY
+cfg = Config.from_params({"verbose": -1, "collective_transport": "tcp"})
+tp = T.TcpTransport.create(coord, nproc, pid, config=cfg)
+T.install(tp)
+rng = np.random.RandomState(7 + pid)
+hist = np.round(rng.randn(leaves, groups, bins, 3)
+                .astype(np.float32) * 100, 3)
+# every rank holds ALL shards too, purely to pin the TCP result
+# bit-exact against the host codec on the same inputs
+shards = np.stack(tp.allgather_obj(hist), axis=0)
+TELEMETRY.configure("counters")
+out = {}
+for mode in ("f32", "q16", "q8"):
+    TELEMETRY.reset()
+    t0 = time.time()
+    for _ in range(reps):
+        res = tp.exchange_histograms(hist, mode)
+    wall = (time.time() - t0) / reps
+    ref = host_exchange_histograms(shards, mode)
+    if not np.array_equal(res, ref):
+        raise SystemExit(f"hist_exchange {mode} over TCP is not "
+                         "bit-exact vs the host codec")
+    c = TELEMETRY.counters()
+    out[mode] = {
+        "payload_wire_bytes":
+            int(c.get("collective_tcp_hist_exchange_bytes", 0)) // reps,
+        "scale_wire_bytes":
+            int(c.get("collective_tcp_hist_scale_bytes", 0)) // reps,
+        "total_wire_bytes":
+            int(c.get("collective_tcp_bytes", 0)) // reps,
+        "rounds": int(c.get("collective_tcp_rounds", 0)) // reps,
+        "wall_ms": round(wall * 1e3, 2),
+    }
+tp.close()
+if pid == 0:
+    print(json.dumps(out))
+"""
+
+
+def run_distributed_exchange(params):
+    """Distributed-exchange roofline point (this round): the r21
+    hist_exchange codec over the REAL host-side TCP transport — two
+    processes, real sockets — reporting per-mode wire bytes from the
+    ``collective_tcp_*`` per-primitive counters and gating the q16
+    payload at >=2x (q8 >=4x) the f32 wire frames, every mode pinned
+    bit-exact against ``host_exchange_histograms`` inside the workers.
+
+    Two honest byte views: ``payload`` counts the frames that carry
+    histogram data (f32 allgather vs the int16/int8 ring); ``total``
+    adds the q-modes' one pmax scale-sync round.  At world=2 the ring
+    and the allgather both move the whole array once, so the total
+    ratio reads just under the dtype ratio — it grows toward
+    world_size at larger worlds, where the f32 allgather pays
+    (P-1) full copies and the integer ring stays ~2 copies."""
+    import socket
+    import subprocess
+
+    leaves = int(os.environ.get("BENCH_DIST_LEAVES", 31))
+    groups = int(os.environ.get("BENCH_DIST_GROUPS", 28))
+    bins = int(os.environ.get("BENCH_DIST_BINS", 64))
+    reps = int(os.environ.get("BENCH_DIST_REPS", 3))
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    coord = f"localhost:{s.getsockname()[1]}"
+    s.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _DIST_EXCHANGE_WORKER, coord, "2",
+         str(i), str(leaves), str(groups), str(bins), str(reps)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            o, e = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise SystemExit("distributed_exchange bench hung")
+        if p.returncode != 0:
+            raise SystemExit(
+                f"distributed_exchange worker failed: {e[-1500:]}")
+        outs.append(o)
+    modes = json.loads(outs[0].strip().splitlines()[-1])
+    ratio16 = modes["f32"]["payload_wire_bytes"] \
+        / max(modes["q16"]["payload_wire_bytes"], 1)
+    ratio8 = modes["f32"]["payload_wire_bytes"] \
+        / max(modes["q8"]["payload_wire_bytes"], 1)
+    if ratio16 < 2.0 or ratio8 < 4.0:
+        raise SystemExit(
+            f"distributed_exchange wire gate failed: q16 {ratio16:.2f}x"
+            f" (need >=2.0), q8 {ratio8:.2f}x (need >=4.0) vs f32")
+    return {
+        "task": "distributed_exchange", "world": 2,
+        "hist_shape": [leaves, groups, bins, 3],
+        "modes": modes,
+        "wire_ratio_q16": round(ratio16, 2),
+        "wire_ratio_q8": round(ratio8, 2),
+        "total_wire_ratio_q16": round(
+            modes["f32"]["total_wire_bytes"]
+            / max(modes["q16"]["total_wire_bytes"], 1), 2),
+        "parity": "pass",
+        "wire_gate": "pass",
+    }
+
+
 def run_compact_bins(params, rows=None):
     """Sub-byte packed bin matrix roofline point (round 18, ROADMAP
     item 4): the nibble-packed (bin_packing=4bit) pipeline measured
@@ -1841,6 +1956,17 @@ def main():
         else:
             shard_block = {"task": "shard_construct", "rows": s_rows,
                            "skipped": note}
+    dist_block = None
+    if os.environ.get("BENCH_DIST", "1") != "0":
+        # two CPU-pinned worker interpreters + three tiny exchanges:
+        # the wall is import-dominated (~20 s on one core), not
+        # data-dependent
+        note = admit("distributed_exchange", 60.0)
+        if note is None:
+            dist_block = run_distributed_exchange(params)
+        else:
+            dist_block = {"task": "distributed_exchange",
+                          "skipped": note}
     compact_block = None
     if os.environ.get("BENCH_COMPACT", "1") != "0":
         cb_rows = int(os.environ.get("BENCH_COMPACT_ROWS",
@@ -1904,6 +2030,11 @@ def main():
         # shard-cache round trip — parity-gated against the
         # single-matrix construction inside the block
         result["shard_construct"] = shard_block
+    if dist_block is not None:
+        # the TCP distributed-exchange block (this round): per-mode
+        # wire bytes over real sockets, q16/q8 payload-reduction gates
+        # and host-codec bit-exactness — all enforced inside the block
+        result["distributed_exchange"] = dist_block
     if compact_block is not None:
         # the sub-byte packed-bin block (round 18): construct rows/s
         # per bin width, host + gauge-measured device matrix bytes,

@@ -744,6 +744,7 @@ class GBDT:
         self._nl_count += n_iters
         self.iter_ += n_iters
         self.timer.stop("tree")
+        self._transport_epoch_tick()
         if self._nl_count >= self._stop_check_every:
             return self._check_stop_window()
         return False
@@ -893,9 +894,47 @@ class GBDT:
         self._after_iteration()
         self.iter_ += 1
         self.timer.stop("tree")
+        self._transport_epoch_tick()
         if self._nl_count >= self._stop_check_every:
             return self._check_stop_window()
         return False
+
+    # ------------------------------------------------------------------
+    def _transport_epoch_tick(self) -> None:
+        """Elastic-membership epoch boundary (the WorldLedger protocol,
+        parallel/transport.py): with a TCP transport active, every
+        ``transport_epoch_iters`` completed iterations all participants
+        tick the coordinator — dead peers retire (degraded continuation
+        per ``sharded_allow_degraded``), and waiting joiners are
+        admitted with this model's captured state as handoff (the r12
+        byte-identical-resume snapshot: a joiner restoring it trains
+        the exact iterations the world trains next).  Strictly BETWEEN
+        iterations, so a collective can never race a membership
+        change; with an unchanged world the tick is one tiny control
+        round."""
+        from ..parallel import transport as _transport
+        tp = _transport.active()
+        if tp is None or tp.world_size < 1:
+            return
+        if self.iter_ % max(1, tp.epoch_every) != 0:
+            return
+
+        def _handoff() -> bytes:
+            import pickle as _pickle
+            state, _stopped = self.capture_state()
+            return _pickle.dumps(state, protocol=4)
+
+        info = tp.epoch_tick(
+            handoff=_handoff,
+            allow_degraded=bool(getattr(self.config,
+                                        "sharded_allow_degraded",
+                                        False)))
+        if info.get("changed"):
+            Log.warning(
+                f"transport epoch {info['epoch']}: world is now "
+                f"{info['world_size']} (dead={info['dead']}, "
+                f"admitted={info['admitted']}) — training continues "
+                "on the reformed membership")
 
     # ------------------------------------------------------------------
     def _train_one_iter_custom(self, grad, hess) -> bool:
@@ -955,6 +994,7 @@ class GBDT:
         self._nl_window.append(nl)
         self._after_iteration()
         self.iter_ += 1
+        self._transport_epoch_tick()
         if len(self._nl_window) >= self._stop_check_every:
             return self._check_stop_window()
         return False

@@ -367,6 +367,22 @@ class Config:
     time_out: int = 120
     machine_list_file: str = ""
     machines: str = ""
+    collective_transport: str = "auto"  # cross-process collective
+    # backend: "xla" runs jax.distributed + cross-process XLA
+    # collectives (pods); "tcp" runs the host-side TCP transport
+    # (parallel/transport.py — the Linker analog: coordinator
+    # rendezvous, persistent peer sockets, Bruck allgather + ring
+    # allreduce over numpy buffers); "auto" picks tcp exactly when a
+    # multi-process world is requested and cross-process XLA
+    # collectives are unavailable (the CPU backend), xla otherwise
+    # (docs/Parallel-Learning-Guide.md transport-selection matrix)
+    transport_epoch_iters: int = 1  # boosting iterations between
+    # elastic-membership epoch boundaries when a TCP transport is
+    # active: every N iterations all participants tick the WorldLedger
+    # coordinator, dead peers retire (degraded continuation per
+    # sharded_allow_degraded), and waiting joiners are admitted with a
+    # state + shard-cache handoff.  1 = a boundary after every
+    # iteration (fastest re-join, one tiny control round each)
 
     # -- tpu-specific (new; no reference analog) --
     hist_compute_dtype: str = "float32"  # one-hot matmul input dtype
@@ -804,6 +820,9 @@ class Config:
     # — the Network time_out analog for every collective op; with
     # sharded_allow_degraded=true a participant stalled past it is
     # EXCLUDED and construction continues on the surviving world.
+    # When a TCP transport is active the deadline also arms PER
+    # communication round (parallel/transport.py): a hung peer bounds
+    # that round's socket waits and surfaces a retryable StallError.
     # 0 = unbounded
     watchdog_checkpoint_s: float = 0.0  # deadline on checkpoint/
     # ledger file IO (atomic writes + checkpoint reads): a wedged
@@ -892,6 +911,14 @@ class Config:
         if str(self.hist_exchange).lower() not in ("f32", "q16", "q8"):
             raise ValueError("hist_exchange must be f32/q16/q8, got "
                              f"{self.hist_exchange!r}")
+        if str(self.collective_transport).lower() not in (
+                "auto", "xla", "tcp"):
+            raise ValueError("collective_transport must be "
+                             "auto/xla/tcp, got "
+                             f"{self.collective_transport!r}")
+        if self.transport_epoch_iters < 1:
+            raise ValueError("transport_epoch_iters must be >= 1, got "
+                             f"{self.transport_epoch_iters}")
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError(f"num_class must be >= 2 for {self.objective}")
         if self.objective not in ("multiclass", "multiclassova") and self.num_class != 1:

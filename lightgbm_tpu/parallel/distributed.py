@@ -38,9 +38,33 @@ def initialize(coordinator_address: Optional[str] = None,
     Transient rendezvous failures (coordinator still starting, DNS
     races) retry with bounded backoff under the config's retry policy
     and the reference ``time_out`` budget — the ``distributed.init``
-    seam in the fault harness (docs/RELIABILITY.md)."""
+    seam in the fault harness (docs/RELIABILITY.md).
+
+    ``Config.collective_transport`` selects the collective plane:
+    ``xla`` rendezvouses through ``jax.distributed`` (cross-process
+    XLA collectives, pods); ``tcp`` builds the host-side TCP transport
+    (``parallel/transport.py``) instead — no ``jax.distributed`` at
+    all, so multi-process training works on the CPU backend; ``auto``
+    picks tcp exactly when cross-process XLA collectives are
+    unavailable (docs/Parallel-Learning-Guide.md)."""
     from ..reliability.faults import FAULTS
     from ..reliability.retry import RetryPolicy, retry_call
+    from . import transport as _transport
+
+    mode = _transport.resolve_transport_mode(config, num_processes)
+    if mode == "tcp" and (num_processes or 1) > 1:
+        if coordinator_address is None or process_id is None:
+            raise ValueError(
+                "collective_transport=tcp needs an explicit "
+                "coordinator_address, num_processes and process_id "
+                "(no cluster auto-detection on the host-side plane)")
+        tp = _transport.TcpTransport.create(
+            coordinator_address, int(num_processes), int(process_id),
+            config=config)
+        _transport.install(tp)
+        from ..telemetry import TELEMETRY
+        TELEMETRY.mark_sync("rendezvous")
+        return
 
     def _init():
         FAULTS.fault_point("distributed.init")
@@ -70,9 +94,8 @@ def sample_local_rows(local_data: np.ndarray, sample_cnt: int,
     validity column is 0 (dropped after the gather).  Each host uses a
     DIFFERENT derived seed so the combined sample isn't biased toward
     identical row positions."""
-    import jax
     n, f = local_data.shape
-    rng = np.random.RandomState(seed + 7919 * jax.process_index())
+    rng = np.random.RandomState(seed + 7919 * _process_index())
     out = np.zeros((sample_cnt, f + 1), dtype=np.float64)
     take = min(n, sample_cnt)
     if n <= sample_cnt:
@@ -114,6 +137,12 @@ def _allgather(arr: np.ndarray) -> np.ndarray:
 
     def _gather() -> np.ndarray:
         FAULTS.fault_point("collectives.allgather")
+        from . import transport as _transport
+        tp = _transport.active()
+        if tp is not None:
+            # host-side TCP plane: the Bruck allgather returns the
+            # same stacked (P, *shape) array the XLA path does
+            return tp.allgather(arr)
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.process_allgather(arr))
 
@@ -157,20 +186,35 @@ def construct_sharded(local_data: np.ndarray, label=None, weight=None,
     """
     from ..data_loader import split_sample_columns
     from ..dataset import Dataset as CoreDataset
+    from . import transport as _transport
     config = config or Config()
     local_data = np.asarray(local_data, dtype=np.float64)
-    local_sample = sample_local_rows(
-        local_data, max(1, config.bin_construct_sample_cnt //
-                        max(1, _num_processes())),
-        config.data_random_seed)
-    combined = allgather_samples(local_sample)
-
-    # the COMBINED sample drives mapper + EFB fitting (bit-equal on
-    # every host); construction then reuses the single-host streaming
-    # machinery with one local "push" of this host's rows
-    sample_vals, sample_rows = split_sample_columns(combined)
+    tp = _transport.active()
+    if tp is not None and tp.world_size > 1:
+        # TCP plane: the r16 boundary-candidate protocol crosses the
+        # real wire — this process's candidates (sharded.binfind seam)
+        # gather over the transport and merge in rank order, so the
+        # fitted mappers are byte-equal to the in-process sharded fit
+        # (and, quotas permitting, to a single-host whole-data fit)
+        from ..sharded import binfind
+        cand = binfind.collect_candidates(local_data, config,
+                                          tp.rank, tp.world_size)
+        sample_vals, sample_rows, total = \
+            binfind.gather_merge_remote(cand, tp)
+    else:
+        local_sample = sample_local_rows(
+            local_data, max(1, config.bin_construct_sample_cnt //
+                            max(1, _num_processes())),
+            config.data_random_seed)
+        combined = allgather_samples(local_sample)
+        # the COMBINED sample drives mapper + EFB fitting (bit-equal
+        # on every host); construction then reuses the single-host
+        # streaming machinery with one local "push" of this host's
+        # rows
+        sample_vals, sample_rows = split_sample_columns(combined)
+        total = combined.shape[0]
     ds = CoreDataset.from_sampled_columns(
-        sample_vals, sample_rows, combined.shape[0],
+        sample_vals, sample_rows, total,
         local_data.shape[0], config=config,
         categorical_features=categorical_features,
         feature_names=feature_names)
@@ -194,10 +238,9 @@ def finalize_global(ds):
     reference data_parallel_tree_learner.cpp:117-246, where each
     machine trains on its shard and histograms are reduce-scattered).
     """
-    import jax
-
     from ..dataset import Metadata
-    nproc = jax.process_count()
+    from . import transport as _transport
+    nproc = _num_processes()
     if nproc <= 1:
         return ds
     n_local = ds.num_data
@@ -225,6 +268,24 @@ def finalize_global(ds):
         gathered = _allgather(init_l).reshape(nproc, k, n_local)
         md.init_score = np.transpose(gathered, (1, 0, 2)).reshape(-1)
     ds.metadata = md
+    tp = _transport.active()
+    if tp is not None and tp.world_size > 1:
+        # host-side TCP plane: no cross-process XLA arrays exist here,
+        # so the global bin matrix REPLICATES — every process gathers
+        # all (N_local, C) uint8 bin shards in rank order (row-wise
+        # concat is layout-safe for every bin_packing: packing is
+        # per-row) and then runs the IDENTICAL deterministic
+        # single-host training program.  Trees are byte-identical to a
+        # single-process run by construction; memory is the full
+        # matrix per process (docs/Parallel-Learning-Guide.md names
+        # this the tcp plane's scaling bound — the xla plane keeps
+        # bins row-sharded)
+        bins = _allgather(np.ascontiguousarray(ds.group_bins))
+        ds.group_bins = np.ascontiguousarray(
+            bins.reshape(-1, bins.shape[-1]))
+        ds.num_data = n_global
+        ds._pushed_rows = n_global
+        return ds
     ds._mh_local_rows = n_local
     ds._multihost = True
     ds.num_data = n_global
@@ -232,8 +293,32 @@ def finalize_global(ds):
 
 
 def _num_processes() -> int:
+    """World size as the ACTIVE transport sees it — an installed TCP
+    transport (including a degraded or elastically-grown world) wins
+    over ``jax.process_count()``, so quota math and telemetry report
+    honest sizes."""
+    from . import transport as _transport
+    tp = _transport.active()
+    if tp is not None:
+        return tp.world_size
     import jax
     try:
         return jax.process_count()
     except Exception:  # pragma: no cover - uninitialized
         return 1
+
+
+def _process_index() -> int:
+    """This process's rank in the active world (transport first, then
+    ``jax.process_index()``) — elastic re-joins get FRESH ranks, and
+    the sampling seams must derive their seeds from the rank actually
+    held, not the one jax booted with."""
+    from . import transport as _transport
+    tp = _transport.active()
+    if tp is not None:
+        return tp.rank
+    import jax
+    try:
+        return jax.process_index()
+    except Exception:  # pragma: no cover - uninitialized
+        return 0

@@ -43,6 +43,11 @@ from typing import List, Sequence, Tuple
 # the full drawable action set; "hang"/"slow" get a drawn duration
 DEFAULT_ACTIONS = ("kill", "oom", "ConnectionError", "TimeoutError",
                    "OSError", "hang", "slow")
+# transport seams additionally draw the peer-shaped faults: a reset
+# socket (peer_drop -> TransportPeerLost -> epoch-boundary reform) and
+# a laggy-but-live peer (peer_slow:<ms>, must stay under any armed
+# watchdog_collective_s deadline)
+TRANSPORT_ACTIONS = DEFAULT_ACTIONS + ("peer_drop", "peer_slow")
 # hang durations default WELL past any test deadline (the watchdog is
 # supposed to fire first); slow durations stay small (tolerated)
 DEFAULT_HANG_MS = (2000, 8000)
@@ -103,11 +108,16 @@ def chaos_entries(seed: int, n_faults: int, seam_glob: str = "*",
             if (seam, nth) not in used:
                 break
         used.add((seam, nth))
-        action = rng.choice(actions)
+        pool = actions
+        if seam.startswith("transport.") and actions == DEFAULT_ACTIONS:
+            # only the DEFAULT pool widens — in-process probes that
+            # restricted the action set keep their restriction
+            pool = TRANSPORT_ACTIONS
+        action = rng.choice(pool)
         if action == "hang":
             action = f"hang:{rng.randint(*hang_ms)}"
-        elif action == "slow":
-            action = f"slow:{rng.randint(*slow_ms)}"
+        elif action in ("slow", "peer_slow"):
+            action = f"{action}:{rng.randint(*slow_ms)}"
         entries.append((seam, nth, action))
     return entries
 

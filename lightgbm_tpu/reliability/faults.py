@@ -32,6 +32,15 @@ Plan grammar (``LTPU_FAULT_PLAN`` env var or ``Config.fault_plan``)::
             | 'slow' ':' ms     -- the seam DELAYS ms milliseconds and
                                    then proceeds normally (must stay
                                    under any armed deadline)
+            | 'peer_drop'       -- raise ConnectionResetError: the
+                                   remote end of a transport round
+                                   died (classified TransportPeerLost
+                                   by parallel/transport.py; the epoch
+                                   protocol is the recovery path)
+            | 'peer_slow' ':' ms -- a laggy-but-live peer: the round
+                                   DELAYS ms milliseconds then
+                                   proceeds (must stay under any armed
+                                   watchdog_collective_s deadline)
             | ExceptionName     -- a builtin exception class, e.g.
                                    ConnectionError, TimeoutError,
                                    OSError, RuntimeError
@@ -92,6 +101,20 @@ SEAMS = (
                              # published model is pinned by
                              # tests/test_continuous.py)
     "distributed.init",      # multi-machine rendezvous / network init
+    "transport.connect",     # TCP transport socket connect attempt
+                             # (parallel/transport.py — rendezvous and
+                             # peer-mesh connects; retried under the
+                             # bounded policy exactly like
+                             # distributed.init)
+    "transport.round",       # TCP transport communication round entry
+                             # (parallel/transport.py _round and
+                             # epoch_tick — fires BEFORE any frame of
+                             # the round moves, so a killed round
+                             # leaves no half-gathered buffer; the
+                             # peer_drop/peer_slow chaos actions land
+                             # here, and a hung peer past an armed
+                             # watchdog_collective_s surfaces as a
+                             # retryable StallError)
     "collectives.allgather", # host-side collective backend calls
     "collectives.hist_exchange",  # host-side compressed histogram
                              # exchange (parallel/collectives.py
@@ -136,19 +159,20 @@ class _Entry:
         self.count = count
         self.exc_type = None
         self.duration_ms = int(duration_ms)
-        if action in ("hang", "slow"):
+        if action in ("hang", "slow", "peer_slow"):
             if self.duration_ms < 1:
                 raise ValueError(
                     f"fault plan action {action!r} needs a positive "
-                    "millisecond duration (hang:<ms> / slow:<ms>)")
-        elif action not in ("kill", "oom"):
+                    "millisecond duration (hang:<ms> / slow:<ms> / "
+                    "peer_slow:<ms>)")
+        elif action not in ("kill", "oom", "peer_drop"):
             exc = getattr(builtins, action, None)
             if not (isinstance(exc, type)
                     and issubclass(exc, BaseException)):
                 raise ValueError(
                     f"fault plan action {action!r} is not 'kill', "
-                    "'oom', 'hang:<ms>', 'slow:<ms>' or a builtin "
-                    "exception name")
+                    "'oom', 'hang:<ms>', 'slow:<ms>', 'peer_drop', "
+                    "'peer_slow:<ms>' or a builtin exception name")
             self.exc_type = exc
 
     def matches(self, n: int) -> bool:
@@ -181,7 +205,7 @@ def parse_plan(spec: str) -> List[_Entry]:
             parts[2].strip()
         idx = 3
         duration_ms = 0
-        if action in ("hang", "slow"):
+        if action in ("hang", "slow", "peer_slow"):
             if len(parts) < 4 or not parts[3].strip().isdigit():
                 raise ValueError(
                     f"fault plan entry {raw!r}: {action} needs a "
@@ -290,12 +314,20 @@ class FaultInjector:
             raise FaultInjected(
                 f"RESOURCE_EXHAUSTED: out of memory (injected at seam "
                 f"{seam}, call {n})")
-        if entry.action == "slow":
+        if entry.action == "peer_drop":
+            # the remote end of a transport round died: surface the
+            # exact exception a reset TCP socket raises, so the
+            # transport's dead-peer classification (TransportPeerLost
+            # -> epoch-boundary reform) is exercised, not simulated
+            raise ConnectionResetError(
+                f"peer dropped (injected at seam {seam}, call {n})")
+        if entry.action in ("slow", "peer_slow"):
             # delay, then PROCEED: models a slow-but-healthy op — an
             # armed deadline must tolerate it (the watchdog fires only
             # past the deadline, so slow durations are drawn under it)
-            Log.debug(f"fault plan: slow {entry.duration_ms} ms at "
-                      f"seam {seam} call {n}")
+            Log.debug(f"fault plan: {entry.action} "
+                      f"{entry.duration_ms} ms at seam {seam} "
+                      f"call {n}")
             time.sleep(entry.duration_ms / 1e3)
             return
         if entry.action == "hang":

@@ -134,6 +134,22 @@ def merge_candidates(cands: Sequence[BoundaryCandidates],
     return vals, rows, int(counts.sum())
 
 
+def gather_merge_remote(local_cand: BoundaryCandidates, transport
+                        ) -> Tuple[List[np.ndarray], List[np.ndarray],
+                                   int]:
+    """Cross-PROCESS candidate gather: this participant's boundary
+    candidates cross the TCP transport (one Bruck allgather of the
+    pickled :class:`BoundaryCandidates` — wire bytes land in the
+    ``collective_tcp_*`` counters), then the full set merges through
+    the same deterministic rank-order :func:`merge_candidates` path
+    the in-process participants use — so the merged (vals, rows,
+    total) is byte-equal whether the shards live in one process or
+    N (the ``LGBM_NetworkInitWithFunctions`` injected-gather pattern,
+    finally over a real wire)."""
+    cands = transport.allgather_obj(local_cand)
+    return merge_candidates(cands)
+
+
 def mapper_fingerprint(mappers: Sequence[BinMapper],
                        bundles: Optional[Sequence[Sequence[int]]] = None,
                        max_bin: int = 0) -> str:

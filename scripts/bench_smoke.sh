@@ -167,6 +167,11 @@ test -s /tmp/lgbtpu_smoke/serve.json
 # bin-matrix bytes with the >=2x packing-ratio gate, and the
 # byte-identical-trees parity gate — its JSON block is asserted by
 # tests/test_bench_smoke.py
+# BENCH_DIST pins the distributed_exchange probe on: the r21
+# hist_exchange codec over the REAL 2-process TCP transport, wire
+# bytes per mode with the q16 >=2x / q8 >=4x payload gates and
+# host-codec bit-exactness — its JSON block is asserted by
+# tests/test_bench_smoke.py
 BENCH_ROWS=${BENCH_ROWS:-4096} \
 BENCH_ITERS=${BENCH_ITERS:-2} \
 BENCH_VALID_ROWS=${BENCH_VALID_ROWS:-2048} \
@@ -183,5 +188,7 @@ BENCH_SKIP_F32=1 \
 BENCH_SHARD=1 \
 BENCH_SHARD_PARTICIPANTS=${BENCH_SHARD_PARTICIPANTS:-2} \
 BENCH_COMPACT=1 \
+BENCH_DIST=1 \
+BENCH_DIST_REPS=${BENCH_DIST_REPS:-2} \
 BENCH_BUDGET_S=${BENCH_BUDGET_S:-600} \
 exec python bench.py

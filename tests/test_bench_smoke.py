@@ -125,6 +125,29 @@ def test_bench_smoke_json_contract():
     assert cb["hist_exchange_ratio_q16"] >= 2.0
     assert cb["hist_exchange_ratio_q8"] >= 4.0
     assert cb["parity"] == "pass"
+    # distributed-exchange probe (this round): the r21 hist_exchange
+    # codec over the REAL 2-process TCP transport — per-mode wire
+    # bytes from the collective_tcp_* per-primitive counters, q16/q8
+    # payload-reduction gates, every mode bit-exact vs the host codec
+    # inside the workers
+    assert "distributed_exchange" in out, \
+        "distributed_exchange probe must run in the smoke"
+    dx = out["distributed_exchange"]
+    for field in ("world", "hist_shape", "modes", "wire_ratio_q16",
+                  "wire_ratio_q8", "total_wire_ratio_q16", "parity",
+                  "wire_gate"):
+        assert field in dx, f"distributed_exchange block missing {field}"
+    assert dx["world"] == 2
+    assert dx["parity"] == "pass" and dx["wire_gate"] == "pass"
+    assert dx["wire_ratio_q16"] >= 2.0, \
+        "q16 must halve the f32 wire payload over real TCP"
+    assert dx["wire_ratio_q8"] >= 4.0
+    for mode in ("f32", "q16", "q8"):
+        assert dx["modes"][mode]["payload_wire_bytes"] > 0, \
+            f"{mode} wire bytes must be measured, not defaulted"
+    # the scale sync must actually cross the wire in the q modes
+    assert dx["modes"]["q16"]["scale_wire_bytes"] > 0
+    assert dx["modes"]["f32"]["scale_wire_bytes"] == 0
     # reliability probe (round 12): checkpoint save overhead measured
     # and the smoke fault-plan recovery (SIGKILL mid-train -> resume)
     # byte-identical — scripts/reliability_probe.py, run in-line by

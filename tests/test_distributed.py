@@ -1,12 +1,24 @@
-"""2-process jax.distributed exercise on CPU: rendezvous, gathered-
+"""Multi-process distributed exercise on CPU: rendezvous, gathered-
 sample bin finding (identical mappers on every host), per-host row
 binning (the redesign of reference dataset_loader.cpp:424-456,
 523-605).  Runs real separate processes — the seam the round-1 review
-flagged as never exercised."""
+flagged as never exercised.
+
+Two collective planes are exercised: the ``jax.distributed`` + XLA
+path (skips where this jaxlib's CPU client cannot run multiprocess
+computations — a missing backend capability) and the host-side TCP
+transport (``collective_transport=tcp``, parallel/transport.py) which
+MUST run everywhere: binning + training across real subprocesses with
+trees byte-identical to a single-process run, plus the 3-process
+elastic re-join (chaos-killed peer -> degraded continuation -> a new
+participant admitted at an epoch boundary with state + shard-cache
+handoff, finishing byte-identical on the restored world)."""
+import hashlib
 import os
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -78,6 +90,166 @@ acc = float((((1/(1+np.exp(-(pred + g.init_score)))) > 0.5)
 print(f"RANK {pid} model {h} trees {len(g.models)} acc {acc:.3f}",
       flush=True)
 assert acc > 0.85, acc
+"""
+
+
+_TCP_WORKER = r"""
+import os, sys, hashlib
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from lightgbm_tpu.config import Config
+cfg = Config.from_params({"objective": "binary", "verbose": -1,
+                          "collective_transport": "tcp"})
+from lightgbm_tpu.parallel import distributed as D
+from lightgbm_tpu.parallel import transport as T
+D.initialize(coordinator_address=coord, num_processes=nproc,
+             process_id=pid, config=cfg)
+tp = T.active()
+assert tp is not None and tp.world_size == nproc
+# satellite: the world view comes from the transport, not jax
+assert D._num_processes() == nproc and D._process_index() == pid
+rng = np.random.RandomState(0)
+X = rng.randn(2000, 6)
+X[rng.rand(2000, 6) < 0.3] = 0.0
+y = (X[:, 0] > 0).astype(float)
+n_shard = 2000 // nproc
+shard = slice(pid * n_shard, (pid + 1) * n_shard)
+ds = D.construct_sharded(X[shard], label=y[shard], config=cfg)
+h = hashlib.sha256("|".join(ds.feature_infos()).encode()).hexdigest()
+bins_h = hashlib.sha256(
+    np.ascontiguousarray(ds.group_bins).tobytes()).hexdigest()
+print(f"RANK {pid} mappers {h} bins {bins_h} rows {ds.num_data} "
+      f"groups {ds.num_groups}", flush=True)
+"""
+
+
+_TCP_TRAIN_WORKER = r"""
+import os, sys, hashlib
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from lightgbm_tpu.config import Config
+cfg = Config.from_params({
+    "objective": "binary", "verbose": -1, "num_leaves": 15,
+    "min_data_in_leaf": 5, "collective_transport": "tcp"})
+from lightgbm_tpu.parallel import distributed as D
+from lightgbm_tpu.parallel import transport as T
+D.initialize(coordinator_address=coord, num_processes=nproc,
+             process_id=pid, config=cfg)
+rng = np.random.RandomState(0)
+N = 2000
+X = rng.randn(N, 6)
+y = (X[:, 0] - 0.5 * X[:, 1] + 0.2 * rng.randn(N) > 0).astype(float)
+shard = slice(pid * (N // nproc), (pid + 1) * (N // nproc))
+ds = D.construct_sharded(X[shard], label=y[shard], config=cfg)
+ds = D.finalize_global(ds)
+assert ds.num_data == N, ds.num_data
+assert ds.group_bins.shape[0] == N
+bins_h = hashlib.sha256(
+    np.ascontiguousarray(ds.group_bins).tobytes()).hexdigest()
+from lightgbm_tpu.boosting.gbdt import GBDT
+g = GBDT(cfg, ds)
+for _ in range(8):
+    g.train_one_iter()
+g.flush_models(final=True)
+model = "".join(t.to_string() for t in g.models)
+h = hashlib.sha256(model.encode()).hexdigest()
+print(f"RANK {pid} model {h} trees {len(g.models)} bins {bins_h}",
+      flush=True)
+"""
+
+
+_ELASTIC_WORKER = r"""
+import os, sys, time, hashlib
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+cache_dir, iters = sys.argv[4], int(sys.argv[5])
+from lightgbm_tpu.config import Config
+P = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+     "min_data_in_leaf": 5}
+cfg = Config.from_params(dict(P, collective_transport="tcp",
+                              transport_epoch_iters=1,
+                              sharded_allow_degraded=True))
+from lightgbm_tpu.parallel import distributed as D
+from lightgbm_tpu.parallel import transport as T
+from lightgbm_tpu.reliability.faults import FAULTS
+rng = np.random.RandomState(0)
+N = 1800
+X = rng.randn(N, 6)
+y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+if pid == 0:
+    # the r16 shard-cache manifest is the DATA half of the joiner
+    # handoff: persist it before training starts
+    from lightgbm_tpu.sharded.cache import save_shard_cache
+    from lightgbm_tpu.sharded.dataset import ShardedDataset
+    sds = ShardedDataset.construct_sharded(
+        X, label=y, config=Config.from_params(dict(P)),
+        num_shards=nproc)
+    save_shard_cache(sds, cache_dir)
+D.initialize(coordinator_address=coord, num_processes=nproc,
+             process_id=pid, config=cfg)
+tp = T.active()
+if pid == 0:
+    tp.handoff_meta = {"manifest_dir": cache_dir}
+shard = slice(pid * (N // nproc), (pid + 1) * (N // nproc))
+ds = D.construct_sharded(X[shard], label=y[shard], config=cfg)
+ds = D.finalize_global(ds)
+from lightgbm_tpu.boosting.gbdt import GBDT
+g = GBDT(cfg, ds)
+if pid == 2:
+    # chaos: die at the THIRD training epoch boundary (configure
+    # restarts the per-seam counters, so construction rounds do not
+    # shift the target)
+    FAULTS.configure("transport.round:3:kill")
+while g.iter_ < iters:
+    g.train_one_iter()      # ticks the epoch boundary internally
+    time.sleep(0.4)         # admission window for the joiner
+g.flush_models(final=True)
+model = "".join(t.to_string() for t in g.models)
+print(f"RANK {pid} model {hashlib.sha256(model.encode()).hexdigest()}"
+      f" world {tp.world_size}", flush=True)
+"""
+
+
+_JOINER_WORKER = r"""
+import os, sys, time, pickle, hashlib
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+coord, trigger, iters = sys.argv[1], sys.argv[2], int(sys.argv[3])
+from lightgbm_tpu.config import Config
+P = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+     "min_data_in_leaf": 5}
+cfg = Config.from_params(dict(P, collective_transport="tcp",
+                              transport_epoch_iters=1,
+                              sharded_allow_degraded=True))
+# pre-warm every import BEFORE the trigger so the JOIN lands while
+# the degraded world still has epoch boundaries left
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.parallel import transport as T
+from lightgbm_tpu.sharded.cache import load_shard_cache
+deadline = time.time() + 300
+while not os.path.exists(trigger):
+    if time.time() > deadline:
+        raise SystemExit("trigger file never appeared")
+    time.sleep(0.05)
+tp = T.TcpTransport.join(coord, config=cfg)
+T.install(tp)
+meta = tp.handoff["meta"]
+state = pickle.loads(tp.handoff["state"])
+sds = load_shard_cache(meta["manifest_dir"], config=cfg)
+g = GBDT(cfg, sds)
+g.restore_state(state)
+joined_at = g.iter_
+while g.iter_ < iters:
+    g.train_one_iter()
+    time.sleep(0.4)
+g.flush_models(final=True)
+model = "".join(t.to_string() for t in g.models)
+print(f"RANK {tp.rank} model "
+      f"{hashlib.sha256(model.encode()).hexdigest()}"
+      f" world {tp.world_size} joined_at {joined_at}", flush=True)
 """
 
 
@@ -177,3 +349,161 @@ def test_two_process_distributed_training(tmp_path):
     # bit-identical models on both hosts
     assert lines["0"][3] == lines["1"][3]
     assert lines["0"][5] == lines["1"][5] == "8"
+
+
+# ---------------------------------------------------------------------------
+# the TCP transport plane: runs (not skips) on the CPU backend
+# ---------------------------------------------------------------------------
+def _run_procs(procs, timeout=600):
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, out + err
+        outs.append(out)
+    return {ln.split()[1]: ln.split() for o in outs
+            for ln in o.splitlines() if ln.startswith("RANK")}
+
+
+def _single_process_reference(X, y, params, iters):
+    """The in-parent single-process run the TCP plane must match
+    byte-for-byte: dataset construction + model hash."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params(dict(params))
+    ds = lgb.Dataset(X, label=y).construct(cfg)
+    bins_h = hashlib.sha256(
+        np.ascontiguousarray(ds.group_bins).tobytes()).hexdigest()
+    if iters == 0:
+        return ds, bins_h, None
+    g = GBDT(cfg, ds)
+    for _ in range(iters):
+        g.train_one_iter()
+    g.flush_models(final=True)
+    model = "".join(t.to_string() for t in g.models)
+    return ds, bins_h, hashlib.sha256(model.encode()).hexdigest()
+
+
+@pytest.mark.slow
+def test_two_process_tcp_binning():
+    """2 real processes, collective_transport=tcp: rendezvous and the
+    boundary-candidate gather cross real sockets, and both processes
+    fit mappers byte-identical to each other AND to a single-process
+    construction of the concatenated data."""
+    coord = f"localhost:{_free_port()}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _TCP_WORKER, coord, "2", str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(2)]
+    lines = _run_procs(procs, timeout=300)
+    assert set(lines) == {"0", "1"}
+    # identical mappers + groups on both processes...
+    assert lines["0"][3] == lines["1"][3]
+    assert lines["0"][9] == lines["1"][9]
+    # ...but DIFFERENT local bin shards (each binned its own rows)
+    assert lines["0"][5] != lines["1"][5]
+    assert lines["0"][7] == lines["1"][7] == "1000"
+    # and the merged fit is byte-equal to the single-process fit
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 6)
+    X[rng.rand(2000, 6) < 0.3] = 0.0
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y).construct(
+        Config.from_params({"objective": "binary", "verbose": -1}))
+    ref = hashlib.sha256(
+        "|".join(ds.feature_infos()).encode()).hexdigest()
+    assert lines["0"][3] == ref, \
+        "TCP candidate-merge mappers diverged from single-process fit"
+
+
+@pytest.mark.slow
+def test_two_process_tcp_training_byte_identical():
+    """The acceptance gate: 2-process training over the TCP plane
+    produces the SAME global bin matrix and byte-identical trees to a
+    single-process run — the transport moved real bytes (candidates,
+    labels, bin shards) without perturbing a single bit of the
+    model."""
+    coord = f"localhost:{_free_port()}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _TCP_TRAIN_WORKER, coord, "2", str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(2)]
+    lines = _run_procs(procs, timeout=600)
+    assert set(lines) == {"0", "1"}
+    assert lines["0"][3] == lines["1"][3]          # same model
+    assert lines["0"][7] == lines["1"][7]          # same global bins
+    assert lines["0"][5] == lines["1"][5] == "8"
+    rng = np.random.RandomState(0)
+    N = 2000
+    X = rng.randn(N, 6)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.2 * rng.randn(N) > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    _, bins_ref, model_ref = _single_process_reference(X, y, params, 8)
+    assert lines["0"][7] == bins_ref, \
+        "TCP-assembled global bin matrix != single-process matrix"
+    assert lines["0"][3] == model_ref, \
+        "TCP 2-process trees are not byte-identical to single-process"
+
+
+@pytest.mark.slow
+def test_three_process_elastic_rejoin_byte_identical(tmp_path):
+    """Elastic membership end-to-end: rank 2 is chaos-killed at its
+    third training epoch boundary, the survivors degrade and keep
+    training, a FRESH participant joins at a later boundary with the
+    captured model state + the r16 shard-cache manifest as handoff,
+    and every finisher (both survivors AND the joiner) flushes a model
+    byte-identical to an uninterrupted single-process run."""
+    coord = f"localhost:{_free_port()}"
+    cache_dir = str(tmp_path / "shards")
+    trigger = str(tmp_path / "rank2-dead")
+    iters = 16
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    survivors = [subprocess.Popen(
+        [sys.executable, "-c", _ELASTIC_WORKER, coord, "3", str(i),
+         cache_dir, str(iters)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(3)]
+    joiner = subprocess.Popen(
+        [sys.executable, "-c", _JOINER_WORKER, coord, trigger,
+         str(iters)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    try:
+        # rank 2 must die by SIGKILL (the injected fault)
+        rc2 = survivors[2].wait(timeout=600)
+        assert rc2 == -9, (rc2, survivors[2].communicate()[1][-800:])
+        with open(trigger, "w") as f:
+            f.write("go")
+        lines = _run_procs([survivors[0], survivors[1], joiner],
+                           timeout=600)
+    finally:
+        for p in survivors + [joiner]:
+            if p.poll() is None:
+                p.kill()
+    # the joiner took the fresh rank 3 (never the corpse's rank 2)
+    assert set(lines) == {"0", "1", "3"}, lines
+    hashes = {r: lines[r][3] for r in lines}
+    assert len(set(hashes.values())) == 1, \
+        f"reformed world diverged: {hashes}"
+    # final world size 3 everywhere (degrade to 2, then re-grow)
+    assert {lines[r][5] for r in lines} == {"3"}, lines
+    assert int(lines["3"][7]) >= 3      # joined after the kill
+    rng = np.random.RandomState(0)
+    N = 1800
+    X = rng.randn(N, 6)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    _, _, model_ref = _single_process_reference(X, y, params, iters)
+    assert hashes["0"] == model_ref, \
+        "elastic world's final model != uninterrupted single-process"

@@ -196,6 +196,8 @@ class _ServingPredictor:
         pending.clear()
         self._oom_cap = max(1, bucket_rows // 2)
         tm.add("oom_downshifts", 1)
+        tm.journal.emit("oom_downshift", seam="predict.dispatch",
+                        bucket=bucket_rows, new_cap=self._oom_cap)
         tm.flight.dump("oom_downshift", seam="predict.dispatch",
                        bucket=bucket_rows, new_cap=self._oom_cap)
         if not self._oom_warned:

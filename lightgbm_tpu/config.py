@@ -593,6 +593,18 @@ class Config:
     # <prefix>-<ns>.flight.json on injected faults, retry exhaustion,
     # OOM downshift or unhandled exception — the last-N telemetry
     # events correlated with the fault seam that fired.  "" disables
+    slo_rules: str = ""             # SLO burn-rate engine
+    # (lightgbm_tpu/slo.py, docs/OBSERVABILITY.md "SLO burn-rate
+    # engine"): path to a JSON rules document (quantile / ratio / rate
+    # / gauge bounds over the live metric registry) evaluated on a
+    # timer with fast/slow burn windows; breaches publish ltpu_slo_*
+    # gauges, journal an slo_breach event and dump the flight
+    # recorder, and GET /slo on the shared listener answers the
+    # verdict.  Parsed eagerly at Config time (a typo'd rules file
+    # fails the run, the fault_plan contract).  "" disables
+    slo_eval_interval_s: float = 10.0  # seconds between timer
+    # evaluations of the armed slo_rules document (floor 0.5s); the
+    # GET /slo route additionally evaluates on demand
     mesh_shape: Tuple[int, ...] = ()
     mesh_axes: Tuple[str, ...] = ()
     sharded_shards: int = 0         # mesh-sharded dataset construction
@@ -862,6 +874,9 @@ class Config:
         _faults_apply(self)
         from .reliability.watchdog import apply_config as _wd_apply
         _wd_apply(self)
+        if self.slo_rules:
+            from .slo import apply_config as _slo_apply
+            _slo_apply(self)
 
     # ------------------------------------------------------------------
     def check(self):
@@ -1045,6 +1060,13 @@ class Config:
             # silently never injecting (a vacuous recovery test)
             from .reliability.faults import parse_plan
             parse_plan(self.fault_plan)
+        if self.slo_eval_interval_s <= 0:
+            raise ValueError("slo_eval_interval_s must be > 0")
+        if self.slo_rules:
+            # parse NOW so a typo'd rules file fails the run instead
+            # of silently never alerting (the fault_plan contract)
+            from .slo import load_rules
+            load_rules(self.slo_rules)
         ct = str(self.construct_threads).lower()
         if ct != "auto":
             try:

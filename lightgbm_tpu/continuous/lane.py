@@ -346,6 +346,9 @@ class ContinuousLane:
             tally = 0
             if TELEMETRY.on:
                 TELEMETRY.add("continuous_drift_refits", 1)
+            TELEMETRY.journal.emit(
+                "drift_refit", seam="continuous.cycle",
+                lane=self.name, threshold=thr)
             Log.warning(
                 f"continuous lane {self.name!r}: drifted-slice tally "
                 f"reached continuous_drift_refit_threshold={thr} — "
@@ -604,6 +607,10 @@ class ContinuousLane:
                 if tm.on:
                     tm.add("continuous_publish_rejects", 1)
                     tm.add("continuous_quarantined", 1)
+                tm.journal.emit(
+                    "quarantine", seam="continuous.cycle",
+                    lane=self.name, cycle=cycle, model=cand,
+                    reason="eval gate")
                 Log.warning(
                     f"continuous lane {self.name!r}: cycle {cycle} "
                     f"candidate {cand} QUARANTINED by the eval gate "
@@ -676,6 +683,9 @@ class ContinuousLane:
         if tm.on:
             tm.add("continuous_rollbacks", 1)
             tm.add("continuous_quarantined", 1)
+        tm.journal.emit(
+            "rollback", seam="continuous.cycle",
+            lane=self.name, model=bad["model"], cause=reason)
         tm.flight.dump("continuous_rollback", seam="continuous.cycle",
                        model=bad["model"], cause=reason, **detail)
         Log.warning(

@@ -336,6 +336,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 c = max(1, c // 2)
                 chunk_size = max(1, min(chunk_size, c))
                 TELEMETRY.add("oom_downshifts", 1)
+                TELEMETRY.journal.emit("oom_downshift",
+                                       seam="gbdt.train_chunk",
+                                       new_chunk=chunk_size)
                 TELEMETRY.flight.dump("oom_downshift",
                                       seam="gbdt.train_chunk",
                                       new_chunk=chunk_size)

@@ -52,15 +52,25 @@ def step_wall_stats(times_s) -> Dict[str, float]:
 
 def _default_gather(seconds: float) -> Optional[List[float]]:
     """Allgather this host's step wall across processes (None when the
-    run is single-process — there is nothing to compare).  Routes
-    through ``distributed._allgather`` so the exchange passes the
+    run is single-process — there is nothing to compare).  An
+    installed TCP transport (``collective_transport=tcp``) carries the
+    exchange directly — the straggler monitor then works on the
+    host-side data plane with no jax distributed runtime, and the
+    round is traced/accounted as a ``transport_round`` like every
+    other transport collective.  Otherwise the exchange routes through
+    ``distributed._allgather`` so it passes the
     ``collectives.allgather`` fault seam and shows up in the
     ``collective_host_allgather_*`` accounting like every other host
     collective."""
-    if TELEMETRY._n_hosts() <= 1:
-        return None
     import numpy as np
 
+    from . import transport as _transport
+    t = _transport.active()
+    if t is not None and t.world_size > 1:
+        gathered = t.allgather(np.asarray([seconds], dtype=np.float64))
+        return [float(x) for x in np.asarray(gathered).ravel()]
+    if TELEMETRY._n_hosts() <= 1:
+        return None
     from .distributed import _allgather
     gathered = _allgather(np.asarray([seconds], dtype=np.float64))
     return [float(x) for x in np.asarray(gathered).ravel()]

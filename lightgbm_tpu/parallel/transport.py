@@ -243,6 +243,12 @@ class TcpTransport:
         self.rank: int = 0
         self.ledger: WorldLedger = WorldLedger({0: ("localhost", 0)})
         self.epoch_every: int = 1
+        # fleet trace id (docs/OBSERVABILITY.md, Tracing): minted by
+        # the coordinator at rendezvous and distributed with the
+        # roster/directives, so collective rounds, epoch ticks and
+        # join/handoff exchanges on DIFFERENT hosts tag their spans
+        # and journal events with one shared trace
+        self.trace_id: str = ""
         # handoff metadata published to joiners (e.g. the shard-cache
         # manifest directory); coordinator-side, caller-settable
         self.handoff_meta: dict = {}
@@ -312,7 +318,11 @@ class TcpTransport:
                     f"rendezvous ranks {sorted(members)} do not tile "
                     f"[0, {num_processes})")
             self.ledger = WorldLedger(members, epoch=0)
-            roster = _obj_frame(self.ledger.to_state())
+            from ..telemetry import new_trace_id
+            self.trace_id = new_trace_id()
+            state = self.ledger.to_state()
+            state["trace"] = self.trace_id
+            roster = _obj_frame(state)
             for r, conn in self._ctrl.items():
                 _send_frame(conn, TAG_ROSTER, roster)
         else:
@@ -322,7 +332,9 @@ class TcpTransport:
                  "port": self._my_addr[1]}))
             self._coord_sock.settimeout(_CTRL_TIMEOUT_S)
             _, payload = _recv_frame(self._coord_sock, TAG_ROSTER)
-            self.ledger = WorldLedger.from_state(pickle.loads(payload))
+            state = pickle.loads(payload)
+            self.trace_id = str(state.get("trace", ""))
+            self.ledger = WorldLedger.from_state(state)
         self._build_mesh()
         self._note_world()
         Log.info(f"tcp transport up: rank {self.rank} of "
@@ -351,12 +363,18 @@ class TcpTransport:
         _, payload = _recv_frame(self._coord_sock, TAG_DIRECTIVE)
         directive = pickle.loads(payload)
         self.rank = int(directive["you"])
+        self.trace_id = str(directive.get("trace", ""))
         self.ledger = WorldLedger.from_state(directive["ledger"])
         _, hpayload = _recv_frame(self._coord_sock, TAG_HANDOFF)
         self.handoff = pickle.loads(hpayload)
         self._coord_sock.settimeout(_CTRL_TIMEOUT_S)
         self._build_mesh()
         self._note_world()
+        from ..telemetry import TELEMETRY
+        TELEMETRY.journal.emit(
+            "membership_join", seam="transport.connect",
+            rank=self.rank, epoch=self.epoch, trace=self.trace_id,
+            world=self.world_size)
         Log.info(f"tcp transport joined: rank {self.rank} of "
                  f"{self.world_size} at epoch {self.epoch}")
         return self
@@ -457,7 +475,28 @@ class TcpTransport:
         fault seam, bounds every socket wait by the armed collective
         deadline (hung peer -> ``StallError``), classifies dead peers
         as ``TransportPeerLost``, and lands bytes/rounds/latency in
-        the ``collective_tcp_*`` telemetry family."""
+        the ``collective_tcp_*`` telemetry family.  In spans mode the
+        round records a ``transport_round`` span tagged with the
+        active trace context (falling back to the fleet trace id the
+        coordinator distributed at rendezvous), so the SAME round's
+        spans on every host share one trace id in the merged
+        timeline."""
+        from ..telemetry import TELEMETRY as tm
+        if not tm.spans_on:
+            return self._round_inner(primitive, sends, recvs)
+        from ..telemetry import current_trace, new_span_id
+        ctx = current_trace()
+        attrs = {"primitive": primitive, "epoch": self.epoch,
+                 "span": new_span_id()}
+        trace_id = ctx[0] if ctx is not None else self.trace_id
+        if trace_id:
+            attrs["trace"] = trace_id
+        with tm.span("transport_round", **attrs):
+            return self._round_inner(primitive, sends, recvs)
+
+    def _round_inner(self, primitive: str,
+                     sends: List[Tuple[int, bytes]],
+                     recvs: List[int]) -> Dict[int, bytes]:
         from ..reliability import watchdog as _watchdog
         from ..reliability.faults import FAULTS
         from ..telemetry import TELEMETRY as tm
@@ -760,7 +799,8 @@ class TcpTransport:
         try:
             self._coord_sock.settimeout(budget)
             _send_frame(self._coord_sock, TAG_TICK, _obj_frame(
-                {"rank": self.rank, "epoch": self.epoch}))
+                {"rank": self.rank, "epoch": self.epoch,
+                 "trace": self.trace_id}))
             _, payload = _recv_frame(self._coord_sock, TAG_DIRECTIVE)
         except (ConnectionError, OSError, socket.timeout,
                 TransportError) as e:
@@ -806,10 +846,15 @@ class TcpTransport:
             raise TransportPeerLost(
                 dead[0], "died before its epoch tick (arm "
                 "sharded_allow_degraded for degraded continuation)")
+        from ..telemetry import TELEMETRY
         ledger = self.ledger
         admitted: List[int] = []
         if dead:
             ledger = ledger.degrade(dead)
+            TELEMETRY.journal.emit(
+                "membership_degrade", seam="transport.round",
+                dead=dead, epoch=ledger.epoch, trace=self.trace_id,
+                world=ledger.world_size)
             Log.warning(
                 f"tcp transport: peer rank(s) {dead} dead — world "
                 f"degrades to {ledger.world_size} at epoch "
@@ -818,12 +863,17 @@ class TcpTransport:
         if joins:
             ledger, admitted = ledger.admit(
                 [(j["host"], j["port"]) for _, j in joins])
+            TELEMETRY.journal.emit(
+                "membership_admit", seam="transport.round",
+                admitted=admitted, epoch=ledger.epoch,
+                trace=self.trace_id, world=ledger.world_size)
             Log.info(f"tcp transport: admitting joiner rank(s) "
                      f"{admitted} at epoch {ledger.epoch}")
         changed = ledger.epoch != self.ledger.epoch
         state = ledger.to_state()
         directive = {"ledger": state, "changed": changed,
-                     "dead": dead, "admitted": admitted}
+                     "dead": dead, "admitted": admitted,
+                     "trace": self.trace_id}
         for r, conn in list(self._ctrl.items()):
             try:
                 _send_frame(conn, TAG_DIRECTIVE,
@@ -846,10 +896,22 @@ class TcpTransport:
     def _adopt(self, directive: dict) -> dict:
         new = WorldLedger.from_state(directive["ledger"])
         changed = bool(directive.get("changed"))
+        if directive.get("trace"):
+            self.trace_id = str(directive["trace"])
         if changed:
             self.ledger = new
             self._build_mesh()
             self._note_world()
+            # every member (coordinator included) journals the epoch
+            # flip with the SHARED fleet trace id, so the merged
+            # timeline shows one trace spanning all host lanes
+            from ..telemetry import TELEMETRY
+            TELEMETRY.journal.emit(
+                "epoch_change", seam="transport.round",
+                epoch=self.epoch, rank=self.rank,
+                world=self.world_size, trace=self.trace_id,
+                dead=list(directive.get("dead") or []),
+                admitted=list(directive.get("admitted") or []))
         info = {"epoch": self.epoch, "world_size": self.world_size,
                 "changed": changed,
                 "dead": list(directive.get("dead") or []),

@@ -301,6 +301,21 @@ class FaultInjector:
             return
         from ..telemetry import TELEMETRY
         TELEMETRY.add("faults_injected", 1)
+        # fleet event journal: EVERY registered seam firing journals —
+        # the seam-coverage lint (scripts/check_seam_coverage.py)
+        # statically pins this call in the shared fire path, so no
+        # seam can fire without a journal event.  A chaos-drawn fault
+        # carries its replay seed.
+        seed = None
+        for part in self.spec.split(";"):
+            bits = part.strip().split(":")
+            if bits and bits[0].strip().lower() == "chaos" \
+                    and len(bits) > 1 and bits[1].strip().isdigit():
+                seed = int(bits[1])
+                break
+        TELEMETRY.journal.emit(
+            "fault_fired", seam=seam, action=entry.action, call=n,
+            **({"chaos_seed": seed} if seed is not None else {}))
         # crash flight recorder (docs/OBSERVABILITY.md): every fired
         # fault dumps the last-N telemetry/log events tagged with THIS
         # seam — for 'kill' the dump lands BEFORE the SIGKILL, which is

@@ -139,6 +139,13 @@ def _record_stall(phase: str, seam: str, deadline_s: float,
     stacks, and warn loudly."""
     from ..telemetry import TELEMETRY
     TELEMETRY.add("stalls_total", 1)
+    # fleet event journal: the stall names its seam and carries the
+    # active trace context (a stalled serve dispatch journals with the
+    # coalesced request's trace)
+    TELEMETRY.journal.emit(
+        "stall", seam=seam, phase=phase,
+        deadline_s=round(float(deadline_s), 6),
+        elapsed_s=round(float(elapsed_s), 6))
     TELEMETRY.flight.dump(
         "stall", seam=seam, phase=phase,
         deadline_s=round(float(deadline_s), 6),

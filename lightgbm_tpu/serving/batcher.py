@@ -47,7 +47,9 @@ from typing import Callable, Deque, List, Optional
 
 import numpy as np
 
-from ..telemetry import TELEMETRY, BATCH_BOUNDS, RATIO_BOUNDS
+from ..telemetry import (TELEMETRY, BATCH_BOUNDS, RATIO_BOUNDS,
+                         clear_trace, current_trace, new_span_id,
+                         set_trace)
 
 
 class ShedLoad(Exception):
@@ -68,9 +70,10 @@ class BatcherClosed(RuntimeError):
 
 class _Request:
     __slots__ = ("rows", "n", "t_enq", "done", "result", "error",
-                 "tag")
+                 "tag", "trace")
 
-    def __init__(self, rows: np.ndarray, t_enq: float, tag=None):
+    def __init__(self, rows: np.ndarray, t_enq: float, tag=None,
+                 trace=None):
         self.rows = rows
         self.n = int(rows.shape[0])
         self.t_enq = t_enq
@@ -80,6 +83,10 @@ class _Request:
         # co-batching identity: which member model this request
         # belongs to (None on a single-model batcher)
         self.tag = tag
+        # causal trace context (trace_id, span_id) snapshotted from
+        # the submitting thread — the coalesced dispatch records a
+        # fan-in link back to each member's span
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -242,7 +249,9 @@ class MicroBatcher:
                     f"projected queue wait {wait:.0f} ms exceeds "
                     f"serve_shed_deadline_ms={self.shed_ms:g}",
                     retry_after_s=wait / 1e3)
-            req = _Request(rows, self._clock(), tag=tag)
+            req = _Request(rows, self._clock(), tag=tag,
+                           trace=current_trace() if tm.spans_on
+                           else None)
             self._pending.append(req)
             self._pending_rows += req.n
             self._cond.notify_all()
@@ -398,11 +407,28 @@ class MicroBatcher:
         now = self._clock()
         t0 = time.perf_counter()
         rows = sum(r.n for r in batch)
+        # fan-in trace links (docs/OBSERVABILITY.md, Tracing): the
+        # coalesced dispatch adopts the first traced member's trace
+        # id, mints its own span id, and records the full member span
+        # list — the merge tool draws one flow arrow per member into
+        # this dispatch slice.  Installed as the active context for
+        # the dispatch so a stall/fault underneath journals with it.
+        attrs = {"requests": len(batch), "rows": rows}
+        token = None
+        if tm.spans_on:
+            links = [r.trace[1] for r in batch if r.trace is not None]
+            if links:
+                attrs["trace"] = next(r.trace[0] for r in batch
+                                      if r.trace is not None)
+                attrs["span"] = new_span_id()
+                attrs["links"] = links
+                token = set_trace(attrs["trace"], attrs["span"])
+            if lane is not None:
+                attrs["lane"] = getattr(lane, "index", lane)
         try:
             x = batch[0].rows if len(batch) == 1 else np.concatenate(
                 [r.rows for r in batch], axis=0)
-            with tm.span("serve_dispatch", requests=len(batch),
-                         rows=rows):
+            with tm.span("serve_dispatch", **attrs):
                 if self.watchdog_s > 0:
                     from ..reliability.watchdog import run_with_deadline
                     out = np.asarray(run_with_deadline(
@@ -420,6 +446,9 @@ class MicroBatcher:
                 self.pool.mark_stalled(lane, e)
             self._fail_batch(batch, e)
             return
+        finally:
+            if token is not None:
+                clear_trace(token)
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
             self._dispatch_ewma_ms = dt_ms if not self._dispatch_ewma_ms \

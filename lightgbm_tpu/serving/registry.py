@@ -280,6 +280,10 @@ class ModelRegistry:
             tm.add("serve_model_swaps" if old is not None
                    else "serve_model_publishes", 1)
             tm.gauge(f"serve_version.{name}", version)
+        tm.journal.emit(
+            "publish", seam="serving.request", model=name,
+            version=version,
+            **({"replaced": old.version} if old is not None else {}))
         if old is not None:
             # new version already serves; finish the old one's queue
             old.batcher.close(drain=True)
@@ -371,6 +375,9 @@ class ModelRegistry:
         if tm.on:
             tm.add("serve_rollbacks", 1)
             tm.gauge(f"serve_version.{name}", prev.version)
+        tm.journal.emit(
+            "rollback", seam="serving.request", model=name,
+            from_version=cur.version, to_version=prev.version)
         cur.batcher.close(drain=True)
         self._refresh_cobatch()
         Log.warning(f"serving registry: rolled {name!r} back "

@@ -49,7 +49,9 @@ import numpy as np
 
 from ..reliability.faults import FAULTS
 from ..reliability.watchdog import StallError
-from ..telemetry import TELEMETRY
+from ..telemetry import (TELEMETRY, TRACE_HEADER, clear_trace,
+                         new_span_id, new_trace_id, parse_trace_header,
+                         set_trace)
 from ..utils.log import Log
 from .batcher import ShedLoad
 from .registry import FeatureWidthMismatch, ModelRegistry
@@ -201,7 +203,28 @@ class ServingFrontend:
     def _predict_route(self, method, path, body, headers):
         t0 = time.perf_counter()
         tm = TELEMETRY
-        span = tm.start_span("serve_request")
+        # causal trace context (docs/OBSERVABILITY.md, Tracing): adopt
+        # the client's X-Ltpu-Trace trace id (a malformed header
+        # degrades to untraced), mint this request's own span id, and
+        # install the pair in the contextvar for the request's
+        # lifetime — the micro-batcher snapshots it at submit, the
+        # journal stamps it on any event fired underneath.  With no
+        # client header a new trace id is minted only when spans are
+        # recording; off/counters stay one mode check.
+        inbound = parse_trace_header(
+            headers.get(TRACE_HEADER, "") if headers is not None
+            else "")
+        token = None
+        attrs = {}
+        if inbound is not None or tm.spans_on:
+            trace_id = inbound[0] if inbound is not None \
+                else new_trace_id()
+            span_id = new_span_id()
+            token = set_trace(trace_id, span_id)
+            attrs = {"trace": trace_id, "span": span_id}
+            if inbound is not None:
+                attrs["parent"] = inbound[1]
+        span = tm.start_span("serve_request", **attrs)
         try:
             resp = self._handle_predict(method, path, body, headers)
         except Exception as e:
@@ -215,6 +238,16 @@ class ServingFrontend:
             resp = _json_response(500, {"error": repr(e)[:300]})
         finally:
             tm.end_span(span)
+            if token is not None:
+                clear_trace(token)
+        if token is not None:
+            # echo the context so the client can find its request in
+            # the merged timeline (and propagate it further)
+            status, ctype, rbody, extra = resp
+            extra = dict(extra or {})
+            extra.setdefault(TRACE_HEADER,
+                             f"{attrs['trace']}-{attrs['span']}")
+            resp = (status, ctype, rbody, extra)
         if tm.on:
             tm.add("serve_http_requests", 1)
             tm.observe("serve_request_ms",

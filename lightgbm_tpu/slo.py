@@ -1,0 +1,428 @@
+"""SLO burn-rate engine over the in-process metric registry.
+
+The observability stack so far produces SIGNALS — counters, latency
+histograms, gauges, spans, a fleet event journal — but judging them
+("is serving healthy?") happens off-box, in whatever dashboards the
+operator wired to the Prometheus textfiles.  This module closes that
+loop in-process: declarative SLO rules (a JSON file named by
+``Config.slo_rules``) are evaluated on a timer against the live
+:data:`~lightgbm_tpu.telemetry.TELEMETRY` registry using the
+multi-window burn-rate method (an SRE-workbook-style fast window that
+catches sharp regressions plus a slow window that catches smoulders),
+and a breach becomes a first-class event: ``ltpu_slo_*`` gauges on the
+scrape surface, an ``slo_breach`` entry in the fleet event journal
+(with the active trace context), a flight-recorder dump, and a warn
+log.  ``GET /slo`` on the shared telemetry listener answers the
+current verdict as JSON, and ``python -m lightgbm_tpu.slo check
+--url`` turns that into a CI/cron-able exit code.
+
+Rule grammar (``{"rules": [...], "fast_window_s": 60,
+"slow_window_s": 600}``; windows optional) — four rule kinds, each
+producing ``burn = observed / bound`` per window (>= 1 is a breach):
+
+- ``quantile``: a latency bound over a histogram —
+  ``{"name": "p99", "kind": "quantile", "hist": "predict_latency_ms",
+  "q": 0.99, "max_ms": 250}``.  The windowed histogram is the bucket
+  DELTA between now and the window-start snapshot, so an old latency
+  spike ages out of the verdict.
+- ``ratio``: an error/shed budget over two counters —
+  ``{"kind": "ratio", "num": "serve_shed_requests",
+  "den": "serve_requests", "max": 0.01}`` (windowed deltas; a den
+  delta of 0 reads as burn 0 — no traffic, no verdict).
+- ``rate``: an events-per-second ceiling on one counter —
+  ``{"kind": "rate", "counter": "retry_exhausted_total",
+  "max_per_s": 0.1}``.
+- ``gauge``: an instantaneous bound on a gauge —
+  ``{"kind": "gauge", "gauge": "straggler_ratio", "max": 2.0}``
+  (no windowing; gauges are already point-in-time).  Quality PSI
+  ceilings ride this kind (``quality_psi_max``).
+
+Off-mode cost: :meth:`SloEngine.evaluate` and the timer body return
+after ONE mode check when ``telemetry=off``, and nothing here touches
+the dispatch path at all — the ``telemetry=off`` HLO-identity pin is
+unaffected by definition (host-side only).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .telemetry import TELEMETRY, _COUNTERS, hist_quantile
+from .utils.log import Log
+
+RULE_KINDS = ("quantile", "ratio", "rate", "gauge")
+DEFAULT_FAST_WINDOW_S = 60.0
+DEFAULT_SLOW_WINDOW_S = 600.0
+MAX_SNAPSHOTS = 512     # bound on the windowed-baseline ring
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"slo_rules: {msg}")
+
+
+def parse_rules(text: str) -> Dict[str, Any]:
+    """Parse + validate an SLO rules document (raises ``ValueError``
+    on any malformed rule — ``Config.check`` calls this eagerly so a
+    typo'd rules file fails the run instead of silently never
+    alerting, the ``fault_plan`` contract)."""
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"slo_rules: not valid JSON ({e})") from None
+    _require(isinstance(doc, dict), "top level must be an object")
+    rules = doc.get("rules")
+    _require(isinstance(rules, list) and rules,
+             'needs a non-empty "rules" array')
+    fast = float(doc.get("fast_window_s", DEFAULT_FAST_WINDOW_S))
+    slow = float(doc.get("slow_window_s", DEFAULT_SLOW_WINDOW_S))
+    _require(0 < fast <= slow,
+             "windows need 0 < fast_window_s <= slow_window_s")
+    seen = set()
+    out: List[Dict[str, Any]] = []
+    for i, r in enumerate(rules):
+        _require(isinstance(r, dict), f"rule {i} must be an object")
+        kind = r.get("kind")
+        _require(kind in RULE_KINDS,
+                 f"rule {i}: kind must be one of {RULE_KINDS}, "
+                 f"got {kind!r}")
+        name = str(r.get("name") or f"rule{i}")
+        _require(name not in seen, f"duplicate rule name {name!r}")
+        seen.add(name)
+        rule = {"name": name, "kind": kind}
+        if kind == "quantile":
+            _require(bool(r.get("hist")),
+                     f"rule {name!r}: quantile needs a 'hist' name")
+            q = float(r.get("q", 0.99))
+            _require(0 < q < 1, f"rule {name!r}: q must be in (0, 1)")
+            bound = float(r.get("max_ms", r.get("max", 0)))
+            _require(bound > 0,
+                     f"rule {name!r}: quantile needs max_ms > 0")
+            rule.update(hist=str(r["hist"]), q=q, bound=bound)
+        elif kind == "ratio":
+            _require(bool(r.get("num")) and bool(r.get("den")),
+                     f"rule {name!r}: ratio needs 'num' and 'den' "
+                     "counter names")
+            bound = float(r.get("max", 0))
+            _require(bound > 0, f"rule {name!r}: ratio needs max > 0")
+            rule.update(num=str(r["num"]), den=str(r["den"]),
+                        bound=bound)
+        elif kind == "rate":
+            _require(bool(r.get("counter")),
+                     f"rule {name!r}: rate needs a 'counter' name")
+            bound = float(r.get("max_per_s", 0))
+            _require(bound > 0,
+                     f"rule {name!r}: rate needs max_per_s > 0")
+            rule.update(counter=str(r["counter"]), bound=bound)
+        else:  # gauge
+            _require(bool(r.get("gauge")),
+                     f"rule {name!r}: gauge needs a 'gauge' name")
+            bound = float(r.get("max", 0))
+            _require(bound > 0, f"rule {name!r}: gauge needs max > 0")
+            rule.update(gauge=str(r["gauge"]), bound=bound)
+        out.append(rule)
+    return {"rules": out, "fast_window_s": fast, "slow_window_s": slow}
+
+
+def load_rules(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return parse_rules(f.read())
+
+
+class SloEngine:
+    """Timer-evaluated burn-rate engine over one rules document.
+
+    Keeps a bounded ring of ``(ts, counters, hist-counts)`` snapshots
+    so each evaluation can form WINDOWED deltas: the baseline for a
+    window is the newest snapshot at least ``window_s`` old (bootstrap:
+    before any snapshot has aged past the window, the oldest snapshot
+    — or process start, i.e. the cumulative totals — serves as the
+    baseline, so a fresh process still alerts on its first bad
+    minute).  All reads go through the public ``Telemetry`` snapshot
+    accessors; nothing here holds the telemetry lock across rule
+    evaluation."""
+
+    def __init__(self, rules: Dict[str, Any],
+                 interval_s: float = 10.0):
+        self.rules = rules["rules"]
+        self.fast_s = float(rules["fast_window_s"])
+        self.slow_s = float(rules["slow_window_s"])
+        self.interval_s = max(0.5, float(interval_s))
+        self._snaps = collections.deque(maxlen=MAX_SNAPSHOTS)
+        self._lock = threading.Lock()
+        self._breached: Dict[str, bool] = {}
+        self._timer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.evaluations = 0    # timer/test observability
+
+    # -- windowed reads -------------------------------------------------
+    def _baseline(self, now: float, window_s: float):
+        """Newest snapshot older than ``window_s`` (None = process
+        start, i.e. cumulative-total deltas during bootstrap)."""
+        base = None
+        for snap in self._snaps:     # oldest -> newest
+            if now - snap[0] >= window_s:
+                base = snap
+            else:
+                break
+        if base is None and self._snaps:
+            base = self._snaps[0]
+            if now - base[0] < 1e-9:
+                return None
+        return base
+
+    @staticmethod
+    def _counter_delta(name, counters, base):
+        cur = float(counters.get(name, 0.0))
+        if base is None:
+            return cur
+        return cur - float(base[1].get(name, 0.0))
+
+    @staticmethod
+    def _hist_delta(name, hists, base):
+        h = hists.get(name)
+        if h is None:
+            return None
+        if base is None or name not in base[2]:
+            return h
+        prev = base[2][name]
+        if list(prev["bounds"]) != list(h["bounds"]):
+            return h    # bounds changed (reset): cumulative view
+        counts = [c - p for c, p in zip(h["counts"], prev["counts"])]
+        return {"bounds": h["bounds"], "counts": counts,
+                "count": max(0, h["count"] - prev["count"]),
+                "sum": h["sum"] - prev["sum"]}
+
+    def _rule_burn(self, rule, counters, hists, gauges, base,
+                   span_s: float) -> float:
+        kind = rule["kind"]
+        if kind == "gauge":
+            v = gauges.get(rule["gauge"])
+            return 0.0 if v is None else float(v) / rule["bound"]
+        if kind == "quantile":
+            h = self._hist_delta(rule["hist"], hists, base)
+            if h is None or h["count"] <= 0:
+                return 0.0
+            return hist_quantile(h, rule["q"]) / rule["bound"]
+        if kind == "ratio":
+            den = self._counter_delta(rule["den"], counters, base)
+            if den <= 0:
+                return 0.0
+            num = self._counter_delta(rule["num"], counters, base)
+            return (num / den) / rule["bound"]
+        # rate
+        d = self._counter_delta(rule["counter"], counters, base)
+        return (d / max(span_s, 1e-9)) / rule["bound"]
+
+    # -- evaluation -----------------------------------------------------
+    def evaluate(self) -> Dict[str, Any]:
+        """One evaluation pass: compute fast/slow burn per rule,
+        publish ``slo_burn*`` gauges, journal breach TRANSITIONS
+        (warn-once until the rule recovers), and return the verdict
+        document (what ``GET /slo`` serves)."""
+        tm = TELEMETRY
+        if tm.mode < _COUNTERS:    # off-mode: one attribute check
+            return {"enabled": False, "breaching": [], "rules": []}
+        now = time.perf_counter()
+        counters = tm.counters()
+        hists = tm.histograms()
+        gauges = tm.gauges()
+        with self._lock:
+            self.evaluations += 1
+            fast_base = self._baseline(now, self.fast_s)
+            slow_base = self._baseline(now, self.slow_s)
+            t0 = getattr(tm, "_t0", now)
+            fast_span = (now - fast_base[0]) if fast_base \
+                else max(now - t0, 1e-9)
+            slow_span = (now - slow_base[0]) if slow_base \
+                else max(now - t0, 1e-9)
+            results = []
+            breaching = []
+            worst = 0.0
+            for rule in self.rules:
+                fast = self._rule_burn(rule, counters, hists, gauges,
+                                       fast_base, fast_span)
+                slow = self._rule_burn(rule, counters, hists, gauges,
+                                       slow_base, slow_span)
+                burn = max(fast, slow)
+                worst = max(worst, burn)
+                breach = burn >= 1.0
+                name = rule["name"]
+                tm.gauge(f"slo_burn.{name}", round(fast, 6))
+                tm.gauge(f"slo_slow_burn.{name}", round(slow, 6))
+                was = self._breached.get(name, False)
+                self._breached[name] = breach
+                if breach:
+                    breaching.append(name)
+                if breach and not was:
+                    tm.journal.emit(
+                        "slo_breach", seam="serving.request",
+                        rule=name, rule_kind=rule["kind"],
+                        burn=round(burn, 4), bound=rule["bound"])
+                    tm.flight.dump(
+                        "slo_breach", seam="serving.request",
+                        rule=name, rule_kind=rule["kind"],
+                        burn=round(burn, 4), bound=rule["bound"])
+                    Log.warning(
+                        f"SLO BREACH: rule {name!r} ({rule['kind']}) "
+                        f"burning at {burn:.2f}x its budget "
+                        f"(fast {fast:.2f}x / slow {slow:.2f}x)")
+                elif was and not breach:
+                    tm.journal.emit(
+                        "slo_recover", seam="serving.request",
+                        rule=name, burn=round(burn, 4))
+                    Log.info(f"SLO recovered: rule {name!r} at "
+                             f"{burn:.2f}x budget")
+                results.append({
+                    "rule": name, "kind": rule["kind"],
+                    "bound": rule["bound"],
+                    "fast_burn": round(fast, 6),
+                    "slow_burn": round(slow, 6),
+                    "breaching": breach})
+            tm.gauge("slo_burn", round(worst, 6))
+            tm.gauge("slo_breaching", len(breaching))
+            # snapshot AFTER evaluation: the next pass's baselines
+            hist_counts = {k: {"bounds": v["bounds"],
+                               "counts": v["counts"],
+                               "count": v["count"], "sum": v["sum"]}
+                           for k, v in hists.items()}
+            self._snaps.append((now, counters, hist_counts))
+        return {"enabled": True, "breaching": breaching,
+                "worst_burn": round(worst, 6),
+                "fast_window_s": self.fast_s,
+                "slow_window_s": self.slow_s,
+                "rules": results}
+
+    # -- timer ----------------------------------------------------------
+    def start(self) -> None:
+        if self._timer is not None and self._timer.is_alive():
+            return
+        self._stop.clear()
+        self._timer = threading.Thread(
+            target=self._run, daemon=True, name="ltpu-slo")
+        self._timer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._timer = self._timer, None
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if TELEMETRY.mode < _COUNTERS:
+                continue
+            try:
+                self.evaluate()
+            except Exception as e:  # pragma: no cover - engine bug
+                Log.warning(f"slo engine evaluation failed: {e}")
+
+    # -- HTTP -----------------------------------------------------------
+    def http_route(self, method, path, body, headers):
+        """``GET /slo`` on the shared telemetry listener: evaluate on
+        demand, 200 when clean, 503 when any rule is breaching (so a
+        probe can alert off the status code alone)."""
+        verdict = self.evaluate()
+        status = 503 if verdict.get("breaching") else 200
+        return (status, "application/json",
+                json.dumps(verdict, sort_keys=True).encode(), None)
+
+
+# -- process-global engine (Config-armed, like transport.install) -------
+_ACTIVE: Optional[SloEngine] = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def active() -> Optional[SloEngine]:
+    return _ACTIVE
+
+
+def install(engine: Optional[SloEngine]) -> Optional[SloEngine]:
+    """Install (or clear, with None) the process-global engine:
+    stops/unmounts the previous one, starts the timer and mounts
+    ``GET /slo`` for the new one."""
+    global _ACTIVE
+    with _INSTALL_LOCK:
+        prev = _ACTIVE
+        if prev is not None:
+            prev.stop()
+            TELEMETRY.unregister_http_route("/slo")
+        _ACTIVE = engine
+        if engine is not None:
+            TELEMETRY.register_http_route("/slo", engine.http_route)
+            engine.start()
+        return prev
+
+
+def apply_config(cfg) -> None:
+    """Arm the engine from ``Config.slo_rules`` (a JSON rules path).
+    An empty knob leaves any armed engine alone — internally-built
+    default Configs must not disarm a run's SLO watch mid-flight (the
+    ``watchdog.apply_config`` contract)."""
+    path = str(getattr(cfg, "slo_rules", "") or "")
+    if not path:
+        return
+    rules = load_rules(path)
+    install(SloEngine(
+        rules,
+        interval_s=float(getattr(cfg, "slo_eval_interval_s", 10.0)
+                         or 10.0)))
+
+
+# -- CLI ----------------------------------------------------------------
+def _cmd_check(argv: List[str]) -> int:
+    """``python -m lightgbm_tpu.slo check --url http://host:port``:
+    fetch ``/slo`` from a live process and turn the verdict into an
+    exit code — 0 clean, 1 breaching, 2 usage/unreachable — the
+    cron/CI contract (mirrors ``telemetry merge``'s rc discipline)."""
+    import argparse
+    import urllib.error
+    import urllib.request
+    ap = argparse.ArgumentParser(
+        prog="lightgbm_tpu.slo check",
+        description="query a live process's /slo verdict")
+    ap.add_argument("--url", required=True,
+                    help="base URL of the telemetry listener "
+                         "(e.g. http://127.0.0.1:9090)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit:
+        return 2
+    url = args.url.rstrip("/") + "/slo"
+    try:
+        req = urllib.request.urlopen(url, timeout=args.timeout)
+        doc = json.loads(req.read().decode())
+    except urllib.error.HTTPError as e:
+        if e.code != 503:
+            print(f"slo check: {url} -> HTTP {e.code}")
+            return 2
+        doc = json.loads(e.read().decode())
+    except (OSError, ValueError) as e:
+        print(f"slo check: cannot reach {url}: {e}")
+        return 2
+    print(json.dumps(doc, sort_keys=True, indent=2))
+    if not doc.get("enabled", False):
+        print("slo check: telemetry off or no rules armed")
+        return 2
+    if doc.get("breaching"):
+        print(f"slo check: BREACHING: {', '.join(doc['breaching'])}")
+        return 1
+    print("slo check: all rules within budget")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import sys
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in ("check",):
+        print("usage: python -m lightgbm_tpu.slo check --url URL")
+        return 2
+    return _cmd_check(argv[1:])
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -79,6 +79,7 @@ from __future__ import annotations
 import atexit
 import bisect
 import collections
+import contextvars
 import json
 import os
 import threading
@@ -120,6 +121,83 @@ PROM_PREFIX = "ltpu_"
 # flight-recorder ring capacity (events, not bytes): the last-N
 # span/counter/log events correlated with a fault
 FLIGHT_EVENTS = 512
+
+# fleet event journal ring capacity: the last-N state transitions
+# (membership epochs, fault firings, stalls, publishes...).  Bounded
+# like the flight ring; eviction counts into ``journal.dropped``
+JOURNAL_EVENTS = 4096
+
+# HTTP header carrying the trace context across the serving edge:
+# value is ``<trace_id>-<span_id>`` (lowercase hex, 32 + 16 chars in
+# the W3C traceparent id widths).  Accepted on ``POST /predict`` and
+# echoed on every response (docs/OBSERVABILITY.md, Tracing)
+TRACE_HEADER = "X-Ltpu-Trace"
+
+# the active causal trace context: ``(trace_id, span_id)`` hex pair or
+# None.  A contextvar propagates per-thread and survives the handler's
+# call stack without threading arguments through every layer; the
+# micro-batcher snapshots it at submit so a coalesced dispatch on the
+# dispatcher thread still links back to each member request's span.
+_TRACE_CTX: "contextvars.ContextVar" = contextvars.ContextVar(
+    "ltpu_trace", default=None)
+
+
+def new_trace_id() -> str:
+    """Fresh 128-bit trace id (32 lowercase hex chars)."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """Fresh 64-bit span id (16 lowercase hex chars)."""
+    return os.urandom(8).hex()
+
+
+def current_trace():
+    """The active ``(trace_id, span_id)`` pair, or None."""
+    return _TRACE_CTX.get()
+
+
+def set_trace(trace_id: str, span_id: Optional[str] = None):
+    """Install a trace context on the current thread/context; returns
+    the reset token for :func:`clear_trace` (always pair them — a
+    leaked context would mis-attribute unrelated later work)."""
+    return _TRACE_CTX.set((str(trace_id),
+                           str(span_id) if span_id else new_span_id()))
+
+
+def clear_trace(token) -> None:
+    _TRACE_CTX.reset(token)
+
+
+def parse_trace_header(value) -> Optional[tuple]:
+    """Parse an ``X-Ltpu-Trace: <trace>-<span>`` header value into a
+    ``(trace_id, span_id)`` pair; None on anything malformed (a bad
+    client header must degrade to an untraced request, never a 500).
+    Lenient on width — any 8..32 / 4..16 hex pair is accepted."""
+    if not value:
+        return None
+    parts = str(value).strip().lower().split("-")
+    if len(parts) != 2:
+        return None
+    trace, span = parts
+    if not (8 <= len(trace) <= 32 and 4 <= len(span) <= 16):
+        return None
+    try:
+        int(trace, 16)
+        int(span, 16)
+    except ValueError:
+        return None
+    return trace, span
+
+
+def format_trace_header(ctx=None) -> str:
+    """Render a ``(trace_id, span_id)`` pair (default: the active
+    context) as the header value; empty string when untraced."""
+    if ctx is None:
+        ctx = _TRACE_CTX.get()
+    if ctx is None:
+        return ""
+    return f"{ctx[0]}-{ctx[1]}"
 
 
 class _Hist:
@@ -321,6 +399,84 @@ class FlightRecorder:
         return path
 
 
+class EventJournal:
+    """Bounded, monotonically-sequenced, host-tagged fleet event
+    journal (docs/OBSERVABILITY.md, event journal): the state
+    transitions that used to exist only as warn-logs — membership
+    epoch changes, degraded exclusions, chaos fault firings, watchdog
+    stalls, OOM downshifts, publish/rollback/quarantine, drift→refit
+    flips — recorded as structured events each carrying the active
+    trace context.  Exported beside the span shards as
+    ``<prefix>.events.jsonl`` (same clock-sync alignment), queryable
+    via ``python -m lightgbm_tpu.telemetry events``, and rendered by
+    the merge tool as Perfetto instant events.
+
+    Off-mode cost is one attribute check in :meth:`emit`; the ring is
+    bounded so a week-long process cannot grow its heap in events."""
+
+    def __init__(self, tm: "Telemetry", maxlen: int = JOURNAL_EVENTS):
+        self._tm = tm
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=maxlen)
+        self._seq = 0
+        self.dropped = 0
+
+    def emit(self, kind: str, seam: str = "", **fields) -> None:
+        """Record one state-transition event.  No-op at ``off``;
+        ``seam`` names the subsystem seam (fault-seam grammar where
+        one exists); extra keyword fields are kept verbatim.  The
+        active trace context is captured so a cross-host cause (the
+        request, the round) stays attached to its effect."""
+        tm = self._tm
+        if tm.mode < _COUNTERS:
+            return
+        ctx = _TRACE_CTX.get()
+        ts = time.perf_counter() - tm._t0
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._seq += 1
+            self._ring.append((self._seq, ts, kind, seam, ctx,
+                               fields or None))
+        tm.add("journal_events", 1)
+        if tm.flight.out:
+            detail = dict(fields) if fields else {}
+            if seam:
+                detail["seam"] = seam
+            tm.flight.note("journal", kind, **detail)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self.dropped = 0
+
+    def events(self) -> List[dict]:
+        """The retained events as export-ready dicts (``ts_us`` is
+        relative to the telemetry clock origin, same timeline as the
+        span export)."""
+        host = self._tm.host()
+        with self._lock:
+            ring = list(self._ring)
+        out = []
+        for seq, ts, kind, seam, ctx, fields in ring:
+            ev = {"type": "event", "seq": seq,
+                  "ts_us": round(ts * 1e6, 1),
+                  "host_id": host, "kind": kind}
+            if seam:
+                ev["seam"] = seam
+            if ctx is not None:
+                ev["trace"], ev["span"] = ctx
+            if fields:
+                ev["fields"] = fields
+            out.append(ev)
+        return out
+
+
 class _NullCtx:
     """Shared no-op context for disabled spans/phases."""
     __slots__ = ()
@@ -394,6 +550,7 @@ class Telemetry:
         self.run_id = ""
         self._sync: Optional[tuple] = None   # (name, rel_ts_s)
         self.flight = FlightRecorder()
+        self.journal = EventJournal(self)
         self._http = None
         # HTTP route table for the shared scrape/serving listener:
         # {path or prefix-ending-in-/: fn(method, path, body, headers)
@@ -441,6 +598,7 @@ class Telemetry:
             self._sync = None
             self._t0 = time.perf_counter()
             self._t0_unix = time.time()
+        self.journal.clear()
 
     @property
     def on(self) -> bool:
@@ -831,7 +989,19 @@ class Telemetry:
         perfetto = f"{prefix}.perfetto.json"
         with open(perfetto, "w") as f:
             json.dump(self._perfetto(events, snap), f)
-        return [jsonl, perfetto]
+        paths = [jsonl, perfetto]
+        journal = self.journal.events()
+        if journal:
+            # the fleet event journal, beside the span shard with the
+            # SAME meta line (host/run identity + clock-sync mark), so
+            # the merge tool aligns it onto the same timeline
+            epath = f"{prefix}.events.jsonl"
+            with open(epath, "w") as f:
+                f.write(json.dumps(meta) + "\n")
+                for ev in journal:
+                    f.write(json.dumps(ev) + "\n")
+            paths.append(epath)
+        return paths
 
     def _perfetto(self, events, snap) -> Dict[str, Any]:
         pid = os.getpid()
@@ -1032,13 +1202,26 @@ class Telemetry:
             self._http.server_close()
             self._http = None
 
+    def prom_shard_path(self, path: str) -> str:
+        """Multi-host-safe Prometheus textfile path: in a multi-host
+        run (or when ``LTPU_HOST_ID`` tags this process) the atexit
+        textfile shards per host like the JSONL export —
+        ``metrics.prom`` becomes ``metrics.host<i>.prom`` — instead
+        of N processes last-writer-winning one file."""
+        if not (self._n_hosts() > 1
+                or os.environ.get("LTPU_HOST_ID") is not None):
+            return path
+        root, ext = os.path.splitext(path)
+        return f"{root}.host{self.host()}{ext or '.prom'}"
+
     def _export_atexit(self) -> None:  # pragma: no cover - process exit
         try:
-            if self.out and (self._events or self._counters):
+            if self.out and (self._events or self._counters
+                             or len(self.journal)):
                 self.export(self.out)
             if self.prom_out and (self._counters or self._hists
                                   or self._gauges):
-                self.write_prom(self.prom_out)
+                self.write_prom(self.prom_shard_path(self.prom_out))
         except Exception:
             pass
 
@@ -1125,6 +1308,7 @@ def apply_config(cfg) -> None:
 def _read_shard(path: str) -> Dict[str, Any]:
     meta: Dict[str, Any] = {}
     spans: List[dict] = []
+    events: List[dict] = []
     snap: Dict[str, Any] = {}
     with open(path) as f:
         for ln in f:
@@ -1137,6 +1321,8 @@ def _read_shard(path: str) -> Dict[str, Any]:
                 meta = obj
             elif t == "span":
                 spans.append(obj)
+            elif t == "event":
+                events.append(obj)
             elif t == "snapshot":
                 snap = obj
     if not meta:
@@ -1145,7 +1331,8 @@ def _read_shard(path: str) -> Dict[str, Any]:
         meta = {"host_id": snap.get("host_id", 0),
                 "run_id": snap.get("run_id", "")}
     meta["path"] = path
-    return {"meta": meta, "spans": spans, "snapshot": snap}
+    return {"meta": meta, "spans": spans, "events": events,
+            "snapshot": snap}
 
 
 def merge_shards(paths: List[str]) -> Dict[str, Any]:
@@ -1158,10 +1345,31 @@ def merge_shards(paths: List[str]) -> Dict[str, Any]:
     coincide with the reference host's — collective skew between hosts
     then reads directly as slice offsets between lanes.  Shards
     without a sync mark merge with zero shift and are listed under
-    ``metadata.unaligned``."""
+    ``metadata.unaligned``.
+
+    Tracing (round 23): spans carrying a ``span`` trace attr are
+    indexed across ALL shards; every span carrying a ``links`` attr
+    (the coalesced dispatch's fan-in list) gets a Perfetto flow arrow
+    (``ph:"s"/"f"``) drawn from each linked member span to it — the
+    causal request→dispatch edges read directly across host lanes.
+    ``<shard>.events.jsonl`` journal shards (passed explicitly or
+    auto-discovered beside a span shard) render as instant events on
+    their host's lane, clock-shifted identically."""
     if not paths:
         raise ValueError("merge needs at least one shard path")
-    shards = [_read_shard(p) for p in paths]
+    pathset = {os.path.abspath(p) for p in paths}
+    shards = []
+    for p in paths:
+        s = _read_shard(p)
+        if p.endswith(".jsonl") and not p.endswith(".events.jsonl"):
+            # auto-discover the sibling journal shard so a plain
+            # `merge run.host*.jsonl` that predates the journal keeps
+            # working and a journal-producing run needs no extra args
+            sib = p[:-len(".jsonl")] + ".events.jsonl"
+            if os.path.abspath(sib) not in pathset \
+                    and os.path.exists(sib):
+                s["events"].extend(_read_shard(sib)["events"])
+        shards.append(s)
     shards.sort(key=lambda s: int(s["meta"].get("host_id", 0)))
     run_ids = {s["meta"].get("run_id", "") for s in shards}
     ref = next((s for s in shards
@@ -1172,10 +1380,14 @@ def merge_shards(paths: List[str]) -> Dict[str, Any]:
     shifts: Dict[str, float] = {}
     unaligned: List[str] = []
     seen_hosts: List[int] = []
+    # cross-shard trace index for flow arrows: span_id -> placed slice
+    span_index: Dict[str, tuple] = {}
+    link_sources: List[tuple] = []   # (links, pid, tid, ts, dur)
     for s in shards:
         meta = s["meta"]
         host = int(meta.get("host_id", 0))
-        seen_hosts.append(host)
+        if host not in seen_hosts:
+            seen_hosts.append(host)
         sync = meta.get("sync_ts_us")
         if ref_sync is not None and sync is not None:
             shift = float(ref_sync) - float(sync)
@@ -1190,17 +1402,37 @@ def merge_shards(paths: List[str]) -> Dict[str, Any]:
         tids: Dict[int, int] = {}
         for ev in s["spans"]:
             tid = tids.setdefault(ev.get("tid", 0), len(tids) + 1)
+            ts = round(ev["ts_us"] + shift, 1)
+            dur = ev.get("dur_us", 0.0)
             out = {"name": ev["name"], "cat": "host", "ph": "X",
-                   "ts": round(ev["ts_us"] + shift, 1),
-                   "dur": ev.get("dur_us", 0.0),
-                   "pid": host, "tid": tid}
-            if ev.get("attrs"):
-                out["args"] = ev["attrs"]
+                   "ts": ts, "dur": dur, "pid": host, "tid": tid}
+            attrs = ev.get("attrs")
+            if attrs:
+                out["args"] = attrs
+                sid = attrs.get("span")
+                if sid:
+                    span_index[str(sid)] = (host, tid, ts, dur)
+                links = attrs.get("links")
+                if links:
+                    link_sources.append((links, host, tid, ts, dur))
             trace.append(out)
         for tid, short in tids.items():
             trace.append({"name": "thread_name", "ph": "M", "pid": host,
                           "tid": short,
                           "args": {"name": f"host{host}-t{short}"}})
+        for ev in s["events"]:
+            # journal events: process-scoped instants on the host lane
+            name = ev.get("kind", "event")
+            if ev.get("seam"):
+                name = f"{name}:{ev['seam']}"
+            args = {k: v for k, v in ev.items()
+                    if k in ("seq", "seam", "trace", "span")}
+            if ev.get("fields"):
+                args.update(ev["fields"])
+            trace.append({"name": name, "cat": "journal", "ph": "i",
+                          "ts": round(ev.get("ts_us", 0.0) + shift, 1),
+                          "pid": host, "tid": 0, "s": "p",
+                          "args": args})
         counters = (s["snapshot"] or {}).get("counters", {})
         last_ts = max((ev["ts_us"] + shift for ev in s["spans"]),
                       default=0.0)
@@ -1208,6 +1440,25 @@ def merge_shards(paths: List[str]) -> Dict[str, Any]:
             trace.append({"name": k, "cat": "counter", "ph": "C",
                           "ts": round(last_ts, 1), "pid": host,
                           "args": {"value": round(float(v), 3)}})
+    flow_id = 0
+    flows = 0
+    for links, dpid, dtid, dts, ddur in link_sources:
+        for lk in links if isinstance(links, (list, tuple)) else []:
+            src = span_index.get(str(lk))
+            if src is None:
+                continue
+            spid, stid, sts, sdur = src
+            flow_id += 1
+            flows += 1
+            # flow start bound mid-slice of the member request span,
+            # finish bound to the enclosing dispatch slice (bp:"e")
+            trace.append({"name": "trace", "cat": "trace", "ph": "s",
+                          "id": flow_id, "pid": spid, "tid": stid,
+                          "ts": round(sts + sdur / 2, 1)})
+            trace.append({"name": "trace", "cat": "trace", "ph": "f",
+                          "bp": "e", "id": flow_id, "pid": dpid,
+                          "tid": dtid,
+                          "ts": round(dts + ddur / 2, 1)})
     merged = {
         "traceEvents": trace,
         "displayTimeUnit": "ms",
@@ -1216,6 +1467,7 @@ def merge_shards(paths: List[str]) -> Dict[str, Any]:
             "run_ids": sorted(r for r in run_ids if r),
             "hosts": seen_hosts,
             "clock_shifts_us": shifts,
+            "flow_links": flows,
         },
     }
     if unaligned:
@@ -1223,19 +1475,8 @@ def merge_shards(paths: List[str]) -> Dict[str, Any]:
     return merged
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    """``python -m lightgbm_tpu.telemetry merge [-o OUT] shard.jsonl...``
-    — merge per-host trace shards (``<prefix>.host<i>.jsonl``) into one
-    Perfetto file (default ``<first shard dir>/merged.perfetto.json``).
-    rc 0 ok / 2 usage."""
+def _cmd_merge(argv: List[str]) -> int:
     import sys
-    argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] != "merge":
-        print("usage: python -m lightgbm_tpu.telemetry merge "
-              "[-o OUT.perfetto.json] <shard.jsonl> [...]",
-              file=sys.stderr)
-        return 2
-    argv = argv[1:]
     out_path = None
     if "-o" in argv:
         i = argv.index("-o")
@@ -1267,6 +1508,95 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"{len(merged['metadata']['hosts'])} host lane(s) -> "
           f"{out_path}")
     return 0
+
+
+def _cmd_events(argv: List[str]) -> int:
+    """Query exported journal shards: filter by seam/host/kind/time
+    range, print matching events one JSON per line (sorted by aligned
+    time then per-host sequence)."""
+    import sys
+    filt = {"seam": None, "host": None, "kind": None,
+            "since": None, "until": None}
+    paths: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("--seam", "--host", "--kind", "--since", "--until"):
+            if i + 1 >= len(argv):
+                print(f"events: {a} needs a value", file=sys.stderr)
+                return 2
+            filt[a[2:]] = argv[i + 1]
+            i += 2
+        elif a.startswith("--"):
+            print(f"events: unknown option {a}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+            i += 1
+    if not paths:
+        print("events: no journal files given", file=sys.stderr)
+        return 2
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"events: file(s) not found: {missing}", file=sys.stderr)
+        return 2
+    try:
+        host = None if filt["host"] is None else int(filt["host"])
+        since = None if filt["since"] is None else float(filt["since"])
+        until = None if filt["until"] is None else float(filt["until"])
+    except ValueError as e:
+        print(f"events: bad filter value ({e})", file=sys.stderr)
+        return 2
+    rows: List[tuple] = []
+    for p in paths:
+        s = _read_shard(p)
+        h = int(s["meta"].get("host_id", 0))
+        for ev in s["events"]:
+            ts = float(ev.get("ts_us", 0.0))
+            if host is not None and ev.get("host_id", h) != host:
+                continue
+            if filt["seam"] is not None \
+                    and ev.get("seam", "") != filt["seam"]:
+                continue
+            if filt["kind"] is not None \
+                    and ev.get("kind", "") != filt["kind"]:
+                continue
+            if since is not None and ts < since:
+                continue
+            if until is not None and ts > until:
+                continue
+            rows.append((ts, ev.get("host_id", h),
+                         ev.get("seq", 0), ev))
+    rows.sort(key=lambda r: (r[0], r[1], r[2]))
+    for _, _, _, ev in rows:
+        print(json.dumps(ev))
+    print(f"{len(rows)} event(s) from {len(paths)} shard(s)",
+          file=sys.stderr)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m lightgbm_tpu.telemetry merge [-o OUT] shard.jsonl...``
+    — merge per-host trace shards (``<prefix>.host<i>.jsonl`` +
+    journal ``.events.jsonl`` siblings) into one Perfetto file
+    (default ``<first shard dir>/merged.perfetto.json``).
+
+    ``python -m lightgbm_tpu.telemetry events [--seam S] [--host H]
+    [--kind K] [--since US] [--until US] <events.jsonl> [...]`` —
+    query exported journal shards.  rc 0 ok / 2 usage."""
+    import sys
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in ("merge", "events"):
+        print("usage: python -m lightgbm_tpu.telemetry merge "
+              "[-o OUT.perfetto.json] <shard.jsonl> [...]\n"
+              "       python -m lightgbm_tpu.telemetry events "
+              "[--seam S] [--host H] [--kind K] [--since US] "
+              "[--until US] <events.jsonl> [...]",
+              file=sys.stderr)
+        return 2
+    if argv[0] == "merge":
+        return _cmd_merge(argv[1:])
+    return _cmd_events(argv[1:])
 
 
 if __name__ == "__main__":  # pragma: no cover - CLI entry

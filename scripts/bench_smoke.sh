@@ -122,6 +122,14 @@ for base, buckets in hists.items():
 print(f"prom scrape ok: {len(hists)} histogram series, "
       f"buckets monotone")
 EOF
+# distributed-tracing probe (round 23): a real HTTP request carrying
+# an X-Ltpu-Trace header through the serving stack in spans mode —
+# header echoed back, the merged Perfetto timeline flow-links the
+# request span to its coalesced dispatch span, and an injected
+# dispatch stall journals its seam WITH the request's trace id;
+# asserted by test_bench_smoke on the JSON it writes
+python scripts/trace_probe.py /tmp/lgbtpu_smoke/trace.json >&2
+test -s /tmp/lgbtpu_smoke/trace.json
 # continuous-training probe (round 15): 2-cycle in-process loop
 # (ingest -> append-construct -> continue-train -> gated publish),
 # served-vs-direct parity, a forced live regression -> auto-rollback,

@@ -12,6 +12,13 @@ and conversely every seam the RELIABILITY.md table documents must
 still be registered — a documented-but-deleted seam means the doc
 (and any chaos glob built on it) silently rotted.
 
+A third direction (the fleet event journal): the shared fire path in
+``FaultInjector.fault_point`` must journal every firing
+(``journal.emit(`` in faults.py) — because ALL seams fire through
+that one path, a static check that the call is present guarantees
+every registered seam's firing lands in the journal; the per-seam
+runtime proof lives in tests/test_tracing.py.
+
 Runs in ``scripts/bench_smoke.sh`` before the bench; rc 0 clean,
 rc 1 drift (findings on stderr), matching the check_carry_layout /
 check_telemetry_coverage contract.  The seam registry is parsed
@@ -66,11 +73,24 @@ def documented_seams():
                           re.M))
 
 
+def journal_wired() -> bool:
+    """Whether the shared fault fire path journals its firings: one
+    ``journal.emit(`` call in faults.py covers every seam (they all
+    fire through ``FaultInjector.fault_point``)."""
+    with open(FAULTS_PY) as f:
+        return "journal.emit(" in f.read()
+
+
 def main() -> int:
     seams = registered_seams()
     sources = exercised_in()
     documented = documented_seams()
     drift = []
+    if not journal_wired():
+        drift.append(
+            "the fault fire path in reliability/faults.py no longer "
+            "journals firings (journal.emit( missing) — chaos/fault "
+            "events would vanish from the fleet event journal")
     for seam in seams:
         users = [rel for rel, src in sources.items() if seam in src]
         if not users:

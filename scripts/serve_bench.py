@@ -93,17 +93,23 @@ def _with_sim_wall(bst, sim_row_ms):
 def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
               mode="closed", rate=200.0, deadline_ms=5.0,
               lanes="1", sim_row_ms=0.0, body_format="json",
-              predict_kwargs=None, shed_ms=None) -> dict:
+              predict_kwargs=None, shed_ms=None,
+              telemetry_mode="counters", send_trace=False) -> dict:
     """Serve ``bst`` in-process and drive it with ``clients``
     concurrent threads; returns the result record (latencies from the
     clients, amortization/fill from the telemetry counters, parity
-    vs direct predict, drain state)."""
+    vs direct predict, drain state).  ``telemetry_mode``/``send_trace``
+    drive the trace-overhead probe: spans mode with every client
+    request carrying an ``X-Ltpu-Trace`` header exercises the full
+    propagation path (context parse/mint, span attrs, fan-in links)."""
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.serving import (BINARY_F32, ModelRegistry,
                                       ServingFrontend)
-    from lightgbm_tpu.telemetry import TELEMETRY, hist_quantile
+    from lightgbm_tpu.telemetry import (TELEMETRY, TRACE_HEADER,
+                                        hist_quantile, new_span_id,
+                                        new_trace_id)
 
-    TELEMETRY.configure("counters")
+    TELEMETRY.configure(telemetry_mode)
     TELEMETRY.reset()
     params = {
         "verbose": -1,
@@ -153,10 +159,14 @@ def run_bench(bst, X, clients=8, requests=24, rows_spec=(1,),
                 dt = next_t - time.perf_counter()
                 if dt > 0:
                     time.sleep(dt)
+            hdrs = {"Content-Type": ctype}
+            if send_trace:
+                hdrs[TRACE_HEADER] = \
+                    f"{new_trace_id()}-{new_span_id()}"
             t0 = time.perf_counter()
             try:
                 conn.request("POST", "/predict/bench", body=body,
-                             headers={"Content-Type": ctype})
+                             headers=hdrs)
                 resp = conn.getresponse()
                 payload = resp.read()
             except Exception as e:
@@ -280,6 +290,40 @@ def lane_scaling_probe(lane_n=2, sim_row_ms=1.0, clients=8,
         # the scale-out gate (docs/SERVING.md): 2 lanes must buy at
         # least 1.5x rows/s on the simulated device wall
         "gate": ("pass" if ratio is not None and ratio >= 1.5
+                 else "fail"),
+    }
+
+
+def trace_overhead_probe(clients=8, requests=24) -> dict:
+    """The tracing-cost gate (docs/OBSERVABILITY.md, Tracing): the
+    SAME closed-loop load in telemetry=spans twice — no trace headers
+    vs EVERY request carrying an X-Ltpu-Trace header — so the p50
+    delta isolates the per-request cost this round adds (header
+    parse, context mint/set/clear, span trace attrs, fan-in link
+    capture, header echo) from the pre-existing spans-mode observer
+    effect, which both runs pay identically.  The host-side design
+    target is <5%; the gate bound is generous (25%) because a CPU
+    smoke's p50 jitter dwarfs the microseconds under test."""
+    results = {}
+    for label, tel_mode, send in (("off", "spans", False),
+                                  ("on", "spans", True)):
+        bst, X = build_model()
+        results[label] = run_bench(
+            bst, X, clients=clients, requests=requests,
+            rows_spec=(1,), mode="closed", deadline_ms=2.0,
+            shed_ms=60_000.0, telemetry_mode=tel_mode,
+            send_trace=send)
+    p50_off = results["off"]["p50_ms"]
+    p50_on = results["on"]["p50_ms"]
+    pct = (100.0 * (p50_on - p50_off) / p50_off
+           if p50_off else None)
+    return {
+        "p50_ms_tracing_off": p50_off,
+        "p50_ms_tracing_on": p50_on,
+        "overhead_pct": round(pct, 2) if pct is not None else None,
+        "parity": ("pass" if results["off"]["parity"]
+                   == results["on"]["parity"] == "pass" else "fail"),
+        "gate": ("pass" if pct is not None and pct < 25.0
                  else "fail"),
     }
 
@@ -449,6 +493,8 @@ def main(argv=None) -> int:
         out["mixed_model"] = run_mixed_bench(
             n_models=_env_int("SERVE_MIXED_MODELS", 3),
             rate=float(os.environ.get("SERVE_MIXED_RATE", "300")))
+    if os.environ.get("SERVE_TRACE_PROBE", "1") != "0":
+        out["trace_overhead"] = trace_overhead_probe()
     text = json.dumps(out, indent=1)
     if argv:
         with open(argv[0], "w") as fh:
@@ -473,6 +519,13 @@ def main(argv=None) -> int:
                   f"{mm['cobatch_fused_models']} model-dispatches "
                   f"(amortized={mm['cobatch_amortized']}, parity "
                   f"{mm['parity']})", file=sys.stderr)
+        to = out.get("trace_overhead")
+        if to:
+            print(f"serve_bench trace_overhead: p50 "
+                  f"{to['p50_ms_tracing_off']} ms untraced -> "
+                  f"{to['p50_ms_tracing_on']} ms traced "
+                  f"({to['overhead_pct']}%, gate {to['gate']})",
+                  file=sys.stderr)
     else:
         print(text)
     ok = out["parity"] == "pass"
@@ -482,6 +535,9 @@ def main(argv=None) -> int:
     mm = out.get("mixed_model")
     if mm is not None:
         ok = ok and mm["parity"] == "pass" and mm["cobatch_amortized"]
+    to = out.get("trace_overhead")
+    if to is not None:
+        ok = ok and to["parity"] == "pass" and to["gate"] == "pass"
     return 0 if ok else 1
 
 

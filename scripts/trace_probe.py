@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""End-to-end distributed-tracing smoke (round 23, the bench_smoke
+trace gate): drives the REAL serving stack in spans mode and proves
+the causal chain the merge tool renders —
+
+1. a client request carrying an ``X-Ltpu-Trace`` header gets the SAME
+   trace id echoed back on the response (context accepted + minted),
+2. the exported + merged Perfetto timeline contains the request's
+   ``serve_request`` span AND a ``serve_dispatch`` span flow-linked to
+   it (the micro-batcher's fan-in arrow), and
+3. an injected dispatch stall (slow predict under an armed
+   ``watchdog_serve_s``) lands in the fleet event journal as a
+   ``stall`` event NAMING its seam and carrying the request's trace id
+   — the 3am property: one grep from a latency alert to the seam that
+   caused it.
+
+Usage: python scripts/trace_probe.py [OUT.json]; rc 0 all gates pass.
+Asserted by tests/test_bench_smoke.py on the JSON it writes.
+"""
+import http.client
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_model(features=6, rows=200, iters=3):
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(11)
+    X = rng.randn(rows, features)
+    y = X[:, 0] - 0.3 * X[:, 1]
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "num_leaves": 7, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), iters, verbose_eval=False)
+    return bst, X
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out_path = argv[0] if argv else ""
+    tmp = os.path.dirname(out_path) or "/tmp"
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serving import ModelRegistry, ServingFrontend
+    from lightgbm_tpu.telemetry import (TELEMETRY, TRACE_HEADER,
+                                        merge_shards, new_span_id,
+                                        new_trace_id)
+
+    TELEMETRY.configure("spans")
+    TELEMETRY.reset()
+    bst, X = build_model()
+
+    # injected stall seam: the probe flips `stall["s"]` and the next
+    # dispatch sleeps past the armed watchdog_serve_s deadline
+    stall = {"s": 0.0}
+    orig = bst.predict
+
+    def predict(rows, **kw):
+        if stall["s"]:
+            time.sleep(stall["s"])
+        return orig(rows, **kw)
+
+    bst.predict = predict
+
+    cfg = Config.from_params({
+        "verbose": -1,
+        "serve_batch_deadline_ms": 1.0,
+        "watchdog_serve_s": 0.15,
+    })
+    registry = ModelRegistry(cfg)
+    registry.publish("probe", bst)
+    frontend = ServingFrontend(registry, cfg)
+    port = frontend.start(0).server_address[1]
+
+    result = {"requests": 0}
+    trace_id = new_trace_id()
+    body = json.dumps({"rows": X[:2].tolist()}).encode()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+
+    # gate 1: header round trip — the response names OUR trace
+    conn.request("POST", "/predict/probe", body=body, headers={
+        "Content-Type": "application/json",
+        TRACE_HEADER: f"{trace_id}-{new_span_id()}"})
+    resp = conn.getresponse()
+    resp.read()
+    result["requests"] += 1
+    echoed = resp.getheader(TRACE_HEADER) or ""
+    result["status"] = resp.status
+    result["header_echo"] = ("pass" if resp.status == 200
+                             and echoed.startswith(trace_id + "-")
+                             else "fail")
+
+    # gate 3 setup: a stalled dispatch under the armed serve watchdog
+    # (expected to FAIL the request — the journal event is the point)
+    stall["s"] = 0.5
+    stall_trace = new_trace_id()
+    try:
+        conn.request("POST", "/predict/probe", body=body, headers={
+            "Content-Type": "application/json",
+            TRACE_HEADER: f"{stall_trace}-{new_span_id()}"})
+        resp = conn.getresponse()
+        resp.read()
+        result["stall_status"] = resp.status
+    except Exception as e:  # noqa: BLE001 - conn may die on the 500
+        result["stall_status"] = repr(e)
+    stall["s"] = 0.0
+    conn.close()
+    frontend.stop(drain=True)
+
+    # export one shard + merge it — the same path a fleet run takes
+    TELEMETRY.mark_sync()
+    prefix = os.path.join(tmp, "trace_telemetry")
+    TELEMETRY.export(prefix)
+    merged = merge_shards([prefix + ".jsonl"])
+    events = merged["traceEvents"]
+
+    # gate 2: the request span and a flow-linked dispatch span
+    req_spans = [e for e in events if e.get("name") == "serve_request"
+                 and (e.get("args") or {}).get("trace") == trace_id]
+    disp_spans = [e for e in events if e.get("name") == "serve_dispatch"
+                  and (e.get("args") or {}).get("trace") == trace_id]
+    member_span = (req_spans[0]["args"].get("span")
+                   if req_spans else None)
+    linked = any(member_span and member_span in
+                 ((e.get("args") or {}).get("links") or [])
+                 for e in disp_spans)
+    result["flow_links"] = merged["metadata"].get("flow_links", 0)
+    result["flow_link"] = ("pass" if req_spans and disp_spans and
+                           linked and result["flow_links"] >= 1
+                           else "fail")
+
+    # gate 3: the stall journaled, naming its seam + the trace id
+    stall_events = [e for e in events
+                    if e.get("cat") == "journal"
+                    and str(e.get("name", "")).startswith("stall")]
+    named = [e for e in stall_events
+             if (e.get("args") or {}).get("seam") == "predict.dispatch"
+             and (e.get("args") or {}).get("trace") == stall_trace]
+    result["stall_journal"] = "pass" if named else "fail"
+    result["journal_instants"] = len(
+        [e for e in events if e.get("cat") == "journal"])
+
+    ok = all(result.get(k) == "pass" for k in
+             ("header_echo", "flow_link", "stall_journal"))
+    result["status_overall"] = "pass" if ok else "fail"
+    text = json.dumps(result, indent=1)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text + "\n")
+        print(f"trace_probe: header_echo {result['header_echo']}, "
+              f"flow_link {result['flow_link']} "
+              f"({result['flow_links']} arrow(s)), stall_journal "
+              f"{result['stall_journal']} -> {out_path}",
+              file=sys.stderr)
+    else:
+        print(text)
+    TELEMETRY.configure("off")
+    TELEMETRY.reset()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,660 @@
+"""Fleet-wide distributed tracing, the causal event journal, and the
+SLO burn-rate engine (round 23, docs/OBSERVABILITY.md).
+
+Covered: W3C-style trace context plumbing (mint/parse/set/clear, the
+lenient ``X-Ltpu-Trace`` header grammar), HTTP header round trip over
+a real listener, the micro-batcher's fan-in links (every coalesced
+member's span id recorded on the dispatch span), the event journal
+(bounded ring, monotone sequence, trace capture, off-mode no-op,
+export + ``events`` CLI + merge-as-instants), the per-seam journal
+guarantee (EVERY registered fault seam's firing lands in the journal
+— the runtime proof behind check_seam_coverage's static pin), the SLO
+engine's four rule kinds with windowed burn math, breach events and
+the ``slo check`` rc contract over real HTTP, the per-host Prometheus
+textfile shard path, and a REAL 2-process TCP run whose shards merge
+into one clock-aligned timeline with both hosts' collective rounds
+sharing one trace id."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import slo
+from lightgbm_tpu.reliability.faults import SEAMS, FAULTS, FaultInjected
+from lightgbm_tpu.telemetry import (TELEMETRY, TRACE_HEADER,
+                                    clear_trace, current_trace,
+                                    format_trace_header, main as
+                                    telemetry_main, merge_shards,
+                                    new_span_id, new_trace_id,
+                                    parse_trace_header, set_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    FAULTS.reset()
+    TELEMETRY.configure("off")
+    TELEMETRY.reset()
+    slo.install(None)
+    yield
+    FAULTS.reset()
+    slo.install(None)
+    TELEMETRY.configure("off")
+    TELEMETRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# trace context primitives
+# ---------------------------------------------------------------------------
+class TestTraceContext:
+    def test_ids_are_hex_of_w3c_widths(self):
+        assert len(new_trace_id()) == 32
+        assert len(new_span_id()) == 16
+        int(new_trace_id(), 16)
+        int(new_span_id(), 16)
+        assert new_trace_id() != new_trace_id()
+
+    def test_set_current_clear_roundtrip(self):
+        assert current_trace() is None
+        tid = new_trace_id()
+        token = set_trace(tid)
+        try:
+            got = current_trace()
+            assert got is not None and got[0] == tid
+            assert len(got[1]) == 16
+        finally:
+            clear_trace(token)
+        assert current_trace() is None
+
+    def test_context_is_per_thread(self):
+        token = set_trace(new_trace_id(), new_span_id())
+        seen = {}
+
+        def other():
+            seen["ctx"] = current_trace()
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        clear_trace(token)
+        # contextvars don't leak across unrelated threads
+        assert seen["ctx"] is None
+
+    def test_parse_header_lenient_and_strict(self):
+        tid, sid = new_trace_id(), new_span_id()
+        assert parse_trace_header(f"{tid}-{sid}") == (tid, sid)
+        # short-but-hex ids are accepted (lenient fleet grammar)
+        assert parse_trace_header("abcd1234-beef") == \
+            ("abcd1234", "beef")
+        for bad in ("", "zz-xx", "no-dash-here-really-not-hex",
+                    f"{tid}", f"{tid}-", "-" + sid,
+                    "g" * 32 + "-" + "a" * 16,
+                    "a" * 40 + "-" + "b" * 16):
+            assert parse_trace_header(bad) is None, bad
+
+    def test_format_header_matches_parse(self):
+        token = set_trace(new_trace_id(), new_span_id())
+        try:
+            hdr = format_trace_header()
+            assert parse_trace_header(hdr) == current_trace()
+        finally:
+            clear_trace(token)
+
+
+# ---------------------------------------------------------------------------
+# event journal
+# ---------------------------------------------------------------------------
+class TestEventJournal:
+    def test_off_mode_is_noop(self):
+        TELEMETRY.journal.emit("x", seam="gbdt.train_chunk")
+        assert len(TELEMETRY.journal) == 0
+
+    def test_emit_records_seq_seam_trace_fields(self):
+        TELEMETRY.configure("counters")
+        token = set_trace("ab" * 16, "cd" * 8)
+        try:
+            TELEMETRY.journal.emit("epoch_change",
+                                   seam="transport.round",
+                                   epoch=3, world=2)
+        finally:
+            clear_trace(token)
+        TELEMETRY.journal.emit("stall", seam="predict.dispatch")
+        evs = TELEMETRY.journal.events()
+        assert [e["seq"] for e in evs] == [1, 2]
+        e0 = evs[0]
+        assert e0["kind"] == "epoch_change"
+        assert e0["seam"] == "transport.round"
+        assert e0["trace"] == "ab" * 16 and e0["span"] == "cd" * 8
+        assert e0["fields"] == {"epoch": 3, "world": 2}
+        # the untraced emit has no trace keys at all
+        assert "trace" not in evs[1]
+        # and the emission is counted on the metric surface
+        assert TELEMETRY.counters()["journal_events"] == 2
+
+    def test_ring_bounded_and_reset_clears(self):
+        TELEMETRY.configure("counters")
+        for i in range(TELEMETRY.journal._ring.maxlen + 5):
+            TELEMETRY.journal.emit("tick", n=i)
+        assert len(TELEMETRY.journal) == TELEMETRY.journal._ring.maxlen
+        assert TELEMETRY.journal.dropped >= 5
+        # monotone sequence survives the drop
+        evs = TELEMETRY.journal.events()
+        assert evs[-1]["seq"] > evs[0]["seq"]
+        TELEMETRY.reset()
+        assert len(TELEMETRY.journal) == 0
+
+    def test_export_writes_events_shard_and_merge_instants(self,
+                                                           tmp_path):
+        TELEMETRY.configure("spans")
+        TELEMETRY.mark_sync()
+        with TELEMETRY.span("work"):
+            TELEMETRY.journal.emit("oom_downshift",
+                                   seam="predict.dispatch", bucket=64)
+        prefix = str(tmp_path / "run")
+        paths = TELEMETRY.export(prefix, shard=False)
+        ev_path = prefix + ".events.jsonl"
+        assert ev_path in paths and os.path.exists(ev_path)
+        lines = [json.loads(ln) for ln in open(ev_path)]
+        assert lines[0]["type"] == "meta"
+        assert lines[1]["kind"] == "oom_downshift"
+        # merge renders the journal as Perfetto instants (sibling
+        # auto-discovery from the span shard path)
+        merged = merge_shards([prefix + ".jsonl"])
+        inst = [e for e in merged["traceEvents"]
+                if e.get("cat") == "journal"]
+        assert len(inst) == 1
+        assert inst[0]["ph"] == "i"
+        assert inst[0]["name"] == "oom_downshift:predict.dispatch"
+        assert inst[0]["args"]["bucket"] == 64
+
+    def test_events_cli_filters_and_rc(self, tmp_path, capsys):
+        TELEMETRY.configure("counters")
+        TELEMETRY.journal.emit("stall", seam="predict.dispatch")
+        TELEMETRY.journal.emit("publish", seam="serving.request",
+                               model="m")
+        prefix = str(tmp_path / "run")
+        TELEMETRY.export(prefix, shard=False)
+        ev_path = prefix + ".events.jsonl"
+        assert telemetry_main(
+            ["events", "--seam", "serving.request", ev_path]) == 0
+        out = capsys.readouterr()
+        rows = [json.loads(ln) for ln in out.out.splitlines()]
+        assert len(rows) == 1 and rows[0]["kind"] == "publish"
+        assert "1 event(s) from 1 shard(s)" in out.err
+        # rc contract: no files / missing file / unknown option = 2
+        assert telemetry_main(["events"]) == 2
+        assert telemetry_main(["events", "/nonexistent.jsonl"]) == 2
+        assert telemetry_main(["events", "--bogus", ev_path]) == 2
+
+    def test_every_registered_seam_journals_its_firing(self):
+        """The satellite-f runtime proof: arm each of the registered
+        fault seams, fire it, and find the journal event naming it —
+        the static check in scripts/check_seam_coverage.py pins the
+        emit call's presence, this pins its behavior per seam."""
+        TELEMETRY.configure("counters")
+        for seam in SEAMS:
+            FAULTS.reset()
+            FAULTS.configure(f"{seam}:1:ValueError")
+            with pytest.raises(ValueError):
+                FAULTS.fault_point(seam)
+            evs = [e for e in TELEMETRY.journal.events()
+                   if e["kind"] == "fault_fired"
+                   and e.get("seam") == seam]
+            assert evs, f"seam {seam} fired without journaling"
+            assert evs[-1]["fields"]["action"] == "ValueError"
+        FAULTS.reset()
+
+    def test_chaos_seed_lands_in_fault_event(self):
+        # seed 1 deterministically draws predict.dispatch:1 with a
+        # transient ConnectionError — a chaos plan that is safe to
+        # fire inside the pytest process (no kill/hang draw)
+        TELEMETRY.configure("counters")
+        FAULTS.configure("chaos:1:1:predict.*")
+        with pytest.raises(ConnectionError):
+            FAULTS.fault_point("predict.dispatch")
+        FAULTS.reset()
+        evs = [e for e in TELEMETRY.journal.events()
+               if e["kind"] == "fault_fired"]
+        assert evs and evs[-1]["fields"]["chaos_seed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# serving: header round trip + fan-in links
+# ---------------------------------------------------------------------------
+class TestServingTrace:
+    def _frontend(self, deadline_ms=20.0):
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.serving import ModelRegistry, ServingFrontend
+
+        class _Fake:
+            def num_feature(self):
+                return 3
+
+            def predict(self, rows, **kw):
+                return np.asarray(rows)[:, 0]
+
+        cfg = Config.from_params({
+            "verbose": -1, "serve_batch_deadline_ms": deadline_ms})
+        registry = ModelRegistry(cfg)
+        registry.publish("m", _Fake())
+        frontend = ServingFrontend(registry, cfg)
+        port = frontend.start(0).server_address[1]
+        return frontend, port
+
+    def _post(self, port, headers=None):
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=30)
+        body = json.dumps({"rows": [[1.0, 2.0, 3.0]]}).encode()
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        conn.request("POST", "/predict/m", body=body, headers=h)
+        resp = conn.getresponse()
+        resp.read()
+        echoed = resp.getheader(TRACE_HEADER)
+        conn.close()
+        return resp.status, echoed
+
+    def test_header_echoed_with_request_span_id(self):
+        TELEMETRY.configure("spans")
+        frontend, port = self._frontend()
+        try:
+            tid = new_trace_id()
+            status, echoed = self._post(
+                port, {TRACE_HEADER: f"{tid}-{new_span_id()}"})
+        finally:
+            frontend.stop(drain=True)
+        assert status == 200
+        got = parse_trace_header(echoed)
+        assert got is not None and got[0] == tid
+        spans = [(n, a) for n, _, _, _, _, a in
+                 TELEMETRY.events_snapshot()
+                 if n == "serve_request"]
+        assert spans and spans[0][1]["trace"] == tid
+        # the response's span id IS the recorded request span
+        assert spans[0][1]["span"] == got[1]
+
+    def test_no_header_no_spans_means_no_trace_work(self):
+        TELEMETRY.configure("counters")
+        frontend, port = self._frontend()
+        try:
+            status, echoed = self._post(port)
+        finally:
+            frontend.stop(drain=True)
+        assert status == 200 and echoed is None
+
+    def test_counters_mode_still_adopts_client_header(self):
+        TELEMETRY.configure("counters")
+        frontend, port = self._frontend()
+        try:
+            tid = new_trace_id()
+            status, echoed = self._post(
+                port, {TRACE_HEADER: f"{tid}-{new_span_id()}"})
+        finally:
+            frontend.stop(drain=True)
+        assert status == 200
+        assert parse_trace_header(echoed)[0] == tid
+
+    def test_malformed_header_degrades_untraced(self):
+        TELEMETRY.configure("counters")
+        frontend, port = self._frontend()
+        try:
+            status, echoed = self._post(
+                port, {TRACE_HEADER: "not-a-trace"})
+        finally:
+            frontend.stop(drain=True)
+        assert status == 200 and echoed is None
+
+    def test_batcher_records_fan_in_links(self):
+        """Two concurrent traced submits coalesce; the dispatch span
+        must record BOTH member span ids in its links."""
+        from lightgbm_tpu.serving.batcher import MicroBatcher
+        TELEMETRY.configure("spans")
+        mb = MicroBatcher(lambda rows: np.asarray(rows)[:, 0],
+                          config=None)
+        mb.deadline_ms = 50.0
+        traces = [new_trace_id() for _ in range(2)]
+        spans = [new_span_id() for _ in range(2)]
+        barrier = threading.Barrier(2)
+
+        def member(i):
+            token = set_trace(traces[i], spans[i])
+            try:
+                barrier.wait()
+                mb.submit(np.asarray([[1.0, 2.0]]), timeout_s=30)
+            finally:
+                clear_trace(token)
+
+        threads = [threading.Thread(target=member, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mb.close()
+        disp = [a for n, _, _, _, _, a in TELEMETRY.events_snapshot()
+                if n == "serve_dispatch" and a and "links" in a]
+        assert disp, "no linked dispatch span recorded"
+        linked = set()
+        for a in disp:
+            linked.update(a["links"])
+            assert a["trace"] in traces
+            assert len(a["span"]) == 16
+        assert linked == set(spans)
+        # dispatch context was cleared when the batch finished
+        assert current_trace() is None
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+RULES = {
+    "rules": [
+        {"name": "p99_latency", "kind": "quantile",
+         "hist": "predict_latency_ms", "q": 0.99, "max_ms": 50},
+        {"name": "shed_budget", "kind": "ratio",
+         "num": "serve_shed_requests", "den": "serve_requests",
+         "max": 0.01},
+        {"name": "retry_rate", "kind": "rate",
+         "counter": "retry_exhausted_total", "max_per_s": 0.5},
+        {"name": "psi", "kind": "gauge", "gauge": "quality_psi_max",
+         "max": 0.2},
+    ],
+    "fast_window_s": 5, "slow_window_s": 30,
+}
+
+
+class TestSloEngine:
+    def _engine(self):
+        return slo.SloEngine(slo.parse_rules(json.dumps(RULES)),
+                             interval_s=10.0)
+
+    def test_parse_rejects_malformed(self):
+        for bad in ('{"rules": []}', '[]', 'not json',
+                    '{"rules": [{"kind": "nope"}]}',
+                    '{"rules": [{"kind": "quantile"}]}',
+                    '{"rules": [{"kind": "ratio", "num": "a"}]}',
+                    '{"rules": [{"kind": "rate", "counter": "c"}]}',
+                    '{"rules": [{"kind": "gauge", "gauge": "g"}]}',
+                    '{"rules": [{"kind": "gauge", "gauge": "g", '
+                    '"max": 1}], "fast_window_s": 60, '
+                    '"slow_window_s": 5}'):
+            with pytest.raises(ValueError):
+                slo.parse_rules(bad)
+
+    def test_off_mode_one_check(self):
+        v = self._engine().evaluate()
+        assert v == {"enabled": False, "breaching": [], "rules": []}
+
+    def test_clean_metrics_no_breach(self):
+        TELEMETRY.configure("counters")
+        TELEMETRY.observe("predict_latency_ms", 2.0)
+        TELEMETRY.add("serve_requests", 100)
+        TELEMETRY.gauge("quality_psi_max", 0.01)
+        v = self._engine().evaluate()
+        assert v["enabled"] and not v["breaching"]
+        assert TELEMETRY.gauges()["slo_burn"] < 1.0
+
+    def test_quantile_breach_gauges_journal_flight(self, tmp_path):
+        TELEMETRY.configure("counters")
+        TELEMETRY.flight.arm(str(tmp_path / "flight"))
+        for _ in range(40):
+            TELEMETRY.observe("predict_latency_ms", 400.0)
+        eng = self._engine()
+        v = eng.evaluate()
+        assert "p99_latency" in v["breaching"]
+        g = TELEMETRY.gauges()
+        assert g["slo_burn"] >= 1.0
+        assert g["slo_burn.p99_latency"] >= 1.0
+        assert g["slo_breaching"] >= 1
+        evs = [e for e in TELEMETRY.journal.events()
+               if e["kind"] == "slo_breach"]
+        assert evs and evs[0]["fields"]["rule"] == "p99_latency"
+        assert TELEMETRY.flight.dumps, "breach must dump the recorder"
+        # warn-once: a second breaching evaluation does not re-journal
+        eng.evaluate()
+        assert len([e for e in TELEMETRY.journal.events()
+                    if e["kind"] == "slo_breach"]) == 1
+        TELEMETRY.flight.disarm()
+
+    def test_ratio_and_rate_and_gauge_breach(self):
+        TELEMETRY.configure("counters")
+        TELEMETRY.add("serve_requests", 100)
+        TELEMETRY.add("serve_shed_requests", 10)   # 10% > 1% budget
+        TELEMETRY.gauge("quality_psi_max", 0.9)    # > 0.2 ceiling
+        v = self._engine().evaluate()
+        assert {"shed_budget", "psi"} <= set(v["breaching"])
+
+    def test_windowed_delta_ages_out_old_breach(self):
+        """A latency spike older than both windows must not keep the
+        rule breaching: the burn is computed on windowed deltas, not
+        cumulative totals."""
+        TELEMETRY.configure("counters")
+        rules = slo.parse_rules(json.dumps(
+            {"rules": [RULES["rules"][0]],
+             "fast_window_s": 0.05, "slow_window_s": 0.1}))
+        eng = slo.SloEngine(rules, interval_s=10.0)
+        for _ in range(40):
+            TELEMETRY.observe("predict_latency_ms", 400.0)
+        assert eng.evaluate()["breaching"] == ["p99_latency"]
+        # settle past both windows; new traffic is fast
+        time.sleep(0.12)
+        eng.evaluate()     # baseline snapshot past the spike
+        for _ in range(40):
+            TELEMETRY.observe("predict_latency_ms", 1.0)
+        time.sleep(0.12)
+        eng.evaluate()
+        v = eng.evaluate()
+        assert not v["breaching"], v
+        # recovery journaled the transition
+        assert any(e["kind"] == "slo_recover"
+                   for e in TELEMETRY.journal.events())
+
+    def test_http_route_and_check_cli_rc(self):
+        TELEMETRY.configure("counters")
+        eng = self._engine()
+        slo.install(eng)
+        srv = TELEMETRY.serve_metrics(0)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}"
+            TELEMETRY.observe("predict_latency_ms", 1.0)
+            assert slo.main(["check", "--url", url]) == 0
+            for _ in range(40):
+                TELEMETRY.observe("predict_latency_ms", 400.0)
+            assert slo.main(["check", "--url", url]) == 1
+            assert slo.main([]) == 2
+            assert slo.main(["check"]) == 2
+            assert slo.main(
+                ["check", "--url", "http://127.0.0.1:1"]) == 2
+        finally:
+            slo.install(None)
+            TELEMETRY.stop_metrics_server()
+
+    def test_config_knob_validates_eagerly(self, tmp_path):
+        from lightgbm_tpu.config import Config
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"rules": [{"kind": "nope"}]}')
+        with pytest.raises(ValueError, match="slo_rules"):
+            Config.from_params({"verbose": -1,
+                                "slo_rules": str(bad)})
+        with pytest.raises(ValueError, match="slo_eval_interval_s"):
+            Config.from_params({"verbose": -1,
+                                "slo_eval_interval_s": 0})
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(RULES))
+        try:
+            Config.from_params({"verbose": -1,
+                                "slo_rules": str(good)})
+            assert slo.active() is not None
+            assert TELEMETRY._resolve_route("/slo") is not None
+        finally:
+            slo.install(None)
+
+
+# ---------------------------------------------------------------------------
+# prometheus textfile sharding
+# ---------------------------------------------------------------------------
+class TestPromShard:
+    def test_single_host_path_unchanged(self):
+        assert TELEMETRY.prom_shard_path("/x/metrics.prom") == \
+            "/x/metrics.prom"
+
+    def test_host_tagged_shard(self, monkeypatch):
+        monkeypatch.setenv("LTPU_HOST_ID", "3")
+        assert TELEMETRY.prom_shard_path("/x/metrics.prom") == \
+            "/x/metrics.host3.prom"
+        assert TELEMETRY.prom_shard_path("/x/metrics") == \
+            "/x/metrics.host3.prom"
+
+
+# ---------------------------------------------------------------------------
+# 2-process TCP run -> host-tagged shards -> one aligned timeline
+# ---------------------------------------------------------------------------
+_WORKER = r"""
+import os, sys
+rank, coord, prefix = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["LTPU_HOST_ID"] = str(rank)
+import numpy as np
+from lightgbm_tpu.telemetry import TELEMETRY
+from lightgbm_tpu.parallel import transport as T
+TELEMETRY.configure("spans")
+TELEMETRY.reset()
+t = T.TcpTransport.create(coord, 2, rank)
+TELEMETRY.mark_sync()
+out = t.allgather(np.asarray([float(rank)], dtype=np.float64))
+assert out.shape[0] == 2 and out[1, 0] == 1.0
+TELEMETRY.journal.emit("worker_done", seam="transport.round",
+                       rank=rank)
+t.close()
+TELEMETRY.export(prefix)
+print("worker", rank, "ok")
+"""
+
+
+def _free_coord():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"localhost:{port}"
+
+
+class TestTwoProcessMerge:
+    def test_tcp_shards_merge_into_one_aligned_timeline(self,
+                                                        tmp_path):
+        coord = _free_coord()
+        prefix = str(tmp_path / "fleet")
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(r), coord, prefix],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for r in range(2)]
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, err[-2000:]
+        shards = [f"{prefix}.host{r}.jsonl" for r in range(2)]
+        for s in shards:
+            assert os.path.exists(s), s
+            assert os.path.exists(
+                s[:-len(".jsonl")] + ".events.jsonl")
+        merged = merge_shards(shards)
+        meta = merged["metadata"]
+        assert meta["hosts"] == [0, 1]
+        # clock-sync alignment: host 1's shard got shifted onto host
+        # 0's timeline (both marked the rendezvous sync)
+        assert meta["clock_shifts_us"], "no clock alignment happened"
+        assert "unaligned" not in meta
+        # both hosts' collective rounds share ONE trace id — the
+        # coordinator minted it, the roster shipped it
+        rounds = {}
+        for ev in merged["traceEvents"]:
+            if ev.get("name") == "transport_round":
+                rounds.setdefault(ev["pid"], []).append(
+                    (ev.get("args") or {}).get("trace"))
+        assert set(rounds) == {0, 1}, rounds
+        traces = {t for per in rounds.values() for t in per}
+        assert len(traces) == 1 and None not in traces, traces
+        # the journal instants ride the same merged timeline
+        inst = [ev for ev in merged["traceEvents"]
+                if ev.get("cat") == "journal"
+                and ev["name"].startswith("worker_done")]
+        assert {ev["pid"] for ev in inst} == {0, 1}
+
+    def test_merge_cli_prints_host_lanes(self, tmp_path, capsys):
+        # the pinned stdout contract survives event-shard siblings:
+        # 2 span shards + 2 auto-discovered event shards still print
+        # "2 host lane(s)"
+        for r in range(2):
+            TELEMETRY.configure("counters")
+            TELEMETRY.reset()
+            TELEMETRY.host_id = None   # unlatch: one process plays 2
+            TELEMETRY.mark_sync()
+            TELEMETRY.journal.emit("tick", n=r)
+            os.environ["LTPU_HOST_ID"] = str(r)
+            try:
+                TELEMETRY.export(str(tmp_path / "run"))
+            finally:
+                del os.environ["LTPU_HOST_ID"]
+            TELEMETRY.reset()
+        TELEMETRY.host_id = None
+        rc = telemetry_main(
+            ["merge", str(tmp_path / "run.host0.jsonl"),
+             str(tmp_path / "run.host1.jsonl"),
+             "-o", str(tmp_path / "m.json")])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "merged 2 shard(s), 2 host lane(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# transport control plane: epoch events journaled with the run trace
+# ---------------------------------------------------------------------------
+class TestTransportJournal:
+    def test_degrade_emits_epoch_change_with_trace(self):
+        """Thread-world transport: kill a member, let the coordinator
+        degrade the world, and find the epoch_change journal event
+        carrying the fleet trace id (tests/test_transport.py owns the
+        protocol mechanics; this pins the observability surface)."""
+        from lightgbm_tpu.parallel import transport as T
+        TELEMETRY.configure("counters")
+        config = None
+        coord = _free_coord()
+        results = {}
+
+        def member(rank):
+            t = T.TcpTransport.create(coord, 2, rank, config=config)
+            results[rank] = t
+        threads = [threading.Thread(target=member, args=(r,),
+                                    daemon=True) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        t0, t1 = results[0], results[1]
+        assert t0.trace_id and t0.trace_id == t1.trace_id
+        trace_id = t0.trace_id
+        t1.close()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            info = t0.epoch_tick(allow_degraded=True)
+            if info.get("changed"):
+                break
+            time.sleep(0.05)
+        t0.close()
+        evs = [e for e in TELEMETRY.journal.events()
+               if e["kind"] == "epoch_change"]
+        assert evs, "degrade produced no epoch_change journal event"
+        assert evs[-1]["fields"]["trace"] == trace_id
+        assert any(e["kind"] == "membership_degrade"
+                   for e in TELEMETRY.journal.events())
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

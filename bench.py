@@ -1201,6 +1201,34 @@ for mode in ("f32", "q16", "q8"):
         "rounds": int(c.get("collective_tcp_rounds", 0)) // reps,
         "wall_ms": round(wall * 1e3, 2),
     }
+# frame-CRC cost on the q16 wire path, measured two ways: the
+# ANALYTIC fraction (the actual payload digest timed over exactly the
+# q16 wire volume at the real per-frame granularity, divided by the
+# q16 round wall — robust to 1-core scheduler jitter) is the <2%
+# gate; the on/off wall delta is informational only
+wire = int(out["q16"]["payload_wire_bytes"]) \
+    + int(out["q16"]["scale_wire_bytes"])
+nframes = max(2 * int(out["q16"]["rounds"]), 1)
+frame = bytes(max(wire // nframes, 1))
+crc_reps = max(reps, 5)
+t0 = time.time()
+for _ in range(crc_reps):
+    for _ in range(nframes):
+        T._payload_crc(frame)
+crc_s = (time.time() - t0) / crc_reps
+T._FRAME_CRC = False
+t0 = time.time()
+for _ in range(reps):
+    tp.exchange_histograms(hist, "q16")
+nocrc_wall = (time.time() - t0) / reps
+T._FRAME_CRC = True
+out["crc"] = {
+    "q16_wire_bytes": wire,
+    "crc_ms": round(crc_s * 1e3, 3),
+    "crc_frac_of_q16_wall": round(
+        crc_s / max(out["q16"]["wall_ms"] / 1e3, 1e-9), 4),
+    "q16_wall_ms_nocrc": round(nocrc_wall * 1e3, 2),
+}
 tp.close()
 if pid == 0:
     print(json.dumps(out))
@@ -1252,6 +1280,7 @@ def run_distributed_exchange(params):
                 f"distributed_exchange worker failed: {e[-1500:]}")
         outs.append(o)
     modes = json.loads(outs[0].strip().splitlines()[-1])
+    crc = modes.pop("crc")
     ratio16 = modes["f32"]["payload_wire_bytes"] \
         / max(modes["q16"]["payload_wire_bytes"], 1)
     ratio8 = modes["f32"]["payload_wire_bytes"] \
@@ -1260,6 +1289,11 @@ def run_distributed_exchange(params):
         raise SystemExit(
             f"distributed_exchange wire gate failed: q16 {ratio16:.2f}x"
             f" (need >=2.0), q8 {ratio8:.2f}x (need >=4.0) vs f32")
+    if crc["crc_frac_of_q16_wall"] >= 0.02:
+        raise SystemExit(
+            "distributed_exchange crc gate failed: frame-CRC costs "
+            f"{crc['crc_frac_of_q16_wall'] * 100:.2f}% of the q16 "
+            "wire path (budget <2%)")
     return {
         "task": "distributed_exchange", "world": 2,
         "hist_shape": [leaves, groups, bins, 3],
@@ -1271,6 +1305,9 @@ def run_distributed_exchange(params):
             / max(modes["q16"]["total_wire_bytes"], 1), 2),
         "parity": "pass",
         "wire_gate": "pass",
+        "crc": crc,
+        "crc_overhead_frac": crc["crc_frac_of_q16_wall"],
+        "crc_gate": "pass",
     }
 
 

@@ -383,6 +383,14 @@ class Config:
     # sharded_allow_degraded), and waiting joiners are admitted with a
     # state + shard-cache handoff.  1 = a boundary after every
     # iteration (fastest re-join, one tiny control round each)
+    transport_reconnect_retries: int = 3  # in-epoch reconnect dials
+    # after a reset/EOF mid-collective before the peer is declared
+    # TransportPeerLost (degrade path): a transient network blip heals
+    # with an idempotent resend instead of permanently shrinking the
+    # world; 0 disables reconnection (every reset degrades, the pre-
+    # hardening behavior).  Each dial backs off exponentially inside
+    # the armed collective deadline (docs/RELIABILITY.md
+    # reconnect-vs-degrade row)
 
     # -- tpu-specific (new; no reference analog) --
     hist_compute_dtype: str = "float32"  # one-hot matmul input dtype
@@ -934,6 +942,10 @@ class Config:
         if self.transport_epoch_iters < 1:
             raise ValueError("transport_epoch_iters must be >= 1, got "
                              f"{self.transport_epoch_iters}")
+        if self.transport_reconnect_retries < 0:
+            raise ValueError(
+                "transport_reconnect_retries must be >= 0, got "
+                f"{self.transport_reconnect_retries}")
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError(f"num_class must be >= 2 for {self.objective}")
         if self.objective not in ("multiclass", "multiclassova") and self.num_class != 1:

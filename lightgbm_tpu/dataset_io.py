@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 import pickle
 import struct
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -50,6 +51,66 @@ FORMAT_VERSION_CRUMB = 4
 # a 10k-feature dataset pickle to a few MB; a length field past this is
 # a corrupted or hostile file, not a real header)
 _MAX_HEADER_BYTES = 1 << 31
+# r20 trailing integrity footer: magic + crc32(header blob) +
+# crc32(bin section).  The raw bin section is otherwise UNPROTECTED —
+# a torn write or flipped page there would train silently wrong.  The
+# footer trails the bins so the memmap offset of every existing v2/v3/
+# v4 file is unchanged; pre-footer files load with a warning.
+FOOTER_MAGIC = b"LTPUFTR1"
+_FOOTER = struct.Struct("<II")
+_FOOTER_LEN = len(FOOTER_MAGIC) + _FOOTER.size
+_CRC_FOLD_MIN = 4096
+
+
+def _section_crc(buf) -> int:
+    """Digest of one cache section, tiered exactly like the wire-frame
+    digest (parallel/transport.py): plain crc32 below the fold
+    threshold, crc32 of the 64-bit XOR word-fold above it — the fold
+    is memory-bandwidth-bound, so verifying a multi-GB bin matrix
+    costs a streaming read, not a software-crc32 crawl."""
+    mv = memoryview(buf).cast("B")
+    n = len(mv)
+    if n < _CRC_FOLD_MIN:
+        return zlib.crc32(mv) & 0xFFFFFFFF
+    nw = n & ~7
+    words = np.frombuffer(mv[:nw], dtype="<u8")
+    fold = int(np.bitwise_xor.reduce(words))
+    crc = zlib.crc32(fold.to_bytes(8, "little"))
+    return zlib.crc32(mv[nw:], crc) & 0xFFFFFFFF
+
+
+def _verify_footer(f, filename: str, header_blob: bytes, gb) -> None:
+    """Read + verify the trailing footer; ``f`` must be positioned at
+    the first byte after the bin section.  Anything
+    between "no trailing bytes at all" (a legacy pre-footer cache,
+    loads with a warning) and "a well-formed footer whose digests
+    match" is rejected loudly — a half-written footer IS a torn
+    write."""
+    tail = f.read()
+    if not tail:
+        Log.warning(
+            f"{filename}: no integrity footer (pre-footer cache) — "
+            "loading unverified; re-save to add section digests")
+        return
+    if (len(tail) != _FOOTER_LEN
+            or tail[:len(FOOTER_MAGIC)] != FOOTER_MAGIC):
+        Log.fatal(
+            f"{filename}: corrupted v2 trailer ({len(tail)} trailing "
+            "bytes after the bin section do not form an integrity "
+            "footer — torn write?)")
+    want_h, want_b = _FOOTER.unpack(tail[len(FOOTER_MAGIC):])
+    got_h = _section_crc(header_blob)
+    if got_h != want_h:
+        Log.fatal(
+            f"{filename}: v2 header digest mismatch (recorded "
+            f"{want_h:#010x}, computed {got_h:#010x}) — the cache is "
+            "corrupt; delete and rebuild it")
+    got_b = _section_crc(gb) if gb is not None else 0
+    if got_b != want_b:
+        Log.fatal(
+            f"{filename}: v2 bin-section digest mismatch (recorded "
+            f"{want_b:#010x}, computed {got_b:#010x}) — the cache is "
+            "corrupt; delete and rebuild it")
 
 # Virtual file schemes (the reference's VirtualFileReader/Writer +
 # HDFSFile seam, src/io/file_io.cpp:54-165).  HDFS itself is a
@@ -157,6 +218,9 @@ def save_binary(dataset: Dataset, filename: str,
             # raw bytes, no pickle framing: this section is what
             # load_binary memmaps in place
             f.write(memoryview(gb).cast("B"))
+        f.write(FOOTER_MAGIC)
+        f.write(_FOOTER.pack(_section_crc(blob),
+                             _section_crc(gb) if gb is not None else 0))
     Log.info(f"Saved binned dataset to binary file {filename} "
              f"(v{header['version']})")
 
@@ -198,25 +262,30 @@ def _read_v2(f, filename: str):
         Log.fatal(f"{filename}: unsupported binary dataset version "
                   f"{payload.get('version')!r}")
     shape = payload.get("bins_shape")
+    offset = len(BINARY_TOKEN) + len(MAGIC_V2) + 8 + blob_len
     if shape is None:
+        _verify_footer(f, filename, blob, None)
         return payload, None
     shape = tuple(int(s) for s in shape)
     need = int(np.prod(shape, dtype=np.int64))
-    offset = len(BINARY_TOKEN) + len(MAGIC_V2) + 8 + blob_len
     if "://" not in filename and os.path.isfile(filename):
         if os.path.getsize(filename) - offset < need:
             Log.fatal(f"{filename}: truncated v2 bin section (need "
                       f"{need} bytes)")
         # the zero-copy path: the packed matrix stays a read-only
         # page-cache mapping; RSS grows only with pages actually read
+        # (the footer digest below streams it once through the page
+        # cache — evictable, never an unpickled in-RSS duplicate)
         gb = np.memmap(filename, dtype=np.uint8, mode="r",
                        offset=offset, shape=shape)
+        f.seek(offset + need)
     else:
         buf = f.read(need)
         if len(buf) != need:
             Log.fatal(f"{filename}: truncated v2 bin section (need "
                       f"{need} bytes)")
         gb = np.frombuffer(buf, dtype=np.uint8).reshape(shape)
+    _verify_footer(f, filename, blob, gb)
     return payload, gb
 
 

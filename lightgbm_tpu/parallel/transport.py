@@ -65,11 +65,14 @@ splits), the ``collective_tcp_round_ms`` latency histogram, and the
 """
 from __future__ import annotations
 
+import collections
 import pickle
+import select
 import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -78,10 +81,22 @@ from ..utils.log import Log
 
 TRANSPORT_MODES = ("auto", "xla", "tcp")
 
-# frame header: magic | tag | payload length
+# wire protocol v2: every frame is
+#   magic(u16) | ver(u8) | tag(u8) | seq(u32) | len(u32) | crc32(u32)
+# The CRC covers the payload (crc 0 = unprotected frame, the bench's
+# overhead-measurement mode); seq is a per-peer DATA-frame sequence
+# number (control frames carry 0) — a reconnect re-sends the same seq,
+# and the receiver's dup-discard makes the retried round idempotent.
+# A v1 peer's 8-byte header parses here as ver 0 -> the version-skew
+# refusal below, not a silent desync.
+PROTOCOL_VERSION = 2
 _MAGIC = 0x4C54                       # "LT"
-_HDR = struct.Struct(">HHI")
-# frame tags (wire protocol v1)
+_HDR = struct.Struct(">HBBIII")
+# CRC verification toggle — module-level so the distributed_exchange
+# bench can measure the wire path with integrity off; everything else
+# runs with it ON
+_FRAME_CRC = True
+# frame tags (unchanged since wire v1)
 TAG_DATA = 1        # collective payload
 TAG_HELLO = 2       # rendezvous: rank announces its data listener
 TAG_ROSTER = 3      # coordinator -> members: the epoch-0 ledger
@@ -97,6 +112,13 @@ TAG_HANDOFF = 8     # coordinator -> joiner: state + manifest handoff
 # blocks until the running world reaches its next epoch boundary
 _CTRL_TIMEOUT_S = 120.0
 _JOIN_TIMEOUT_S = 600.0
+# after EOF on a member's control socket the coordinator waits this
+# long for the member to re-home on a fresh connection (a control-plane
+# blip) before declaring it dead; bounded by the collective budget
+_REHOME_GRACE_S = 2.0
+# single-candidate dial timeout during failover walks / reconnects —
+# a dead process refuses instantly, this only bounds a wedged host
+_DIAL_TIMEOUT_S = 5.0
 
 
 class TransportError(ConnectionError):
@@ -120,11 +142,54 @@ class TransportPeerLost(TransportError):
               "(epoch_tick; docs/RELIABILITY.md peer-death row)")
 
 
+class FrameCorrupt(TransportError):
+    """A received frame failed its CRC32 payload check.  Counted as
+    ``collective_tcp_crc_errors`` and journaled (kind ``crc_error``)
+    at the receive site; a corrupt DATA frame converts to a clean
+    in-epoch reconnect + idempotent resend, a corrupt CONTROL frame
+    stays loud."""
+
+    def __init__(self, tag: int, peer, want: int, got: int):
+        self.tag = tag
+        self.peer = peer
+        super().__init__(
+            f"frame CRC mismatch on tag {tag} from peer {peer}: "
+            f"header crc 0x{want:08x}, payload crc 0x{got:08x} — "
+            "bytes were corrupted in flight (never applied; "
+            "docs/RELIABILITY.md frame-integrity row)")
+
+
 # ---------------------------------------------------------------------------
 # framing
 # ---------------------------------------------------------------------------
-def _send_frame(sock: socket.socket, tag: int, payload: bytes) -> int:
-    sock.sendall(_HDR.pack(_MAGIC, tag, len(payload)) + payload)
+# payload-digest fold threshold: frames below it get a plain crc32;
+# larger frames get the crc32 of their 64-bit XOR word-fold (+ tail
+# bytes), which runs at memory bandwidth (~30x software crc32 here) —
+# that is what keeps integrity-on inside the distributed_exchange
+# bench's <2% q16 wire-path budget.  The fold catches any single-bit
+# flip (and any odd number of flips per bit column); uncorrelated
+# multi-word corruption escapes with ~2^-64 fold-collision odds.
+_CRC_FOLD_MIN = 4096
+
+
+def _payload_crc(payload: bytes) -> int:
+    """The 4-byte header digest over ``payload`` (see fold note
+    above).  Both ends compute the same function, so the header field
+    stays a plain u32 checksum."""
+    if len(payload) < _CRC_FOLD_MIN:
+        return zlib.crc32(payload) & 0xFFFFFFFF
+    n = len(payload) & ~7
+    words = np.frombuffer(payload, dtype="<u8", count=n // 8)
+    fold = int(np.bitwise_xor.reduce(words))
+    crc = zlib.crc32(fold.to_bytes(8, "little"))
+    return zlib.crc32(payload[n:], crc) & 0xFFFFFFFF
+
+
+def _send_frame(sock: socket.socket, tag: int, payload: bytes,
+                seq: int = 0) -> int:
+    crc = _payload_crc(payload) if _FRAME_CRC else 0
+    sock.sendall(_HDR.pack(_MAGIC, PROTOCOL_VERSION, tag, seq,
+                           len(payload), crc) + payload)
     return len(payload)
 
 
@@ -141,17 +206,52 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_frame(sock: socket.socket,
-                expect_tag: Optional[int] = None) -> Tuple[int, bytes]:
-    magic, tag, n = _HDR.unpack(_recv_exact(sock, _HDR.size))
+                expect_tag: Optional[int] = None,
+                peer="?") -> Tuple[int, int, bytes]:
+    """Read one frame -> (tag, seq, payload).  Verifies magic, then
+    protocol version (BEFORE trusting the length field — a skewed
+    peer's header is not laid out like ours), then the payload CRC."""
+    magic, ver, tag, seq, n, crc = _HDR.unpack(
+        _recv_exact(sock, _HDR.size))
     if magic != _MAGIC:
         raise TransportError(
             f"bad frame magic 0x{magic:04x} (expected 0x{_MAGIC:04x}) "
             "— not a lightgbm_tpu transport peer, or a desynchronized "
             "stream")
+    if ver != PROTOCOL_VERSION:
+        raise TransportError(
+            f"transport protocol version mismatch: peer {peer} speaks "
+            f"v{ver}, this process speaks v{PROTOCOL_VERSION} — "
+            "upgrade skew across the world (a rolling restart must "
+            "finish before mixed versions exchange frames; restart "
+            "the older participant on the current build)")
     if expect_tag is not None and tag != expect_tag:
         raise TransportError(
             f"unexpected frame tag {tag} (expected {expect_tag})")
-    return tag, _recv_exact(sock, n)
+    payload = _recv_exact(sock, n)
+    if _FRAME_CRC and crc != 0:
+        got = _payload_crc(payload)
+        if got != crc:
+            from ..telemetry import TELEMETRY
+            TELEMETRY.add("collective_tcp_crc_errors", 1)
+            TELEMETRY.journal.emit(
+                "crc_error", seam="transport.round", tag=tag,
+                peer=str(peer), seq=seq, nbytes=n)
+            raise FrameCorrupt(tag, peer, crc, got)
+    return tag, seq, payload
+
+
+def _refuse_skew(payload: dict, who: str) -> None:
+    """Handshake-level (HELLO/IDENT/TICK) protocol-version refusal —
+    the frame layer already rejects skewed headers; this catches a
+    same-header build whose PAYLOAD contract moved."""
+    ver = int(payload.get("ver", 0))
+    if ver != PROTOCOL_VERSION:
+        raise TransportError(
+            f"{who} announced transport protocol v{ver}, this process "
+            f"speaks v{PROTOCOL_VERSION} — upgrade skew: finish the "
+            "rolling restart (restart the older participant) before "
+            "it joins the world")
 
 
 def _obj_frame(obj) -> bytes:
@@ -263,6 +363,25 @@ class TcpTransport:
         self._retry_policy = None
         self._lock = threading.Lock()
         self._closed = False
+        # the coordinator is ALWAYS the lowest rank in the ledger
+        # (founding coordinator is rank 0; joiners only ever get fresh
+        # higher ranks) — so the successor after a coordinator death
+        # is named by the replicated ledger itself, no election
+        self._coord_rank: int = 0
+        # reconnect dials per blip before TransportPeerLost/degrade
+        self.reconnect_retries: int = 3
+        # per-peer DATA-frame sequence state (reset at epoch flips,
+        # KEPT across in-epoch reconnects — that continuity is what
+        # makes a re-sent round idempotent)
+        self._send_seq: Dict[int, int] = {}
+        self._recv_seq: Dict[int, int] = {}
+        # the last few DATA frames sent per peer, for resend after a
+        # reconnect (a sender runs at most one round ahead of a
+        # receiver, so a short log always covers the unacked window)
+        self._sent_log: Dict[int, collections.deque] = {}
+        # JOIN connections that arrived on the data listener outside a
+        # tick (a joiner walking the ledger) — served at the next tick
+        self._pending_joins: List[Tuple[socket.socket, dict]] = []
 
     # -- identity -----------------------------------------------------
     @property
@@ -275,7 +394,7 @@ class TcpTransport:
 
     @property
     def is_coordinator(self) -> bool:
-        return self._ctrl_listener is not None
+        return self.rank == self._coord_rank
 
     # -- construction -------------------------------------------------
     @classmethod
@@ -305,8 +424,10 @@ class TcpTransport:
             members = {0: self._my_addr}
             for _ in range(num_processes - 1):
                 conn = self._accept(self._ctrl_listener)
-                _, payload = _recv_frame(conn, TAG_HELLO)
+                _, _, payload = _recv_frame(conn, TAG_HELLO)
                 hello = pickle.loads(payload)
+                _refuse_skew(hello, "rendezvous HELLO from rank "
+                             f"{hello.get('rank')}")
                 r = int(hello["rank"])
                 if r in members or r in self._ctrl:
                     raise TransportError(
@@ -329,12 +450,15 @@ class TcpTransport:
             self._coord_sock = self._connect_retry(host, port)
             _send_frame(self._coord_sock, TAG_HELLO, _obj_frame(
                 {"rank": self.rank, "host": self._my_addr[0],
-                 "port": self._my_addr[1]}))
+                 "port": self._my_addr[1],
+                 "ver": PROTOCOL_VERSION}))
             self._coord_sock.settimeout(_CTRL_TIMEOUT_S)
-            _, payload = _recv_frame(self._coord_sock, TAG_ROSTER)
+            _, _, payload = _recv_frame(self._coord_sock, TAG_ROSTER,
+                                        peer="coordinator")
             state = pickle.loads(payload)
             self.trace_id = str(state.get("trace", ""))
             self.ledger = WorldLedger.from_state(state)
+        self._coord_rank = min(self.ledger.members)
         self._build_mesh()
         self._note_world()
         Log.info(f"tcp transport up: rank {self.rank} of "
@@ -345,27 +469,67 @@ class TcpTransport:
     @classmethod
     def join(cls, coordinator_address: str, config=None,
              bind_host: Optional[str] = None,
-             timeout_s: float = _JOIN_TIMEOUT_S) -> "TcpTransport":
+             timeout_s: float = _JOIN_TIMEOUT_S,
+             ledger=None) -> "TcpTransport":
         """Elastic re-join: connect to a RUNNING world's coordinator,
         wait for admission at its next epoch boundary, receive the
         new ledger + the handoff payload (``self.handoff``), and build
-        the mesh as a fresh rank."""
+        the mesh as a fresh rank.
+
+        ``ledger`` (a :class:`WorldLedger` or its ``to_state()`` dict,
+        e.g. from a checkpoint or a prior directive) arms the STALE
+        COORDINATOR WALK: if ``coordinator_address`` refuses, the
+        joiner dials the ledger's members in ascending rank order —
+        the lowest live rank IS the coordinator (failover invariant),
+        so the first successful connect lands the JOIN at the right
+        door (the coordinator drains its data listener every tick)."""
         self = cls()
         self._init_policy(config)
         host, port = _parse_addr(coordinator_address)
         my_host = bind_host or host
         self._data_listener = _listen(my_host, 0)
         self._my_addr = (my_host, self._data_listener.getsockname()[1])
-        self._coord_sock = self._connect_retry(host, port)
+        led = None
+        if ledger is not None:
+            led = ledger if isinstance(ledger, WorldLedger) \
+                else WorldLedger.from_state(dict(ledger))
+        if led is None:
+            self._coord_sock = self._connect_retry(host, port)
+        else:
+            candidates = [("coordinator", (host, port))] + \
+                [(f"ledger rank {r}", led.members[r])
+                 for r in led.ranks()]
+            last: Optional[BaseException] = None
+            for who, (h, p) in candidates:
+                try:
+                    self._coord_sock = _dial(h, int(p))
+                    break
+                except (ConnectionError, OSError, socket.timeout) as e:
+                    last = e
+                    Log.warning(
+                        f"join: {who} at {h}:{p} unreachable ({e}) — "
+                        "walking the replicated ledger for the live "
+                        "coordinator")
+            else:
+                raise TransportError(
+                    f"join: no reachable coordinator among "
+                    f"{len(candidates)} candidate(s) — the whole "
+                    f"world is gone? (last: {last})")
         _send_frame(self._coord_sock, TAG_JOIN, _obj_frame(
-            {"host": self._my_addr[0], "port": self._my_addr[1]}))
+            {"host": self._my_addr[0], "port": self._my_addr[1],
+             "ver": PROTOCOL_VERSION}))
         self._coord_sock.settimeout(float(timeout_s))
-        _, payload = _recv_frame(self._coord_sock, TAG_DIRECTIVE)
+        _, _, payload = _recv_frame(self._coord_sock, TAG_DIRECTIVE,
+                                    peer="coordinator")
         directive = pickle.loads(payload)
         self.rank = int(directive["you"])
         self.trace_id = str(directive.get("trace", ""))
         self.ledger = WorldLedger.from_state(directive["ledger"])
-        _, hpayload = _recv_frame(self._coord_sock, TAG_HANDOFF)
+        self._coord_rank = min(self.ledger.members)
+        if directive.get("hmeta"):
+            self.handoff_meta = dict(directive["hmeta"])
+        _, _, hpayload = _recv_frame(self._coord_sock, TAG_HANDOFF,
+                                     peer="coordinator")
         self.handoff = pickle.loads(hpayload)
         self._coord_sock.settimeout(_CTRL_TIMEOUT_S)
         self._build_mesh()
@@ -389,6 +553,8 @@ class TcpTransport:
                 float(getattr(config, "time_out", 2)) * 60.0
             self.epoch_every = max(1, int(getattr(
                 config, "transport_epoch_iters", 1) or 1))
+            self.reconnect_retries = max(0, int(getattr(
+                config, "transport_reconnect_retries", 3)))
 
     def _connect_retry(self, host: str, port: int) -> socket.socket:
         """Coordinator/peer connect under the bounded retry policy —
@@ -399,7 +565,13 @@ class TcpTransport:
         from ..reliability.retry import retry_call
 
         def _connect():
-            FAULTS.fault_point("transport.connect")
+            from ..reliability.faults import TransportChaos
+            try:
+                FAULTS.fault_point("transport.connect")
+            except TransportChaos as e:
+                # a network-shaped chaos action at connect time IS a
+                # failed dial: transient, retried under the policy
+                raise ConnectionResetError(str(e)) from e
             s = socket.create_connection((host, port),
                                          timeout=_CTRL_TIMEOUT_S)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -423,6 +595,11 @@ class TcpTransport:
         for s in self._peers.values():
             _quiet_close(s)
         self._peers = {}
+        # fresh epoch, fresh sequence space (in-epoch reconnects KEEP
+        # these — see _reconnect_peer)
+        self._send_seq = {}
+        self._recv_seq = {}
+        self._sent_log = {}
         lower = [r for r in self.ledger.ranks() if r < self.rank]
         higher = [r for r in self.ledger.ranks() if r > self.rank]
         # connect up to every lower rank...
@@ -430,14 +607,30 @@ class TcpTransport:
             h, p = self.ledger.members[r]
             s = self._connect_retry(h, p)
             _send_frame(s, TAG_IDENT, _obj_frame(
-                {"rank": self.rank, "epoch": self.epoch}))
+                {"rank": self.rank, "epoch": self.epoch,
+                 "ver": PROTOCOL_VERSION}))
             self._peers[r] = s
         # ...and accept every higher rank (any order)
         expect = set(higher)
         while expect:
             conn = self._accept(self._data_listener)
-            _, payload = _recv_frame(conn, TAG_IDENT)
+            try:
+                tag, _, payload = _recv_frame(conn)
+            except TransportError:
+                _quiet_close(conn)
+                continue
+            if tag == TAG_JOIN:
+                # a ledger-walking joiner knocked during a reform —
+                # park it for the next epoch tick
+                self._pending_joins.append((conn,
+                                            pickle.loads(payload)))
+                continue
+            if tag != TAG_IDENT:
+                _quiet_close(conn)
+                continue
             ident = pickle.loads(payload)
+            _refuse_skew(ident, "mesh IDENT from rank "
+                         f"{ident.get('rank')}")
             r = int(ident["rank"])
             if int(ident.get("epoch", self.epoch)) != self.epoch:
                 # a corpse from a previous epoch racing the reform —
@@ -449,6 +642,13 @@ class TcpTransport:
                 raise TransportError(
                     f"unexpected mesh peer rank {r} "
                     f"(expected one of {sorted(expect)})")
+            if ident.get("reconnect"):
+                # a reconnect dial raced the epoch flip: complete its
+                # ack handshake so the dialer unblocks
+                _send_frame(conn, TAG_IDENT, _obj_frame(
+                    {"rank": self.rank, "epoch": self.epoch,
+                     "ver": PROTOCOL_VERSION,
+                     "ack": self._recv_seq.get(r, 0)}))
             expect.discard(r)
             self._peers[r] = conn
 
@@ -498,73 +698,118 @@ class TcpTransport:
                      sends: List[Tuple[int, bytes]],
                      recvs: List[int]) -> Dict[int, bytes]:
         from ..reliability import watchdog as _watchdog
-        from ..reliability.faults import FAULTS
+        from ..reliability.faults import FAULTS, TransportChaos
         from ..telemetry import TELEMETRY as tm
 
+        chaos: Optional[TransportChaos] = None
         try:
             FAULTS.fault_point("transport.round")
+        except TransportChaos as e:
+            chaos = e          # applied to real frames below
         except ConnectionError as e:
-            # an injected peer_drop IS a reset socket: classify it the
-            # way a real one classifies
+            # an injected peer_drop IS a reset socket with no live
+            # endpoint to re-dial: classify it the way a real one
+            # classifies
             raise TransportPeerLost(None, str(e)) from e
         deadline = _watchdog.deadline("collective")
         budget = deadline if deadline > 0 else _CTRL_TIMEOUT_S
         t0 = time.perf_counter()
-        nbytes = 0
-        peer = None
-        # sends ride a helper thread so a same-peer exchange can never
-        # deadlock on full TCP buffers (both sides blocked in sendall)
-        send_err: List[BaseException] = []
-
-        def _do_sends():
-            try:
-                for r, payload in sends:
-                    self._peer(r).settimeout(budget)
-                    _send_frame(self._peer(r), TAG_DATA, payload)
-            except BaseException as e:  # noqa: BLE001 - relayed
-                send_err.append(e)
-
-        sender = threading.Thread(target=_do_sends, daemon=True)
-        sender.start()
+        # sequence numbers are assigned ONCE per round — a reconnect
+        # re-sends the SAME seq, and the receiver's dup-discard makes
+        # the retried round idempotent (a chunk is never double-added)
+        seq_of: Dict[int, int] = {}
+        for r, _p in sends:
+            seq_of[r] = self._send_seq.get(r, 0) + 1
+            self._send_seq[r] = seq_of[r]
+        corrupt = chaos is not None and chaos.action == "corrupt"
+        dup = chaos is not None and chaos.action == "dup"
+        if chaos is not None and chaos.action == "partition":
+            self._chaos_partition(recvs or [r for r, _ in sends],
+                                  chaos.duration_ms)
         out: Dict[int, bytes] = {}
-        try:
-            for peer in recvs:
-                s = self._peer(peer)
-                s.settimeout(budget)
-                _, out[peer] = _recv_frame(s, TAG_DATA)
-                nbytes += len(out[peer])
-        except socket.timeout:
-            elapsed = time.perf_counter() - t0
-            if deadline > 0:
-                _watchdog._record_stall("host_collective",
-                                        "transport.round", deadline,
-                                        elapsed)
-                raise _watchdog.StallError(
-                    phase="host_collective", seam="transport.round",
-                    deadline_s=deadline, elapsed_s=elapsed) from None
-            raise TransportPeerLost(
-                peer, f"no frame within {budget:g}s") from None
-        except (ConnectionError, OSError, TransportError) as e:
-            if isinstance(e, TransportPeerLost):
-                raise
-            raise TransportPeerLost(peer, str(e)) from e
-        sender.join(timeout=budget)
-        if send_err:
-            e = send_err[0]
-            if isinstance(e, socket.timeout) and deadline > 0:
+        sent_ok: set = set()
+        blips = 0
+        while True:
+            # sends ride a helper thread so a same-peer exchange can
+            # never deadlock on full TCP buffers (both sides blocked
+            # in sendall)
+            send_err: List[Tuple[Optional[int], BaseException]] = []
+            pending = [(r, p) for r, p in sends if r not in sent_ok]
+
+            def _do_sends(pending=pending, send_err=send_err,
+                          corrupt=corrupt, dup=dup):
+                for r, payload in pending:
+                    try:
+                        if dup:
+                            self._replay_last(r, budget)
+                            dup = False
+                        self._send_data(r, payload, seq_of[r], budget,
+                                        corrupt=corrupt)
+                        corrupt = False
+                        sent_ok.add(r)
+                    except BaseException as e:  # noqa: BLE001
+                        send_err.append((r, e))
+                        return
+
+            sender = threading.Thread(target=_do_sends, daemon=True)
+            sender.start()
+            blip: Optional[Tuple[Optional[int], BaseException]] = None
+            peer = None
+            try:
+                for peer in recvs:
+                    if peer in out:
+                        continue
+                    out[peer] = self._recv_data(peer, budget)
+            except socket.timeout:
                 elapsed = time.perf_counter() - t0
-                _watchdog._record_stall("host_collective",
-                                        "transport.round", deadline,
-                                        elapsed)
-                raise _watchdog.StallError(
-                    phase="host_collective", seam="transport.round",
-                    deadline_s=deadline, elapsed_s=elapsed)
-            if isinstance(e, (ConnectionError, OSError,
-                              TransportError)) \
-                    and not isinstance(e, TransportPeerLost):
-                raise TransportPeerLost(None, str(e)) from e
-            raise e
-        nbytes += sum(len(p) for _, p in sends)
+                if deadline > 0:
+                    _watchdog._record_stall("host_collective",
+                                            "transport.round",
+                                            deadline, elapsed)
+                    raise _watchdog.StallError(
+                        phase="host_collective",
+                        seam="transport.round",
+                        deadline_s=deadline,
+                        elapsed_s=elapsed) from None
+                raise TransportPeerLost(
+                    peer, f"no frame within {budget:g}s") from None
+            except (ConnectionError, OSError, TransportError) as e:
+                if isinstance(e, TransportPeerLost):
+                    raise
+                blip = (peer, e)
+            sender.join(timeout=budget)
+            for r, e in send_err:
+                if isinstance(e, socket.timeout) and deadline > 0:
+                    elapsed = time.perf_counter() - t0
+                    _watchdog._record_stall("host_collective",
+                                            "transport.round",
+                                            deadline, elapsed)
+                    raise _watchdog.StallError(
+                        phase="host_collective",
+                        seam="transport.round",
+                        deadline_s=deadline, elapsed_s=elapsed)
+                if isinstance(e, (ConnectionError, OSError,
+                                  TransportError)) \
+                        and not isinstance(e, TransportPeerLost):
+                    if blip is None:
+                        blip = (r, e)
+                else:
+                    raise e
+            # chaos one-shots are consumed by the first attempt; a
+            # retried attempt re-sends the TRUE frame
+            corrupt = dup = False
+            if blip is None:
+                break
+            rank, cause = blip
+            blips += 1
+            if rank is None or blips > self.reconnect_retries:
+                raise TransportPeerLost(rank, str(cause)) from cause
+            # a reset/EOF/corrupt frame mid-round is a transient blip
+            # until reconnection exhausts — reconnect within the
+            # epoch, resync by ack, resend what the peer never applied
+            self._reconnect_peer(rank, budget, cause)
+        nbytes = sum(len(out[p]) for p in out) \
+            + sum(len(p) for _, p in sends)
         if tm.on:
             tm.add("collective_tcp_bytes", nbytes)
             tm.add("collective_tcp_rounds", 1)
@@ -573,6 +818,237 @@ class TcpTransport:
             tm.observe("collective_tcp_round_ms",
                        (time.perf_counter() - t0) * 1e3)
         return out
+
+    # -- data-plane frames, reconnection ------------------------------
+    def _send_data(self, rank: int, payload: bytes, seq: int,
+                   budget: float, corrupt: bool = False) -> None:
+        """One DATA frame to ``rank``, logged for post-reconnect
+        resend.  ``corrupt`` (chaos) flips one payload bit IN FLIGHT —
+        the header CRC still covers the true bytes, so the receiver
+        must detect it."""
+        # log BEFORE touching the socket: a dead socket must not keep
+        # this frame out of the post-reconnect resend window
+        log = self._sent_log.setdefault(
+            rank, collections.deque(maxlen=4))
+        if not log or log[-1][0] != seq:
+            log.append((seq, payload))
+        s = self._peer(rank)
+        s.settimeout(budget)
+        if corrupt and payload:
+            crc = _payload_crc(payload) if _FRAME_CRC else 0
+            bad = bytearray(payload)
+            bad[0] ^= 0x01
+            s.sendall(_HDR.pack(_MAGIC, PROTOCOL_VERSION, TAG_DATA,
+                                seq, len(bad), crc) + bytes(bad))
+            return
+        _send_frame(s, TAG_DATA, payload, seq=seq)
+
+    def _recv_data(self, rank: int, budget: float) -> bytes:
+        """One in-sequence DATA payload from ``rank``: replayed or
+        duplicated frames (seq <= last applied) are discarded, a
+        sequence GAP is loud — it means a frame this process never saw
+        was silently skipped."""
+        from ..telemetry import TELEMETRY
+        last = self._recv_seq.get(rank, 0)
+        while True:
+            s = self._peer(rank)
+            s.settimeout(budget)
+            _, seq, payload = _recv_frame(s, TAG_DATA, peer=rank)
+            if seq <= last:
+                TELEMETRY.add("collective_tcp_dup_frames", 1)
+                continue
+            if seq != last + 1:
+                raise TransportError(
+                    f"DATA sequence gap from rank {rank}: got seq "
+                    f"{seq}, expected {last + 1} — a frame was lost "
+                    "without a reconnect resync")
+            self._recv_seq[rank] = seq
+            return payload
+
+    def _replay_last(self, rank: int, budget: float) -> None:
+        """Chaos ``dup``: re-send the most recent DATA frame to
+        ``rank`` with its ORIGINAL seq — the receiver's dup-discard
+        must drop it."""
+        log = self._sent_log.get(rank)
+        if not log:
+            return
+        seq, payload = log[-1]
+        s = self._peer(rank)
+        s.settimeout(budget)
+        _send_frame(s, TAG_DATA, payload, seq=seq)
+
+    def _chaos_partition(self, victims: List[int], ms: int) -> None:
+        """Chaos ``partition:<ms>``: sever the link to the first
+        listed peer in BOTH directions (close our end — the peer sees
+        FIN/RST), sit out the outage, then proceed into the round;
+        reconnection heals the link and the resynced round completes
+        bit-exact."""
+        for v in victims:
+            s = self._peers.get(v)
+            if s is not None:
+                Log.debug(f"chaos partition: severing link to rank "
+                          f"{v} for {ms} ms")
+                _quiet_close(s)
+                break
+        time.sleep(max(0, int(ms)) / 1e3)
+
+    def _reconnect_peer(self, rank: int, budget: float,
+                        cause: BaseException) -> None:
+        """Heal the link to ``rank`` WITHIN the epoch: the higher rank
+        dials the lower rank's data listener (same direction as the
+        mesh build) under bounded backoff; an IDENT{reconnect} ack
+        exchange tells each side the other's last applied seq, and any
+        unacked frame is re-sent from the sent log.  Exhaustion — and
+        only exhaustion — converts to :class:`TransportPeerLost`."""
+        from ..telemetry import TELEMETRY
+        old = self._peers.pop(rank, None)
+        if old is not None:
+            _quiet_close(old)
+        if rank not in self.ledger.members:
+            raise TransportPeerLost(
+                rank, f"not in the epoch-{self.epoch} ledger") \
+                from cause
+        deadline_at = time.monotonic() + budget
+        delay = 0.05
+        last: BaseException = cause
+        for attempt in range(max(1, self.reconnect_retries)):
+            remain = deadline_at - time.monotonic()
+            if remain <= 0:
+                break
+            try:
+                if self.rank > rank:
+                    conn = self._dial_reconnect(rank, remain)
+                else:
+                    conn = self._accept_reconnect(rank, remain)
+                self._peers[rank] = conn
+                TELEMETRY.add("collective_tcp_reconnects", 1)
+                TELEMETRY.journal.emit(
+                    "reconnect", seam="transport.round", peer=rank,
+                    rank=self.rank, epoch=self.epoch,
+                    trace=self.trace_id, attempt=attempt + 1,
+                    cause=str(cause)[:160])
+                Log.warning(
+                    f"tcp transport: link to rank {rank} reconnected "
+                    f"within epoch {self.epoch} (attempt "
+                    f"{attempt + 1}; cause: {cause})")
+                return
+            except (ConnectionError, OSError, socket.timeout,
+                    TransportError) as e:
+                last = e
+                time.sleep(min(delay, max(0.0, deadline_at
+                                          - time.monotonic())))
+                delay = min(delay * 2, 1.0)
+        raise TransportPeerLost(
+            rank, f"reconnect exhausted after "
+            f"{max(1, self.reconnect_retries)} attempt(s) "
+            f"(last: {last})") from cause
+
+    def _dial_reconnect(self, rank: int,
+                        remain: float) -> socket.socket:
+        h, p = self.ledger.members[rank]
+        s = _dial(h, p, timeout=min(_DIAL_TIMEOUT_S, remain))
+        try:
+            s.settimeout(max(0.1, remain))
+            _send_frame(s, TAG_IDENT, _obj_frame(
+                {"rank": self.rank, "epoch": self.epoch,
+                 "ver": PROTOCOL_VERSION, "reconnect": True,
+                 "ack": self._recv_seq.get(rank, 0)}))
+            _, _, payload = _recv_frame(s, TAG_IDENT, peer=rank)
+            reply = pickle.loads(payload)
+            if int(reply.get("epoch", -1)) != self.epoch:
+                raise TransportError(
+                    f"reconnect ack from rank {rank} is for epoch "
+                    f"{reply.get('epoch')}, not {self.epoch}")
+            self._resync(rank, s, int(reply.get("ack", 0)))
+            return s
+        except BaseException:
+            _quiet_close(s)
+            raise
+
+    def _accept_reconnect(self, rank: int,
+                          remain: float) -> socket.socket:
+        """Lower-rank side of a reconnect: accept on the data listener
+        until the expected peer's IDENT{reconnect} arrives (other
+        valid reconnects are adopted in passing; stale epochs and
+        stray frames are refused).  Each attempt's wait is capped at
+        the re-home grace, NOT the full collective budget — a live
+        blipped peer redials within milliseconds, so a silent listener
+        means the peer is dead and waiting the whole budget would turn
+        every peer death into a near-hang for its lower-rank
+        survivors."""
+        deadline_at = time.monotonic() + min(remain, _REHOME_GRACE_S)
+        while True:
+            left = deadline_at - time.monotonic()
+            if left <= 0:
+                raise socket.timeout(
+                    f"no reconnect dial from rank {rank} within "
+                    f"{remain:g}s")
+            self._data_listener.settimeout(min(0.5, left))
+            try:
+                conn, _ = self._data_listener.accept()
+            except (socket.timeout, BlockingIOError):
+                continue
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(min(_DIAL_TIMEOUT_S, max(0.1, left)))
+            try:
+                tag, _, payload = _recv_frame(conn)
+                obj = pickle.loads(payload)
+            except (ConnectionError, OSError, socket.timeout,
+                    TransportError):
+                _quiet_close(conn)
+                continue
+            if tag == TAG_JOIN:
+                self._pending_joins.append((conn, obj))
+                continue
+            if tag != TAG_IDENT \
+                    or int(obj.get("epoch", -1)) != self.epoch \
+                    or not obj.get("reconnect"):
+                _quiet_close(conn)
+                continue
+            _refuse_skew(obj, "reconnect IDENT from rank "
+                         f"{obj.get('rank')}")
+            r = int(obj["rank"])
+            try:
+                _send_frame(conn, TAG_IDENT, _obj_frame(
+                    {"rank": self.rank, "epoch": self.epoch,
+                     "ver": PROTOCOL_VERSION,
+                     "ack": self._recv_seq.get(r, 0)}))
+                self._resync(r, conn, int(obj.get("ack", 0)))
+            except (ConnectionError, OSError, socket.timeout,
+                    TransportError):
+                # a failed handshake must CLOSE the conn — a leaked
+                # half-healed socket leaves the dialer believing the
+                # link is up and blocking on a frame that never comes
+                _quiet_close(conn)
+                continue
+            if r == rank:
+                return conn
+            # a concurrent blip on another link: adopt its healed
+            # socket and keep waiting for the one we came for
+            old = self._peers.pop(r, None)
+            if old is not None:
+                _quiet_close(old)
+            self._peers[r] = conn
+
+    def _resync(self, rank: int, sock: socket.socket,
+                their_ack: int) -> None:
+        """Post-reconnect resend: every logged frame the peer never
+        applied (seq > their ack) goes again, in order, with its
+        ORIGINAL seq.  An ack below the log's reach is loud — the
+        frames to replay are gone."""
+        sent = self._send_seq.get(rank, 0)
+        if sent <= their_ack:
+            return
+        log = self._sent_log.get(rank) or ()
+        replay = [(q, p) for q, p in log if q > their_ack]
+        if not replay or replay[0][0] != their_ack + 1:
+            raise TransportError(
+                f"reconnect resync with rank {rank} impossible: peer "
+                f"acked seq {their_ack}, sent log covers "
+                f"{[q for q, _ in log]} — unacked frames fell out of "
+                "the resend window")
+        for q, p in replay:
+            _send_frame(sock, TAG_DATA, p, seq=q)
 
     # -- collectives --------------------------------------------------
     def allgather_bytes(self, payload: bytes,
@@ -784,62 +1260,270 @@ class TcpTransport:
         :class:`TransportPeerLost` — the fail-fast default mirrors
         ``sharded_allow_degraded``."""
         from ..reliability import watchdog as _watchdog
-        from ..reliability.faults import FAULTS
+        from ..reliability.faults import FAULTS, TransportChaos
+        chaos = None
         try:
             FAULTS.fault_point("transport.round")
+        except TransportChaos as e:
+            chaos = e
         except ConnectionError as e:
             raise TransportPeerLost(None, str(e)) from e
         deadline = _watchdog.deadline("collective")
         budget = deadline if deadline > 0 else _CTRL_TIMEOUT_S
-        if self.rank != 0:
-            return self._member_tick(budget)
-        return self._coordinator_tick(handoff, allow_degraded, budget)
+        if chaos is not None and chaos.action == "partition" \
+                and self.rank != self._coord_rank \
+                and self._coord_sock is not None:
+            # control-plane blip: sever our coordinator link; the
+            # member tick below heals it by re-homing through the
+            # coordinator's data listener (same walk as failover)
+            _quiet_close(self._coord_sock)
+            time.sleep(max(0, chaos.duration_ms) / 1e3)
+        if self.rank == self._coord_rank:
+            return self._coordinator_tick(handoff, allow_degraded,
+                                          budget)
+        return self._member_tick(handoff, allow_degraded, budget)
 
-    def _member_tick(self, budget: float) -> dict:
+    def _member_tick(self, handoff, allow_degraded: bool,
+                     budget: float) -> dict:
         try:
+            if self._coord_sock is None:
+                raise TransportError("no coordinator socket")
             self._coord_sock.settimeout(budget)
             _send_frame(self._coord_sock, TAG_TICK, _obj_frame(
                 {"rank": self.rank, "epoch": self.epoch,
-                 "trace": self.trace_id}))
-            _, payload = _recv_frame(self._coord_sock, TAG_DIRECTIVE)
+                 "trace": self.trace_id, "ver": PROTOCOL_VERSION}))
+            _, _, payload = _recv_frame(self._coord_sock,
+                                        TAG_DIRECTIVE,
+                                        peer="coordinator")
         except (ConnectionError, OSError, socket.timeout,
                 TransportError) as e:
-            raise TransportPeerLost(0, f"coordinator: {e}") from e
+            return self._coordinator_failover(e, handoff,
+                                              allow_degraded, budget)
         directive = pickle.loads(payload)
         return self._adopt(directive)
 
-    def _coordinator_tick(self, handoff, allow_degraded: bool,
-                          budget: float) -> dict:
-        dead: List[int] = []
-        for r in [r for r in self.ledger.ranks() if r != 0]:
-            conn = self._ctrl.get(r)
-            if conn is None:
-                dead.append(r)
-                continue
-            try:
-                conn.settimeout(budget)
-                _recv_frame(conn, TAG_TICK)
-            except (ConnectionError, OSError, socket.timeout,
-                    TransportError):
-                dead.append(r)
-                _quiet_close(conn)
-                self._ctrl.pop(r, None)
-        joins: List[Tuple[socket.socket, dict]] = []
-        # drain pending JOIN connects (non-blocking poll)
-        while True:
-            self._ctrl_listener.settimeout(0.0)
-            try:
-                conn, _ = self._ctrl_listener.accept()
-            except (BlockingIOError, socket.timeout, OSError):
+    def _coordinator_failover(self, cause, handoff,
+                              allow_degraded: bool,
+                              budget: float) -> dict:
+        """The coordinator is unreachable at a tick.  Walk the
+        REPLICATED ledger inside a ``watchdog_collective_s``-bounded
+        grace: re-dial the old coordinator's data listener first (a
+        control-plane blip heals by re-homing to the SAME coordinator
+        — no spurious failover on a one-sided reset), then every
+        survivor in ascending rank order.  The lowest live rank is the
+        deterministic successor; reaching our own rank on the walk
+        means WE are it."""
+        from ..reliability.faults import FAULTS
+        try:
+            FAULTS.fault_point("transport.failover")
+        except ConnectionError as e:
+            raise TransportPeerLost(self._coord_rank, str(e)) from e
+        old = self._coord_rank
+        if self._coord_sock is not None:
+            _quiet_close(self._coord_sock)
+            self._coord_sock = None
+        Log.warning(
+            f"tcp transport rank {self.rank}: coordinator rank {old} "
+            f"unreachable at epoch {self.epoch} tick ({cause}) — "
+            "walking the replicated ledger for the successor "
+            "(docs/RELIABILITY.md coordinator-failover runbook)")
+        deadline_at = time.monotonic() + budget
+        candidates = [old] + [r for r in self.ledger.ranks()
+                              if r != old]
+        last: BaseException = cause
+        for cand in candidates:
+            remain = deadline_at - time.monotonic()
+            if remain <= 0:
                 break
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn.settimeout(_CTRL_TIMEOUT_S)
+            if cand == self.rank:
+                return self._become_coordinator(
+                    old, handoff, allow_degraded, max(0.5, remain))
+            addr = self.ledger.members.get(cand)
+            if addr is None:
+                continue
+            s = None
             try:
-                _, payload = _recv_frame(conn, TAG_JOIN)
-                joins.append((conn, pickle.loads(payload)))
+                s = _dial(addr[0], addr[1],
+                          timeout=min(_DIAL_TIMEOUT_S, remain))
+                s.settimeout(max(0.5, remain))
+                _send_frame(s, TAG_TICK, _obj_frame(
+                    {"rank": self.rank, "epoch": self.epoch,
+                     "trace": self.trace_id,
+                     "ver": PROTOCOL_VERSION, "rehome": True}))
+                _, _, payload = _recv_frame(
+                    s, TAG_DIRECTIVE, peer=f"successor {cand}")
+            except (ConnectionError, OSError, socket.timeout,
+                    TransportError) as e:
+                last = e
+                if s is not None:
+                    _quiet_close(s)
+                continue
+            self._coord_sock = s
+            from ..telemetry import TELEMETRY
+            TELEMETRY.add("collective_tcp_rehomes", 1)
+            TELEMETRY.journal.emit(
+                "reconnect", seam="transport.failover",
+                rank=self.rank, peer=cand, epoch=self.epoch,
+                trace=self.trace_id, control_plane=True,
+                cause=str(cause)[:160])
+            Log.warning(
+                f"tcp transport rank {self.rank}: re-homed control "
+                f"traffic to rank {cand} ({'same coordinator' if cand == old else 'successor'})")
+            return self._adopt(pickle.loads(payload))
+        raise TransportPeerLost(
+            old, f"coordinator failover exhausted every ledger "
+            f"candidate (last: {last})") from cause
+
+    def _become_coordinator(self, old: int, handoff,
+                            allow_degraded: bool,
+                            budget: float) -> dict:
+        """This process is the lowest surviving rank: journal the
+        change, take over the epoch protocol mid-run, and run the tick
+        we were already inside — collecting the other survivors'
+        re-homed TICKs on the data listener."""
+        self._coord_rank = self.rank
+        from ..telemetry import TELEMETRY
+        TELEMETRY.add("collective_tcp_coordinator_changes", 1)
+        TELEMETRY.journal.emit(
+            "coordinator_change", seam="transport.failover",
+            old=old, new=self.rank, epoch=self.epoch,
+            trace=self.trace_id, world=self.world_size)
+        Log.warning(
+            f"tcp transport: rank {self.rank} is the new coordinator "
+            f"(rank {old} died at epoch {self.epoch}; trace "
+            f"{self.trace_id or '-'}) — resuming the epoch protocol "
+            "mid-run")
+        return self._coordinator_tick(handoff, allow_degraded, budget,
+                                      pre_dead=[old])
+
+    def _drain_listener(self, listener: socket.socket, budget: float,
+                        ticked: Dict[int, bool],
+                        joins: List[Tuple[socket.socket, dict]],
+                        eof_at: Dict[int, float]) -> None:
+        """Accept every pending connection on ``listener`` and sort
+        its first frame: re-homed member TICKs replace control
+        sockets, JOINs queue for admission, reconnect IDENTs heal data
+        links that blipped into a tick boundary."""
+        while True:
+            listener.settimeout(0.0)
+            try:
+                conn, _ = listener.accept()
+            except (BlockingIOError, socket.timeout, OSError):
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(min(_DIAL_TIMEOUT_S, budget))
+            try:
+                tag, _, payload = _recv_frame(conn)
+                obj = pickle.loads(payload)
             except (ConnectionError, OSError, socket.timeout,
                     TransportError):
                 _quiet_close(conn)
+                continue
+            if tag == TAG_JOIN:
+                joins.append((conn, obj))
+                continue
+            if tag == TAG_TICK:
+                if int(obj.get("epoch", -1)) != self.epoch:
+                    _quiet_close(conn)   # stale-epoch corpse
+                    continue
+                r = int(obj["rank"])
+                old = self._ctrl.pop(r, None)
+                if old is not None:
+                    _quiet_close(old)
+                conn.settimeout(_CTRL_TIMEOUT_S)
+                self._ctrl[r] = conn
+                ticked[r] = True
+                eof_at.pop(r, None)
+                continue
+            if tag == TAG_IDENT and obj.get("reconnect") \
+                    and int(obj.get("epoch", -1)) == self.epoch:
+                r = int(obj["rank"])
+                _send_frame(conn, TAG_IDENT, _obj_frame(
+                    {"rank": self.rank, "epoch": self.epoch,
+                     "ver": PROTOCOL_VERSION,
+                     "ack": self._recv_seq.get(r, 0)}))
+                self._resync(r, conn, int(obj.get("ack", 0)))
+                stale = self._peers.pop(r, None)
+                if stale is not None:
+                    _quiet_close(stale)
+                self._peers[r] = conn
+                continue
+            _quiet_close(conn)
+
+    def _coordinator_tick(self, handoff, allow_degraded: bool,
+                          budget: float,
+                          pre_dead: Optional[List[int]] = None
+                          ) -> dict:
+        dead: List[int] = list(pre_dead or [])
+        expected = [r for r in self.ledger.ranks()
+                    if r != self.rank and r not in dead]
+        ticked: Dict[int, bool] = {}
+        joins: List[Tuple[socket.socket, dict]] = \
+            list(self._pending_joins)
+        self._pending_joins = []
+        # EOF on a member's control socket starts a short re-home
+        # grace (a blipped member re-dials our data listener) before
+        # the member is declared dead
+        eof_at: Dict[int, float] = {
+            r: 0.0 for r in expected if r not in self._ctrl}
+        deadline_at = time.monotonic() + budget
+        listeners = [ln for ln in (self._ctrl_listener,
+                                   self._data_listener)
+                     if ln is not None]
+        while True:
+            now = time.monotonic()
+            for r in list(expected):
+                if r in ticked:
+                    continue
+                started = eof_at.get(r)
+                if started is not None and started > 0 \
+                        and now - started > min(_REHOME_GRACE_S,
+                                                budget):
+                    dead.append(r)
+                    expected.remove(r)
+            pending = [r for r in expected if r not in ticked]
+            if not pending:
+                break
+            if now >= deadline_at:
+                for r in pending:
+                    dead.append(r)
+                    expected.remove(r)
+                break
+            socks = [self._ctrl[r] for r in pending
+                     if r in self._ctrl] + listeners
+            for r in pending:
+                # a fresh successor has no control socket yet: start
+                # its re-home wait against the FULL budget, not the
+                # EOF grace
+                if r not in self._ctrl and r not in eof_at:
+                    eof_at[r] = 0.0
+            try:
+                rd, _, _ = select.select(
+                    socks, [], [], min(0.25, deadline_at - now))
+            except (OSError, ValueError):
+                rd = []
+            for s in rd:
+                if s in listeners:
+                    self._drain_listener(s, budget, ticked, joins,
+                                         eof_at)
+                    continue
+                r = next((k for k, v in self._ctrl.items()
+                          if v is s), None)
+                if r is None:
+                    continue
+                try:
+                    _recv_frame(s, TAG_TICK, peer=r)
+                    ticked[r] = True
+                    eof_at.pop(r, None)
+                except (ConnectionError, OSError, socket.timeout,
+                        TransportError):
+                    _quiet_close(s)
+                    self._ctrl.pop(r, None)
+                    if r not in ticked:
+                        eof_at[r] = time.monotonic()
+        # one final drain for joiners/re-homes that raced the barrier
+        for ln in listeners:
+            self._drain_listener(ln, budget, ticked, joins, eof_at)
         if dead and not allow_degraded:
             for conn, _ in joins:
                 _quiet_close(conn)
@@ -860,6 +1544,17 @@ class TcpTransport:
                 f"degrades to {ledger.world_size} at epoch "
                 f"{ledger.epoch} (survivor shards continue; "
                 "docs/RELIABILITY.md)")
+        skewed = [(c, j) for c, j in joins
+                  if int(j.get("ver", 0)) != PROTOCOL_VERSION]
+        for conn, j in skewed:
+            Log.warning(
+                f"tcp transport: refusing joiner {j.get('host')}:"
+                f"{j.get('port')} speaking protocol v"
+                f"{j.get('ver', 0)} (this world speaks v"
+                f"{PROTOCOL_VERSION}) — finish the rolling restart "
+                "before it re-joins")
+            _quiet_close(conn)
+        joins = [(c, j) for c, j in joins if (c, j) not in skewed]
         if joins:
             ledger, admitted = ledger.admit(
                 [(j["host"], j["port"]) for _, j in joins])
@@ -871,9 +1566,13 @@ class TcpTransport:
                      f"{admitted} at epoch {ledger.epoch}")
         changed = ledger.epoch != self.ledger.epoch
         state = ledger.to_state()
+        # the full ledger AND the handoff metadata ride EVERY
+        # directive: any member can serve as successor without ever
+        # having talked to a joiner
         directive = {"ledger": state, "changed": changed,
                      "dead": dead, "admitted": admitted,
-                     "trace": self.trace_id}
+                     "trace": self.trace_id, "coord": self.rank,
+                     "hmeta": dict(self.handoff_meta)}
         for r, conn in list(self._ctrl.items()):
             try:
                 _send_frame(conn, TAG_DIRECTIVE,
@@ -891,13 +1590,17 @@ class TcpTransport:
                 {"meta": dict(self.handoff_meta),
                  "state": handoff_bytes}))
             self._ctrl[r] = conn
-        return self._adopt(dict(directive, you=0))
+        return self._adopt(dict(directive, you=self.rank))
 
     def _adopt(self, directive: dict) -> dict:
         new = WorldLedger.from_state(directive["ledger"])
         changed = bool(directive.get("changed"))
         if directive.get("trace"):
             self.trace_id = str(directive["trace"])
+        if directive.get("hmeta"):
+            # replicated so ANY survivor can serve joiners after a
+            # coordinator death
+            self.handoff_meta = dict(directive["hmeta"])
         if changed:
             self.ledger = new
             self._build_mesh()
@@ -912,6 +1615,8 @@ class TcpTransport:
                 world=self.world_size, trace=self.trace_id,
                 dead=list(directive.get("dead") or []),
                 admitted=list(directive.get("admitted") or []))
+        # the coordinator is named by the ledger itself: lowest rank
+        self._coord_rank = min(self.ledger.members)
         info = {"epoch": self.epoch, "world_size": self.world_size,
                 "changed": changed,
                 "dead": list(directive.get("dead") or []),
@@ -928,12 +1633,16 @@ class TcpTransport:
             _quiet_close(s)
         for s in self._ctrl.values():
             _quiet_close(s)
+        for conn, _ in self._pending_joins:
+            _quiet_close(conn)
         for s in (self._coord_sock, self._ctrl_listener,
                   self._data_listener):
             if s is not None:
                 _quiet_close(s)
         self._peers = {}
         self._ctrl = {}
+        self._pending_joins = []
+        self._sent_log = {}
 
 
 # ---------------------------------------------------------------------------
@@ -945,6 +1654,16 @@ def _parse_addr(address: str) -> Tuple[str, int]:
             f"coordinator address {address!r} must be host:port")
     host, _, port = address.rpartition(":")
     return host, int(port)
+
+
+def _dial(host: str, port: int,
+          timeout: float = _DIAL_TIMEOUT_S) -> socket.socket:
+    """One bounded dial (no retry policy): failover walks and
+    reconnects bound each candidate attempt themselves."""
+    s = socket.create_connection((host, int(port)),
+                                 timeout=max(0.1, timeout))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
 
 
 def _listen(host: str, port: int) -> socket.socket:

@@ -43,15 +43,21 @@ from typing import List, Sequence, Tuple
 # the full drawable action set; "hang"/"slow" get a drawn duration
 DEFAULT_ACTIONS = ("kill", "oom", "ConnectionError", "TimeoutError",
                    "OSError", "hang", "slow")
-# transport seams additionally draw the peer-shaped faults: a reset
-# socket (peer_drop -> TransportPeerLost -> epoch-boundary reform) and
-# a laggy-but-live peer (peer_slow:<ms>, must stay under any armed
-# watchdog_collective_s deadline)
-TRANSPORT_ACTIONS = DEFAULT_ACTIONS + ("peer_drop", "peer_slow")
+# transport seams additionally draw the network-shaped faults: a
+# reset socket (peer_drop -> TransportPeerLost -> epoch-boundary
+# reform), a laggy-but-live peer (peer_slow:<ms>, must stay under any
+# armed watchdog_collective_s deadline), a bit-flipped frame (corrupt
+# -> the CRC must catch it), a replayed frame (dup -> the seq
+# dup-discard must drop it) and a severed-then-healed link
+# (partition:<ms> -> the in-epoch reconnect must resync bit-exact)
+TRANSPORT_ACTIONS = DEFAULT_ACTIONS + (
+    "peer_drop", "peer_slow", "corrupt", "dup", "partition")
 # hang durations default WELL past any test deadline (the watchdog is
-# supposed to fire first); slow durations stay small (tolerated)
+# supposed to fire first); slow durations stay small (tolerated);
+# partitions heal inside the reconnect budget
 DEFAULT_HANG_MS = (2000, 8000)
 DEFAULT_SLOW_MS = (5, 50)
+DEFAULT_PARTITION_MS = (20, 120)
 
 
 def chaos_seams(seam_glob: str = "*") -> List[str]:
@@ -75,7 +81,8 @@ def chaos_entries(seed: int, n_faults: int, seam_glob: str = "*",
                   actions: Sequence[str] = DEFAULT_ACTIONS,
                   max_nth: int = 4,
                   hang_ms: Tuple[int, int] = DEFAULT_HANG_MS,
-                  slow_ms: Tuple[int, int] = DEFAULT_SLOW_MS
+                  slow_ms: Tuple[int, int] = DEFAULT_SLOW_MS,
+                  partition_ms: Tuple[int, int] = DEFAULT_PARTITION_MS
                   ) -> List[Tuple[str, int, str]]:
     """Draw ``n_faults`` deterministic (seam, nth, action) tuples.
     Same arguments -> byte-identical plan, always (``random.Random``
@@ -118,6 +125,8 @@ def chaos_entries(seed: int, n_faults: int, seam_glob: str = "*",
             action = f"hang:{rng.randint(*hang_ms)}"
         elif action in ("slow", "peer_slow"):
             action = f"{action}:{rng.randint(*slow_ms)}"
+        elif action == "partition":
+            action = f"partition:{rng.randint(*partition_ms)}"
         entries.append((seam, nth, action))
     return entries
 

@@ -41,6 +41,19 @@ Plan grammar (``LTPU_FAULT_PLAN`` env var or ``Config.fault_plan``)::
                                    DELAYS ms milliseconds then
                                    proceeds (must stay under any armed
                                    watchdog_collective_s deadline)
+            | 'corrupt'         -- flip one payload bit in a frame in
+                                   flight (transport seams: the frame
+                                   CRC must catch it — loud error or
+                                   clean retried round, never silent
+                                   misdata)
+            | 'dup'             -- replay the last transport frame
+                                   (the receiver's sequence-number
+                                   dup-discard must drop it)
+            | 'partition' ':' ms -- sever one peer link BOTH ways for
+                                   ms milliseconds, then heal (the
+                                   in-epoch reconnect must resync and
+                                   finish bit-exact with zero
+                                   degradation)
             | ExceptionName     -- a builtin exception class, e.g.
                                    ConnectionError, TimeoutError,
                                    OSError, RuntimeError
@@ -115,6 +128,14 @@ SEAMS = (
                              # here, and a hung peer past an armed
                              # watchdog_collective_s surfaces as a
                              # retryable StallError)
+    "transport.failover",    # coordinator-failover walk entry
+                             # (parallel/transport.py
+                             # _coordinator_failover — fires when a
+                             # member's tick finds the coordinator
+                             # unreachable, BEFORE any successor is
+                             # dialed; an injected fault here proves
+                             # the walk's own failure path converts to
+                             # TransportPeerLost, never a hang)
     "collectives.allgather", # host-side collective backend calls
     "collectives.hist_exchange",  # host-side compressed histogram
                              # exchange (parallel/collectives.py
@@ -147,6 +168,22 @@ class FaultInjected(Exception):
     exception name ('oom' and future synthetic actions)."""
 
 
+class TransportChaos(FaultInjected):
+    """The network-shaped chaos actions — ``corrupt`` (bit-flip a
+    payload in flight), ``dup`` (replay the last frame) and
+    ``partition:<ms>`` (sever the link both directions, then heal).
+    ``parallel/transport.py`` catches this at its seams and applies
+    the action to REAL frames; anywhere else it propagates as a loud
+    FaultInjected."""
+
+    def __init__(self, action: str, seam: str, call: int,
+                 duration_ms: int = 0):
+        self.action = action
+        self.duration_ms = int(duration_ms)
+        super().__init__(
+            f"{action} (injected at seam {seam}, call {call})")
+
+
 class _Entry:
     __slots__ = ("seam", "nth", "action", "count", "exc_type",
                  "duration_ms")
@@ -159,20 +196,22 @@ class _Entry:
         self.count = count
         self.exc_type = None
         self.duration_ms = int(duration_ms)
-        if action in ("hang", "slow", "peer_slow"):
+        if action in ("hang", "slow", "peer_slow", "partition"):
             if self.duration_ms < 1:
                 raise ValueError(
                     f"fault plan action {action!r} needs a positive "
                     "millisecond duration (hang:<ms> / slow:<ms> / "
-                    "peer_slow:<ms>)")
-        elif action not in ("kill", "oom", "peer_drop"):
+                    "peer_slow:<ms> / partition:<ms>)")
+        elif action not in ("kill", "oom", "peer_drop", "corrupt",
+                            "dup"):
             exc = getattr(builtins, action, None)
             if not (isinstance(exc, type)
                     and issubclass(exc, BaseException)):
                 raise ValueError(
                     f"fault plan action {action!r} is not 'kill', "
                     "'oom', 'hang:<ms>', 'slow:<ms>', 'peer_drop', "
-                    "'peer_slow:<ms>' or a builtin exception name")
+                    "'peer_slow:<ms>', 'corrupt', 'dup', "
+                    "'partition:<ms>' or a builtin exception name")
             self.exc_type = exc
 
     def matches(self, n: int) -> bool:
@@ -205,7 +244,7 @@ def parse_plan(spec: str) -> List[_Entry]:
             parts[2].strip()
         idx = 3
         duration_ms = 0
-        if action in ("hang", "slow", "peer_slow"):
+        if action in ("hang", "slow", "peer_slow", "partition"):
             if len(parts) < 4 or not parts[3].strip().isdigit():
                 raise ValueError(
                     f"fault plan entry {raw!r}: {action} needs a "
@@ -329,6 +368,12 @@ class FaultInjector:
             raise FaultInjected(
                 f"RESOURCE_EXHAUSTED: out of memory (injected at seam "
                 f"{seam}, call {n})")
+        if entry.action in ("corrupt", "dup", "partition"):
+            # network-shaped actions: the transport applies them to
+            # real frames in flight (bit-flip / replay / sever+heal);
+            # outside a transport seam this propagates loud
+            raise TransportChaos(entry.action, seam, n,
+                                 entry.duration_ms)
         if entry.action == "peer_drop":
             # the remote end of a transport round died: surface the
             # exact exception a reset TCP socket raises, so the

@@ -32,10 +32,25 @@ The catalog (docs/RELIABILITY.md, "Chaos testing"):
 ``loud_failure``
     Whenever work was lost, the process exited nonzero AND a flight
     dump names the seam that fired — no silent partial success.
+``transport_no_silent_misdata``
+    A chaos ``corrupt`` (bit-flipped frame in flight) is ALWAYS
+    caught: the CRC counter fired or the run failed loudly, and any
+    completed run's collective results are bit-identical to the
+    fault-free expectation — never silent wrong bytes.
+``partition_heals``
+    A healed ``partition:<ms>`` leaves the world byte-identical and
+    UNDEGRADED: results match the fault-free expectation, the world
+    size is unchanged, and the in-epoch reconnect path (not the
+    degrade path) did the healing (``collective_tcp_reconnects`` > 0).
+``coordinator_failover``
+    A coordinator killed mid-run does not kill the run: the lowest
+    surviving rank takes over (a ``coordinator_change`` journal event
+    exists), the run completes, and the results are byte-identical to
+    the uninterrupted reference.
 
 Invariants skip (return no violations) when their inputs are absent
-from the context, so one registry serves train, serve and continuous
-workloads.
+from the context, so one registry serves train, serve, continuous and
+transport workloads.
 """
 from __future__ import annotations
 
@@ -70,7 +85,18 @@ class ChaosContext:
     ``ledger_path``, ``served`` / ``expected`` (prediction arrays),
     ``exit_code`` + ``work_lost`` + ``flight_dumps`` (loud-failure
     evidence), ``seed`` + ``plan`` (replay identity, echoed into
-    violations)."""
+    violations).
+
+    Transport-chaos fields (all optional, set by the transport
+    workload): ``transport_result`` / ``transport_expected`` (lists of
+    collective result arrays, compared bit-wise),
+    ``transport_counters`` (telemetry counter snapshot),
+    ``transport_events`` (journal event kinds seen),
+    ``transport_corrupt_fired`` / ``transport_partition_fired`` /
+    ``coordinator_killed`` (which faults the plan injected),
+    ``transport_failed`` (the run raised — loud, acceptable for
+    corrupt; fatal for partition/failover), ``transport_world_start``
+    / ``transport_world_end`` (degradation evidence)."""
 
     def __init__(self, workdir: Optional[str] = None,
                  reference_model: Optional[str] = None,
@@ -80,7 +106,16 @@ class ChaosContext:
                  exit_code: Optional[int] = None,
                  work_lost: bool = False,
                  flight_dumps: Optional[Sequence[str]] = None,
-                 seed: Optional[int] = None, plan: str = ""):
+                 seed: Optional[int] = None, plan: str = "",
+                 transport_result=None, transport_expected=None,
+                 transport_counters: Optional[Dict[str, float]] = None,
+                 transport_events: Optional[Sequence[str]] = None,
+                 transport_corrupt_fired: bool = False,
+                 transport_partition_fired: bool = False,
+                 coordinator_killed: bool = False,
+                 transport_failed: bool = False,
+                 transport_world_start: Optional[int] = None,
+                 transport_world_end: Optional[int] = None):
         self.workdir = workdir
         self.reference_model = reference_model
         self.final_model = final_model
@@ -92,6 +127,17 @@ class ChaosContext:
         self.flight_dumps = list(flight_dumps or [])
         self.seed = seed
         self.plan = plan
+        self.transport_result = transport_result
+        self.transport_expected = transport_expected
+        self.transport_counters = dict(transport_counters or {})
+        self.transport_events = list(transport_events or [])
+        self.transport_corrupt_fired = bool(transport_corrupt_fired)
+        self.transport_partition_fired = bool(
+            transport_partition_fired)
+        self.coordinator_killed = bool(coordinator_killed)
+        self.transport_failed = bool(transport_failed)
+        self.transport_world_start = transport_world_start
+        self.transport_world_end = transport_world_end
 
 
 @invariant("resume_byte_identical")
@@ -185,6 +231,70 @@ def _loud_failure(ctx: ChaosContext) -> List[str]:
     if not any(seams - {""}):
         out.append("work was lost but no flight dump names the seam "
                    f"that fired (dumps scanned: {len(ctx.flight_dumps)})")
+    return out
+
+
+def _transport_mismatches(ctx: ChaosContext) -> List[str]:
+    """Bit-compare the transport workload's collective results
+    against the fault-free expectation (both lists of arrays)."""
+    if ctx.transport_result is None or ctx.transport_expected is None:
+        return []
+    got = [np.asarray(a) for a in ctx.transport_result]
+    want = [np.asarray(a) for a in ctx.transport_expected]
+    if len(got) != len(want):
+        return [f"{len(got)} collective result(s) vs "
+                f"{len(want)} expected"]
+    return [f"collective round {i} result differs from the "
+            "fault-free expectation — bytes went silently wrong"
+            for i, (g, w) in enumerate(zip(got, want))
+            if g.shape != w.shape or not np.array_equal(g, w)]
+
+
+@invariant("transport_no_silent_misdata")
+def _transport_no_silent_misdata(ctx: ChaosContext) -> List[str]:
+    if not ctx.transport_corrupt_fired:
+        return []
+    out: List[str] = []
+    crc = ctx.transport_counters.get("collective_tcp_crc_errors", 0)
+    if crc <= 0 and not ctx.transport_failed:
+        out.append("a corrupt frame was injected but the CRC never "
+                   "fired and the run did not fail loudly")
+    if not ctx.transport_failed:
+        out.extend(_transport_mismatches(ctx))
+    return out
+
+
+@invariant("partition_heals")
+def _partition_heals(ctx: ChaosContext) -> List[str]:
+    if not ctx.transport_partition_fired:
+        return []
+    if ctx.transport_failed:
+        return ["a healed partition must not fail the run — the "
+                "in-epoch reconnect should have resynced the round"]
+    out = _transport_mismatches(ctx)
+    if ctx.transport_counters.get("collective_tcp_reconnects", 0) <= 0:
+        out.append("partition healed without a counted reconnect — "
+                   "the degrade path, not the reconnect path, ran")
+    if (ctx.transport_world_start is not None
+            and ctx.transport_world_end is not None
+            and ctx.transport_world_end != ctx.transport_world_start):
+        out.append(f"world degraded {ctx.transport_world_start} -> "
+                   f"{ctx.transport_world_end} across a TRANSIENT "
+                   "partition")
+    return out
+
+
+@invariant("coordinator_failover")
+def _coordinator_failover(ctx: ChaosContext) -> List[str]:
+    if not ctx.coordinator_killed:
+        return []
+    if ctx.transport_failed:
+        return ["coordinator death killed the run — the lowest "
+                "surviving rank never took over"]
+    out = _transport_mismatches(ctx)
+    if "coordinator_change" not in ctx.transport_events:
+        out.append("no coordinator_change journal event — the "
+                   "successor never announced the takeover")
     return out
 
 

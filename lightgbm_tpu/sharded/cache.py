@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -46,6 +47,19 @@ class ShardCacheError(ValueError):
 
 def _shard_file(i: int) -> str:
     return f"shard_{i}.bin"
+
+
+def _manifest_crc(man: dict) -> int:
+    """Manifest self-digest: crc32 over the CANONICAL JSON (sorted
+    keys, compact separators — independent of on-disk pretty-printing)
+    of every field except the digest itself.  The atomic tmp+rename
+    writer rules out torn COMMITS, but not a flipped page or a partial
+    overwrite by an outside tool — this catches field-level corruption
+    that still parses as valid JSON."""
+    body = {k: v for k, v in man.items() if k != "manifest_crc"}
+    blob = json.dumps(body, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return zlib.crc32(blob) & 0xFFFFFFFF
 
 
 def _shard_core(sds: ShardedDataset, i: int) -> CoreDataset:
@@ -88,6 +102,7 @@ def save_shard_cache(sds: ShardedDataset, cache_dir: str) -> str:
         "bin_packing": lay.to_state() if lay is not None else None,
         "shards": shards,
     }
+    manifest["manifest_crc"] = _manifest_crc(manifest)
     mpath = os.path.join(cache_dir, MANIFEST_NAME)
     atomic_write_text(mpath, json.dumps(manifest, indent=1,
                                         sort_keys=True))
@@ -121,6 +136,18 @@ def load_shard_cache(cache_dir: str,
         raise ShardCacheError(
             f"{mpath}: corrupted shard-cache manifest "
             f"({type(e).__name__}: {e})") from e
+    if "manifest_crc" in man:
+        want = int(man["manifest_crc"])
+        got = _manifest_crc(man)
+        if got != want:
+            raise ShardCacheError(
+                f"{mpath}: manifest self-digest mismatch (recorded "
+                f"{want:#010x}, computed {got:#010x}) — torn or "
+                "corrupted manifest; reconstruct the cache")
+    else:
+        Log.warning(f"{mpath}: manifest carries no self-digest "
+                    "(pre-digest cache) — loading unverified; "
+                    "re-save to add it")
     if man.get("schema") != SHARD_CACHE_SCHEMA:
         raise ShardCacheError(
             f"{mpath}: shard-cache schema {man.get('schema')!r} "

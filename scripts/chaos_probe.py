@@ -3,8 +3,10 @@ train / serve / continuous stacks, gated by the invariant registry.
 
 Run by ``scripts/bench_smoke.sh`` and asserted by
 ``tests/test_bench_smoke.py``.  For each seed in ``CHAOS_SEEDS``
-(default 4) it runs one chaos plan per workload (>= 12 plans at the
-default budget), every plan drawn by the deterministic chaos
+(default 4) it runs one chaos plan per workload (>= 20 plans at the
+default budget; the transport workload contributes two — a network
+sweep and a coordinator-kill), every plan drawn by the deterministic
+chaos
 scheduler (``reliability/chaos.py``) so ANY red run replays exactly
 from the seed it prints:
 
@@ -24,6 +26,18 @@ from the seed it prints:
   the lane retries from its ledger until the cycle lands, and the
   candidate must be byte-identical to a fault-free reference lane
   over the same slices, with the ledger still replayable.
+- **transport** (in-process, threaded TCP world): per seed, (a) a
+  2-rank world runs exact-integer allreduces under two faults drawn
+  from the NETWORK action pool (``corrupt`` / ``partition:<ms>`` /
+  ``dup`` / ``slow`` / ``peer_slow``) on ``transport.round`` — the
+  CRC must catch every corrupt frame, the in-epoch reconnect must
+  heal every partition with zero degradation, and every completed
+  result must be BIT-identical to the fault-free expectation
+  (``transport_no_silent_misdata`` + ``partition_heals``); and (b) a
+  3-rank world loses its coordinator mid-run — the lowest surviving
+  rank must take over (``coordinator_change`` journaled), the world
+  reforms, and the remaining rounds stay bit-exact
+  (``coordinator_failover``).
 
 Env knobs: ``CHAOS_SEEDS`` (how many seeds per workload),
 ``CHAOS_BUDGET_S`` (wall budget — on excess the sweep stops with a
@@ -264,6 +278,208 @@ def continuous_plan(seed: int, workroot: str, setup: dict,
 
 
 # ---------------------------------------------------------------------------
+# transport workload (in-process threaded TCP world; network chaos)
+# ---------------------------------------------------------------------------
+# the survivable network pool: no kill/oom/peer_drop — an in-process
+# probe must outlive its own faults, and these five are exactly the
+# shapes the hardened transport claims to absorb
+TRANSPORT_POOL = ("corrupt", "partition", "dup", "slow", "peer_slow")
+TRANSPORT_ROUNDS = 6
+
+
+def _transport_world(world, fn, config=None, timeout=30.0):
+    """Threaded ``world``-rank TCP transport; returns (results,
+    errors) per rank.  Mirrors tests/test_transport.py::_run_world
+    but never re-raises — the caller feeds errors to the invariants."""
+    import socket as _socket
+    import threading
+
+    from lightgbm_tpu.parallel import transport as T
+    s = _socket.socket()
+    s.bind(("localhost", 0))
+    coord = f"localhost:{s.getsockname()[1]}"
+    s.close()
+    results, errors, tps = ([None] * world for _ in range(3))
+
+    def _member(rank):
+        try:
+            tps[rank] = T.TcpTransport.create(coord, world, rank,
+                                              config=config)
+            results[rank] = fn(tps[rank], rank)
+        except BaseException as e:  # noqa: BLE001 - judged by invariants
+            errors[rank] = e
+        finally:
+            if tps[rank] is not None:
+                tps[rank].close()
+
+    threads = [threading.Thread(target=_member, args=(r,),
+                                daemon=True) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    for i, t in enumerate(threads):
+        if t.is_alive() and errors[i] is None:
+            errors[i] = TimeoutError(f"rank {i} hung past {timeout}s")
+    return results, errors
+
+
+def _journal_kinds(since_seq: int):
+    from lightgbm_tpu.telemetry import TELEMETRY
+    return [e["kind"] for e in TELEMETRY.journal.events()
+            if e["seq"] > since_seq]
+
+
+def _counter_delta(before: dict, keys):
+    from lightgbm_tpu.telemetry import TELEMETRY
+    after = TELEMETRY.counters()
+    return {k: after.get(k, 0) - before.get(k, 0) for k in keys}
+
+
+_TCP_KEYS = ("collective_tcp_crc_errors", "collective_tcp_reconnects",
+             "collective_tcp_dup_frames", "collective_tcp_rehomes",
+             "collective_tcp_coordinator_changes")
+
+
+def transport_plan(seed: int) -> dict:
+    """(a) 2-rank network-chaos run: corrupt/partition/dup/slow drawn
+    on ``transport.round``, results bit-compared to the fault-free
+    expectation."""
+    import numpy as np
+
+    from lightgbm_tpu.reliability import watchdog
+    from lightgbm_tpu.reliability.chaos import chaos_spec
+    from lightgbm_tpu.reliability.faults import FAULTS
+    from lightgbm_tpu.reliability.invariants import (ChaosContext,
+                                                     violations)
+    from lightgbm_tpu.telemetry import TELEMETRY
+    spec = chaos_spec(seed, 2, "transport.round",
+                      actions=TRANSPORT_POOL, max_nth=8,
+                      slow_ms=(2, 15), partition_ms=(20, 80))
+    actions = {e.split(":")[2] for e in spec.split(";")}
+    before = TELEMETRY.counters()
+    seq0 = max([e["seq"] for e in TELEMETRY.journal.events()],
+               default=0)
+    FAULTS.configure(spec)
+    watchdog.set_deadline("collective", 8.0)
+
+    def work(tp, r):
+        return [tp.allreduce_sum(
+            np.arange(8, dtype=np.int64) * (k + 1) + r)
+            for k in range(TRANSPORT_ROUNDS)]
+
+    try:
+        res, errs = _transport_world(2, work)
+    finally:
+        FAULTS.reset()
+        watchdog.set_deadline("collective", 0.0)
+    failed = any(e is not None for e in errs)
+    expected = [np.arange(8, dtype=np.int64) * (k + 1) * 2 + 1
+                for k in range(TRANSPORT_ROUNDS)]
+    flat = [a for r in res if r is not None for a in r]
+    ctx = ChaosContext(
+        seed=seed, plan=spec,
+        transport_result=None if failed else flat,
+        transport_expected=None if failed
+        else [e for r in res if r is not None for e in expected],
+        transport_counters=_counter_delta(before, _TCP_KEYS),
+        transport_events=_journal_kinds(seq0),
+        transport_corrupt_fired="corrupt" in actions,
+        transport_partition_fired="partition" in actions,
+        transport_failed=failed)
+    viol = violations(ctx, ["transport_no_silent_misdata",
+                            "partition_heals"])
+    return {"workload": "transport", "mode": "net", "seed": seed,
+            "plan": spec, "errors": [type(e).__name__
+                                     for e in errs if e is not None],
+            "counters": ctx.transport_counters,
+            "violations": viol, "green": not viol}
+
+
+def transport_failover_plan(seed: int) -> dict:
+    """(b) coordinator-kill run: a 3-rank world loses rank 0 (the
+    coordinator) after round 2; the survivors must fail over to rank 1
+    and finish the remaining rounds bit-exact over the reformed
+    world."""
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.transport import TransportPeerLost
+    from lightgbm_tpu.reliability import watchdog
+    from lightgbm_tpu.reliability.invariants import (ChaosContext,
+                                                     violations)
+    from lightgbm_tpu.telemetry import TELEMETRY
+    kill_at = 3
+    before = TELEMETRY.counters()
+    seq0 = max([e["seq"] for e in TELEMETRY.journal.events()],
+               default=0)
+    cfg = Config.from_params({"verbose": -1,
+                              "transport_reconnect_retries": 1})
+    watchdog.set_deadline("collective", 2.0)
+    worlds = {}
+
+    def work(tp, r):
+        outs = []
+        k = 0
+        while k < TRANSPORT_ROUNDS:
+            if r == 0 and k == kill_at:
+                return outs          # coordinator dies (abrupt close)
+            try:
+                outs.append(tp.allreduce_sum(
+                    np.arange(8, dtype=np.int64) * (k + 1) + r))
+                tp.epoch_tick(handoff=lambda: b"",
+                              allow_degraded=True)
+            except (TransportPeerLost, watchdog.StallError):
+                # the dead coordinator surfaces here: reform the
+                # world (failover inside), then redo the round
+                tp.epoch_tick(handoff=lambda: b"",
+                              allow_degraded=True)
+                continue
+            k += 1
+        worlds[r] = tp.world_size
+        return outs
+
+    try:
+        res, errs = _transport_world(3, work, config=cfg, timeout=40.0)
+    finally:
+        watchdog.set_deadline("collective", 0.0)
+    failed = any(e is not None for e in errs)
+
+    def expect(r):
+        # rounds before the kill sum all three ranks; after the
+        # failover the world is {1, 2}
+        return [np.arange(8, dtype=np.int64) * (k + 1) * 3 + 3
+                if k < kill_at else
+                np.arange(8, dtype=np.int64) * (k + 1) * 2 + 3
+                for k in range(TRANSPORT_ROUNDS)]
+
+    flat, flat_exp = [], []
+    if not failed:
+        for r in (1, 2):
+            flat.extend(res[r] or [])
+            flat_exp.extend(expect(r))
+    ctx = ChaosContext(
+        seed=seed, plan=f"coordinator-kill@round{kill_at}",
+        transport_result=None if failed else flat,
+        transport_expected=None if failed else flat_exp,
+        transport_counters=_counter_delta(before, _TCP_KEYS),
+        transport_events=_journal_kinds(seq0),
+        coordinator_killed=True, transport_failed=failed,
+        transport_world_start=3,
+        transport_world_end=worlds.get(1))
+    viol = violations(ctx, ["coordinator_failover"])
+    if not failed and worlds.get(1) != 2:
+        viol.append(f"[seed {seed}] survivors ended at world "
+                    f"{worlds.get(1)}, expected 2")
+    return {"workload": "transport", "mode": "failover", "seed": seed,
+            "plan": ctx.plan,
+            "errors": [type(e).__name__ for e in errs
+                       if e is not None],
+            "counters": ctx.transport_counters,
+            "violations": viol, "green": not viol}
+
+
+# ---------------------------------------------------------------------------
 def main() -> int:
     out_json = sys.argv[1] if len(sys.argv) > 1 \
         else "/tmp/lgbtpu_smoke/chaos.json"
@@ -315,7 +531,9 @@ def main() -> int:
                     lambda: serve_plan(seed, serve_setup),
                     lambda: continuous_plan(seed, workroot,
                                             cont_setup,
-                                            cont_ref_model)):
+                                            cont_ref_model),
+                    lambda: transport_plan(seed),
+                    lambda: transport_failover_plan(seed)):
             if time.perf_counter() - t0 > BUDGET_S:
                 budget_exceeded = True
                 break
@@ -340,7 +558,8 @@ def main() -> int:
         "plans_green": green,
         "invariants": ["resume_byte_identical", "no_partial_artifacts",
                        "ledger_converges", "serving_parity",
-                       "loud_failure"],
+                       "loud_failure", "transport_no_silent_misdata",
+                       "partition_heals", "coordinator_failover"],
         "stalls_total": int(counters.get("stalls_total", 0)),
         "faults_injected": int(counters.get("faults_injected", 0)),
         "plans": plans,

@@ -19,6 +19,13 @@ that one path, a static check that the call is present guarantees
 every registered seam's firing lands in the journal; the per-seam
 runtime proof lives in tests/test_tracing.py.
 
+A fourth direction (transport lifecycle kinds, ISSUE 20): the TCP
+transport must journal its recovery lifecycle — ``coordinator_change``
+and ``reconnect`` (plus ``crc_error`` and ``membership_join``) emit
+calls in ``parallel/transport.py``.  A failover or an in-epoch
+reconnect that leaves no journal trail is undebuggable at 3am; the
+runtime proof lives in tests/test_transport.py.
+
 Runs in ``scripts/bench_smoke.sh`` before the bench; rc 0 clean,
 rc 1 drift (findings on stderr), matching the check_carry_layout /
 check_telemetry_coverage contract.  The seam registry is parsed
@@ -81,6 +88,22 @@ def journal_wired() -> bool:
         return "journal.emit(" in f.read()
 
 
+TRANSPORT_PY = os.path.join(REPO, "lightgbm_tpu", "parallel",
+                            "transport.py")
+TRANSPORT_JOURNAL_KINDS = ("coordinator_change", "reconnect",
+                           "crc_error", "membership_join")
+
+
+def transport_journal_missing():
+    """Transport lifecycle kinds with no ``journal.emit("<kind>"``
+    call left in parallel/transport.py (the emit's kind argument is
+    the first positional, possibly on the next line)."""
+    with open(TRANSPORT_PY) as f:
+        src = f.read()
+    return [k for k in TRANSPORT_JOURNAL_KINDS
+            if not re.search(r'journal\.emit\(\s*"%s"' % k, src)]
+
+
 def main() -> int:
     seams = registered_seams()
     sources = exercised_in()
@@ -91,6 +114,11 @@ def main() -> int:
             "the fault fire path in reliability/faults.py no longer "
             "journals firings (journal.emit( missing) — chaos/fault "
             "events would vanish from the fleet event journal")
+    for kind in transport_journal_missing():
+        drift.append(
+            f"parallel/transport.py no longer journals {kind!r} — "
+            "the transport recovery lifecycle (failover/reconnect/"
+            "integrity) would vanish from the fleet event journal")
     for seam in seams:
         users = [rel for rel, src in sources.items() if seam in src]
         if not users:

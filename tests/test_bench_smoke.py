@@ -135,10 +135,15 @@ def test_bench_smoke_json_contract():
     dx = out["distributed_exchange"]
     for field in ("world", "hist_shape", "modes", "wire_ratio_q16",
                   "wire_ratio_q8", "total_wire_ratio_q16", "parity",
-                  "wire_gate"):
+                  "wire_gate", "crc", "crc_overhead_frac", "crc_gate"):
         assert field in dx, f"distributed_exchange block missing {field}"
     assert dx["world"] == 2
     assert dx["parity"] == "pass" and dx["wire_gate"] == "pass"
+    # frame-integrity budget (ISSUE 20): the tiered payload digest
+    # must cost < 2% of the q16 wire-path wall
+    assert dx["crc_gate"] == "pass"
+    assert 0.0 <= dx["crc_overhead_frac"] < 0.02
+    assert dx["crc"]["q16_wire_bytes"] > 0
     assert dx["wire_ratio_q16"] >= 2.0, \
         "q16 must halve the f32 wire payload over real TCP"
     assert dx["wire_ratio_q8"] >= 4.0
@@ -183,7 +188,7 @@ def test_bench_smoke_json_contract():
         # the acceptance floor: >= 12 seeded plans across all three
         # workloads, every one green, every plan carrying its seed +
         # expanded spec for replay
-        assert ch["plans_run"] >= 12, \
+        assert ch["plans_run"] >= 20, \
             f"chaos sweep ran only {ch['plans_run']} plans"
         # in-process workloads (serve/continuous) count into the
         # probe's own faults_injected; train faults fire in
@@ -191,14 +196,17 @@ def test_bench_smoke_json_contract():
         # live seam — vacuous plans
         assert ch["faults_injected"] >= 4
         workloads = {p["workload"] for p in ch["plans"]}
-        assert workloads == {"train", "serve", "continuous"}
+        assert workloads == {"train", "serve", "continuous",
+                             "transport"}
     for p in ch["plans"]:
         assert p["green"] and not p["violations"], p
         assert isinstance(p["seed"], int) and p["plan"], \
             "a chaos plan must be replayable from its seed"
     assert set(ch["invariants"]) >= {
         "resume_byte_identical", "no_partial_artifacts",
-        "ledger_converges", "serving_parity", "loud_failure"}
+        "ledger_converges", "serving_parity", "loud_failure",
+        "transport_no_silent_misdata", "partition_heals",
+        "coordinator_failover"}
     # distributed-observability probe (round 13): the Prometheus
     # textfile was written and scrape-parsed (bucket monotonicity is
     # asserted inside bench_smoke.sh), and the flight-recorder smoke

@@ -576,6 +576,140 @@ def test_checkpoint_torn_write_fuzz(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# binary-cache (v2/v3/v4) + shard-manifest torn-write fuzz (satellite)
+# ---------------------------------------------------------------------------
+def _cache_fuzz_cases(pristine: bytes, header_end: int,
+                      footer_len: int):
+    """Truncations and single-bit flips at every section boundary of
+    a v2-family cache file: token, magic, header length, header blob,
+    raw bin section, trailing footer."""
+    from lightgbm_tpu import dataset_io
+    L = len(pristine)
+    tok = len(dataset_io.BINARY_TOKEN)
+    bins_mid = (header_end + (L - footer_len)) // 2
+    cases = []
+    for cut in (0, tok // 2, tok + 3, tok + 8 + 4, header_end - 9,
+                bins_mid, L - footer_len // 2):
+        cases.append((f"truncate@{cut}", pristine[:cut]))
+    for flip in (tok + 1, tok + 8 + 2, header_end - 17,
+                 header_end + 1, bins_mid, L - 4):
+        b = bytearray(pristine)
+        b[flip % L] ^= 0x40
+        cases.append((f"bitflip@{flip % L}", bytes(b)))
+    # amputating EXACTLY the footer masquerades as a legacy pre-footer
+    # file: the bins are intact there, so the only acceptable outcome
+    # is a bit-identical (warned) load — covered by the caller's
+    # "any successful load must match pristine" invariant
+    cases.append((f"truncate@{L - footer_len}",
+                  pristine[:L - footer_len]))
+    return cases
+
+
+@pytest.mark.parametrize("packing,version", [
+    ("8bit", 2), ("4bit", 3), ("2bit", 4)])
+def test_binary_cache_torn_write_fuzz(tmp_path, packing, version):
+    """ISSUE 20 satellite: every v2-family cache version under torn
+    writes.  Any truncation or bit flip at a section boundary must be
+    rejected loudly, OR (when the mutation happens to leave the data
+    bytes intact, e.g. amputating exactly the footer) load
+    bit-identical — a silently-wrong load is the one outcome the
+    trailing section digests exist to kill."""
+    import pickle
+    import struct
+
+    from lightgbm_tpu import dataset_io
+    rng = np.random.RandomState(7)
+    X = rng.randn(300, 6)
+    X[:, 2] = rng.randint(0, 2, 300)
+    y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1,
+              "bin_packing": packing}
+    if packing == "4bit":
+        params["max_bin"] = 15
+    elif packing == "2bit":
+        params["max_bin"] = 3
+    cfg = Config.from_params(params)
+    ds = lgb.Dataset(X, label=y).construct(cfg)
+    path = str(tmp_path / "cache.bin")
+    dataset_io.save_binary(ds, path)
+    pristine = open(path, "rb").read()
+    tok = len(dataset_io.BINARY_TOKEN) + len(dataset_io.MAGIC_V2)
+    (blob_len,) = struct.unpack("<Q", pristine[tok:tok + 8])
+    header_end = tok + 8 + blob_len
+    hdr = pickle.loads(pristine[tok + 8:header_end])
+    assert hdr["version"] == version, \
+        "fuzz is not covering the cache version it claims to cover"
+    assert pristine.endswith(
+        dataset_io._FOOTER.pack(
+            dataset_io._section_crc(pristine[tok + 8:header_end]),
+            dataset_io._section_crc(
+                pristine[header_end:len(pristine)
+                         - dataset_io._FOOTER_LEN]))[-8:])
+    ref_bins = np.asarray(ds.group_bins).copy()
+    ref_label = np.asarray(ds.metadata.label).copy()
+    for name, blob in _cache_fuzz_cases(pristine, header_end,
+                                        dataset_io._FOOTER_LEN):
+        with open(path, "wb") as f:
+            f.write(blob)
+        try:
+            got = dataset_io.load_binary(path)
+        except Exception:
+            continue                     # loud rejection = correct
+        np.testing.assert_array_equal(
+            np.asarray(got.group_bins), ref_bins,
+            err_msg=f"{name}: survived load differs from pristine")
+        np.testing.assert_array_equal(
+            np.asarray(got.metadata.label), ref_label,
+            err_msg=f"{name}: survived load differs from pristine")
+    with open(path, "wb") as f:
+        f.write(pristine)                # pristine again
+    dataset_io.load_binary(path)
+
+
+def test_shard_manifest_torn_write_fuzz(tmp_path):
+    """ISSUE 20 satellite: manifest.json under truncation + bit
+    flips.  The self-digest (canonical-JSON crc32) must catch
+    corruption that still parses as valid JSON; anything that loads
+    anyway must be bit-identical to pristine (flips in the
+    pretty-printing whitespace change no field)."""
+    from lightgbm_tpu.sharded import (ShardedDataset, load_shard_cache,
+                                      save_shard_cache)
+    rng = np.random.RandomState(3)
+    X = rng.randn(240, 5)
+    y = (X[:, 0] > 0).astype(float)
+    cfg = Config.from_params({"objective": "binary", "verbose": -1})
+    sds = ShardedDataset.construct_sharded(X, label=y, config=cfg,
+                                           num_shards=2)
+    d = str(tmp_path / "cache")
+    save_shard_cache(sds, d)
+    mpath = os.path.join(d, "manifest.json")
+    pristine = open(mpath, "rb").read()
+    ref = load_shard_cache(d, expect_world_size=2)
+    ref_bins = [np.asarray(b).copy() for b in ref.shard_bins]
+    L = len(pristine)
+    cases = [(f"truncate@{c}", pristine[:c])
+             for c in (0, 7, L // 3, L - 2)]
+    for flip in range(5, L - 1, max(1, L // 9)):
+        b = bytearray(pristine)
+        b[flip] ^= 0x20
+        cases.append((f"bitflip@{flip}", bytes(b)))
+    for name, blob in cases:
+        with open(mpath, "wb") as f:
+            f.write(blob)
+        try:
+            got = load_shard_cache(d, expect_world_size=2)
+        except Exception:
+            continue                     # loud rejection = correct
+        for gb, rb in zip(got.shard_bins, ref_bins):
+            np.testing.assert_array_equal(
+                np.asarray(gb), rb,
+                err_msg=f"{name}: survived load differs from pristine")
+    with open(mpath, "wb") as f:
+        f.write(pristine)                # pristine again
+    load_shard_cache(d, expect_world_size=2)
+
+
+# ---------------------------------------------------------------------------
 # graceful SIGTERM drain (satellite; a REAL signal, a real subprocess)
 # ---------------------------------------------------------------------------
 def test_serve_sigterm_drains_and_exits_zero(tmp_path):

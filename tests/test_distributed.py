@@ -253,6 +253,99 @@ print(f"RANK {tp.rank} model "
 """
 
 
+_FAILOVER_WORKER = r"""
+import os, sys, hashlib
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+iters = int(sys.argv[4])
+from lightgbm_tpu.telemetry import TELEMETRY
+TELEMETRY.configure("counters")
+from lightgbm_tpu.config import Config
+cfg = Config.from_params({
+    "objective": "binary", "verbose": -1, "num_leaves": 15,
+    "min_data_in_leaf": 5, "collective_transport": "tcp",
+    "transport_epoch_iters": 1, "sharded_allow_degraded": True,
+    "transport_reconnect_retries": 1, "watchdog_collective_s": 20.0})
+from lightgbm_tpu.parallel import distributed as D
+from lightgbm_tpu.parallel import transport as T
+from lightgbm_tpu.reliability.faults import FAULTS
+rng = np.random.RandomState(0)
+N = 1800
+X = rng.randn(N, 6)
+y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+D.initialize(coordinator_address=coord, num_processes=nproc,
+             process_id=pid, config=cfg)
+tp = T.active()
+shard = slice(pid * (N // nproc), (pid + 1) * (N // nproc))
+ds = D.construct_sharded(X[shard], label=y[shard], config=cfg)
+ds = D.finalize_global(ds)
+from lightgbm_tpu.boosting.gbdt import GBDT
+g = GBDT(cfg, ds)
+if pid == 0:
+    # chaos: the COORDINATOR dies at its third training epoch
+    # boundary (configure restarts the per-seam counters, so
+    # construction rounds do not shift the target)
+    FAULTS.configure("transport.round:3:kill")
+while g.iter_ < iters:
+    g.train_one_iter()
+g.flush_models(final=True)
+model = "".join(t.to_string() for t in g.models)
+c = TELEMETRY.counters()
+print(f"RANK {pid} model {hashlib.sha256(model.encode()).hexdigest()}"
+      f" world {tp.world_size} coord {int(tp.is_coordinator)}"
+      f" changes {c.get('collective_tcp_coordinator_changes', 0)}",
+      flush=True)
+"""
+
+
+_PARTITION_WORKER = r"""
+import os, sys, hashlib
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from lightgbm_tpu.telemetry import TELEMETRY
+TELEMETRY.configure("counters")
+from lightgbm_tpu.config import Config
+cfg = Config.from_params({
+    "objective": "binary", "verbose": -1, "num_leaves": 15,
+    "min_data_in_leaf": 5, "collective_transport": "tcp",
+    "watchdog_collective_s": 20.0})
+from lightgbm_tpu.parallel import distributed as D
+from lightgbm_tpu.parallel import transport as T
+from lightgbm_tpu.reliability.faults import FAULTS
+D.initialize(coordinator_address=coord, num_processes=nproc,
+             process_id=pid, config=cfg)
+if pid == 0:
+    # chaos: a transient network partition severs a data-plane link
+    # mid-construction; the in-epoch reconnect must heal it with ZERO
+    # degradation (same world, same epoch, byte-identical bins/model)
+    FAULTS.configure("transport.round:2:partition:60")
+rng = np.random.RandomState(0)
+N = 2000
+X = rng.randn(N, 6)
+y = (X[:, 0] - 0.5 * X[:, 1] + 0.2 * rng.randn(N) > 0).astype(float)
+shard = slice(pid * (N // nproc), (pid + 1) * (N // nproc))
+ds = D.construct_sharded(X[shard], label=y[shard], config=cfg)
+ds = D.finalize_global(ds)
+assert ds.num_data == N, ds.num_data
+bins_h = hashlib.sha256(
+    np.ascontiguousarray(ds.group_bins).tobytes()).hexdigest()
+from lightgbm_tpu.boosting.gbdt import GBDT
+g = GBDT(cfg, ds)
+for _ in range(8):
+    g.train_one_iter()
+g.flush_models(final=True)
+tp = T.active()
+model = "".join(t.to_string() for t in g.models)
+c = TELEMETRY.counters()
+print(f"RANK {pid} model {hashlib.sha256(model.encode()).hexdigest()}"
+      f" bins {bins_h} world {tp.world_size} epoch {tp.epoch}"
+      f" reconnects {c.get('collective_tcp_reconnects', 0)}",
+      flush=True)
+"""
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("localhost", 0))
@@ -507,3 +600,84 @@ def test_three_process_elastic_rejoin_byte_identical(tmp_path):
     _, _, model_ref = _single_process_reference(X, y, params, iters)
     assert hashes["0"] == model_ref, \
         "elastic world's final model != uninterrupted single-process"
+
+
+@pytest.mark.slow
+def test_three_process_coordinator_kill_successor_byte_identical():
+    """ISSUE 20 acceptance: the COORDINATOR (rank 0) is chaos-killed
+    at a training epoch boundary; rank 1 — the lowest surviving rank,
+    named deterministically by the replicated ledger (no election) —
+    takes over the epoch protocol mid-run, rank 2 re-homes its
+    control traffic to the successor, and both survivors finish the
+    run with trees byte-identical to an uninterrupted single-process
+    run."""
+    coord = f"localhost:{_free_port()}"
+    iters = 10
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _FAILOVER_WORKER, coord, "3", str(i),
+         str(iters)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(3)]
+    try:
+        # rank 0 must die by SIGKILL (the injected fault)
+        rc0 = procs[0].wait(timeout=600)
+        assert rc0 == -9, (rc0, procs[0].communicate()[1][-800:])
+        lines = _run_procs([procs[1], procs[2]], timeout=600)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert set(lines) == {"1", "2"}, lines
+    # byte-identical finish on the degraded world of 2
+    assert lines["1"][3] == lines["2"][3]
+    assert {lines[r][5] for r in lines} == {"2"}, lines
+    # rank 1 IS the successor coordinator; rank 2 is not
+    assert lines["1"][7] == "1" and lines["2"][7] == "0", lines
+    assert int(lines["1"][9]) >= 1, \
+        "the successor never counted a coordinator_change"
+    rng = np.random.RandomState(0)
+    N = 1800
+    X = rng.randn(N, 6)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    _, _, model_ref = _single_process_reference(X, y, params, iters)
+    assert lines["1"][3] == model_ref, \
+        "post-failover model != uninterrupted single-process run"
+
+
+@pytest.mark.slow
+def test_two_process_partition_heals_byte_identical():
+    """ISSUE 20 acceptance: a transient partition (chaos
+    ``partition:60``) severs a data-plane link during distributed
+    construction; the in-epoch reconnect heals it — the run finishes
+    with the SAME world and epoch, at least one counted reconnect,
+    and bins + trees byte-identical to a single-process run (zero
+    degradation, zero misdata)."""
+    coord = f"localhost:{_free_port()}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PARTITION_WORKER, coord, "2", str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(2)]
+    lines = _run_procs(procs, timeout=600)
+    assert set(lines) == {"0", "1"}, lines
+    assert lines["0"][3] == lines["1"][3]          # same model
+    assert lines["0"][5] == lines["1"][5]          # same global bins
+    # zero degradation: full world, epoch never advanced
+    assert {lines[r][7] for r in lines} == {"2"}, lines
+    assert {lines[r][9] for r in lines} == {"0"}, lines
+    # the partitioned side actually reconnected
+    assert any(int(lines[r][11]) >= 1 for r in lines), lines
+    rng = np.random.RandomState(0)
+    N = 2000
+    X = rng.randn(N, 6)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.2 * rng.randn(N) > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    _, bins_ref, model_ref = _single_process_reference(X, y, params, 8)
+    assert lines["0"][5] == bins_ref, \
+        "partition-healed global bin matrix != single-process matrix"
+    assert lines["0"][3] == model_ref, \
+        "partition-healed trees != single-process trees"

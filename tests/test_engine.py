@@ -385,17 +385,13 @@ def test_xentropy_objectives():
         assert vals[-1] < vals[0], obj
 
 
-def test_regression_objectives_train():
+def _objectives_train_decreasing(cases):
     rng = np.random.RandomState(0)
     X = rng.randn(600, 5)
     y_pos = np.exp(X[:, 0] * 0.5 + 0.1 * rng.randn(600))
-    cases = {
-        "regression_l1": None, "huber": None, "fair": None,
-        "quantile": None, "mape": None,
-        "poisson": y_pos, "gamma": y_pos, "tweedie": y_pos,
-    }
-    for obj, labels in cases.items():
-        yy = labels if labels is not None else X[:, 0] * 2 + 0.2 * rng.randn(600)
+    for obj in cases:
+        yy = y_pos if obj in ("poisson", "gamma", "tweedie") \
+            else X[:, 0] * 2 + 0.2 * rng.randn(600)
         # the assertion is only "the metric decreases" — 8 iterations
         # at 15 leaves keep the 8-objective sweep cheap on 1 CPU core
         params = {"objective": obj, "verbose": -1, "metric": obj,
@@ -407,6 +403,23 @@ def test_regression_objectives_train():
         key = next(iter(er["valid_0"]))
         vals = er["valid_0"][key]
         assert vals[-1] < vals[0], (obj, vals[0], vals[-1])
+
+
+def test_regression_objectives_train():
+    """Fast tier-1 pin: one asymmetric-loss objective + one positive-
+    label objective train downhill (the full eight-objective sweep is
+    the slow-tier test below; per-objective gradient math is pinned at
+    unit level elsewhere)."""
+    _objectives_train_decreasing(["huber", "poisson"])
+
+
+# re-tiered slow (tier-1 wall budget): six further trainings sweeping
+# the remaining objectives; the train-downhill pin stays fast above
+@pytest.mark.slow
+def test_regression_objectives_train_full_sweep():
+    _objectives_train_decreasing(
+        ["regression_l1", "fair", "quantile", "mape", "gamma",
+         "tweedie"])
 
 
 def test_prediction_early_stop():

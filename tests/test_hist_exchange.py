@@ -142,7 +142,10 @@ def _trees(X, y, shards=0, mode=None):
     return "".join(t.to_string() for t in g.models)
 
 
-@pytest.mark.parametrize("shards", [2, 4])
+# the 4-shard arm re-tiered slow (tier-1 wall budget): codec byte-
+# identity is shard-count-independent; 2 shards keeps the pin fast
+@pytest.mark.parametrize("shards", [
+    2, pytest.param(4, marks=pytest.mark.slow)])
 def test_tree_byte_identity_across_codecs(shards):
     X, y = _l1_data()
     serial = _trees(X, y)

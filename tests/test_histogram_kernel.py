@@ -2,6 +2,7 @@
 reference's GPU_DEBUG_COMPARE CPU-vs-GPU histogram comparator,
 gpu_tree_learner.cpp:1020-1044)."""
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from lightgbm_tpu.ops.histogram import (compute_group_histograms,
@@ -364,8 +365,23 @@ def test_leaf_partition_grows_identical_trees():
                    lgb.Dataset(X, label=y), 8, verbose_eval=False)
     assert m0.model_to_string() == m1.model_to_string()
 
-    # no-cache mode histograms BOTH children through the partition —
-    # the parents pass shares the round's permutation
+
+# re-tiered slow (tier-1 wall budget): the no-cache arm doubles the
+# training cost of the A/B pin above; the partition route itself stays
+# pinned fast
+@pytest.mark.slow
+def test_leaf_partition_no_cache_identical_trees():
+    """No-cache mode histograms BOTH children through the partition —
+    the parents pass shares the round's permutation."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(1536, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(1536)
+         > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+            "quantized_grad": True, "hist_compute_dtype": "bfloat16",
+            "force_pallas_interpret": True, "min_data_in_leaf": 5}
     nc0 = lgb.train(dict(base, histogram_pool_size=0.001),
                     lgb.Dataset(X, label=y), 8, verbose_eval=False)
     nc1 = lgb.train(dict(base, histogram_pool_size=0.001,
@@ -393,9 +409,24 @@ def test_split_route_grows_identical_trees():
                    lgb.Dataset(X, label=y), 8, verbose_eval=False)
     assert m0.model_to_string() == m1.model_to_string()
 
-    # no-cache mode (histogram_pool_size=0 drops subtraction and
-    # histograms BOTH children directly) exercises the split-route
-    # left-histogram branch too
+
+# re-tiered slow (tier-1 wall budget): the no-cache arm doubles the
+# training cost of the A/B pin above; the split route itself stays
+# pinned fast
+@pytest.mark.slow
+def test_split_route_no_cache_identical_trees():
+    """No-cache mode (histogram_pool_size=0 drops subtraction and
+    histograms BOTH children directly) exercises the split-route
+    left-histogram branch too."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(1536, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(1536)
+         > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+            "quantized_grad": True, "hist_compute_dtype": "bfloat16",
+            "force_pallas_interpret": True, "min_data_in_leaf": 5}
     nc0 = lgb.train(dict(base, histogram_pool_size=0.001),
                     lgb.Dataset(X, label=y), 8, verbose_eval=False)
     nc1 = lgb.train(dict(base, histogram_pool_size=0.001,

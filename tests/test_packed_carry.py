@@ -44,6 +44,19 @@ def ref_model():
     return X, y, _model(X, y, dispatch_chunk=1)
 
 
+def test_packed_vs_legacy_carry_single_point(ref_model):
+    """The fast tier-1 pin: packed vs the legacy 18-array carry at the
+    default chunking grows byte-identical models (the full six-way
+    (carry, chunk) sweep is the slow-tier test below)."""
+    X, y, ref = ref_model
+    assert _model(X, y, dispatch_chunk=10,
+                  packed_tree_carry="off") == ref
+
+
+# re-tiered slow (tier-1 wall budget): five extra trainings sweeping
+# redundant (carry, chunk) combinations; the unique packed-vs-legacy
+# pin stays fast in test_packed_vs_legacy_carry_single_point
+@pytest.mark.slow
 def test_packed_vs_legacy_carry_across_chunk_sizes(ref_model):
     """All six (carry, chunk) combinations grow byte-identical models:
     packed vs the legacy 18-array carry, across dispatch_chunk 1 / 10 /

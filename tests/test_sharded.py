@@ -304,6 +304,10 @@ def test_shard_cache_rejects_stale_fingerprint(cached):
     mpath = os.path.join(d, "manifest.json")
     man = json.load(open(mpath))
     man["mapper_fingerprint"] = "0" * 64
+    # a STALE-but-well-formed manifest: re-stamp the self-digest so
+    # the fingerprint check (not the torn-write digest) must fire
+    from lightgbm_tpu.sharded.cache import _manifest_crc
+    man["manifest_crc"] = _manifest_crc(man)
     with open(mpath, "w") as f:
         json.dump(man, f)
     with pytest.raises(ShardCacheError, match="fingerprint"):

@@ -37,6 +37,9 @@ def test_classifier_binary():
     assert (pred == y_te).mean() > 0.9
 
 
+# re-tiered slow (tier-1 wall budget): multiclass semantics pinned fast by test_engine.py::test_multiclass;
+# the wrapper surface by test_classifier_binary
+@pytest.mark.slow
 def test_classifier_multiclass():
     X, y = load_digits(n_class=4, return_X_y=True)
     X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=0)
@@ -72,6 +75,9 @@ def test_ranker():
     assert scores[rel == 3].mean() > scores[rel == 0].mean()
 
 
+# re-tiered slow (tier-1 wall budget): custom-objective semantics pinned fast by
+# test_engine.py::test_custom_objective_fobj
+@pytest.mark.slow
 def test_custom_objective():
     X, y = load_breast_cancer(return_X_y=True)
 
@@ -87,6 +93,8 @@ def test_custom_objective():
     assert log_loss(y, p) < 0.25
 
 
+# re-tiered slow (tier-1 wall budget): dart semantics pinned fast by test_engine.py::test_dart
+@pytest.mark.slow
 def test_dart_sklearn():
     X, y = load_breast_cancer(return_X_y=True)
     m = lgb.LGBMClassifier(boosting_type="dart", n_estimators=20,
@@ -108,6 +116,9 @@ def test_clone_and_pickle():
                        m.booster_.predict(X[:5]))
 
 
+# re-tiered slow (tier-1 wall budget): sklearn-integration surface pinned fast by test_clone_and_pickle
+# + test_sklearn_check_estimator_basics
+@pytest.mark.slow
 def test_grid_search_compatible():
     from sklearn.model_selection import GridSearchCV
     X, y = load_breast_cancer(return_X_y=True)
@@ -117,6 +128,9 @@ def test_grid_search_compatible():
     assert gs.best_score_ > 0.85
 
 
+# re-tiered slow (tier-1 wall budget): early-stopping semantics pinned fast by
+# test_engine.py::test_early_stopping
+@pytest.mark.slow
 def test_early_stopping_sklearn():
     X, y = load_breast_cancer(return_X_y=True)
     X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=0)

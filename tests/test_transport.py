@@ -415,6 +415,308 @@ def test_dead_peer_without_allow_degraded_is_loud():
 
 
 # ---------------------------------------------------------------------------
+# frame integrity + versioning (wire v2)
+# ---------------------------------------------------------------------------
+def test_frame_crc_rejects_corrupt_payload_loudly():
+    """A bit-flipped payload under a truthful header CRC raises
+    FrameCorrupt naming tag + peer, bumps the counter, and journals —
+    never silently returns wrong bytes."""
+    TELEMETRY.configure("counters")
+    a, b = socket.socketpair()
+    try:
+        payload = b"histogram-bytes" * 10
+        crc = T._payload_crc(payload)
+        bad = bytearray(payload)
+        bad[3] ^= 0x40
+        a.sendall(T._HDR.pack(T._MAGIC, T.PROTOCOL_VERSION,
+                              T.TAG_DATA, 7, len(bad), crc)
+                  + bytes(bad))
+        with pytest.raises(T.FrameCorrupt) as ei:
+            T._recv_frame(b, T.TAG_DATA, peer=5)
+        assert ei.value.tag == T.TAG_DATA and ei.value.peer == 5
+        assert "peer 5" in str(ei.value)
+    finally:
+        a.close()
+        b.close()
+    assert TELEMETRY.counters().get("collective_tcp_crc_errors", 0) \
+        == 1
+    assert any(e["kind"] == "crc_error"
+               for e in TELEMETRY.journal.events())
+
+
+def test_payload_digest_tiers_catch_bit_flips():
+    """Both digest tiers (plain crc32 under the fold threshold, the
+    crc32'd XOR word-fold above it) change under a single flipped bit
+    at the start, middle and end of the payload."""
+    for payload in (b"\x5a" * 100, b"\x5a" * 100_000):
+        ref = T._payload_crc(payload)
+        for pos in (0, len(payload) // 2, len(payload) - 1):
+            bad = bytearray(payload)
+            bad[pos] ^= 0x10
+            assert T._payload_crc(bytes(bad)) != ref, \
+                f"flip at {pos}/{len(payload)} escaped the digest"
+
+
+def test_version_skew_refused_with_actionable_message():
+    """A frame from a peer speaking another protocol version is
+    refused BEFORE its length field is trusted, and the handshake
+    layer refuses a skewed HELLO/IDENT — both messages name the fix
+    (finish the rolling restart)."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(T._HDR.pack(T._MAGIC, T.PROTOCOL_VERSION - 1,
+                              T.TAG_DATA, 0, 4, 0) + b"xxxx")
+        with pytest.raises(T.TransportError, match="upgrade skew"):
+            T._recv_frame(b, peer=3)
+    finally:
+        a.close()
+        b.close()
+    with pytest.raises(T.TransportError, match="rolling restart"):
+        T._refuse_skew({"ver": T.PROTOCOL_VERSION - 1},
+                       "rendezvous HELLO from rank 1")
+
+
+def test_corrupt_frame_retries_clean_bit_exact():
+    """Chaos ``corrupt``: the receiver's CRC catches the flipped
+    frame, the link reconnects within the epoch, the round re-sends
+    the TRUE bytes, and every collective lands bit-exact."""
+    TELEMETRY.configure("counters")
+    FAULTS.configure("transport.round:3:corrupt")
+    watchdog.set_deadline("collective", 8.0)
+
+    def _body(tp, r):
+        return [tp.allreduce_sum(
+            np.arange(8, dtype=np.int64) * (k + 1) + r)
+            for k in range(4)]
+
+    outs = _run_world(2, _body)
+    for r in range(2):
+        for k in range(4):
+            np.testing.assert_array_equal(
+                outs[r][k],
+                np.arange(8, dtype=np.int64) * (k + 1) * 2 + 1)
+    c = TELEMETRY.counters()
+    assert c.get("collective_tcp_crc_errors", 0) >= 1
+    assert c.get("collective_tcp_reconnects", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# transient-blip reconnection (in-epoch) + coordinator failover
+# ---------------------------------------------------------------------------
+def test_partition_heals_within_epoch_idempotent():
+    """Chaos ``partition:<ms>``: the severed link heals by an
+    in-epoch reconnect (IDENT epoch+rank handshake, ack-based
+    resend), the seq dup-discard keeps the retried round idempotent,
+    and NOTHING degrades: same epoch, same world, bit-exact sums."""
+    TELEMETRY.configure("counters")
+    FAULTS.configure("transport.round:3:partition:60")
+    watchdog.set_deadline("collective", 8.0)
+    state = {}
+
+    def _body(tp, r):
+        outs = [tp.allreduce_sum(
+            np.arange(8, dtype=np.int64) * (k + 1) + r)
+            for k in range(5)]
+        state[r] = (tp.epoch, tp.world_size)
+        return outs
+
+    outs = _run_world(2, _body)
+    for r in range(2):
+        for k in range(5):
+            np.testing.assert_array_equal(
+                outs[r][k],
+                np.arange(8, dtype=np.int64) * (k + 1) * 2 + 1)
+        assert state[r] == (0, 2), \
+            "a transient partition must not degrade the world"
+    c = TELEMETRY.counters()
+    assert c.get("collective_tcp_reconnects", 0) >= 1
+    assert any(e["kind"] == "reconnect"
+               for e in TELEMETRY.journal.events())
+
+
+def test_dup_frame_discarded_by_sequence():
+    """Chaos ``dup``: a replayed frame (original seq) is discarded by
+    the receiver's sequence check — counted, harmless, bit-exact."""
+    TELEMETRY.configure("counters")
+    FAULTS.configure("transport.round:3:dup")
+
+    def _body(tp, r):
+        return [tp.allreduce_sum(
+            np.arange(8, dtype=np.int64) * (k + 1) + r)
+            for k in range(4)]
+
+    outs = _run_world(2, _body)
+    for r in range(2):
+        for k in range(4):
+            np.testing.assert_array_equal(
+                outs[r][k],
+                np.arange(8, dtype=np.int64) * (k + 1) * 2 + 1)
+    assert TELEMETRY.counters().get(
+        "collective_tcp_dup_frames", 0) >= 1
+
+
+def test_coordinator_death_promotes_lowest_surviving_rank():
+    """Coordinator failover end to end: rank 0 dies, rank 1 (the
+    lowest survivor — named by the replicated ledger, no election)
+    takes over the epoch protocol mid-run and journals the change,
+    rank 2 re-homes its control traffic, and the reformed world
+    completes a bit-exact collective."""
+    TELEMETRY.configure("counters")
+    coord = _free_coord()
+    world = 3
+    outcome = {}
+    errors = []
+    lock = threading.Lock()
+
+    def _member(rank):
+        try:
+            tp = T.TcpTransport.create(coord, world, rank)
+            tp.barrier()
+            if rank == 0:
+                tp.close()             # the coordinator dies
+                return
+            info = tp.epoch_tick(handoff=lambda: b"",
+                                 allow_degraded=True)
+            got = tp.allreduce_sum(
+                np.arange(6, dtype=np.int64) + tp.rank)
+            with lock:
+                outcome[rank] = (info, tp.is_coordinator, got,
+                                 tp.world_size)
+            tp.close()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            with lock:
+                errors.append((rank, e))
+
+    threads = [threading.Thread(target=_member, args=(r,),
+                                daemon=True) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(40.0)
+    assert not any(t.is_alive() for t in threads), \
+        f"failover hung (outcome so far: {sorted(outcome)})"
+    assert not errors, errors
+    info1, is_coord1, got1, ws1 = outcome[1]
+    info2, is_coord2, got2, ws2 = outcome[2]
+    assert info1["changed"] and 0 in info1["dead"]
+    assert ws1 == ws2 == 2 and info1["epoch"] == 1
+    assert is_coord1 and not is_coord2, \
+        "the LOWEST surviving rank must be the successor"
+    expect = np.arange(6, dtype=np.int64) * 2 + 3
+    np.testing.assert_array_equal(got1, expect)
+    np.testing.assert_array_equal(got2, expect)
+    c = TELEMETRY.counters()
+    assert c.get("collective_tcp_coordinator_changes", 0) >= 1
+    assert c.get("collective_tcp_rehomes", 0) >= 1
+    assert any(e["kind"] == "coordinator_change"
+               for e in TELEMETRY.journal.events())
+
+
+def test_stale_coordinator_joiner_walks_ledger():
+    """A joiner handed a DEAD coordinator address plus a replicated
+    ledger walks the member list: the first live member it reaches is
+    the coordinator (lowest-live-rank invariant), and admission
+    proceeds normally from there."""
+    coord = _free_coord()
+    stale = _free_coord()                 # nothing ever listens here
+    world = 2
+    ledger_state = {}
+    ready = threading.Event()
+    outcome = {}
+    errors = []
+    lock = threading.Lock()
+
+    def _member(rank):
+        try:
+            tp = T.TcpTransport.create(coord, world, rank)
+            with lock:
+                if not ledger_state:
+                    ledger_state.update(tp.ledger.to_state())
+            tp.barrier()
+            ready.set()
+            time.sleep(0.5)            # let the walked JOIN land
+            info = tp.epoch_tick(handoff=lambda: b"WALKED",
+                                 allow_degraded=True)
+            got = tp.allgather_obj(("rank", tp.rank))
+            with lock:
+                outcome[rank] = (info, got)
+            tp.close()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            with lock:
+                errors.append((rank, e))
+
+    def _joiner():
+        try:
+            assert ready.wait(30.0)
+            tp = T.TcpTransport.join(stale, ledger=ledger_state)
+            got = tp.allgather_obj(("rank", tp.rank))
+            with lock:
+                outcome["join"] = (tp.rank, tp.handoff["state"], got)
+            tp.close()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            with lock:
+                errors.append(("joiner", e))
+
+    threads = [threading.Thread(target=_member, args=(r,),
+                                daemon=True) for r in range(world)]
+    threads.append(threading.Thread(target=_joiner, daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(40.0)
+    assert not any(t.is_alive() for t in threads), \
+        f"joiner walk hung (outcome so far: {sorted(map(str, outcome))})"
+    assert not errors, errors
+    join_rank, join_state, join_got = outcome["join"]
+    assert join_rank == 2 and join_state == b"WALKED"
+    expect = [("rank", 0), ("rank", 1), ("rank", 2)]
+    assert join_got == expect
+    assert outcome[0][1] == expect and outcome[1][1] == expect
+    assert outcome[0][0]["admitted"] == [2]
+
+
+def test_failover_seam_injected_fault_is_peer_lost():
+    """An injected fault at the ``transport.failover`` seam (chaos
+    hitting the walk itself) converts to TransportPeerLost — the
+    degrade/abort path, never a hang or a silent retry loop."""
+    FAULTS.configure("transport.failover:1:ConnectionError")
+    coord = _free_coord()
+    results = {}
+    errors = []
+    lock = threading.Lock()
+
+    def _member(rank):
+        try:
+            tp = T.TcpTransport.create(coord, 2, rank)
+            tp.barrier()
+            if rank == 0:
+                tp.close()
+                return
+            try:
+                tp.epoch_tick(allow_degraded=True)
+                with lock:
+                    results[rank] = "ticked"
+            except T.TransportPeerLost as e:
+                with lock:
+                    results[rank] = e
+            tp.close()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            with lock:
+                errors.append((rank, e))
+
+    threads = [threading.Thread(target=_member, args=(r,),
+                                daemon=True) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not errors, errors
+    assert isinstance(results[1], T.TransportPeerLost)
+    assert any(f["seam"] == "transport.failover"
+               for f in FAULTS.fired)
+
+
+# ---------------------------------------------------------------------------
 # world view + mode resolution + config
 # ---------------------------------------------------------------------------
 class _StubTransport:
